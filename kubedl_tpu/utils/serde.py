@@ -1,0 +1,97 @@
+"""Generic dataclass <-> plain-dict serialization with camelCase keys.
+
+Gives our API types the same YAML/JSON surface as the reference's CRDs
+(e.g. ref api/tensorflow/v1/types.go marshals `tfReplicaSpecs`,
+`cleanPodPolicy`, ...) without hand-writing a marshaller per type.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import sys
+import typing
+from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINT_CACHE: dict = {}
+
+
+def camel(snake: str) -> str:
+    parts = snake.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _hints(cls) -> dict:
+    if cls not in _HINT_CACHE:
+        _HINT_CACHE[cls] = get_type_hints(cls)
+    return _HINT_CACHE[cls]
+
+
+def to_dict(obj: Any, *, drop_empty: bool = True) -> Any:
+    """Serialize a dataclass tree into plain dicts with camelCase keys."""
+    if obj is None:
+        return None
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            if not f.metadata.get("serialize", True):
+                continue
+            v = to_dict(getattr(obj, f.name), drop_empty=drop_empty)
+            if drop_empty and (v is None or v == "" or v == [] or v == {}):
+                continue
+            out[f.metadata.get("name") or camel(f.name)] = v
+        return out
+    if isinstance(obj, dict):
+        return {str(k.value if isinstance(k, enum.Enum) else k): to_dict(v, drop_empty=drop_empty)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v, drop_empty=drop_empty) for v in obj]
+    return obj
+
+
+def _strip_optional(tp):
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Type[T], data: Any) -> T:
+    """Deserialize plain dicts (camelCase or snake_case keys) into dataclass `cls`."""
+    return _from(cls, data)
+
+
+def _from(tp, data):
+    if data is None:
+        return None
+    tp = _strip_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return [_from(elem, v) for v in data]
+    if origin is dict:
+        args = get_args(tp)
+        kt, vt = (args if args else (str, Any))
+        return {_from(kt, k): _from(vt, v) for k, v in data.items()}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        hints = _hints(tp)
+        by_key = {}
+        for f in dataclasses.fields(tp):
+            by_key[f.metadata.get("name") or camel(f.name)] = f
+            by_key[f.name] = f
+        kwargs = {}
+        for k, v in data.items():
+            f = by_key.get(k)
+            if f is None:
+                continue  # tolerate unknown fields, like k8s does
+            kwargs[f.name] = _from(hints[f.name], v)
+        return tp(**kwargs)
+    if tp in (int, float, str, bool):
+        return tp(data) if data is not None else None
+    return data

@@ -1,0 +1,73 @@
+"""Condition-machine invariants (behavioral parity with ref pkg/util/status.go)."""
+from kubedl_tpu.api.common import (
+    ConditionStatus,
+    JobConditionType,
+    JobStatus,
+    REASON_JOB_CREATED,
+    REASON_JOB_FAILED,
+    REASON_JOB_RESTARTING,
+    REASON_JOB_RUNNING,
+    REASON_JOB_SUCCEEDED,
+    get_condition,
+    is_failed,
+    is_restarting,
+    is_running,
+    is_succeeded,
+    update_job_conditions,
+)
+
+
+def test_created_then_running():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.CREATED, REASON_JOB_CREATED, "created")
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "running")
+    assert is_running(s)
+    assert [c.type for c in s.conditions] == [JobConditionType.CREATED, JobConditionType.RUNNING]
+
+
+def test_running_restarting_mutually_exclusive():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "")
+    update_job_conditions(s, JobConditionType.RESTARTING, REASON_JOB_RESTARTING, "")
+    assert is_restarting(s) and not is_running(s)
+    assert get_condition(s, JobConditionType.RUNNING) is None
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "")
+    assert is_running(s) and not is_restarting(s)
+    assert get_condition(s, JobConditionType.RESTARTING) is None
+
+
+def test_failed_is_sticky():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.FAILED, REASON_JOB_FAILED, "boom")
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "")
+    assert is_failed(s) and not is_running(s)
+    update_job_conditions(s, JobConditionType.SUCCEEDED, REASON_JOB_SUCCEEDED, "")
+    assert not is_succeeded(s)
+
+
+def test_terminal_demotes_running_to_false():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "")
+    update_job_conditions(s, JobConditionType.SUCCEEDED, REASON_JOB_SUCCEEDED, "done")
+    run = get_condition(s, JobConditionType.RUNNING)
+    assert run is not None and run.status == ConditionStatus.FALSE
+    assert is_succeeded(s) and not is_running(s)
+
+
+def test_noop_when_status_and_reason_unchanged():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "msg1")
+    t1 = get_condition(s, JobConditionType.RUNNING).last_update_time
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "msg2")
+    assert get_condition(s, JobConditionType.RUNNING).last_update_time == t1
+    assert get_condition(s, JobConditionType.RUNNING).message == "msg1"
+
+
+def test_transition_time_preserved_on_reason_change():
+    s = JobStatus()
+    update_job_conditions(s, JobConditionType.RUNNING, REASON_JOB_RUNNING, "")
+    t1 = get_condition(s, JobConditionType.RUNNING).last_transition_time
+    update_job_conditions(s, JobConditionType.RUNNING, "OtherReason", "")
+    c = get_condition(s, JobConditionType.RUNNING)
+    assert c.reason == "OtherReason"
+    assert c.last_transition_time == t1

@@ -1,0 +1,22 @@
+import pytest
+
+from kubedl_tpu.utils.exit_codes import (
+    EXIT_TPU_PREEMPTED,
+    EXIT_XLA_COMPILE_ERROR,
+    is_retryable_exit_code,
+)
+
+
+@pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139, EXIT_XLA_COMPILE_ERROR])
+def test_permanent(code):
+    assert not is_retryable_exit_code(code)
+
+
+@pytest.mark.parametrize("code", [130, 137, 143, 138, EXIT_TPU_PREEMPTED])
+def test_retryable(code):
+    assert is_retryable_exit_code(code)
+
+
+@pytest.mark.parametrize("code", [3, 42, 200, 255])
+def test_unknown_treated_permanent(code):
+    assert not is_retryable_exit_code(code)

@@ -1,0 +1,57 @@
+from kubedl_tpu.api.common import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    SuccessPolicy,
+)
+from kubedl_tpu.api.pod import Container, ContainerPort, PodSpec, PodTemplateSpec
+from kubedl_tpu.utils.serde import from_dict, to_dict
+
+
+def test_roundtrip_replica_spec():
+    rs = ReplicaSpec(
+        replicas=3,
+        restart_policy=RestartPolicy.EXIT_CODE,
+        template=PodTemplateSpec(
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        name="tensorflow",
+                        image="img:v1",
+                        ports=[ContainerPort(name="tfjob-port", container_port=2222)],
+                    )
+                ]
+            )
+        ),
+    )
+    d = to_dict(rs)
+    assert d["replicas"] == 3
+    assert d["restartPolicy"] == "ExitCode"
+    assert d["template"]["spec"]["containers"][0]["ports"][0]["containerPort"] == 2222
+    back = from_dict(ReplicaSpec, d)
+    assert back == rs
+
+
+def test_camel_and_snake_accepted():
+    d = {"cleanPodPolicy": "Running", "backoff_limit": 5,
+         "schedulingPolicy": {"minAvailable": 4, "tpuSlice": "v5e-8"}}
+    rp = from_dict(RunPolicy, d)
+    assert rp.clean_pod_policy == CleanPodPolicy.RUNNING
+    assert rp.backoff_limit == 5
+    assert rp.scheduling_policy == SchedulingPolicy(min_available=4, tpu_slice="v5e-8")
+
+
+def test_unknown_fields_tolerated():
+    rp = from_dict(RunPolicy, {"cleanPodPolicy": "All", "bogusField": 1})
+    assert rp.clean_pod_policy == CleanPodPolicy.ALL
+
+
+def test_success_policy_min_finish():
+    # Ref controllers/xdl/status.go:151-160: absolute wins; percentage ceils.
+    assert SuccessPolicy(min_finish_worker_num=3).min_finish(10) == 3
+    assert SuccessPolicy(min_finish_worker_num=30).min_finish(10) == 10
+    assert SuccessPolicy(min_finish_worker_percentage=90).min_finish(10) == 9
+    assert SuccessPolicy(min_finish_worker_percentage=90).min_finish(7) == 7  # ceil(6.3)
+    assert SuccessPolicy().min_finish(5) == 5
