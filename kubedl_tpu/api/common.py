@@ -21,7 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubedl_tpu.api.meta import now
-from kubedl_tpu.api.pod import PodTemplateSpec
+from kubedl_tpu.api.pod import PodPhase, PodTemplateSpec
 
 # ---------------------------------------------------------------------------
 # Labels / annotations (ref pkg/job_controller/api/v1/constants.go:3-33)
@@ -114,20 +114,22 @@ class ReplicaSpec:
 class SuccessPolicy:
     """XDL's min-finish success policy promoted to the common layer.
 
-    Ref api/xdl/v1alpha1/types.go:38-49 (MinFinishWorkerNum /
-    MinFinishWorkerPercentage); success rule ref controllers/xdl/status.go:151-160:
-    absolute number wins over percentage; percentage uses ceil.
+    Ref api/xdl/v1alpha1/types.go:38-49 + controllers/xdl/status.go
+    calculateMinFinish: percentage takes precedence over the absolute
+    number when both are set; percentage uses ceil. We additionally clamp
+    the absolute number to the worker count (the reference lets an
+    over-large MinFinishWorkerNum make the job unfinishable).
     """
 
     min_finish_worker_num: Optional[int] = None
     min_finish_worker_percentage: Optional[int] = None
 
     def min_finish(self, total_workers: int) -> int:
-        if self.min_finish_worker_num is not None:
-            return min(self.min_finish_worker_num, total_workers)
         if self.min_finish_worker_percentage is not None:
             pct = min(max(self.min_finish_worker_percentage, 0), 100)
             return -(-total_workers * pct // 100)  # ceil division
+        if self.min_finish_worker_num is not None:
+            return min(self.min_finish_worker_num, total_workers)
         return total_workers
 
 
@@ -272,18 +274,27 @@ def update_job_conditions(
     status.conditions = kept
 
 
+def replica_key(rtype) -> str:
+    """Canonical status-map key for a replica type.
+
+    Replica types are open strings in the reference (custom roles like XDL's
+    ExtendRole are legal), so unknown names pass through instead of raising.
+    """
+    if isinstance(rtype, ReplicaType):
+        return rtype.value
+    return str(rtype)
+
+
 def initialize_replica_statuses(status: JobStatus, replica_types) -> None:
-    """Ref pkg/job_controller/status.go:9-16."""
-    status.replica_statuses = {str(ReplicaType(rt).value): ReplicaStatus() for rt in replica_types}
+    """Reset the given types' tallies, preserving others (ref status.go:9-16)."""
+    for rt in replica_types:
+        status.replica_statuses[replica_key(rt)] = ReplicaStatus()
 
 
 def update_job_replica_statuses(status: JobStatus, rtype, pod) -> None:
     """Tally one pod's phase into the replica status (ref status.go:18-27)."""
-    key = str(ReplicaType(rtype).value)
-    rs = status.replica_statuses.setdefault(key, ReplicaStatus())
+    rs = status.replica_statuses.setdefault(replica_key(rtype), ReplicaStatus())
     phase = pod.status.phase
-    from kubedl_tpu.api.pod import PodPhase
-
     if phase == PodPhase.RUNNING:
         rs.active += 1
     elif phase == PodPhase.SUCCEEDED:

@@ -24,12 +24,6 @@ class PodPhase(str, enum.Enum):
 
 
 @dataclass
-class EnvVar:
-    name: str = ""
-    value: str = ""
-
-
-@dataclass
 class ContainerPort:
     name: str = ""
     container_port: int = 0

@@ -1,0 +1,34 @@
+"""Workload controller registry (ref controllers/controllers.go:31-47 +
+per-workload add_*.go init() registration), gated per deploy by the
+workload-gate expression."""
+from __future__ import annotations
+
+from typing import Callable, List
+
+from kubedl_tpu.utils.workload_gate import is_workload_enabled
+
+# name -> controller factory; populated below as workloads are implemented.
+_FACTORIES: dict = {}
+
+
+def register_workload(name: str, factory: Callable) -> None:
+    _FACTORIES[name] = factory
+
+
+def enabled_controllers(expr: str = "*") -> List:
+    out = []
+    for name in sorted(_FACTORIES):
+        if is_workload_enabled(name, expr):
+            out.append(_FACTORIES[name]())
+    return out
+
+
+def _populate() -> None:
+    # Imported lazily so api/controller modules stay import-cycle free.
+    try:
+        from kubedl_tpu.workloads import tensorflow, pytorch, xgboost, xdl, jaxjob  # noqa: F401
+    except ImportError:
+        pass
+
+
+_populate()

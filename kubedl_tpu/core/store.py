@@ -1,0 +1,202 @@
+"""In-process object store — the framework's etcd + API-server equivalent.
+
+The reference delegates object storage/watch to the Kubernetes API server
+(SURVEY.md §1 L0). This framework is standalone, so the store provides the
+same contract natively: namespaced typed objects, optimistic concurrency via
+resourceVersion, label-selector lists, and watch streams that drive
+controllers. Deep copies cross the boundary in both directions, so cached
+mutation bugs (a classic controller-runtime hazard) cannot leak between
+clients — the same isolation the API server's serialization gives Go clients.
+"""
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from kubedl_tpu.api.meta import new_uid, now
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    """resourceVersion mismatch — caller must re-read and retry."""
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str = ADDED
+    kind: str = ""
+    obj: Any = None
+
+
+def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class ObjectStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # kind -> "ns/name" -> object
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        self._rv = 0
+        self._watchers: List["Watch"] = []
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _emit(self, etype: str, kind: str, obj) -> None:
+        ev = WatchEvent(type=etype, kind=kind, obj=obj)
+        for w in list(self._watchers):
+            w._offer(ev)
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, obj):
+        kind = obj.kind
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            bucket = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            if not obj.metadata.uid:
+                obj.metadata.uid = new_uid()
+            obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now()
+            obj.metadata.resource_version = self._next_rv()
+            bucket[key] = obj
+            out = copy.deepcopy(obj)
+            self._emit(ADDED, kind, copy.deepcopy(obj))
+            return out
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._objects.get(kind, {}).get(f"{namespace}/{name}")
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def update(self, obj):
+        """Full-object update with optimistic concurrency."""
+        kind = obj.kind
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            key = self._key(obj)
+            cur = bucket.get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {key} not found")
+            if obj.metadata.resource_version != cur.metadata.resource_version:
+                raise Conflict(
+                    f"{kind} {key}: resourceVersion {obj.metadata.resource_version} "
+                    f"!= {cur.metadata.resource_version}"
+                )
+            obj = copy.deepcopy(obj)
+            obj.metadata.uid = cur.metadata.uid
+            obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            bucket[key] = obj
+            out = copy.deepcopy(obj)
+            self._emit(MODIFIED, kind, copy.deepcopy(obj))
+            return out
+
+    def delete(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            key = f"{namespace}/{name}"
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            obj.metadata.deletion_timestamp = now()
+            self._emit(DELETED, kind, copy.deepcopy(obj))
+            return obj
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for obj in self._objects.get(kind, {}).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if not match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    def kinds(self) -> List[str]:
+        with self._lock:
+            return [k for k, v in self._objects.items() if v]
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None) -> "Watch":
+        """Subscribe to events; optionally restricted to `kinds`.
+
+        The stream replays current objects as ADDED first (informer-style
+        initial list+watch), then live events.
+        """
+        w = Watch(self, kinds)
+        with self._lock:
+            for kind in kinds or list(self._objects.keys()):
+                for obj in self._objects.get(kind, {}).values():
+                    w._offer(WatchEvent(type=ADDED, kind=kind, obj=copy.deepcopy(obj)))
+            self._watchers.append(w)
+        return w
+
+
+class Watch:
+    def __init__(self, store: ObjectStore, kinds: Optional[List[str]]) -> None:
+        self._store = store
+        self._kinds = set(kinds) if kinds else None
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = False
+
+    def _offer(self, ev: WatchEvent) -> None:
+        if self._stopped:
+            return
+        if self._kinds is not None and ev.kind not in self._kinds:
+            return
+        self._q.put(ev)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._store._lock:
+            if self in self._store._watchers:
+                self._store._watchers.remove(self)
+        self._q.put(None)

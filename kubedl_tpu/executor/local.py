@@ -1,0 +1,311 @@
+"""Local pod executor — the framework's kubelet.
+
+The reference delegates pod execution to Kubernetes kubelets; this framework
+is standalone, so the executor watches Pod objects and runs their containers
+as real host processes: Pending -> Running (Ready condition stamped for
+launch-delay metrics, ref pkg/metrics/job_metrics.go:139-194) ->
+Succeeded/Failed with per-container exit codes, honoring pod-level restart
+policies (Always/OnFailure restart in place with restart_count accrual, the
+behavior pastBackoffLimit sums over — ref job.go:282-319).
+
+Container images are not pulled: `command`+`args` run directly on the host,
+which is exactly what CI needs (SURVEY.md §4: distribution is simulated
+process-level). emptyDir volumes map to per-pod temp dirs.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.meta import now
+from kubedl_tpu.api.pod import (
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+    PodCondition,
+    PodPhase,
+    PodRestartPolicy,
+)
+from kubedl_tpu.core.store import ADDED, DELETED, MODIFIED, Conflict, NotFound, ObjectStore
+
+log = logging.getLogger("kubedl_tpu.executor")
+
+
+@dataclass
+class _RunningPod:
+    pod: Pod
+    procs: Dict[str, subprocess.Popen] = field(default_factory=dict)
+    restart_counts: Dict[str, int] = field(default_factory=dict)
+    workdir: str = ""
+    stop: bool = False
+    thread: Optional[threading.Thread] = None
+
+
+class LocalPodExecutor:
+    """Runs pods as host processes, reflecting status back into the store."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        scheduler=None,
+        restart_backoff: float = 0.05,
+        launch_hook=None,
+    ) -> None:
+        self.store = store
+        # Optional TPU-slice scheduler (gang admission): pod stays Pending
+        # until scheduler.assign(pod) returns a placement.
+        self.scheduler = scheduler
+        self.restart_backoff = restart_backoff
+        self.launch_hook = launch_hook  # test seam: fn(pod) -> env overrides
+        self._running: Dict[str, _RunningPod] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._watch = self.store.watch(["Pod"])
+        self._thread = threading.Thread(target=self._loop, name="executor", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch:
+            self._watch.stop()
+        with self._lock:
+            entries = list(self._running.values())
+        for entry in entries:
+            self._kill(entry)
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.1)
+            if ev is None:
+                continue
+            key = f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"
+            if ev.type == ADDED:
+                self._maybe_launch(key, ev.obj)
+            elif ev.type == DELETED:
+                with self._lock:
+                    entry = self._running.pop(key, None)
+                if entry:
+                    self._kill(entry)
+                if self.scheduler is not None:
+                    self.scheduler.release(ev.obj)
+
+    def _maybe_launch(self, key: str, pod: Pod) -> None:
+        with self._lock:
+            if key in self._running:
+                return
+            entry = _RunningPod(pod=pod)
+            self._running[key] = entry
+        entry.thread = threading.Thread(
+            target=self._run_pod, args=(key, entry), name=f"pod-{key}", daemon=True
+        )
+        entry.thread.start()
+
+    # -- pod run loop ----------------------------------------------------
+
+    def _run_pod(self, key: str, entry: _RunningPod) -> None:
+        pod = entry.pod
+        try:
+            # 1. schedule (TPU slice admission when configured)
+            placement = None
+            if self.scheduler is not None:
+                while not self._stop.is_set() and not entry.stop:
+                    placement = self.scheduler.assign(pod)
+                    if placement is not None:
+                        break
+                    time.sleep(0.05)
+                if placement is None:
+                    return
+            if entry.stop:
+                return
+
+            entry.workdir = tempfile.mkdtemp(prefix=f"kubedl-pod-{pod.metadata.name}-")
+            volumes = self._prepare_volumes(pod, entry.workdir)
+
+            # 2. init containers run sequentially to completion
+            for c in pod.spec.init_containers:
+                rc = self._run_container(entry, c, volumes, placement, wait=True)
+                if rc != 0:
+                    self._set_status(
+                        key, PodPhase.FAILED,
+                        [ContainerStatus(name=c.name, terminated=ContainerStateTerminated(exit_code=rc, reason="InitError"))],
+                        message=f"init container {c.name} failed with exit code {rc}",
+                    )
+                    return
+
+            # 3. main containers; restart in place per pod restart policy
+            while not entry.stop and not self._stop.is_set():
+                started = now()
+                for c in pod.spec.containers:
+                    self._run_container(entry, c, volumes, placement, wait=False)
+                self._set_status(
+                    key, PodPhase.RUNNING,
+                    [
+                        ContainerStatus(name=c.name, ready=True,
+                                        restart_count=entry.restart_counts.get(c.name, 0))
+                        for c in pod.spec.containers
+                    ],
+                    ready=True, start_time=started, placement=placement,
+                )
+                exit_codes = {}
+                for name, proc in list(entry.procs.items()):
+                    exit_codes[name] = proc.wait()
+                if entry.stop or self._stop.is_set():
+                    return
+                failed = {n: rc for n, rc in exit_codes.items() if rc != 0}
+                policy = pod.spec.restart_policy
+                should_restart = policy == PodRestartPolicy.ALWAYS or (
+                    policy == PodRestartPolicy.ON_FAILURE and failed
+                )
+                statuses = [
+                    ContainerStatus(
+                        name=n,
+                        restart_count=entry.restart_counts.get(n, 0),
+                        terminated=ContainerStateTerminated(
+                            exit_code=rc, finished_at=now(),
+                            reason="Error" if rc else "Completed",
+                        ),
+                    )
+                    for n, rc in exit_codes.items()
+                ]
+                if should_restart:
+                    for n in exit_codes:
+                        entry.restart_counts[n] = entry.restart_counts.get(n, 0) + 1
+                    # keep phase Running with accrued restart counts, like a
+                    # kubelet in CrashLoopBackOff-free fast path
+                    self._set_status(
+                        key, PodPhase.RUNNING,
+                        [
+                            ContainerStatus(name=n, ready=False,
+                                            restart_count=entry.restart_counts.get(n, 0),
+                                            terminated=s.terminated)
+                            for n, s in zip(exit_codes, statuses)
+                        ],
+                        placement=placement,
+                    )
+                    time.sleep(self.restart_backoff)
+                    continue
+                phase = PodPhase.FAILED if failed else PodPhase.SUCCEEDED
+                self._set_status(key, phase, statuses, placement=placement)
+                return
+        except Exception:
+            log.exception("executor failed running pod %s", key)
+            self._set_status(
+                key, PodPhase.FAILED,
+                [ContainerStatus(name="executor", terminated=ContainerStateTerminated(exit_code=127, reason="ExecutorError"))],
+            )
+        finally:
+            if self.scheduler is not None and entry.pod.spec.tpu_chips() > 0:
+                self.scheduler.release(entry.pod)
+            if entry.workdir:
+                shutil.rmtree(entry.workdir, ignore_errors=True)
+            with self._lock:
+                self._running.pop(key, None)
+
+    def _prepare_volumes(self, pod: Pod, workdir: str) -> Dict[str, str]:
+        paths = {}
+        for vol in pod.spec.volumes:
+            if vol.kind == "hostPath":
+                paths[vol.name] = vol.host_path
+            else:
+                p = os.path.join(workdir, "vol", vol.name)
+                os.makedirs(p, exist_ok=True)
+                paths[vol.name] = p
+        return paths
+
+    def _run_container(self, entry: _RunningPod, container, volumes, placement, wait: bool):
+        pod = entry.pod
+        env = dict(os.environ)
+        env.update(container.env)
+        env["POD_NAME"] = pod.metadata.name
+        env["POD_NAMESPACE"] = pod.metadata.namespace
+        for k, v in pod.metadata.labels.items():
+            env[f"KUBEDL_LABEL_{k.upper().replace('-', '_')}"] = v
+        if placement is not None:
+            env.update(placement.env())
+        if self.launch_hook is not None:
+            env.update(self.launch_hook(pod) or {})
+        # volume mounts exported as env so host processes can find them
+        for vm in container.volume_mounts:
+            if vm.name in volumes:
+                env[f"KUBEDL_VOLUME_{vm.name.upper().replace('-', '_')}"] = volumes[vm.name]
+        argv = list(container.command) + list(container.args)
+        if not argv:
+            argv = ["true"]
+        cwd = container.working_dir or entry.workdir
+        proc = subprocess.Popen(
+            argv, env=env, cwd=cwd,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        if wait:
+            return proc.wait()
+        entry.procs[container.name] = proc
+        return None
+
+    def _kill(self, entry: _RunningPod) -> None:
+        entry.stop = True
+        for proc in entry.procs.values():
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + 2.0
+        for proc in entry.procs.values():
+            remaining = deadline - time.monotonic()
+            try:
+                proc.wait(timeout=max(remaining, 0.1))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+    # -- status write ----------------------------------------------------
+
+    def _set_status(
+        self, key: str, phase: PodPhase, container_statuses: List[ContainerStatus],
+        ready: bool = False, start_time: Optional[float] = None,
+        placement=None, message: str = "",
+    ) -> None:
+        namespace, name = key.split("/", 1)
+        for _ in range(5):
+            try:
+                pod = self.store.get("Pod", namespace, name)
+            except NotFound:
+                return
+            pod.status.phase = phase
+            pod.status.container_statuses = container_statuses
+            pod.status.message = message
+            if start_time is not None and pod.status.start_time is None:
+                pod.status.start_time = start_time
+            if ready and pod.status.ready_time() is None:
+                pod.status.conditions = [
+                    c for c in pod.status.conditions if c.type != "Ready"
+                ] + [PodCondition(type="Ready", status="True", last_transition_time=now())]
+            if placement is not None:
+                pod.status.node_name = placement.node_name
+                pod.status.tpu_slice = placement.slice_name
+                pod.status.tpu_worker_id = placement.worker_id
+            try:
+                self.store.update(pod)
+                return
+            except Conflict:
+                continue
+        log.warning("status update for pod %s kept conflicting", key)

@@ -1,0 +1,154 @@
+"""Operator — the process entrypoint wiring (ref main.go:48-115).
+
+Assembles: object store (L0-equivalent), controller manager, per-workload
+reconcilers (registered via the workload registry, gated like the reference's
+workloadgate), TPU-slice gang admission, the local pod executor, metrics
+registry, and optional storage persistence. Usage:
+
+    op = Operator(OperatorConfig(enable_gang_scheduling=True,
+                                 tpu_slices=["v5e-8", "v5p-32"]))
+    op.register_all()       # every known workload (TF/PyTorch/XGB/XDL/JAX)
+    op.start()
+    job = op.apply(manifest_dict)           # like kubectl apply
+    op.wait_for_condition(job, "Succeeded")
+    op.stop()
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import JobConditionType, has_condition
+from kubedl_tpu.controllers.engine import EngineConfig, JobReconciler
+from kubedl_tpu.core.events import EventRecorder
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.core.store import NotFound, ObjectStore
+from kubedl_tpu.executor.local import LocalPodExecutor
+from kubedl_tpu.gang.interface import GangRegistry
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+from kubedl_tpu.metrics.job_metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.utils.serde import from_dict
+
+
+@dataclass
+class OperatorConfig:
+    # flag parity with ref main.go:54-66 / docs/startup_flags.md
+    max_reconciles: int = 1
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "tpu-slice"
+    # TPU pool available to the executor, e.g. ["v5e-8", "v5p-32"]
+    tpu_slices: List[str] = field(default_factory=list)
+    # workload gate expression, ref pkg/util/workloadgate: "*", "tf,pytorch", "*,-xdl"
+    workloads: str = "*"
+    cluster_domain: str = ""
+    run_executor: bool = True
+
+
+class Operator:
+    def __init__(self, config: Optional[OperatorConfig] = None) -> None:
+        self.config = config or OperatorConfig()
+        self.store = ObjectStore()
+        self.manager = Manager(self.store)
+        self.recorder = EventRecorder(self.store)
+        self.metrics_registry = MetricsRegistry()
+        self.gang_registry = GangRegistry()
+        self.gang_registry.register(TPUSliceAdmitter.with_pool(self.store, self.config.tpu_slices))
+        self._gang = self.gang_registry.get(self.config.gang_scheduler_name)
+        self.executor: Optional[LocalPodExecutor] = None
+        if self.config.run_executor:
+            scheduler = self._gang if self.config.tpu_slices else None
+            self.executor = LocalPodExecutor(self.store, scheduler=scheduler)
+        self.reconcilers: Dict[str, JobReconciler] = {}
+        self._kind_by_lower: Dict[str, str] = {}
+        self._started = False
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, controller) -> JobReconciler:
+        """Register one workload controller (ref controllers/controllers.go:31-47)."""
+        engine = JobReconciler(
+            self.store,
+            controller,
+            recorder=self.recorder,
+            metrics=self.metrics_registry.for_kind(controller.kind),
+            gang_scheduler=self._gang,
+            config=EngineConfig(
+                enable_gang_scheduling=self.config.enable_gang_scheduling,
+                cluster_domain=self.config.cluster_domain,
+            ),
+        )
+        controller.engine = engine
+        runner = self.manager.add_controller(
+            controller.controller_name, engine.reconcile, workers=self.config.max_reconciles
+        )
+        engine.setup(runner)
+        self.reconcilers[controller.kind] = engine
+        self._kind_by_lower[controller.kind.lower()] = controller.kind
+        return engine
+
+    def register_all(self) -> None:
+        from kubedl_tpu.controllers.registry import enabled_controllers
+
+        for controller in enabled_controllers(self.config.workloads):
+            self.register(controller)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        if self.executor is not None:
+            self.executor.start()
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
+        if self.executor is not None:
+            self.executor.stop()
+
+    # -- client-ish helpers ---------------------------------------------
+
+    def apply(self, manifest: Dict):
+        """kubectl-apply equivalent: route a manifest dict to its typed job."""
+        kind = manifest.get("kind", "")
+        canonical = self._kind_by_lower.get(kind.lower())
+        if canonical is None:
+            raise ValueError(
+                f"no controller registered for kind {kind!r} "
+                f"(enabled: {sorted(self.reconcilers)})"
+            )
+        engine = self.reconcilers[canonical]
+        job_cls = engine.controller.job_type()
+        job = from_dict(job_cls, manifest)
+        job.kind = canonical
+        try:
+            existing = self.store.get(canonical, job.metadata.namespace, job.metadata.name)
+            job.metadata.resource_version = existing.metadata.resource_version
+            job.metadata.uid = existing.metadata.uid
+            job.status = existing.status
+            return self.store.update(job)
+        except NotFound:
+            return self.store.create(job)
+
+    def get_job(self, kind: str, namespace: str, name: str):
+        return self.store.get(self._kind_by_lower.get(kind.lower(), kind), namespace, name)
+
+    def wait_for_condition(
+        self, job, condition: str, timeout: float = 30.0, poll: float = 0.02
+    ) -> bool:
+        import time
+
+        ctype = JobConditionType(condition)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                fresh = self.store.get(job.kind, job.metadata.namespace, job.metadata.name)
+            except NotFound:
+                time.sleep(poll)
+                continue
+            if has_condition(fresh.status, ctype):
+                return True
+            time.sleep(poll)
+        return False

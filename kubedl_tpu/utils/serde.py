@@ -92,6 +92,13 @@ def _from(tp, data):
                 continue  # tolerate unknown fields, like k8s does
             kwargs[f.name] = _from(hints[f.name], v)
         return tp(**kwargs)
+    if tp is float and isinstance(data, str):
+        # Timestamps arrive as RFC3339 in k8s-style manifests; internal
+        # representation is float epoch seconds (see api/meta.py).
+        import calendar
+        import time as _time
+
+        return float(calendar.timegm(_time.strptime(data, "%Y-%m-%dT%H:%M:%SZ")))
     if tp in (int, float, str, bool):
         return tp(data) if data is not None else None
     return data

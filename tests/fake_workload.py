@@ -1,0 +1,80 @@
+"""Fake TestJob workload — the unit-test harness for the generic engine.
+
+Mirrors the reference's pkg/test_job/v1 (TestJob with Master/Worker replicas
+and a stub controller) so the shared reconciler runtime can be exercised
+without any real workload controller.
+"""
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from kubedl_tpu.api.common import ReplicaSpec, ReplicaType, RestartPolicy
+from kubedl_tpu.api.job import BaseJob, BaseJobSpec
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import Container, PodSpec, PodTemplateSpec
+from kubedl_tpu.controllers.base import BaseWorkloadController
+
+TEST_KIND = "TestJob"
+
+
+@dataclass
+class TestJob(BaseJob):
+    kind: str = TEST_KIND
+
+
+class TestJobController(BaseWorkloadController):
+    __test__ = False  # not a pytest class
+    kind = TEST_KIND
+    api_version = "test.kubedl-tpu.io/v1"
+    default_container_name = "test-container"
+    default_port_name = "test-port"
+    default_port = 2222
+
+    def __init__(self):
+        self.cluster_spec_calls = []
+
+    def job_type(self):
+        return TestJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def set_cluster_spec(self, job, pod_template, rtype, index):
+        self.cluster_spec_calls.append((job.metadata.name, rtype, index))
+        for c in pod_template.spec.containers:
+            c.env["TEST_RTYPE"] = rtype
+            c.env["TEST_INDEX"] = str(index)
+
+    def reconcile_orders(self):
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    @property
+    def master_types(self):
+        return [str(ReplicaType.MASTER.value)]
+
+
+def make_test_job(
+    name="test-job",
+    workers=2,
+    masters=1,
+    restart_policy=RestartPolicy.EXIT_CODE,
+    run_policy=None,
+):
+    specs: Dict[str, ReplicaSpec] = {}
+
+    def template():
+        return PodTemplateSpec(
+            spec=PodSpec(containers=[Container(name="test-container", image="test:latest")])
+        )
+
+    if masters:
+        specs[str(ReplicaType.MASTER.value)] = ReplicaSpec(
+            replicas=masters, restart_policy=restart_policy, template=template()
+        )
+    if workers:
+        specs[str(ReplicaType.WORKER.value)] = ReplicaSpec(
+            replicas=workers, restart_policy=restart_policy, template=template()
+        )
+    job = TestJob(metadata=ObjectMeta(name=name), spec=BaseJobSpec(replica_specs=specs))
+    if run_policy is not None:
+        job.spec.run_policy = run_policy
+    return job

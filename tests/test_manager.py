@@ -1,0 +1,87 @@
+import threading
+import time
+
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import Pod
+from kubedl_tpu.core.expectations import ControllerExpectations
+from kubedl_tpu.core.manager import Manager, Result
+from kubedl_tpu.core.store import ObjectStore
+
+
+def test_manager_drives_reconcile_from_watch():
+    m = Manager()
+    seen = []
+    done = threading.Event()
+
+    def reconcile(key):
+        seen.append(key)
+        done.set()
+        return Result()
+
+    c = m.add_controller("pods", reconcile)
+    c.watch("Pod", lambda ev: c.enqueue(f"{ev.obj.metadata.namespace}/{ev.obj.metadata.name}"))
+    m.start()
+    m.store.create(Pod(metadata=ObjectMeta(name="p1")))
+    assert done.wait(2.0)
+    assert seen == ["default/p1"]
+    m.stop()
+
+
+def test_manager_retries_on_exception():
+    m = Manager()
+    calls = []
+    done = threading.Event()
+
+    def reconcile(key):
+        calls.append(key)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        done.set()
+        return Result()
+
+    c = m.add_controller("flaky", reconcile)
+    c.watch("Pod", lambda ev: c.enqueue("k"))
+    m.start()
+    m.store.create(Pod(metadata=ObjectMeta(name="p1")))
+    assert done.wait(5.0)
+    assert len(calls) == 3
+    m.stop()
+
+
+def test_requeue_after():
+    m = Manager()
+    times = []
+    done = threading.Event()
+
+    def reconcile(key):
+        times.append(time.monotonic())
+        if len(times) >= 2:
+            done.set()
+            return Result()
+        return Result(requeue_after=0.2)
+
+    c = m.add_controller("ttl", reconcile)
+    c.watch("Pod", lambda ev: c.enqueue("k"))
+    m.start()
+    m.store.create(Pod(metadata=ObjectMeta(name="p1")))
+    assert done.wait(3.0)
+    assert times[1] - times[0] >= 0.18
+    m.stop()
+
+
+def test_expectations_gate():
+    e = ControllerExpectations()
+    key = "default/job1/pods"
+    assert e.satisfied(key)
+    e.expect_creations(key, 2)
+    assert not e.satisfied(key)
+    e.creation_observed(key)
+    assert not e.satisfied(key)
+    e.creation_observed(key)
+    assert e.satisfied(key)
+    e.expect_deletions(key, 1)
+    assert not e.satisfied(key)
+    e.deletion_observed(key)
+    assert e.satisfied(key)
+    e.delete_expectations(key)
+    assert e.satisfied(key)

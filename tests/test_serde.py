@@ -49,9 +49,18 @@ def test_unknown_fields_tolerated():
 
 
 def test_success_policy_min_finish():
-    # Ref controllers/xdl/status.go:151-160: absolute wins; percentage ceils.
+    # Ref controllers/xdl/status.go calculateMinFinish: percentage takes
+    # precedence over the absolute number; percentage ceils.
     assert SuccessPolicy(min_finish_worker_num=3).min_finish(10) == 3
     assert SuccessPolicy(min_finish_worker_num=30).min_finish(10) == 10
     assert SuccessPolicy(min_finish_worker_percentage=90).min_finish(10) == 9
     assert SuccessPolicy(min_finish_worker_percentage=90).min_finish(7) == 7  # ceil(6.3)
+    assert SuccessPolicy(min_finish_worker_num=2, min_finish_worker_percentage=90).min_finish(10) == 9
     assert SuccessPolicy().min_finish(5) == 5
+
+
+def test_rfc3339_timestamp_accepted():
+    from kubedl_tpu.api.meta import ObjectMeta
+
+    m = from_dict(ObjectMeta, {"name": "x", "creationTimestamp": "2026-07-29T10:00:00Z"})
+    assert isinstance(m.creation_timestamp, float) and m.creation_timestamp > 1.7e9

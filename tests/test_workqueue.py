@@ -1,0 +1,48 @@
+import time
+
+from kubedl_tpu.core.workqueue import RateLimitingQueue
+
+
+def test_dedup_while_queued():
+    q = RateLimitingQueue()
+    q.add("a")
+    q.add("a")
+    assert q.get(timeout=0.1) == "a"
+    q.done("a")
+    assert q.get(timeout=0.05) is None
+
+
+def test_requeue_if_added_while_processing():
+    q = RateLimitingQueue()
+    q.add("a")
+    assert q.get(timeout=0.1) == "a"
+    q.add("a")  # while processing
+    assert q.get(timeout=0.05) is None  # not handed out twice concurrently
+    q.done("a")
+    assert q.get(timeout=0.5) == "a"
+
+
+def test_add_after_delays():
+    q = RateLimitingQueue()
+    q.add_after("a", 0.15)
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == "a"
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_rate_limited_backoff_grows_and_forget_resets():
+    q = RateLimitingQueue(base_delay=0.02, max_delay=1.0)
+    q.add_rate_limited("a")
+    assert q.num_requeues("a") == 1
+    q.add_rate_limited("a")
+    assert q.num_requeues("a") == 2
+    q.forget("a")
+    assert q.num_requeues("a") == 0
+
+
+def test_shutdown_unblocks_get():
+    q = RateLimitingQueue()
+    t0 = time.monotonic()
+    q.shutdown()
+    assert q.get(timeout=5) is None
+    assert time.monotonic() - t0 < 1
