@@ -1,0 +1,166 @@
+"""kubedl-tpu CLI — run jobs locally or serve the operator.
+
+    python -m kubedl_tpu.cli run -f examples/tf_job_mnist.yaml
+    python -m kubedl_tpu.cli operator --metrics-port 8443 --workloads '*'
+    python -m kubedl_tpu.cli validate -f job.yaml
+
+Flag names keep parity with the reference's startup flags
+(ref main.go:54-66, docs/startup_flags.md): --max-reconciles,
+--gang-scheduler-name, --workloads; TPU-native additions: --tpu-slices.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import yaml
+
+from kubedl_tpu.api.common import JobConditionType, has_condition, is_failed, is_succeeded
+from kubedl_tpu.operator import Operator, OperatorConfig
+from kubedl_tpu.server import OperatorHTTPServer
+
+
+def _load_manifests(path: str):
+    with open(path) as f:
+        return [m for m in yaml.safe_load_all(f) if m]
+
+
+def _mk_operator(args) -> Operator:
+    return Operator(
+        OperatorConfig(
+            max_reconciles=args.max_reconciles,
+            enable_gang_scheduling=bool(args.tpu_slices) or args.gang,
+            gang_scheduler_name=args.gang_scheduler_name,
+            tpu_slices=args.tpu_slices,
+            workloads=args.workloads,
+        )
+    )
+
+
+def cmd_run(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    op.start()
+    server = None
+    if args.metrics_port:
+        server = OperatorHTTPServer(op, port=args.metrics_port)
+        port = server.start()
+        print(f"serving metrics/API on http://127.0.0.1:{port}")
+    rc = 0
+    try:
+        jobs = [op.apply(m) for p in args.files for m in _load_manifests(p)]
+        for job in jobs:
+            print(f"applied {job.kind} {job.metadata.namespace}/{job.metadata.name}")
+        deadline = time.monotonic() + args.timeout
+        pending = {(j.kind, j.metadata.namespace, j.metadata.name) for j in jobs}
+        last_report = 0.0
+        while pending and time.monotonic() < deadline:
+            for key in list(pending):
+                kind, ns, name = key
+                try:
+                    fresh = op.store.get(kind, ns, name)
+                except Exception:
+                    pending.discard(key)
+                    continue
+                if is_succeeded(fresh.status):
+                    print(f"{kind} {ns}/{name}: Succeeded")
+                    pending.discard(key)
+                elif is_failed(fresh.status):
+                    cond = fresh.status.conditions[-1]
+                    print(f"{kind} {ns}/{name}: Failed — {cond.message}")
+                    pending.discard(key)
+                    rc = 1
+            if time.monotonic() - last_report > 5:
+                last_report = time.monotonic()
+                for kind, ns, name in pending:
+                    phases = [
+                        (p.metadata.name, p.status.phase.value)
+                        for p in op.store.list("Pod", namespace=ns)
+                        if p.metadata.labels.get("job-name") == name
+                    ]
+                    print(f"waiting on {kind} {ns}/{name}: pods={phases}")
+            time.sleep(0.1)
+        if pending:
+            print(f"timed out waiting for: {sorted(pending)}")
+            rc = 1
+    finally:
+        if server:
+            server.stop()
+        op.stop()
+    return rc
+
+
+def cmd_operator(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    op.start()
+    server = OperatorHTTPServer(op, host=args.bind, port=args.metrics_port or 8443)
+    port = server.start()
+    print(f"kubedl-tpu operator serving on http://{args.bind}:{port} "
+          f"(kinds: {sorted(op.reconcilers)})")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        op.stop()
+    return 0
+
+
+def cmd_validate(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    rc = 0
+    for path in args.files:
+        for m in _load_manifests(path):
+            kind = m.get("kind", "")
+            canonical = op._kind_by_lower.get(kind.lower())
+            if canonical is None:
+                print(f"{path}: unknown kind {kind!r}")
+                rc = 1
+                continue
+            engine = op.reconcilers[canonical]
+            from kubedl_tpu.utils.serde import from_dict
+
+            job = from_dict(engine.controller.job_type(), m)
+            engine.controller.set_defaults(job)
+            n = sum(int(s.replicas or 0) for s in engine.controller.replica_specs(job).values())
+            print(f"{path}: {canonical} {job.metadata.name} ok ({n} replicas)")
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubedl-tpu")
+    parser.add_argument("--max-reconciles", type=int, default=1)
+    parser.add_argument("--workloads", default="*")
+    parser.add_argument("--gang-scheduler-name", default="tpu-slice")
+    parser.add_argument("--gang", action="store_true", help="enable gang scheduling")
+    parser.add_argument("--tpu-slices", nargs="*", default=[],
+                        help="TPU pool, e.g. v5e-8 v5p-32")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run job manifests to completion locally")
+    p_run.add_argument("-f", "--files", nargs="+", required=True)
+    p_run.add_argument("--timeout", type=float, default=600.0)
+    p_run.add_argument("--metrics-port", type=int, default=0)
+    p_run.set_defaults(fn=cmd_run)
+
+    p_op = sub.add_parser("operator", help="serve the operator over HTTP")
+    p_op.add_argument("--bind", default="127.0.0.1")
+    p_op.add_argument("--metrics-port", type=int, default=8443)
+    p_op.set_defaults(fn=cmd_operator)
+
+    p_val = sub.add_parser("validate", help="parse and default manifests")
+    p_val.add_argument("-f", "--files", nargs="+", required=True)
+    p_val.set_defaults(fn=cmd_validate)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,13 @@ class LocalPodExecutor:
         for vm in container.volume_mounts:
             if vm.name in volumes:
                 env[f"KUBEDL_VOLUME_{vm.name.upper().replace('-', '_')}"] = volumes[vm.name]
+        # Local mode has no container images: make the framework's own
+        # runtime modules (kubedl_tpu.train.*) importable from any cwd,
+        # merging with (not clobbering) any user-set PYTHONPATH.
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_parent not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = f"{pkg_parent}{os.pathsep}{existing}" if existing else pkg_parent
         argv = list(container.command) + list(container.args)
         if not argv:
             argv = ["true"]

@@ -1,0 +1,141 @@
+"""JAXJob — the flagship first-class TPU workload (net-new).
+
+Added via the reference's documented extension path
+(ref docs/how-to-add-a-custom-workload.md:1-110): a new kind + controller
+registered with the shared engine. Design (SURVEY.md §7 step 4):
+  * replica types: Worker (SPMD ranks; worker-0 hosts the coordination
+    service). No PS, no chief — JAX is single-program multi-data;
+  * spec.mesh declares named axes ("data", "fsdp", "tensor", "context",
+    "expert") the runtime materializes as a jax.sharding.Mesh over the
+    slice (parallel/mesh.py);
+  * spec.checkpoint: Orbax checkpoint dir + save interval — first-class
+    because TPU preemptions make resume mandatory (SURVEY.md §5);
+  * SetClusterSpec injects ONLY the coordination-service env (one rendezvous
+    scheme instead of the reference's four) plus the mesh/checkpoint config;
+  * default restart policy ExitCode: TPU preemptions exit retryable
+    (utils/exit_codes.py), XLA compile errors permanent.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+)
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.workloads import common
+
+KIND = "JAXJob"
+API_VERSION = "kubedl-tpu.io/v1alpha1"
+
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+
+_CANONICAL = {"worker": REPLICA_WORKER}
+
+
+@dataclass
+class MeshSpec:
+    """Named mesh axes; sizes multiply to the process*local-device count.
+    A size of -1 means "fill with whatever devices remain" (like a reshape)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+    expert: int = 1
+
+    def axis_dict(self) -> Dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "context": self.context,
+            "expert": self.expert,
+        }
+
+    def encode(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in self.axis_dict().items())
+
+
+@dataclass
+class CheckpointSpec:
+    path: str = ""
+    save_interval_steps: int = 0
+    keep: int = 3
+    restore: bool = True
+
+
+@dataclass
+class JAXJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "jaxReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    mesh: Optional[MeshSpec] = None
+    checkpoint: Optional[CheckpointSpec] = None
+
+
+@dataclass
+class JAXJob(BaseJob):
+    spec: JAXJobSpec = field(default_factory=JAXJobSpec)
+    kind: str = KIND
+
+
+class JAXJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "jax"
+    default_port_name = "jaxjob-port"
+    default_port = common.COORDINATOR_PORT
+
+    def job_type(self):
+        return JAXJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def set_defaults(self, job) -> None:
+        specs = job.spec.replica_specs
+        for key in list(specs):
+            canonical = _CANONICAL.get(key.lower())
+            if canonical and canonical != key:
+                specs[canonical] = specs.pop(key)
+        super().set_defaults(job)
+        if job.spec.run_policy.backoff_limit is None:
+            # preemptions are routine on TPU; retry generously
+            job.spec.run_policy.backoff_limit = 10
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        return RestartPolicy.EXIT_CODE
+
+    @property
+    def master_types(self) -> List[str]:
+        return []
+
+    def reconcile_orders(self):
+        return [ReplicaType.WORKER]
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        env = {}
+        if job.spec.mesh is not None:
+            env["KUBEDL_MESH"] = job.spec.mesh.encode()
+        ckpt = job.spec.checkpoint
+        if ckpt is not None and ckpt.path:
+            env["KUBEDL_CHECKPOINT_PATH"] = ckpt.path
+            env["KUBEDL_CHECKPOINT_INTERVAL"] = str(ckpt.save_interval_steps)
+            env["KUBEDL_CHECKPOINT_KEEP"] = str(ckpt.keep)
+            env["KUBEDL_CHECKPOINT_RESTORE"] = "1" if ckpt.restore else "0"
+        common.add_env(pod_template, env)
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            REPLICA_WORKER, int(index),
+        )
+
+
+register_workload("jax", JAXJobController)

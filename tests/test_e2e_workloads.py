@@ -1,0 +1,56 @@
+"""End-to-end for real workload kinds: the reference's anchor example
+(TFJob MNIST) and the flagship JAXJob, through operator + executor + real
+training processes on CPU devices."""
+import os
+import sys
+
+import pytest
+import yaml
+
+from kubedl_tpu.operator import Operator, OperatorConfig
+
+
+@pytest.fixture
+def op():
+    operator = Operator(OperatorConfig())
+    operator.register_all()
+    operator.start()
+    yield operator
+    operator.stop()
+
+
+def load_example(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "examples", name)
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def force_cpu(manifest, replica_field):
+    """Pods inherit our env; pin the training subprocess to JAX CPU so tests
+    don't touch the real TPU (and keep steps small)."""
+    for spec in manifest["spec"][replica_field].values():
+        for c in spec["template"]["spec"]["containers"]:
+            c.setdefault("env", {})
+            if isinstance(c["env"], dict):
+                c["env"]["JAX_PLATFORMS"] = "cpu"
+                c["env"]["XLA_FLAGS"] = ""
+            c["command"] = [sys.executable, "-m", "kubedl_tpu.train.mnist", "--steps", "10"]
+    return manifest
+
+
+def test_tfjob_mnist_example_succeeds(op):
+    manifest = force_cpu(load_example("tf_job_mnist.yaml"), "tfReplicaSpecs")
+    job = op.apply(manifest)
+    assert op.wait_for_condition(job, "Succeeded", timeout=90)
+    status = op.get_job("TFJob", "default", "mnist").status
+    assert status.replica_statuses["Worker"].succeeded == 1
+    jm = op.metrics_registry.get("TFJob")
+    assert jm.successful == 1
+
+
+def test_jaxjob_mnist_example_succeeds(op):
+    manifest = force_cpu(load_example("jax_job_mnist.yaml"), "jaxReplicaSpecs")
+    job = op.apply(manifest)
+    assert op.wait_for_condition(job, "Succeeded", timeout=90)
+    jm = op.metrics_registry.get("JAXJob")
+    assert jm.successful == 1

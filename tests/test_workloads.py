@@ -1,0 +1,317 @@
+"""Per-workload controller tests: cluster-spec env golden tests (the
+reference's simulated-distribution strategy, SURVEY.md §4.8), defaulting,
+reconcile orders, and status rules."""
+import json
+
+import pytest
+
+from kubedl_tpu.api.common import (
+    CleanPodPolicy,
+    RestartPolicy,
+    is_failed,
+    is_running,
+    is_succeeded,
+)
+from kubedl_tpu.api.pod import ContainerStateTerminated, ContainerStatus, PodPhase
+from kubedl_tpu.controllers.engine import JobReconciler
+from kubedl_tpu.controllers.registry import enabled_controllers
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.utils.serde import from_dict
+from kubedl_tpu.workloads.jaxjob import JAXJob, JAXJobController
+from kubedl_tpu.workloads.pytorch import PyTorchJob, PyTorchJobController
+from kubedl_tpu.workloads.tensorflow import TFJob, TFJobController
+from kubedl_tpu.workloads.xdl import XDLJob, XDLJobController
+from kubedl_tpu.workloads.xgboost import XGBoostJob, XGBoostJobController
+
+
+def container_manifest(name, port_name=None, port=None, env=None):
+    c = {"name": name, "image": "img"}
+    if port:
+        c["ports"] = [{"name": port_name, "containerPort": port}]
+    if env:
+        c["env"] = env
+    return c
+
+
+def make_job(cls, kind, replica_field, replicas: dict, container_name, extra_spec=None):
+    spec = {replica_field: {}}
+    for rtype, n in replicas.items():
+        spec[replica_field][rtype] = {
+            "replicas": n,
+            "template": {"spec": {"containers": [container_manifest(container_name)]}},
+        }
+    spec.update(extra_spec or {})
+    job = from_dict(cls, {"metadata": {"name": "job1", "uid": "uid-123"}, "spec": spec})
+    return job
+
+
+def reconcile_once(ctrl, job):
+    store = ObjectStore()
+    engine = JobReconciler(store, ctrl)
+    ctrl.engine = engine
+    created = store.create(job)
+    engine.reconcile(created.key)
+    return store, engine
+
+
+def pod_env(store, name):
+    pod = store.get("Pod", "default", name)
+    return pod.spec.containers[0].env
+
+
+# ---------------------------------------------------------------------------
+# TFJob
+# ---------------------------------------------------------------------------
+
+
+def test_tf_config_content_and_exclusions():
+    ctrl = TFJobController()
+    job = make_job(TFJob, "TFJob", "tfReplicaSpecs",
+                   {"PS": 2, "Worker": 2, "Evaluator": 1}, "tensorflow")
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "job1-worker-1")
+    cfg = json.loads(env["TF_CONFIG"])
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    assert cfg["environment"] == "cloud"
+    assert cfg["cluster"]["ps"] == [
+        "job1-ps-0.default.svc:2222", "job1-ps-1.default.svc:2222"
+    ]
+    assert cfg["cluster"]["worker"] == [
+        "job1-worker-0.default.svc:2222", "job1-worker-1.default.svc:2222"
+    ]
+    # evaluator excluded from cluster spec but still gets a pod
+    assert "evaluator" not in cfg["cluster"]
+    assert store.get("Pod", "default", "job1-evaluator-0") is not None
+    # TPU-native coordinator env alongside TF_CONFIG
+    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "job1-worker-0.default.svc:8471"
+    assert env["KUBEDL_NUM_PROCESSES"] == "5"
+
+
+def test_tf_single_replica_skips_tf_config():
+    ctrl = TFJobController()
+    job = make_job(TFJob, "TFJob", "tfReplicaSpecs", {"Worker": 1}, "tensorflow")
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "job1-worker-0")
+    assert "TF_CONFIG" not in env
+
+
+def test_tf_defaults():
+    ctrl = TFJobController()
+    job = make_job(TFJob, "TFJob", "tfReplicaSpecs", {"worker": 2}, "tensorflow")
+    ctrl.set_defaults(job)
+    # camel-cased replica key, ExitCode restart, port injected, CleanPodPolicy Running
+    assert "Worker" in job.spec.replica_specs and "worker" not in job.spec.replica_specs
+    spec = job.spec.replica_specs["Worker"]
+    assert spec.restart_policy == RestartPolicy.EXIT_CODE
+    assert spec.template.spec.containers[0].port_named("tfjob-port") == 2222
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+
+
+def test_tf_worker0_completed_heuristic():
+    ctrl = TFJobController()
+    job = make_job(TFJob, "TFJob", "tfReplicaSpecs", {"Worker": 3}, "tensorflow")
+    store, engine = reconcile_once(ctrl, job)
+    # worker-0 succeeded exit 0; others running -> job Succeeded
+    for name, phase, code in (
+        ("job1-worker-0", PodPhase.SUCCEEDED, 0),
+        ("job1-worker-1", PodPhase.RUNNING, None),
+        ("job1-worker-2", PodPhase.RUNNING, None),
+    ):
+        pod = store.get("Pod", "default", name)
+        pod.status.phase = phase
+        if code is not None:
+            pod.status.container_statuses = [
+                ContainerStatus(name="tensorflow",
+                                terminated=ContainerStateTerminated(exit_code=code))
+            ]
+        store.update(pod)
+    for rt in ("worker",):
+        engine.expectations.delete_expectations(f"default/job1/{rt}/pods")
+        engine.expectations.delete_expectations(f"default/job1/{rt}/services")
+    engine.reconcile("default/job1")
+    assert is_succeeded(store.get("TFJob", "default", "job1").status)
+
+
+def test_tf_chief_drives_when_present():
+    ctrl = TFJobController()
+    job = make_job(TFJob, "TFJob", "tfReplicaSpecs", {"Chief": 1, "Worker": 2}, "tensorflow")
+    store, engine = reconcile_once(ctrl, job)
+    chief = store.get("Pod", "default", "job1-chief-0")
+    assert chief.metadata.labels["job-role"] == "master"
+    chief.status.phase = PodPhase.RUNNING
+    store.update(chief)
+    for rt in ("chief", "worker"):
+        engine.expectations.delete_expectations(f"default/job1/{rt}/pods")
+        engine.expectations.delete_expectations(f"default/job1/{rt}/services")
+    engine.reconcile("default/job1")
+    assert is_running(store.get("TFJob", "default", "job1").status)
+
+
+# ---------------------------------------------------------------------------
+# PyTorchJob
+# ---------------------------------------------------------------------------
+
+
+def test_pytorch_env_master_and_worker():
+    ctrl = PyTorchJobController()
+    job = make_job(PyTorchJob, "PyTorchJob", "pytorchReplicaSpecs",
+                   {"Master": 1, "Worker": 2}, "pytorch")
+    store, _ = reconcile_once(ctrl, job)
+    menv = pod_env(store, "job1-master-0")
+    assert menv["MASTER_ADDR"] == "localhost"
+    assert menv["RANK"] == "0"
+    assert menv["MASTER_PORT"] == "23456"
+    assert menv["WORLD_SIZE"] == "3"
+    assert menv["PJRT_DEVICE"] == "TPU"
+    wenv = pod_env(store, "job1-worker-1")
+    assert wenv["MASTER_ADDR"] == "job1-master-0.default.svc"
+    assert wenv["RANK"] == "2"  # index+1
+
+
+def test_pytorch_services_only_for_master():
+    ctrl = PyTorchJobController()
+    job = make_job(PyTorchJob, "PyTorchJob", "pytorchReplicaSpecs",
+                   {"Master": 1, "Worker": 2}, "pytorch")
+    store, _ = reconcile_once(ctrl, job)
+    services = store.list("Service")
+    assert [s.metadata.name for s in services] == ["job1-master-0"]
+
+
+def test_pytorch_requires_master():
+    ctrl = PyTorchJobController()
+    job = make_job(PyTorchJob, "PyTorchJob", "pytorchReplicaSpecs", {"Worker": 1}, "pytorch")
+    store = ObjectStore()
+    engine = JobReconciler(store, ctrl)
+    ctrl.engine = engine
+    created = store.create(job)
+    with pytest.raises(ValueError):
+        engine.reconcile(created.key)
+
+
+def test_pytorch_default_restart_policies():
+    ctrl = PyTorchJobController()
+    job = make_job(PyTorchJob, "PyTorchJob", "pytorchReplicaSpecs",
+                   {"Master": 1, "Worker": 1}, "pytorch")
+    ctrl.set_defaults(job)
+    assert job.spec.replica_specs["Master"].restart_policy == RestartPolicy.EXIT_CODE
+    assert job.spec.replica_specs["Worker"].restart_policy == RestartPolicy.ON_FAILURE
+
+
+# ---------------------------------------------------------------------------
+# XGBoostJob
+# ---------------------------------------------------------------------------
+
+
+def test_xgboost_rabit_env_and_defaults():
+    ctrl = XGBoostJobController()
+    job = make_job(XGBoostJob, "XGBoostJob", "xgbReplicaSpecs",
+                   {"Master": 1, "Worker": 2}, "xgboostjob")
+    ctrl.set_defaults(job)
+    assert job.spec.run_policy.ttl_seconds_after_finished == 100
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.NONE
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "job1-worker-0")
+    assert env["MASTER_ADDR"] == "job1-master-0.default.svc"
+    assert env["MASTER_PORT"] == "9999"
+    assert env["WORLD_SIZE"] == "3"
+    assert env["RANK"] == "0"  # xgboost rank is plain index (no +1)
+
+
+# ---------------------------------------------------------------------------
+# XDLJob
+# ---------------------------------------------------------------------------
+
+
+def test_xdl_env_task_name_and_zk_suffix():
+    ctrl = XDLJobController()
+    job = make_job(XDLJob, "XDLJob", "xdlReplicaSpecs",
+                   {"PS": 1, "Scheduler": 1, "Worker": 2}, "xdl")
+    job.spec.replica_specs["Worker"].template.spec.containers[0].env["ZK_ADDR"] = (
+        "zk://zk-service:2181"
+    )
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "job1-worker-1")
+    assert env["TASK_NAME"] == "worker"
+    assert env["TASK_INDEX"] == "1"
+    assert env["ZK_ADDR"] == "zk://zk-service:2181/uid-123"
+    assert env["KUBEDL_SPARSECORE"] == "1"
+    # coordinator is the scheduler when present
+    assert env["KUBEDL_COORDINATOR_ADDRESS"].startswith("job1-scheduler-0.")
+
+
+def test_xdl_min_finish_success():
+    ctrl = XDLJobController()
+    job = make_job(XDLJob, "XDLJob", "xdlReplicaSpecs", {"Worker": 10}, "xdl",
+                   extra_spec={"minFinishWorkRate": 50})
+    store, engine = reconcile_once(ctrl, job)
+    pods = store.list("Pod")
+    assert len(pods) == 10
+    for i, pod in enumerate(pods):
+        pod.status.phase = PodPhase.SUCCEEDED if i < 5 else PodPhase.RUNNING
+        store.update(pod)
+    engine.expectations.delete_expectations("default/job1/worker/pods")
+    engine.expectations.delete_expectations("default/job1/worker/services")
+    engine.reconcile("default/job1")
+    assert is_succeeded(store.get("XDLJob", "default", "job1").status)
+
+
+def test_xdl_default_min_finish_is_90_pct():
+    ctrl = XDLJobController()
+    job = make_job(XDLJob, "XDLJob", "xdlReplicaSpecs", {"Worker": 10}, "xdl")
+    ctrl.set_defaults(job)
+    assert job.spec.run_policy.success_policy.min_finish(10) == 9
+    assert job.spec.run_policy.backoff_limit == 20
+
+
+# ---------------------------------------------------------------------------
+# JAXJob
+# ---------------------------------------------------------------------------
+
+
+def test_jaxjob_coordinator_and_mesh_env():
+    ctrl = JAXJobController()
+    job = from_dict(JAXJob, {
+        "metadata": {"name": "job1"},
+        "spec": {
+            "jaxReplicaSpecs": {"Worker": {"replicas": 4, "template": {
+                "spec": {"containers": [container_manifest("jax")]}}}},
+            "mesh": {"data": 2, "fsdp": 2, "context": 1},
+            "checkpoint": {"path": "/ckpt/job1", "saveIntervalSteps": 100},
+        },
+    })
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "job1-worker-2")
+    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "job1-worker-0.default.svc:8471"
+    assert env["KUBEDL_NUM_PROCESSES"] == "4"
+    assert env["KUBEDL_PROCESS_ID"] == "2"
+    assert env["KUBEDL_MESH"] == "data=2,fsdp=2,tensor=1,context=1,expert=1"
+    assert env["KUBEDL_CHECKPOINT_PATH"] == "/ckpt/job1"
+    assert env["KUBEDL_CHECKPOINT_INTERVAL"] == "100"
+
+
+def test_jaxjob_defaults():
+    ctrl = JAXJobController()
+    job = from_dict(JAXJob, {
+        "metadata": {"name": "job1"},
+        "spec": {"jaxReplicaSpecs": {"worker": {"template": {
+            "spec": {"containers": [container_manifest("jax")]}}}}},
+    })
+    ctrl.set_defaults(job)
+    spec = job.spec.replica_specs["Worker"]
+    assert spec.replicas == 1
+    assert spec.restart_policy == RestartPolicy.EXIT_CODE
+    assert job.spec.run_policy.backoff_limit == 10
+
+
+# ---------------------------------------------------------------------------
+# registry / workload gate
+# ---------------------------------------------------------------------------
+
+
+def test_registry_and_gate():
+    kinds = sorted(c.kind for c in enabled_controllers("*"))
+    assert kinds == ["JAXJob", "PyTorchJob", "TFJob", "XDLJob", "XGBoostJob"]
+    kinds = sorted(c.kind for c in enabled_controllers("*,-xdl"))
+    assert "XDLJob" not in kinds and len(kinds) == 4
+    kinds = sorted(c.kind for c in enabled_controllers("tensorflow,jax"))
+    assert kinds == ["JAXJob", "TFJob"]
