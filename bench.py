@@ -1,0 +1,153 @@
+"""Benchmark — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md): p50 job-launch delay through the full
+operator stack (job created -> first pod Ready), against the reference
+north-star target of 60 s on GKE. Extras: flagship Llama training
+throughput and MNIST steps/s on the real chip (measured in a subprocess so
+a wedged TPU tunnel degrades to the control-plane metric instead of
+hanging the bench).
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+BASELINE_LAUNCH_DELAY_S = 60.0  # BASELINE.json north star: p50 < 60 s
+
+
+def bench_launch_delay(jobs: int = 5):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from kubedl_tpu.operator import Operator, OperatorConfig
+    from fake_workload import TEST_KIND, TestJobController
+
+    op = Operator(OperatorConfig())
+    op.register(TestJobController())
+    op.start()
+    delays = []
+    try:
+        for i in range(jobs):
+            name = f"bench-{i}"
+            manifest = {
+                "kind": TEST_KIND,
+                "metadata": {"name": name},
+                "spec": {"replicaSpecs": {"Worker": {
+                    "replicas": 2, "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [{
+                        # long enough for the Running transition (and its
+                        # launch-delay observation) to be reconciled
+                        "name": "test-container", "command": ["/bin/sh", "-c", "sleep 0.5"],
+                    }]}},
+                }}},
+            }
+            job = op.apply(manifest)
+            op.wait_for_condition(job, "Succeeded", timeout=30)
+        jm = op.metrics_registry.get(TEST_KIND)
+        delays = [d for _, d in jm.first_launch_delays]
+    finally:
+        op.stop()
+    return statistics.median(delays) if delays else None
+
+
+_LLAMA_SNIPPET = r"""
+import json, time, sys
+import jax, jax.numpy as jnp, numpy as np, optax
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+from kubedl_tpu.parallel.train_step import make_train_step
+
+config = llama.LlamaConfig(
+    vocab_size=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=16,
+    d_ff=5632, max_seq_len=2048, remat=True)
+rules = ShardingRules()
+mesh = build_mesh({"data": len(jax.devices())})
+params = llama.init(config, jax.random.PRNGKey(0))
+spec_tree = llama.param_specs(config, rules)
+
+def loss(params, batch):
+    return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
+
+init_state, train_step = make_train_step(
+    loss, optax.adamw(3e-4), mesh, spec_tree, rules.spec("batch", None), rules)
+state = init_state(params)
+BATCH, SEQ = 8, 2049
+tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, config.vocab_size)
+state, metrics = train_step(state, tokens)  # compile
+jax.block_until_ready(metrics["loss"])
+STEPS = 10
+t0 = time.perf_counter()
+for _ in range(STEPS):
+    state, metrics = train_step(state, tokens)
+jax.block_until_ready(metrics["loss"])
+dt = time.perf_counter() - t0
+tok_s = STEPS * BATCH * (SEQ - 1) / dt
+nparams = llama.param_count(state.params)
+flops_per_tok = 6 * nparams
+mfu_denom = 197e12  # v5e bf16 peak flop/s per chip
+print(json.dumps({
+    "llama_tokens_per_sec": tok_s,
+    "llama_params": nparams,
+    "llama_step_s": dt / STEPS,
+    "llama_mfu": tok_s * flops_per_tok / mfu_denom,
+    "device": str(jax.devices()[0]),
+}))
+"""
+
+_MNIST_SNIPPET = r"""
+import json, time
+import sys
+from kubedl_tpu.train import mnist
+import io, contextlib
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    mnist.main(["--steps", "200", "--batch", "512"])
+line = buf.getvalue().strip().splitlines()[-1]
+sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
+print(json.dumps({"mnist_steps_per_sec": sps}))
+"""
+
+
+def _run_snippet(snippet: str, timeout: float):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.abspath(__file__)) + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        if proc.returncode != 0:
+            return {"error": (proc.stderr or "")[-300:]}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+        return {"error": "no json output"}
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout"}
+
+
+def main() -> int:
+    extras = {}
+    p50 = bench_launch_delay()
+    extras["llama"] = _run_snippet(_LLAMA_SNIPPET, timeout=600)
+    extras["mnist"] = _run_snippet(_MNIST_SNIPPET, timeout=300)
+
+    result = {
+        "metric": "job_launch_delay_p50",
+        "value": round(p50, 6) if p50 is not None else None,
+        "unit": "s",
+        "vs_baseline": round(BASELINE_LAUNCH_DELAY_S / p50, 1) if p50 else None,
+        "extras": extras,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

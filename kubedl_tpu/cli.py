@@ -18,6 +18,7 @@ import time
 import yaml
 
 from kubedl_tpu.api.common import JobConditionType, has_condition, is_failed, is_succeeded
+from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.operator import Operator, OperatorConfig
 from kubedl_tpu.server import OperatorHTTPServer
 
@@ -61,8 +62,10 @@ def cmd_run(args) -> int:
                 kind, ns, name = key
                 try:
                     fresh = op.store.get(kind, ns, name)
-                except Exception:
+                except NotFound:
+                    print(f"{kind} {ns}/{name}: deleted before completion")
                     pending.discard(key)
+                    rc = 1
                     continue
                 if is_succeeded(fresh.status):
                     print(f"{kind} {ns}/{name}: Succeeded")

@@ -68,10 +68,22 @@ class BaseWorkloadController(WorkloadController):
     def default_clean_pod_policy(self):
         return CleanPodPolicy.RUNNING
 
+    # Manifest replica-type key canonicalization, e.g. {"worker": "Worker"}
+    # (ref api/*/defaults.go camel-casing); applied by set_defaults.
+    replica_key_map: Dict[str, str] = {}
+
     # -- defaulting (ref api/*/defaults.go) ------------------------------
 
     def set_defaults(self, job) -> None:
         specs = self.replica_specs(job)
+        for key in list(specs):
+            canonical = self.replica_key_map.get(key.lower())
+            if canonical and canonical != key:
+                if canonical in specs:
+                    raise ValueError(
+                        f"replica specs contain both {key!r} and {canonical!r}"
+                    )
+                specs[canonical] = specs.pop(key)
         for rtype, spec in specs.items():
             if spec.replicas is None:
                 spec.replicas = 1

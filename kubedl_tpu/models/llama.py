@@ -1,0 +1,252 @@
+"""Llama-family decoder — the flagship JAXJob workload (BASELINE.json
+config 4: "Llama-7B SPMD pretrain on v5p-32").
+
+Pure-functional JAX: params are a pytree of arrays, the forward is a plain
+jittable function, and every tensor carries a logical sharding spec
+(parallel/mesh.ShardingRules) so one model definition runs 1-chip or
+dp/fsdp/tp/cp-sharded unchanged — XLA inserts the collectives.
+
+TPU-first choices:
+  * bf16 params/activations, f32 RMSNorm epsilon path and logits
+    (MXU-friendly, HBM-light);
+  * attention via the Pallas flash kernel (ops/flash_attention.py) on a
+    single context shard, or ring attention (ops/ring_attention.py) when the
+    mesh's "context" axis > 1;
+  * per-layer jax.checkpoint (remat) to trade FLOPs for HBM on long
+    sequences;
+  * weights laid out so tensor-parallel matmuls contract over the sharded
+    dim exactly once (wo/w2 row-sharded -> one psum per block).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubedl_tpu.ops.flash_attention import flash_attention
+from kubedl_tpu.ops.ring_attention import ring_attention
+from kubedl_tpu.parallel.mesh import ShardingRules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash: bool = True
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test/dry-run size."""
+        defaults = dict(
+            vocab_size=256, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=256, max_seq_len=256,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def bench_1b() -> "LlamaConfig":
+        """~1.1B params — fits one v5e chip (16 GB HBM) in bf16 + optimizer."""
+        return LlamaConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, d_ff=5632, max_seq_len=2048,
+        )
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def param_specs(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> Dict:
+    """PartitionSpec pytree matching init() — the sharding contract."""
+    r = rules or ShardingRules()
+    layer = {
+        "attn_norm": r.spec("embed"),
+        "wq": r.spec("embed", "heads"),
+        "wk": r.spec("embed", "heads"),
+        "wv": r.spec("embed", "heads"),
+        "wo": r.spec("heads", "embed"),
+        "mlp_norm": r.spec("embed"),
+        "w1": r.spec("embed", "mlp"),
+        "w3": r.spec("embed", "mlp"),
+        "w2": r.spec("mlp", "embed"),
+    }
+    specs = {
+        "embed": r.spec("vocab", "embed"),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "final_norm": r.spec("embed"),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = r.spec("embed", "vocab")
+    return specs
+
+
+def init(config: LlamaConfig, key: jax.Array) -> Dict:
+    """Initialize the param pytree (truncated-normal fan-in scaling)."""
+    d, dff, hd = config.d_model, config.d_ff, config.head_dim
+    nq, nkv = config.n_heads, config.n_kv_heads
+    dt = config.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    keys = jax.random.split(key, config.n_layers + 3)
+    layers = []
+    for i in range(config.n_layers):
+        ks = jax.random.split(keys[i], 7)
+        layers.append({
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wq": dense(ks[0], (d, nq * hd), d),
+            "wk": dense(ks[1], (d, nkv * hd), d),
+            "wv": dense(ks[2], (d, nkv * hd), d),
+            "wo": dense(ks[3], (nq * hd, d), nq * hd),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "w1": dense(ks[4], (d, dff), d),
+            "w3": dense(ks[5], (d, dff), d),
+            "w2": dense(ks[6], (dff, d), dff),
+        })
+    params = {
+        "embed": dense(keys[-3], (config.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[-2], (d, config.vocab_size), d)
+    return params
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, weight, eps):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """Rotary embeddings over [b, h, t, d_head]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, None, :, :]  # [b, 1, t, half]
+    sin = jnp.sin(angles)[:, None, :, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, context_size):
+    b, t, d = x.shape
+    hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps)
+    q = (h @ layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
+    k = (h @ layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    v = (h @ layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    if nq != nkv:
+        rep = nq // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if context_size > 1:
+        attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+    elif config.use_flash:
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        from kubedl_tpu.ops.flash_attention import attention_reference
+
+        attn = attention_reference(q, k, v, causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
+    return x + (attn @ layer["wo"]).astype(x.dtype)
+
+
+def _mlp_block(x, layer, config: LlamaConfig):
+    h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(h.dtype)
+    up = h @ layer["w3"]
+    return x + ((gate * up) @ layer["w2"]).astype(x.dtype)
+
+
+def forward(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+) -> jax.Array:
+    """Logits [batch, seq, vocab] (f32)."""
+    rules = rules or ShardingRules()
+    context_size = 1
+    if mesh is not None:
+        context_size = mesh.shape.get("context", 1)
+
+    def constrain(x, *dims):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
+
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    x = params["embed"][tokens].astype(config.dtype)
+    x = constrain(x, "batch", "seq", None)
+
+    def layer_fn(x, layer):
+        x = _attention_block(x, layer, config, positions, mesh, rules, context_size)
+        x = constrain(x, "batch", "seq", None)
+        x = _mlp_block(x, layer, config)
+        return constrain(x, "batch", "seq", None)
+
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    for layer in params["layers"]:
+        x = layer_fn(x, layer)
+
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(config.dtype)
+    logits = (x @ head).astype(jnp.float32)
+    return constrain(logits, "batch", "seq", "vocab")
+
+
+def loss_fn(params, tokens, config: LlamaConfig, mesh=None, rules=None):
+    """Next-token cross entropy; tokens [b, t], loss over tokens[:, 1:]."""
+    logits = forward(params, tokens[:, :-1], config, mesh=mesh, rules=rules)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

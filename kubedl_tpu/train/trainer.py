@@ -1,0 +1,168 @@
+"""Trainer — the JAXJob workload runtime (what the operator launches).
+
+Ties the compute path together: coordinator bootstrap from injected env
+(train/coordinator.py) -> mesh from KUBEDL_MESH (parallel/mesh.py) -> Llama
+model (models/llama.py) -> sharded train step (parallel/train_step.py) ->
+Orbax checkpointing with preemption-safe save/resume.
+
+Checkpoint/resume is first-class (SURVEY.md §5 — the reference delegates it
+entirely to training code): SIGTERM (TPU maintenance/preemption surfaces as
+SIGTERM, ref pkg/util/train/train_util.go semantics) triggers a final save
+and exit with the retryable preemption code, so the operator's ExitCode
+policy restarts the pod and the trainer resumes from the latest step.
+
+Usage (as a pod command):
+    python -m kubedl_tpu.train.trainer --model tiny --steps 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-1b", "llama-7b"])
+    p.add_argument("--steps", type=int, default=int(os.environ.get("KUBEDL_STEPS", 100)))
+    p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
+    p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--checkpoint-interval",
+                   type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_INTERVAL", 0)))
+    p.add_argument("--checkpoint-keep",
+                   type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_KEEP", 3)))
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+    from kubedl_tpu.utils.exit_codes import EXIT_TPU_PREEMPTED, EXIT_XLA_COMPILE_ERROR
+
+    info = coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    config = {
+        "tiny": llama.LlamaConfig.tiny(),
+        "bench-1b": llama.LlamaConfig.bench_1b(),
+        "llama-7b": llama.LlamaConfig.llama_7b(),
+    }[args.model]
+
+    mesh = build_mesh(parse_mesh_env())
+    rules = ShardingRules()
+    print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
+          f"model={args.model} params≈{config.n_layers}L/{config.d_model}d", flush=True)
+
+    # preemption flag flipped by SIGTERM
+    preempted = {"flag": False}
+
+    def on_sigterm(signum, frame):
+        preempted["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    params = llama.init(config, jax.random.PRNGKey(0))
+    spec_tree = llama.param_specs(config, rules)
+
+    def loss(params, batch):
+        return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
+
+    tx = optax.adamw(args.lr, weight_decay=0.01)
+    try:
+        init_state, train_step = make_train_step(
+            loss, tx, mesh, spec_tree, rules.spec("batch", None), rules
+        )
+        state = init_state(params)
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e) or "XlaRuntimeError" in type(e).__name__:
+            print(f"compile/alloc failure: {e}", file=sys.stderr)
+            return EXIT_XLA_COMPILE_ERROR
+        raise
+
+    # checkpointing (Orbax)
+    mngr = None
+    start_step = 0
+    if args.checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=args.checkpoint_keep, create=True
+        )
+        mngr = ocp.CheckpointManager(args.checkpoint_path, options=options)
+        latest = mngr.latest_step()
+        if latest is not None and os.environ.get("KUBEDL_CHECKPOINT_RESTORE", "1") == "1":
+            # Restore straight into the SHARDED state: the live arrays act
+            # as the abstract target, so each leaf comes back with its
+            # param_specs sharding instead of landing replicated on one
+            # device (mandatory for models that only fit sharded).
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+            state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+            start_step = int(state.step)
+            print(f"restored checkpoint at step {start_step}", flush=True)
+
+    def save(step, final=False):
+        if mngr is None:
+            return
+        if mngr.latest_step() == step:
+            return  # already saved by the interval hook
+        import orbax.checkpoint as ocp
+
+        mngr.save(step, args=ocp.args.StandardSave(state))
+        mngr.wait_until_finished()
+        if final:
+            print(f"saved final checkpoint at step {step}", flush=True)
+
+    rng = np.random.default_rng(info.process_id)
+    tokens_per_step = args.batch * (args.seq_len - 1)
+
+    t_start = time.perf_counter()
+    last_log = t_start
+    for step in range(start_step, args.steps):
+        batch = jnp.asarray(
+            rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
+        )
+        state, metrics = train_step(state, batch)
+        if preempted["flag"]:
+            jax.block_until_ready(metrics["loss"])
+            save(step + 1, final=True)
+            print("preempted: checkpoint saved, exiting retryable", flush=True)
+            return EXIT_TPU_PREEMPTED
+        if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
+            jax.block_until_ready(metrics["loss"])
+            save(step + 1)
+        if (step + 1) % args.log_every == 0:
+            loss_v = float(metrics["loss"])
+            now = time.perf_counter()
+            sps = args.log_every / (now - last_log)
+            last_log = now
+            print(f"step {step + 1}: loss={loss_v:.4f} "
+                  f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
+
+    jax.block_until_ready(state.step)
+    total = time.perf_counter() - t_start
+    steps_done = args.steps - start_step
+    print(f"done: {steps_done} steps in {total:.1f}s "
+          f"({steps_done / total:.2f} step/s, "
+          f"{steps_done * tokens_per_step / total:.0f} tok/s)", flush=True)
+    save(args.steps, final=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

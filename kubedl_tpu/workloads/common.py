@@ -67,21 +67,52 @@ def add_env(pod_template, env: Dict[str, str]) -> None:
             c.env.setdefault(k, v)
 
 
+def global_rank(
+    replica_specs: Dict[str, ReplicaSpec],
+    order: list,
+    coordinator_rtype: str,
+    rtype: str,
+    index: int,
+) -> int:
+    """Globally-unique process id with the coordinator replica pinned to 0.
+
+    jax.distributed requires process 0 to host the coordination service at
+    the advertised address, so the rank ordering puts the coordinator's
+    replica type first, then the remaining types in the controller's
+    reconcile order.
+    """
+    ordered = [coordinator_rtype] + [
+        t for t in order if t != coordinator_rtype and t in replica_specs
+    ]
+    rank = 0
+    for t in ordered:
+        spec = replica_specs.get(t)
+        if spec is None:
+            continue
+        if t == rtype:
+            return rank + int(index)
+        rank += int(spec.replicas or 0)
+    return rank + int(index)
+
+
 def inject_coordinator_env(
     job, pod_template, rtype: str, index: int,
     replica_specs: Dict[str, ReplicaSpec],
     coordinator_rtype: str,
-    global_rank: int,
+    order: list,
 ) -> None:
-    """The ONE rendezvous scheme for TPU-native workloads: worker-0 (or the
-    designated coordinator replica) hosts the JAX coordination service; every
-    process gets its address, the world size, and its own process id."""
+    """The ONE rendezvous scheme for TPU-native workloads: the coordinator
+    replica's index-0 pod hosts the JAX coordination service; every process
+    gets its address, the world size, and a unique process id where id 0 IS
+    the pod at that address."""
     addr = f"{service_dns(job, coordinator_rtype, 0)}:{COORDINATOR_PORT}"
     add_env(
         pod_template,
         {
             ENV_COORDINATOR_ADDRESS: addr,
             ENV_NUM_PROCESSES: str(get_total_replicas(replica_specs)),
-            ENV_PROCESS_ID: str(global_rank),
+            ENV_PROCESS_ID: str(
+                global_rank(replica_specs, order, coordinator_rtype, rtype, index)
+            ),
         },
     )

@@ -94,6 +94,8 @@ class JAXJobController(BaseWorkloadController):
     default_port_name = "jaxjob-port"
     default_port = common.COORDINATOR_PORT
 
+    replica_key_map = _CANONICAL
+
     def job_type(self):
         return JAXJob
 
@@ -101,11 +103,6 @@ class JAXJobController(BaseWorkloadController):
         return job.spec.replica_specs
 
     def set_defaults(self, job) -> None:
-        specs = job.spec.replica_specs
-        for key in list(specs):
-            canonical = _CANONICAL.get(key.lower())
-            if canonical and canonical != key:
-                specs[canonical] = specs.pop(key)
         super().set_defaults(job)
         if job.spec.run_policy.backoff_limit is None:
             # preemptions are routine on TPU; retry generously
@@ -134,7 +131,7 @@ class JAXJobController(BaseWorkloadController):
         common.add_env(pod_template, env)
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,
-            REPLICA_WORKER, int(index),
+            REPLICA_WORKER, [str(rt.value) for rt in self.reconcile_orders()],
         )
 
 

@@ -59,19 +59,13 @@ class PyTorchJobController(BaseWorkloadController):
     default_port_name = "pytorchjob-port"
     default_port = 23456
 
+    replica_key_map = _CANONICAL
+
     def job_type(self):
         return PyTorchJob
 
     def replica_specs(self, job):
         return job.spec.replica_specs
-
-    def set_defaults(self, job) -> None:
-        specs = job.spec.replica_specs
-        for key in list(specs):
-            canonical = _CANONICAL.get(key.lower())
-            if canonical and canonical != key:
-                specs[canonical] = specs.pop(key)
-        super().set_defaults(job)
 
     def default_restart_policy(self, rtype: str) -> RestartPolicy:
         # ref constants.go:26-36
@@ -127,7 +121,7 @@ class PyTorchJobController(BaseWorkloadController):
         )
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,
-            REPLICA_MASTER, rank,
+            REPLICA_MASTER, [str(rt.value) for rt in self.reconcile_orders()],
         )
 
 

@@ -71,19 +71,13 @@ class TFJobController(BaseWorkloadController):
     default_port_name = "tfjob-port"
     default_port = 2222
 
+    replica_key_map = _CANONICAL
+
     def job_type(self):
         return TFJob
 
     def replica_specs(self, job):
         return job.spec.replica_specs
-
-    def set_defaults(self, job) -> None:
-        specs = job.spec.replica_specs
-        for key in list(specs):
-            canonical = _CANONICAL.get(key.lower())
-            if canonical and canonical != key:
-                specs[canonical] = specs.pop(key)
-        super().set_defaults(job)
 
     def default_restart_policy(self, rtype: str) -> RestartPolicy:
         return RestartPolicy.EXIT_CODE
@@ -136,7 +130,8 @@ class TFJobController(BaseWorkloadController):
                 "environment": "cloud",
             }
             common.add_env(pod_template, {"TF_CONFIG": json.dumps(tf_config)})
-        # TPU-native coordinator wiring: chief/master/worker-0 coordinates.
+        # TPU-native coordinator wiring: chief/master/worker-0 coordinates
+        # (and is therefore process id 0 — see common.global_rank).
         coordinator_rt = REPLICA_WORKER
         for mt in (REPLICA_CHIEF, REPLICA_MASTER):
             if mt in job.spec.replica_specs:
@@ -144,21 +139,8 @@ class TFJobController(BaseWorkloadController):
                 break
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,
-            coordinator_rt, self._global_rank(job, rtype, index),
+            coordinator_rt, [str(rt.value) for rt in self.reconcile_orders()],
         )
-
-    def _global_rank(self, job, rtype: str, index: int) -> int:
-        """Stable global process id: replicas ordered by reconcile order."""
-        rank = 0
-        for rt in self.reconcile_orders():
-            key = str(rt.value)
-            spec = job.spec.replica_specs.get(key)
-            if spec is None:
-                continue
-            if key == rtype:
-                return rank + int(index)
-            rank += int(spec.replicas or 0)
-        return rank + int(index)
 
 
 register_workload("tensorflow", TFJobController)

@@ -85,6 +85,8 @@ class XDLJobController(BaseWorkloadController):
     default_port_name = "xdl-port"
     default_port = 2222
 
+    replica_key_map = _CANONICAL
+
     def job_type(self):
         return XDLJob
 
@@ -92,11 +94,6 @@ class XDLJobController(BaseWorkloadController):
         return job.spec.replica_specs
 
     def set_defaults(self, job) -> None:
-        specs = job.spec.replica_specs
-        for key in list(specs):
-            canonical = _CANONICAL.get(key.lower())
-            if canonical and canonical != key:
-                specs[canonical] = specs.pop(key)
         super().set_defaults(job)
         rp = job.spec.run_policy
         if rp.backoff_limit is None:
@@ -153,7 +150,7 @@ class XDLJobController(BaseWorkloadController):
         )
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,
-            coordinator_rt, int(index),
+            coordinator_rt, [str(rt.value) for rt in self.reconcile_orders()],
         )
 
 

@@ -59,6 +59,8 @@ class XGBoostJobController(BaseWorkloadController):
     default_port_name = "xgboostjob-port"
     default_port = 9999
 
+    replica_key_map = _CANONICAL
+
     def job_type(self):
         return XGBoostJob
 
@@ -66,11 +68,6 @@ class XGBoostJobController(BaseWorkloadController):
         return job.spec.replica_specs
 
     def set_defaults(self, job) -> None:
-        specs = job.spec.replica_specs
-        for key in list(specs):
-            canonical = _CANONICAL.get(key.lower())
-            if canonical and canonical != key:
-                specs[canonical] = specs.pop(key)
         super().set_defaults(job)
         rp = job.spec.run_policy
         if rp.ttl_seconds_after_finished is None:
@@ -106,7 +103,7 @@ class XGBoostJobController(BaseWorkloadController):
         )
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,
-            REPLICA_MASTER, int(index),
+            REPLICA_MASTER, [str(rt.value) for rt in self.reconcile_orders()],
         )
 
 

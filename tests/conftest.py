@@ -1,11 +1,30 @@
-"""Test bootstrap: force CPU JAX with 8 virtual devices so multi-chip
-sharding logic is exercised without TPUs (SURVEY.md §4 implication)."""
+"""Test bootstrap: force a REAL CPU JAX backend with 8 virtual devices.
+
+The environment injects a sitecustomize that registers a remote-TPU PJRT
+plugin and programmatically sets jax_platforms="axon,cpu" — right for bench,
+wrong for tests, which must be hermetic and exercise multi-chip sharding on
+a virtual CPU mesh (SURVEY.md §4). sitecustomize already ran (and imported
+jax) by the time this conftest loads, so we flip the config back to
+cpu-only and clear any initialized backends; XLA_FLAGS must be set before
+the CPU client is (re)created.
+"""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    if _xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -25,6 +25,15 @@ def load_example(name):
         return yaml.safe_load(f)
 
 
+CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    # empty value disables the environment's TPU sitecustomize hook so the
+    # training subprocess gets a hermetic CPU JAX
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
 def force_cpu(manifest, replica_field):
     """Pods inherit our env; pin the training subprocess to JAX CPU so tests
     don't touch the real TPU (and keep steps small)."""
@@ -32,8 +41,7 @@ def force_cpu(manifest, replica_field):
         for c in spec["template"]["spec"]["containers"]:
             c.setdefault("env", {})
             if isinstance(c["env"], dict):
-                c["env"]["JAX_PLATFORMS"] = "cpu"
-                c["env"]["XLA_FLAGS"] = ""
+                c["env"].update(CPU_ENV)
             c["command"] = [sys.executable, "-m", "kubedl_tpu.train.mnist", "--steps", "10"]
     return manifest
 
