@@ -36,6 +36,9 @@ def _mk_operator(args) -> Operator:
             gang_scheduler_name=args.gang_scheduler_name,
             tpu_slices=args.tpu_slices,
             workloads=args.workloads,
+            object_storage=args.object_storage,
+            event_storage=args.event_storage,
+            storage_db_path=args.storage_db_path,
         )
     )
 
@@ -144,6 +147,13 @@ def main(argv=None) -> int:
     parser.add_argument("--gang", action="store_true", help="enable gang scheduling")
     parser.add_argument("--tpu-slices", nargs="*", default=[],
                         help="TPU pool, e.g. v5e-8 v5p-32")
+    # persistence flags (ref --object-storage/--event-storage, persist_controller.go:30-74)
+    parser.add_argument("--object-storage", default="",
+                        help="object history backend name, e.g. sqlite")
+    parser.add_argument("--event-storage", default="",
+                        help="event history backend name, e.g. sqlite")
+    parser.add_argument("--storage-db-path", default=":memory:",
+                        help="database path for the sqlite backend")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p_run = sub.add_parser("run", help="run job manifests to completion locally")

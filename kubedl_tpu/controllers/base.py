@@ -32,6 +32,7 @@ from kubedl_tpu.api.common import (
     RestartPolicy,
     is_failed,
     is_restarting,
+    is_succeeded,
     replica_key,
     update_job_conditions,
 )
@@ -168,6 +169,11 @@ class BaseWorkloadController(WorkloadController):
                     if self.engine is not None and not previous_restarting:
                         if self.engine.metrics:
                             self.engine.metrics.failure_inc()
+                        if self.engine.recorder:
+                            self.engine.recorder.warning(
+                                job, REASON_JOB_RESTARTING,
+                                f"{self.kind} {job.metadata.name} is restarting.",
+                            )
                 else:
                     if status.completion_time is None:
                         status.completion_time = now()
@@ -179,6 +185,12 @@ class BaseWorkloadController(WorkloadController):
                     if self.engine is not None and not previous_failed:
                         if self.engine.metrics:
                             self.engine.metrics.failure_inc()
+                        if self.engine.recorder:
+                            self.engine.recorder.warning(
+                                job, REASON_JOB_FAILED,
+                                f"{self.kind} {job.metadata.name} failed: "
+                                f"{failed} {rtype} replica(s) failed.",
+                            )
 
     def _min_finish(self, job, total_workers: int) -> int:
         rp = self.run_policy(job)
@@ -187,14 +199,21 @@ class BaseWorkloadController(WorkloadController):
         return total_workers
 
     def _mark_succeeded(self, job, status: JobStatus) -> None:
+        previous_succeeded = is_succeeded(status)
         if status.completion_time is None:
             status.completion_time = now()
         update_job_conditions(
             status, JobConditionType.SUCCEEDED, REASON_JOB_SUCCEEDED,
             f"{self.kind} {job.metadata.name} successfully completed.",
         )
-        if self.engine is not None and self.engine.metrics:
-            self.engine.metrics.success_inc()
+        if self.engine is not None and not previous_succeeded:
+            if self.engine.metrics:
+                self.engine.metrics.success_inc()
+            if self.engine.recorder:
+                self.engine.recorder.normal(
+                    job, REASON_JOB_SUCCEEDED,
+                    f"{self.kind} {job.metadata.name} successfully completed.",
+                )
 
     def _worker0_completed(self, job) -> bool:
         """Ref controllers/tensorflow/status.go:62-101."""

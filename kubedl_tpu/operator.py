@@ -15,6 +15,7 @@ registry, and optional storage persistence. Usage:
 """
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -43,6 +44,13 @@ class OperatorConfig:
     workloads: str = "*"
     cluster_domain: str = ""
     run_executor: bool = True
+    # persistence flags, ref persist_controller.go:30-74 (--object-storage /
+    # --event-storage + REGION env); backend names resolve via the storage
+    # registry ("sqlite" built in). Empty string disables.
+    object_storage: str = ""
+    event_storage: str = ""
+    storage_db_path: str = ":memory:"
+    region: str = field(default_factory=lambda: os.environ.get("REGION", ""))
 
 
 class Operator:
@@ -62,6 +70,11 @@ class Operator:
         self.reconcilers: Dict[str, JobReconciler] = {}
         self._kind_by_lower: Dict[str, str] = {}
         self._started = False
+        # storage persistence (ref main.go:97-100): backends resolved at
+        # start() so every registered workload gets a persist controller
+        self.object_backend = None
+        self.event_backend = None
+        self._persist_controllers: List = []
 
     # -- registration ----------------------------------------------------
 
@@ -99,14 +112,55 @@ class Operator:
         if self._started:
             return
         self._started = True
+        self._setup_persistence()
         if self.executor is not None:
             self.executor.start()
         self.manager.start()
+
+    def _setup_persistence(self) -> None:
+        if not (self.config.object_storage or self.config.event_storage):
+            return
+        from kubedl_tpu.controllers.persist import setup_persist_controllers
+        from kubedl_tpu.storage import registry as storage_registry
+
+        if self.config.object_storage:
+            self.object_backend = storage_registry.new_object_backend(
+                self.config.object_storage, db_path=self.config.storage_db_path
+            )
+            self.object_backend.initialize()
+        if self.config.event_storage:
+            # share the object backend when both flags name the same backend
+            # and it implements the event role too (sqlite does)
+            if (
+                self.config.event_storage == self.config.object_storage
+                and hasattr(self.object_backend, "save_event")
+            ):
+                self.event_backend = self.object_backend
+            else:
+                self.event_backend = storage_registry.new_event_backend(
+                    self.config.event_storage, db_path=self.config.storage_db_path
+                )
+                self.event_backend.initialize()
+        workload_controllers = {
+            kind: engine.controller for kind, engine in self.reconcilers.items()
+        }
+        self._persist_controllers = setup_persist_controllers(
+            self.manager,
+            self.store,
+            workload_controllers,
+            object_backend=self.object_backend,
+            event_backend=self.event_backend,
+            region=self.config.region,
+        )
 
     def stop(self) -> None:
         self.manager.stop()
         if self.executor is not None:
             self.executor.stop()
+        if self.object_backend is not None:
+            self.object_backend.close()
+        if self.event_backend is not None and self.event_backend is not self.object_backend:
+            self.event_backend.close()
 
     # -- client-ish helpers ---------------------------------------------
 

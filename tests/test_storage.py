@@ -1,0 +1,322 @@
+"""Storage subsystem tests — converters (golden, ref §4 item 6), the SQLite
+backend's upsert/stop/soft-delete/pagination semantics (ref mysql.go), and
+the persist controllers mirroring a live job end-to-end."""
+import json
+import sys
+import time
+
+import pytest
+
+from kubedl_tpu.api.common import (
+    ANNOTATION_TENANCY,
+    LABEL_REPLICA_TYPE,
+    JobCondition,
+    JobConditionType,
+    JobStatus,
+    ReplicaSpec,
+)
+from kubedl_tpu.api.meta import ObjectMeta, OwnerReference
+from kubedl_tpu.api.pod import (
+    Container,
+    ContainerStateTerminated,
+    ContainerStatus,
+    Pod,
+    PodPhase,
+    PodSpec,
+    PodStatus,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubedl_tpu.storage import Query, QueryPagination, SQLiteBackend
+from kubedl_tpu.storage.converters import (
+    NoDependentOwner,
+    NoReplicaTypeLabel,
+    compute_pod_resources,
+    convert_job_to_dmo_job,
+    convert_pod_to_dmo_pod,
+)
+from kubedl_tpu.storage.dmo import STATUS_STOPPED
+from kubedl_tpu.utils.tenancy import get_tenancy
+
+from fake_workload import TEST_KIND, make_test_job
+
+
+def make_pod(name="job-worker-0", phase=PodPhase.RUNNING, owner_uid="juid", exit_code=None):
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=name,
+            namespace="default",
+            uid="puid-" + name,
+            resource_version=3,
+            creation_timestamp=100.0,
+            labels={LABEL_REPLICA_TYPE: "Worker"},
+            owner_references=[
+                OwnerReference(kind=TEST_KIND, name="job", uid=owner_uid, controller=True)
+            ],
+        ),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    name="test-container",
+                    image="img:v1",
+                    resources=ResourceRequirements(
+                        requests={"cpu": 2.0}, limits={"google.com/tpu": 4}
+                    ),
+                )
+            ]
+        ),
+        status=PodStatus(phase=phase, start_time=101.0),
+    )
+    term = None
+    if exit_code is not None:
+        term = ContainerStateTerminated(
+            exit_code=exit_code, reason="Error" if exit_code else "Completed",
+            finished_at=105.0,
+        )
+    pod.status.container_statuses = [
+        ContainerStatus(name="test-container", terminated=term)
+    ]
+    return pod
+
+
+# -- converters ----------------------------------------------------------
+
+
+def test_compute_pod_resources_sums_main_maxes_init():
+    spec = PodSpec(
+        containers=[
+            Container(resources=ResourceRequirements(requests={"cpu": 1, "memory": 4})),
+            Container(resources=ResourceRequirements(requests={"cpu": 2})),
+        ],
+        init_containers=[
+            Container(resources=ResourceRequirements(requests={"cpu": 8})),
+            Container(resources=ResourceRequirements(requests={"cpu": 5})),
+        ],
+    )
+    res = compute_pod_resources(spec)
+    # main containers sum to cpu=3, init max is 8 -> elementwise max = 8
+    assert res["requests"] == {"cpu": 8, "memory": 4}
+
+
+def test_convert_pod_running():
+    row = convert_pod_to_dmo_pod(make_pod(), "test-container", region="us-central2")
+    assert row.job_id == "juid"
+    assert row.replica_type == "Worker"
+    assert row.status == "Running"
+    assert row.image == "img:v1"
+    assert row.gmt_started == 101.0
+    assert row.deploy_region == "us-central2"
+    assert json.loads(row.resources)["limits"]["google.com/tpu"] == 4
+
+
+def test_convert_pod_failed_captures_exit_code_remark():
+    row = convert_pod_to_dmo_pod(
+        make_pod(phase=PodPhase.FAILED, exit_code=137), "test-container"
+    )
+    assert row.status == "Failed"
+    assert "ExitCode: 137" in row.remark
+    assert row.gmt_finished == 105.0
+
+
+def test_convert_pod_requires_owner_and_replica_label():
+    pod = make_pod()
+    pod.metadata.owner_references = []
+    with pytest.raises(NoDependentOwner):
+        convert_pod_to_dmo_pod(pod, "test-container")
+    pod = make_pod()
+    pod.metadata.labels = {}
+    with pytest.raises(NoReplicaTypeLabel):
+        convert_pod_to_dmo_pod(pod, "test-container")
+
+
+def test_convert_job_latest_condition_and_tenancy():
+    job = make_test_job(name="conv-job", workers=2)
+    job.metadata.uid = "juid"
+    job.metadata.creation_timestamp = 50.0
+    job.metadata.annotations[ANNOTATION_TENANCY] = json.dumps(
+        {"tenant": "team-a", "user": "alice", "region": "eu-west4"}
+    )
+    status = JobStatus(
+        conditions=[
+            JobCondition(type=JobConditionType.CREATED),
+            JobCondition(type=JobConditionType.RUNNING),
+        ]
+    )
+    row = convert_job_to_dmo_job(job, TEST_KIND, job.spec.replica_specs, status)
+    assert row.status == "Running"  # latest condition wins
+    assert row.tenant == "team-a" and row.owner == "alice"
+    assert row.deploy_region == "eu-west4"  # tenancy region fallback
+    res = json.loads(row.resources)
+    assert res["Worker"]["replicas"] == 2
+
+
+def test_convert_job_no_conditions_defaults_created():
+    job = make_test_job(name="fresh")
+    row = convert_job_to_dmo_job(job, TEST_KIND, job.spec.replica_specs, JobStatus())
+    assert row.status == "Created"
+    assert row.tenant == "" and row.owner == ""
+
+
+def test_tenancy_parse_roundtrip():
+    job = make_test_job(name="t")
+    assert get_tenancy(job) is None
+    job.metadata.annotations[ANNOTATION_TENANCY] = '{"tenant":"x","user":"y"}'
+    tn = get_tenancy(job)
+    assert (tn.tenant, tn.user) == ("x", "y")
+    job.metadata.annotations[ANNOTATION_TENANCY] = "{bad"
+    with pytest.raises(ValueError):
+        get_tenancy(job)
+
+
+# -- sqlite backend ------------------------------------------------------
+
+
+@pytest.fixture()
+def backend():
+    b = SQLiteBackend()
+    b.initialize()
+    yield b
+    b.close()
+
+
+def test_save_pod_upsert_and_stop(backend):
+    pod = make_pod()
+    backend.save_pod(pod, "test-container")
+    backend.save_pod(pod, "test-container")  # idempotent upsert
+    rows = backend.list_pods("juid")
+    assert len(rows) == 1
+
+    # stale write (older resourceVersion) must not clobber the newer record
+    pod.metadata.resource_version = 9
+    pod.status.phase = PodPhase.SUCCEEDED
+    backend.save_pod(pod, "test-container")
+    stale = make_pod()
+    stale.metadata.resource_version = 2
+    backend.save_pod(stale, "test-container")
+    assert backend.list_pods("juid")[0].status == "Succeeded"
+
+    backend.stop_pod("default", pod.metadata.name, pod.metadata.uid)
+    row = backend.list_pods("juid")[0]
+    assert row.status == "Succeeded"  # terminal status preserved
+    assert row.is_in_etcd == 0
+
+    # a non-terminal pod becomes Stopped
+    running = make_pod(name="job-worker-1")
+    backend.save_pod(running, "test-container")
+    backend.stop_pod("default", "job-worker-1", running.metadata.uid)
+    by_name = {r.name: r for r in backend.list_pods("juid")}
+    assert by_name["job-worker-1"].status == STATUS_STOPPED
+    assert by_name["job-worker-1"].gmt_finished is not None
+
+
+def test_job_save_get_stop_delete(backend):
+    job = make_test_job(name="sql-job")
+    job.metadata.uid = "juid-1"
+    job.metadata.creation_timestamp = 10.0
+    status = JobStatus(conditions=[JobCondition(type=JobConditionType.RUNNING)])
+    backend.save_job(job, TEST_KIND, job.spec.replica_specs, status)
+
+    row = backend.get_job("default", "sql-job", "juid-1")
+    assert row.status == "Running" and row.kind == TEST_KIND
+
+    backend.stop_job("default", "sql-job", "juid-1")
+    assert backend.get_job("default", "sql-job", "juid-1").status == STATUS_STOPPED
+
+    backend.delete_job("default", "sql-job", "juid-1")
+    row = backend.get_job("default", "sql-job", "juid-1")
+    assert row.deleted == 1 and row.is_in_etcd == 0  # soft delete: row survives
+
+    with pytest.raises(KeyError):
+        backend.get_job("default", "nope", "x")
+
+
+def test_list_jobs_filters_and_pagination(backend):
+    for i in range(5):
+        job = make_test_job(name=f"list-job-{i}")
+        job.metadata.uid = f"uid-{i}"
+        job.metadata.creation_timestamp = 100.0 + i
+        cond = JobConditionType.SUCCEEDED if i % 2 == 0 else JobConditionType.RUNNING
+        backend.save_job(
+            job, TEST_KIND, job.spec.replica_specs,
+            JobStatus(conditions=[JobCondition(type=cond)]),
+        )
+
+    assert len(backend.list_jobs(Query(status="Succeeded"))) == 3
+    assert len(backend.list_jobs(Query(start_time=102.0))) == 3
+    assert len(backend.list_jobs(Query(name="list-job"))) == 5
+
+    page = QueryPagination(page_num=2, page_size=2)
+    rows = backend.list_jobs(Query(pagination=page))
+    assert page.count == 5
+    # newest-first ordering: page 2 of size 2 holds jobs created at 102, 101
+    assert [r.gmt_created for r in rows] == [102.0, 101.0]
+
+
+def test_event_save_and_list(backend):
+    from kubedl_tpu.core.events import Event, ObjectReference
+
+    ev = Event(
+        metadata=ObjectMeta(name="e1", namespace="default"),
+        involved_object=ObjectReference(kind=TEST_KIND, namespace="default", name="j"),
+        reason="JobCreated",
+        message="created",
+        first_timestamp=10.0,
+        last_timestamp=10.0,
+    )
+    backend.save_event(ev)
+    ev.count = 3
+    ev.last_timestamp = 20.0
+    backend.save_event(ev)  # dedup by (namespace, name): update count
+    rows = backend.list_events("default", "j")
+    assert len(rows) == 1 and rows[0].count == 3
+    assert backend.list_events("default", "j", from_ts=25.0) == []
+
+
+# -- persist controllers e2e ---------------------------------------------
+
+
+def test_persist_mirrors_job_lifecycle(tmp_path):
+    from kubedl_tpu.operator import Operator, OperatorConfig
+    from fake_workload import TestJobController
+
+    db = str(tmp_path / "history.db")
+    op = Operator(
+        OperatorConfig(object_storage="sqlite", event_storage="sqlite",
+                       storage_db_path=db)
+    )
+    op.register(TestJobController())
+    op.start()
+    try:
+        manifest = {
+            "kind": TEST_KIND,
+            "metadata": {"name": "persist-job"},
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "test-container",
+                    "command": [sys.executable, "-c", "pass"],
+                }]}},
+            }}},
+        }
+        job = op.apply(manifest)
+        assert op.wait_for_condition(job, "Succeeded", timeout=30)
+        op.manager.wait_idle(timeout=10)
+
+        backend = op.object_backend
+        row = backend.get_job("default", "persist-job", job.metadata.uid)
+        assert row.kind == TEST_KIND
+        assert row.status in ("Succeeded",)
+        pods = backend.list_pods(job.metadata.uid)
+        # label values are lowercased by the engine (ref GenLabels)
+        assert len(pods) == 1 and pods[0].replica_type == "worker"
+
+        events = op.event_backend.list_events("default", "persist-job")
+        assert any(e.reason == "JobSucceeded" for e in events)
+
+        # deletion closes out history but keeps rows (soft delete)
+        op.store.delete(TEST_KIND, "default", "persist-job")
+        op.manager.wait_idle(timeout=10)
+        row = backend.get_job("default", "persist-job", job.metadata.uid)
+        assert row.deleted == 1 and row.is_in_etcd == 0
+    finally:
+        op.stop()
