@@ -211,7 +211,12 @@ class JobReconciler:
             self.gang.create_gang(job, replicas)
 
         if self.code_syncer is not None:
-            self.code_syncer.inject(job, replicas)
+            # a bad annotation must not wedge the reconcile loop
+            # (ref job.go:99-103 logs and continues on code-sync errors)
+            try:
+                self.code_syncer.inject(job, replicas)
+            except Exception as e:
+                self.recorder.warning(job, "FailedCodeSync", f"code-sync injection failed: {e}")
 
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)

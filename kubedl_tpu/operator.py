@@ -80,12 +80,15 @@ class Operator:
 
     def register(self, controller) -> JobReconciler:
         """Register one workload controller (ref controllers/controllers.go:31-47)."""
+        from kubedl_tpu.codesync import CodeSyncer
+
         engine = JobReconciler(
             self.store,
             controller,
             recorder=self.recorder,
             metrics=self.metrics_registry.for_kind(controller.kind),
             gang_scheduler=self._gang,
+            code_syncer=CodeSyncer(),
             config=EngineConfig(
                 enable_gang_scheduling=self.config.enable_gang_scheduling,
                 cluster_domain=self.config.cluster_domain,

@@ -101,10 +101,11 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
     def name(self) -> str:
         return "sqlite"
 
-    def _execute(self, sql: str, params=()) -> sqlite3.Cursor:
+    def _execute(self, sql: str, params=(), commit: bool = False) -> sqlite3.Cursor:
         assert self._conn is not None, "backend not initialized"
         cur = self._conn.execute(sql, params)
-        self._conn.commit()
+        if commit:
+            self._conn.commit()
         return cur
 
     def _upsert(self, table: str, cls, row, key_fields: List[str]) -> None:
@@ -125,6 +126,7 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
                     f"INSERT INTO {table} ({','.join(cols)}) "
                     f"VALUES ({','.join('?' for _ in cols)})",
                     [data[c] for c in cols],
+                    commit=True,
                 )
                 return
             try:
@@ -136,6 +138,7 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
             self._execute(
                 f"UPDATE {table} SET {sets} WHERE id=?",
                 [data[c] for c in cols] + [existing["id"]],
+                commit=True,
             )
 
     def _stop_record(
@@ -160,6 +163,7 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
                 f"UPDATE {table} SET status=?, gmt_finished=?, gmt_modified=?{extra} "
                 "WHERE id=?",
                 (status, finished, time.time(), row["id"]),
+                commit=True,
             )
 
     # -- pods ------------------------------------------------------------
@@ -260,6 +264,7 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
                 "UPDATE job_info SET deleted=1, is_in_etcd=0, gmt_modified=? "
                 "WHERE namespace=? AND name=? AND job_id=?",
                 (time.time(), namespace, name, job_id),
+                commit=True,
             )
 
     # -- events ----------------------------------------------------------
@@ -279,11 +284,13 @@ class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
                     f"INSERT INTO event_info ({','.join(cols)}) "
                     f"VALUES ({','.join('?' for _ in cols)})",
                     [data[c] for c in cols],
+                    commit=True,
                 )
             else:
                 self._execute(
                     "UPDATE event_info SET count=?, last_timestamp=?, message=? WHERE id=?",
                     (row.count, row.last_timestamp, row.message, existing["id"]),
+                    commit=True,
                 )
 
     def list_events(

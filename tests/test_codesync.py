@@ -1,0 +1,184 @@
+"""Code-sync tests — injection unit tests (ref pkg/code_sync behavior) and a
+real end-to-end clone through the operator + local executor."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubedl_tpu.api.common import ANNOTATION_GIT_SYNC_CONFIG
+from kubedl_tpu.codesync import (
+    DEFAULT_CODE_ROOT_PATH,
+    DEFAULT_GIT_SYNC_IMAGE,
+    GIT_SYNC_CONTAINER_NAME,
+    GIT_SYNC_VOLUME_NAME,
+    CodeSyncer,
+    GitSyncOptions,
+)
+
+from fake_workload import TEST_KIND, TestJobController, make_test_job
+
+
+def sync_config(**overrides):
+    cfg = {"source": "https://github.com/example/my-project.git"}
+    cfg.update(overrides)
+    return json.dumps(cfg)
+
+
+def test_options_defaults():
+    opts = GitSyncOptions.parse(sync_config())
+    opts.set_defaults()
+    assert opts.root_path == DEFAULT_CODE_ROOT_PATH
+    assert opts.dest_path == "my-project"  # project name, .git stripped
+    assert opts.image == DEFAULT_GIT_SYNC_IMAGE
+    assert opts.max_failures == 3
+
+
+def test_sync_envs_contract():
+    opts = GitSyncOptions.parse(sync_config(
+        branch="main", revision="abc123", depth="1",
+        user="bob", password="pw", ssh=True, sshFile="/keys/id",
+    ))
+    opts.set_defaults()
+    envs = opts.sync_envs()
+    assert envs["GIT_SYNC_REPO"] == "https://github.com/example/my-project.git"
+    assert envs["GIT_SYNC_ONE_TIME"] == "true"  # init container must exit
+    assert envs["GIT_SYNC_BRANCH"] == "main"
+    assert envs["GIT_SYNC_REV"] == "abc123"
+    assert envs["GIT_SYNC_DEPTH"] == "1"
+    assert envs["GIT_SYNC_ROOT"] == DEFAULT_CODE_ROOT_PATH
+    assert envs["GIT_SYNC_DEST"] == "my-project"
+    assert envs["GIT_SYNC_SSH"] == "true"
+    assert envs["GIT_SSH_KEY_FILE"] == "/keys/id"
+    assert envs["GIT_SYNC_USERNAME"] == "bob"
+    assert envs["GIT_SYNC_PASSWORD"] == "pw"
+
+
+def test_inject_adds_init_container_volume_and_mounts():
+    job = make_test_job(name="sync-job", workers=2, masters=1)
+    job.metadata.annotations[ANNOTATION_GIT_SYNC_CONFIG] = sync_config()
+    for spec in job.spec.replica_specs.values():
+        spec.template.spec.containers[0].working_dir = "/workspace"
+        spec.template.spec.containers[0].resources.requests["cpu"] = 4.0
+
+    CodeSyncer().inject(job, job.spec.replica_specs)
+
+    for spec in job.spec.replica_specs.values():
+        ps = spec.template.spec
+        assert [c.name for c in ps.init_containers] == [GIT_SYNC_CONTAINER_NAME]
+        # clone container inherits the main container's resources
+        assert ps.init_containers[0].resources.requests["cpu"] == 4.0
+        assert any(v.name == GIT_SYNC_VOLUME_NAME for v in ps.volumes)
+        mounts = ps.containers[0].volume_mounts
+        assert any(
+            m.name == GIT_SYNC_VOLUME_NAME and m.mount_path == "/workspace/my-project"
+            for m in mounts
+        )
+    # idempotent within a pass
+    CodeSyncer().inject(job, job.spec.replica_specs)
+    for spec in job.spec.replica_specs.values():
+        assert len(spec.template.spec.init_containers) == 1
+
+
+def test_inject_noop_without_annotation():
+    job = make_test_job(name="plain-job")
+    CodeSyncer().inject(job, job.spec.replica_specs)
+    for spec in job.spec.replica_specs.values():
+        assert spec.template.spec.init_containers == []
+
+
+def test_inject_requires_source():
+    job = make_test_job(name="bad-job")
+    job.metadata.annotations[ANNOTATION_GIT_SYNC_CONFIG] = "{}"
+    with pytest.raises(ValueError):
+        CodeSyncer().inject(job, job.spec.replica_specs)
+
+
+def test_bad_annotation_does_not_wedge_reconcile():
+    """A malformed git-sync config must not poison the job's reconcile loop:
+    the job still runs, with a FailedCodeSync warning event recorded."""
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    op = Operator(OperatorConfig())
+    op.register(TestJobController())
+    op.start()
+    try:
+        manifest = {
+            "kind": TEST_KIND,
+            "metadata": {
+                "name": "bad-sync-job",
+                "annotations": {ANNOTATION_GIT_SYNC_CONFIG: "{not json"},
+            },
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "test-container",
+                    "command": [sys.executable, "-c", "pass"],
+                }]}},
+            }}},
+        }
+        job = op.apply(manifest)
+        assert op.wait_for_condition(job, "Succeeded", timeout=30)
+        events = [e for e in op.store.list("Event") if e.reason == "FailedCodeSync"]
+        assert events, "expected a FailedCodeSync warning event"
+    finally:
+        op.stop()
+
+
+@pytest.fixture()
+def local_git_repo(tmp_path):
+    repo = tmp_path / "upstream"
+    repo.mkdir()
+    env = dict(os.environ,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, env=env, check=True,
+                       capture_output=True)
+    git("init", "-q", "-b", "main")
+    (repo / "train.py").write_text("print('hello from synced code')\n")
+    git("add", "train.py")
+    git("commit", "-q", "-m", "init")
+    return str(repo)
+
+
+def test_e2e_git_sync_clones_before_main_container(local_git_repo, tmp_path):
+    """Full path: annotation -> injected init container -> real git clone ->
+    main container sees the checkout via the shared volume."""
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    marker = tmp_path / "seen.txt"
+    op = Operator(OperatorConfig())
+    op.register(TestJobController())
+    op.start()
+    try:
+        # main container proves the clone happened before it ran
+        probe = (
+            "import os, shutil, sys;"
+            "src = os.path.join(os.environ['KUBEDL_VOLUME_GIT_SYNC'], 'upstream', 'train.py');"
+            f"shutil.copy(src, {str(marker)!r})"
+        )
+        manifest = {
+            "kind": TEST_KIND,
+            "metadata": {
+                "name": "git-job",
+                "annotations": {
+                    ANNOTATION_GIT_SYNC_CONFIG: json.dumps(
+                        {"source": local_git_repo, "branch": "main"}
+                    )
+                },
+            },
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "test-container",
+                    "command": [sys.executable, "-c", probe],
+                }]}},
+            }}},
+        }
+        job = op.apply(manifest)
+        assert op.wait_for_condition(job, "Succeeded", timeout=60)
+        assert marker.read_text() == "print('hello from synced code')\n"
+    finally:
+        op.stop()
