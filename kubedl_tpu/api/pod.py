@@ -63,6 +63,8 @@ class Container:
 class VolumeMount:
     name: str = ""
     mount_path: str = ""
+    # mount only this subdirectory of the volume (k8s volumeMounts.subPath)
+    sub_path: str = ""
 
 
 @dataclass

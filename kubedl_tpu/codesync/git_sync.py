@@ -23,7 +23,7 @@ def _git(args, cwd=None, env=None):
 
 
 def sync_once(repo: str, root: str, dest: str, branch: str, rev: str, depth: str,
-              user: str, password: str) -> None:
+              user: str, password: str, ssh_key_file: str = "") -> None:
     os.makedirs(root, exist_ok=True)
     target = os.path.join(root, dest)
     if os.path.isdir(os.path.join(target, ".git")):
@@ -31,6 +31,12 @@ def sync_once(repo: str, root: str, dest: str, branch: str, rev: str, depth: str
 
     env = dict(os.environ)
     env.setdefault("GIT_TERMINAL_PROMPT", "0")
+    if ssh_key_file:
+        import shlex
+
+        env["GIT_SSH_COMMAND"] = (
+            f"ssh -i {shlex.quote(ssh_key_file)} -o StrictHostKeyChecking=accept-new"
+        )
     askpass = None
     if user and password:
         # credentials go through an ephemeral GIT_ASKPASS helper — never in
@@ -88,15 +94,19 @@ def main() -> int:
     depth = os.environ.get("GIT_SYNC_DEPTH", "")
     user = os.environ.get("GIT_SYNC_USERNAME", "")
     password = os.environ.get("GIT_SYNC_PASSWORD", "")
+    ssh_key_file = ""
+    if os.environ.get("GIT_SYNC_SSH", "").lower() == "true":
+        ssh_key_file = os.environ.get("GIT_SSH_KEY_FILE", "")
     max_failures = int(os.environ.get("GIT_SYNC_MAX_SYNC_FAILURES", "3"))
 
     attempt = 0
     while True:
         try:
-            sync_once(repo, root, dest, branch, rev, depth, user, password)
+            sync_once(repo, root, dest, branch, rev, depth, user, password,
+                      ssh_key_file=ssh_key_file)
             print(f"synced {repo} -> {os.path.join(root, dest)}")
             return 0
-        except RuntimeError as e:
+        except (RuntimeError, OSError) as e:
             attempt += 1
             print(f"sync attempt {attempt} failed: {e}", file=sys.stderr)
             if attempt > max_failures:

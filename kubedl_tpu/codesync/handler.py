@@ -13,7 +13,6 @@ from __future__ import annotations
 import copy
 import json
 import posixpath
-import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -110,21 +109,24 @@ class GitSyncOptions:
 class GitSyncHandler:
     """Builds the clone init container (ref gitSyncHandler.InitContainer)."""
 
-    def init_container(self, raw_config: str, volume_name: str) -> Tuple[Container, str]:
+    def init_container(
+        self, raw_config: str, volume_name: str
+    ) -> Tuple[Container, GitSyncOptions]:
         opts = GitSyncOptions.parse(raw_config)
         if not opts.source:
             raise ValueError("git-sync config requires 'source'")
         opts.set_defaults()
+        # command left empty so the git-sync image's own entrypoint runs on a
+        # cluster; the local executor (which has no image runtime) recognizes
+        # the GIT_SYNC_REPO env and substitutes the native sync runner
+        # (executor/local.py), keeping one injected spec valid for both.
         container = Container(
             name=GIT_SYNC_CONTAINER_NAME,
             image=opts.image,
-            # native sync path for the local executor; ignored when the pod
-            # runs on a cluster with the real git-sync image
-            command=[sys.executable, "-m", "kubedl_tpu.codesync.git_sync"],
             env=opts.sync_envs(),
             volume_mounts=[VolumeMount(name=volume_name, mount_path=opts.root_path)],
         )
-        return container, opts.dest_path
+        return container, opts
 
 
 class CodeSyncer:
@@ -138,7 +140,8 @@ class CodeSyncer:
         raw = (job.metadata.annotations or {}).get(ANNOTATION_GIT_SYNC_CONFIG)
         if not raw:
             return
-        init_container, dest = self._git.init_container(raw, GIT_SYNC_VOLUME_NAME)
+        init_container, opts = self._git.init_container(raw, GIT_SYNC_VOLUME_NAME)
+        dest = opts.dest_path
         for spec in replicas.values():
             pod_spec = spec.template.spec
             if any(c.name == GIT_SYNC_CONTAINER_NAME for c in pod_spec.init_containers):
@@ -151,9 +154,14 @@ class CodeSyncer:
             pod_spec.init_containers.append(ic)
             pod_spec.volumes.append(Volume(name=GIT_SYNC_VOLUME_NAME, kind="emptyDir"))
             for c in pod_spec.containers:
+                # subPath so the checkout itself (volume-root/dest) lands at
+                # workingDir/dest, not workingDir/dest/dest; containers with
+                # no workingDir fall back to the absolute sync root so the
+                # mountPath is never relative (k8s rejects relative paths)
                 c.volume_mounts.append(
                     VolumeMount(
                         name=GIT_SYNC_VOLUME_NAME,
-                        mount_path=posixpath.join(c.working_dir or "", dest),
+                        mount_path=posixpath.join(c.working_dir or opts.root_path, dest),
+                        sub_path=dest,
                     )
                 )

@@ -19,6 +19,7 @@ import os
 import shutil
 import signal
 import subprocess
+import sys
 import tempfile
 import threading
 import time
@@ -253,7 +254,13 @@ class LocalPodExecutor:
             env["PYTHONPATH"] = f"{pkg_parent}{os.pathsep}{existing}" if existing else pkg_parent
         argv = list(container.command) + list(container.args)
         if not argv:
-            argv = ["true"]
+            if "GIT_SYNC_REPO" in container.env:
+                # an injected git-sync init container relies on its image
+                # entrypoint on a cluster; locally there is no image, so run
+                # the native sync runner (codesync/git_sync.py) instead
+                argv = [sys.executable, "-m", "kubedl_tpu.codesync.git_sync"]
+            else:
+                argv = ["true"]
         cwd = container.working_dir or entry.workdir
         proc = subprocess.Popen(
             argv, env=env, cwd=cwd,

@@ -21,15 +21,17 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubedl_tpu.models.moe import moe_init, moe_mlp, moe_param_specs
 from kubedl_tpu.ops.flash_attention import flash_attention
 from kubedl_tpu.ops.ring_attention import ring_attention
+from kubedl_tpu.parallel import pipeline
 from kubedl_tpu.parallel.mesh import ShardingRules
 
 
@@ -48,6 +50,12 @@ class LlamaConfig:
     remat: bool = True
     use_flash: bool = True
     tie_embeddings: bool = False
+    # MoE (expert parallelism over the "expert" mesh axis): n_experts=0 means
+    # dense FFN; >0 replaces every FFN with a top-k-routed expert layer
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -91,10 +99,15 @@ def param_specs(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> D
         "wv": r.spec("embed", "heads"),
         "wo": r.spec("heads", "embed"),
         "mlp_norm": r.spec("embed"),
-        "w1": r.spec("embed", "mlp"),
-        "w3": r.spec("embed", "mlp"),
-        "w2": r.spec("mlp", "embed"),
     }
+    if config.n_experts > 0:
+        layer["moe"] = moe_param_specs(r)
+    else:
+        layer.update({
+            "w1": r.spec("embed", "mlp"),
+            "w3": r.spec("embed", "mlp"),
+            "w2": r.spec("mlp", "embed"),
+        })
     specs = {
         "embed": r.spec("vocab", "embed"),
         "layers": [dict(layer) for _ in range(config.n_layers)],
@@ -119,17 +132,23 @@ def init(config: LlamaConfig, key: jax.Array) -> Dict:
     layers = []
     for i in range(config.n_layers):
         ks = jax.random.split(keys[i], 7)
-        layers.append({
+        layer = {
             "attn_norm": jnp.ones((d,), jnp.float32),
             "wq": dense(ks[0], (d, nq * hd), d),
             "wk": dense(ks[1], (d, nkv * hd), d),
             "wv": dense(ks[2], (d, nkv * hd), d),
             "wo": dense(ks[3], (nq * hd, d), nq * hd),
             "mlp_norm": jnp.ones((d,), jnp.float32),
-            "w1": dense(ks[4], (d, dff), d),
-            "w3": dense(ks[5], (d, dff), d),
-            "w2": dense(ks[6], (dff, d), dff),
-        })
+        }
+        if config.n_experts > 0:
+            layer["moe"] = moe_init(ks[4], d, dff, config.n_experts, dtype=dt)
+        else:
+            layer.update({
+                "w1": dense(ks[4], (d, dff), d),
+                "w3": dense(ks[5], (d, dff), d),
+                "w2": dense(ks[6], (dff, d), dff),
+            })
+        layers.append(layer)
     params = {
         "embed": dense(keys[-3], (config.vocab_size, d), d),
         "layers": layers,
@@ -194,21 +213,28 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
     return x + (attn @ layer["wo"]).astype(x.dtype)
 
 
-def _mlp_block(x, layer, config: LlamaConfig):
+def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
+    """Dense or MoE FFN; returns (out, aux_loss)."""
     h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    if "moe" in layer:
+        y, aux = moe_mlp(
+            h, layer["moe"], top_k=config.expert_top_k,
+            capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
+        )
+        return x + y.astype(x.dtype), aux
     gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(h.dtype)
     up = h @ layer["w3"]
-    return x + ((gate * up) @ layer["w2"]).astype(x.dtype)
+    return x + ((gate * up) @ layer["w2"]).astype(x.dtype), jnp.zeros((), jnp.float32)
 
 
-def forward(
+def forward_and_aux(
     params: Dict,
     tokens: jax.Array,  # [batch, seq] int32
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
     rules: Optional[ShardingRules] = None,
-) -> jax.Array:
-    """Logits [batch, seq, vocab] (f32)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits [batch, seq, vocab] f32, summed MoE aux loss — 0 when dense)."""
     rules = rules or ShardingRules()
     context_size = 1
     if mesh is not None:
@@ -224,29 +250,115 @@ def forward(
     x = params["embed"][tokens].astype(config.dtype)
     x = constrain(x, "batch", "seq", None)
 
-    def layer_fn(x, layer):
+    def layer_fn(carry, layer):
+        x, aux = carry
         x = _attention_block(x, layer, config, positions, mesh, rules, context_size)
         x = constrain(x, "batch", "seq", None)
-        x = _mlp_block(x, layer, config)
-        return constrain(x, "batch", "seq", None)
+        x, a = _mlp_block(x, layer, config, mesh, rules)
+        return constrain(x, "batch", "seq", None), aux + a
 
     if config.remat:
         layer_fn = jax.checkpoint(layer_fn)
+    aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
-        x = layer_fn(x, layer)
+        x, aux = layer_fn((x, aux), layer)
 
     x = rms_norm(x, params["final_norm"], config.rms_eps)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T.astype(config.dtype)
     logits = (x @ head).astype(jnp.float32)
-    return constrain(logits, "batch", "seq", "vocab")
+    return constrain(logits, "batch", "seq", "vocab"), aux
 
 
-def loss_fn(params, tokens, config: LlamaConfig, mesh=None, rules=None):
-    """Next-token cross entropy; tokens [b, t], loss over tokens[:, 1:]."""
-    logits = forward(params, tokens[:, :-1], config, mesh=mesh, rules=rules)
-    targets = tokens[:, 1:]
+def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.Array:
+    """Logits [batch, seq, vocab] (f32)."""
+    return forward_and_aux(params, tokens, config, mesh=mesh, rules=rules)[0]
+
+
+def _next_token_ce(logits, targets):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def loss_fn(params, tokens, config: LlamaConfig, mesh=None, rules=None):
+    """Next-token cross entropy (+ MoE aux); tokens [b, t], loss over [:, 1:]."""
+    logits, aux = forward_and_aux(params, tokens[:, :-1], config, mesh=mesh, rules=rules)
+    return _next_token_ce(logits, tokens[:, 1:]) + config.moe_aux_coef * aux
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel path ("stage" mesh axis; SURVEY.md §2.4 PP row)
+# ---------------------------------------------------------------------------
+
+
+def param_specs_pp(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> Dict:
+    """Spec pytree matching stack_params(): layer leaves gain a leading
+    layer dim sharded over "stage"."""
+    r = rules or ShardingRules()
+    base = param_specs(config, r)
+    layer0 = base["layers"][0]
+    base["layers"] = jax.tree_util.tree_map(
+        lambda s: P(*(r.rules["layers"] + tuple(s))), layer0,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return base
+
+
+def stack_params(params: Dict) -> Dict:
+    """Per-layer list-of-dicts -> stacked leaves [n_layers, ...] for the
+    pipelined forward (parallel/pipeline.py layout)."""
+    out = dict(params)
+    out["layers"] = pipeline.stack_layers(params["layers"])
+    return out
+
+
+def forward_pipelined(
+    params: Dict,  # stacked layout (stack_params)
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    n_microbatches: int = 4,
+) -> jax.Array:
+    """GPipe forward over the mesh's "stage" axis. Composes with data
+    parallelism; tensor/context/expert must be size 1 on a pipelined mesh
+    (those shardings need manual collectives inside shard_map)."""
+    if config.n_experts > 0:
+        raise ValueError("pipelined path requires dense FFN (n_experts=0)")
+    for ax in ("tensor", "context", "expert"):
+        if mesh.shape.get(ax, 1) != 1:
+            raise ValueError(f"pipelined mesh must have {ax}=1, got {mesh.shape[ax]}")
+    rules = rules or ShardingRules()
+    b, t = tokens.shape
+    positions1 = jnp.arange(t, dtype=jnp.int32)[None]
+
+    x = params["embed"][tokens].astype(config.dtype)
+
+    def layer_fn(a, layer):
+        pos = jnp.broadcast_to(positions1, (a.shape[0], t))
+        a = _attention_block(a, layer, config, pos, None, rules, 1)
+        a, _ = _mlp_block(a, layer, config)
+        return a
+
+    x = pipeline.microbatch(x, n_microbatches)
+    y = pipeline.pipeline_apply(
+        params["layers"], x, layer_fn, mesh=mesh, remat=config.remat
+    )
+    x = pipeline.unmicrobatch(y)
+
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(config.dtype)
+    return (x @ head).astype(jnp.float32)
+
+
+def loss_fn_pp(
+    params, tokens, config: LlamaConfig, mesh: Mesh, rules=None, n_microbatches: int = 4
+):
+    logits = forward_pipelined(
+        params, tokens[:, :-1], config, mesh, rules=rules, n_microbatches=n_microbatches
+    )
+    return _next_token_ce(logits, tokens[:, 1:])

@@ -1,0 +1,155 @@
+"""Mixture-of-Experts FFN with expert parallelism — the "expert" mesh axis.
+
+The reference has no expert parallelism (SURVEY.md §2.4: "Expert parallelism
+(EP): absent"); this is the net-new TPU-native path behind the JAXJob mesh
+spec's `expert` axis. Design is the GShard/Switch dense-dispatch recipe —
+the shape XLA pipelines best on TPU — rather than gather/scatter send-recv:
+
+  * top-k gating with a fixed per-expert capacity C (static shape — no
+    data-dependent shapes under jit);
+  * dispatch/combine are one-hot einsums: `[S,E,C] x [S,d] -> [E,C,d]`.
+    With tokens sharded over data/fsdp and the expert dim sharded over the
+    "expert" mesh axis, the sharding constraint on the `[E,C,d]` buffer
+    makes XLA insert the all-to-all over ICI — no hand-written collective;
+  * per-expert FFN is one batched einsum over the expert dim — E local
+    matmuls on each expert shard, MXU-shaped;
+  * auxiliary load-balance loss (mean-prob x mean-assignment, GShard
+    eq. (4)-style) keeps the router from collapsing.
+
+Tokens overflowing an expert's capacity are dropped (contribute zero) and
+their residual path passes through — standard Switch behavior.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from kubedl_tpu.parallel.mesh import ShardingRules
+
+
+def moe_param_specs(rules: Optional[ShardingRules] = None) -> Dict:
+    """PartitionSpec pytree matching moe_init() for one MoE FFN layer."""
+    r = rules or ShardingRules()
+    return {
+        "router": r.spec("embed", "expert"),
+        "w1": r.spec("expert", "embed", "mlp"),
+        "w3": r.spec("expert", "embed", "mlp"),
+        "w2": r.spec("expert", "mlp", "embed"),
+    }
+
+
+def moe_init(
+    key: jax.Array, d_model: int, d_ff: int, n_experts: int, dtype=jnp.bfloat16
+) -> Dict:
+    ks = jax.random.split(key, 4)
+
+    def dense(k, shape, fan_in):
+        return (
+            jax.random.truncated_normal(k, -2, 2, shape, jnp.float32)
+            * (1.0 / np.sqrt(fan_in))
+        ).astype(dtype)
+
+    return {
+        # router stays f32: tiny, and gating is precision-sensitive
+        "router": (
+            jax.random.truncated_normal(ks[0], -2, 2, (d_model, n_experts), jnp.float32)
+            * (1.0 / np.sqrt(d_model))
+        ),
+        "w1": dense(ks[1], (n_experts, d_model, d_ff), d_model),
+        "w3": dense(ks[2], (n_experts, d_model, d_ff), d_model),
+        "w2": dense(ks[3], (n_experts, d_ff, d_model), d_ff),
+    }
+
+
+def expert_capacity(
+    n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    return max(1, int(np.ceil(top_k * n_tokens / n_experts * capacity_factor)))
+
+
+def _top_k_gating(
+    gate_logits: jax.Array,  # [S, E] f32
+    top_k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (dispatch [S,E,C], combine [S,E,C], aux_loss scalar)."""
+    s, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+
+    # iterative top-k: pick argmax, mask, repeat (k is tiny and static)
+    remaining = probs
+    masks, gates = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        masks.append(onehot)
+        gates.append(jnp.sum(probs * onehot, axis=-1))
+        remaining = remaining * (1.0 - onehot)
+
+    # load-balance aux: E * mean(prob) . mean(top-1 assignment)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux_loss = e * jnp.sum(me * ce)
+
+    # per-expert slot assignment in token order, k=0 choices first
+    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
+    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    pos_offset = jnp.zeros((e,), jnp.float32)
+    for k in range(top_k):
+        m = masks[k]
+        pos_in_expert = jnp.cumsum(m, axis=0) - m + pos_offset  # [S, E]
+        pos_offset = pos_offset + jnp.sum(m, axis=0)
+        keep = m * (pos_in_expert < capacity)
+        slot = jnp.sum(pos_in_expert * m, axis=-1).astype(jnp.int32)  # [S]
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [S, C]
+        disp_k = keep[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + disp_k
+        combine = combine + disp_k * gates[k][:, None, None]
+
+    # renormalize combine weights over the experts that actually kept the token
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux_loss
+
+
+def moe_mlp(
+    h: jax.Array,  # [b, t, d] normed hidden states
+    params: Dict,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (output [b,t,d], aux_load_balance_loss scalar)."""
+    rules = rules or ShardingRules()
+    b, t, d = h.shape
+    s = b * t
+    e = params["w1"].shape[0]
+    c = expert_capacity(s, e, top_k, capacity_factor)
+
+    def constrain(x, *dims):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
+
+    hf = h.reshape(s, d)
+    gate_logits = hf.astype(jnp.float32) @ params["router"]
+    dispatch, combine, aux = _top_k_gating(gate_logits, top_k, c)
+
+    # tokens -> expert slots: the all-to-all (from the sharding constraint)
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(h.dtype), hf)
+    expert_in = constrain(expert_in, "expert", None, "embed")
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]).astype(jnp.float32)
+    ).astype(h.dtype)
+    up = jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
+    out = jnp.einsum("ecf,efd->ecd", gate * up, params["w2"])
+    out = constrain(out, "expert", None, "embed")
+    # expert slots -> tokens: the reverse all-to-all
+    y = jnp.einsum("sec,ecd->sd", combine.astype(h.dtype), out)
+    return y.reshape(b, t, d), aux

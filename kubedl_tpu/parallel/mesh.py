@@ -21,7 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_ORDER = ("data", "fsdp", "tensor", "context", "expert")
+AXIS_ORDER = ("data", "fsdp", "stage", "tensor", "context", "expert")
 
 # Batch shards over data+fsdp (fsdp also shards params — ZeRO-3 style).
 BATCH_AXES = ("data", "fsdp")
@@ -100,7 +100,7 @@ class ShardingRules:
             "head_dim": (),
             "mlp": ("tensor",),
             "vocab": ("tensor",),
-            "layers": (),
+            "layers": ("stage",),
             "expert": ("expert",),
         }
     )

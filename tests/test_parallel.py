@@ -23,7 +23,9 @@ def test_parse_mesh_env():
 
 def test_build_mesh_8_devices():
     mesh = build_mesh({"data": 2, "fsdp": 2, "tensor": 2})
-    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "tensor": 2, "context": 1, "expert": 1}
+    assert dict(mesh.shape) == {
+        "data": 2, "fsdp": 2, "stage": 1, "tensor": 2, "context": 1, "expert": 1,
+    }
 
 
 def test_build_mesh_wildcard():

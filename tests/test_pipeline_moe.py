@@ -1,0 +1,169 @@
+"""Pipeline parallelism (GPipe over "stage" axis) and MoE expert parallelism
+on the 8-virtual-CPU-device mesh (SURVEY.md §4 multi-host-without-TPU
+strategy; §2.4 PP/EP rows — both net-new vs the reference)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.models.moe import expert_capacity, moe_init, moe_mlp
+from kubedl_tpu.parallel import pipeline
+from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+from kubedl_tpu.parallel.train_step import make_train_step
+
+
+def tiny(**kw):
+    return llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_microbatch_roundtrip():
+    x = jnp.arange(24).reshape(8, 3)
+    mb = pipeline.microbatch(x, 4)
+    assert mb.shape == (4, 2, 3)
+    np.testing.assert_array_equal(pipeline.unmicrobatch(mb), x)
+    with pytest.raises(ValueError):
+        pipeline.microbatch(x, 3)
+
+
+def test_stack_unstack_layers():
+    layers = [{"w": jnp.full((2,), i)} for i in range(4)]
+    stacked = pipeline.stack_layers(layers)
+    assert stacked["w"].shape == (4, 2)
+    back = pipeline.unstack_layers(stacked, 4)
+    np.testing.assert_array_equal(back[2]["w"], layers[2]["w"])
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipelined_forward_matches_sequential(remat):
+    config = tiny(n_layers=4, remat=remat)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, config.vocab_size)
+
+    ref = llama.forward(params, tokens, config)
+    stacked = llama.stack_params(params)
+    out = jax.jit(
+        lambda p, t: llama.forward_pipelined(p, t, config, mesh, n_microbatches=4)
+    )(stacked, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_pipelined_loss_and_grads_match():
+    config = tiny(n_layers=4, remat=False)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.init(config, jax.random.PRNGKey(0))
+    stacked = llama.stack_params(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 17), 0, config.vocab_size)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, tokens, config)
+    )(params)
+    pp_loss, pp_grads = jax.jit(
+        jax.value_and_grad(
+            lambda p: llama.loss_fn_pp(p, tokens, config, mesh, n_microbatches=4)
+        )
+    )(stacked)
+    np.testing.assert_allclose(float(pp_loss), float(ref_loss), atol=1e-5, rtol=1e-5)
+    ref_stacked = llama.stack_params(ref_grads)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_stacked), jax.tree_util.tree_leaves(pp_grads)
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-4, rtol=2e-3)
+
+
+def test_pipeline_rejects_underfilled():
+    config = tiny(n_layers=4)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    with pytest.raises(ValueError, match="microbatches"):
+        llama.forward_pipelined(params, tokens, config, mesh, n_microbatches=2)
+
+
+def test_pipelined_train_step_on_mesh():
+    """Full pp+dp train step through make_train_step — what the driver's
+    dryrun_multichip exercises."""
+    config = tiny(n_layers=4, remat=True)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    rules = ShardingRules()
+    params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+    spec_tree = llama.param_specs_pp(config, rules)
+
+    def loss(p, tokens):
+        return llama.loss_fn_pp(p, tokens, config, mesh, rules=rules, n_microbatches=4)
+
+    init_state, train_step = make_train_step(
+        loss, optax.adamw(1e-3), mesh, spec_tree, rules.spec("batch", None), rules
+    )
+    state = init_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 17), 0, config.vocab_size)
+    state, metrics = train_step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1
+
+
+# ---------------------------------------------------------------------------
+# MoE / expert parallelism
+# ---------------------------------------------------------------------------
+
+
+def test_expert_capacity():
+    assert expert_capacity(128, 4, 2, 1.0) == 64
+    assert expert_capacity(1, 8, 1, 1.0) == 1
+
+
+def test_moe_mlp_shapes_and_gating_mass():
+    params = moe_init(jax.random.PRNGKey(0), 16, 32, n_experts=4, dtype=jnp.float32)
+    h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    y, aux = moe_mlp(h, params, top_k=2, capacity_factor=2.0)
+    assert y.shape == h.shape
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_sharded_matches_unsharded():
+    """Expert-parallel execution is a layout change, not a math change."""
+    mesh = build_mesh({"expert": 4, "data": 2})
+    params = moe_init(jax.random.PRNGKey(0), 16, 32, n_experts=4, dtype=jnp.float32)
+    h = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+    y_ref, aux_ref = moe_mlp(h, params, top_k=2, capacity_factor=2.0)
+    y_sh, aux_sh = jax.jit(
+        lambda h, p: moe_mlp(h, p, top_k=2, capacity_factor=2.0, mesh=mesh)
+    )(h, params)
+    np.testing.assert_allclose(np.asarray(y_sh), np.asarray(y_ref), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(float(aux_sh), float(aux_ref), rtol=1e-6)
+
+
+def test_moe_llama_end_to_end():
+    config = tiny(n_layers=2, n_experts=4, remat=False)
+    mesh = build_mesh({"expert": 4, "data": 2})
+    rules = ShardingRules()
+    params = llama.init(config, jax.random.PRNGKey(0))
+    assert "moe" in params["layers"][0] and "w1" not in params["layers"][0]
+    spec_tree = llama.param_specs(config, rules)
+
+    def loss(p, tokens):
+        return llama.loss_fn(p, tokens, config, mesh=mesh, rules=rules)
+
+    init_state, train_step = make_train_step(
+        loss, optax.adamw(1e-3), mesh, spec_tree, rules.spec("batch", None), rules
+    )
+    state = init_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 17), 0, config.vocab_size)
+    state, metrics = train_step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_moe_pipelined_rejected():
+    config = tiny(n_layers=4, n_experts=4)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+    tokens = jnp.zeros((8, 8), jnp.int32)
+    with pytest.raises(ValueError, match="dense"):
+        llama.forward_pipelined(params, tokens, config, mesh, n_microbatches=4)
