@@ -263,17 +263,22 @@ def forward_and_aux(
     for layer in params["layers"]:
         x, aux = layer_fn((x, aux), layer)
 
-    x = rms_norm(x, params["final_norm"], config.rms_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T.astype(config.dtype)
-    logits = (x @ head).astype(jnp.float32)
+    logits = _lm_head(x, params, config)
     return constrain(logits, "batch", "seq", "vocab"), aux
 
 
 def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.Array:
     """Logits [batch, seq, vocab] (f32)."""
     return forward_and_aux(params, tokens, config, mesh=mesh, rules=rules)[0]
+
+
+def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
+    """Final norm + (tied or separate) LM head -> f32 logits."""
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(config.dtype)
+    return (x @ head).astype(jnp.float32)
 
 
 def _next_token_ce(logits, targets):
@@ -347,12 +352,7 @@ def forward_pipelined(
         params["layers"], x, layer_fn, mesh=mesh, remat=config.remat
     )
     x = pipeline.unmicrobatch(y)
-
-    x = rms_norm(x, params["final_norm"], config.rms_eps)
-    head = params.get("lm_head")
-    if head is None:
-        head = params["embed"].T.astype(config.dtype)
-    return (x @ head).astype(jnp.float32)
+    return _lm_head(x, params, config)
 
 
 def loss_fn_pp(

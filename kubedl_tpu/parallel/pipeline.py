@@ -73,6 +73,12 @@ def pipeline_apply(
             f"need >= {n_stages} microbatches to fill a {n_stages}-stage "
             f"pipeline, got {n_micro}"
         )
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(
+            f"stacked layer count {n_layers} not divisible by the "
+            f"{stage_axis}-axis size {n_stages}"
+        )
     x_rank = x_microbatches.ndim
 
     per_layer = layer_fn
