@@ -51,8 +51,10 @@ class ControllerRunner:
 
 
 class Manager:
-    def __init__(self, store: Optional[ObjectStore] = None) -> None:
+    def __init__(self, store: Optional[ObjectStore] = None, runtime_metrics=None) -> None:
         self.store = store or ObjectStore()
+        # RuntimeMetrics sink (metrics/runtime_metrics.py); None disables
+        self.runtime_metrics = runtime_metrics
         self._controllers: List[ControllerRunner] = []
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -63,6 +65,8 @@ class Manager:
     ) -> ControllerRunner:
         c = ControllerRunner(name, reconcile, workers)
         self._controllers.append(c)
+        if self.runtime_metrics is not None:
+            self.runtime_metrics.register_queue(name, c.queue.__len__)
         return c
 
     # -- run loop --------------------------------------------------------
@@ -101,20 +105,31 @@ class Manager:
                 self._threads.append(t)
 
     def _worker(self, c: ControllerRunner) -> None:
+        import time
+
+        rm = self.runtime_metrics
         while not self._stop.is_set():
             key = c.queue.get(timeout=0.1)
             if key is None:
                 continue
+            t0 = time.perf_counter()
             try:
                 result = c.reconcile(key)
             except Exception:
                 log.error("reconcile %s %s failed: %s", c.name, key, traceback.format_exc())
+                if rm is not None:
+                    rm.observe_reconcile(c.name, time.perf_counter() - t0, error=True)
+                    rm.observe_requeue(c.name)
                 c.queue.add_rate_limited(key)
                 c.queue.done(key)
                 continue
+            if rm is not None:
+                rm.observe_reconcile(c.name, time.perf_counter() - t0)
             if result is not None and result.requeue_after is not None:
                 c.queue.add_after(key, result.requeue_after)
             elif result is not None and result.requeue:
+                if rm is not None:
+                    rm.observe_requeue(c.name)
                 c.queue.add_rate_limited(key)
             else:
                 c.queue.forget(key)

@@ -29,6 +29,7 @@ from kubedl_tpu.executor.local import LocalPodExecutor
 from kubedl_tpu.gang.interface import GangRegistry
 from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
 from kubedl_tpu.metrics.job_metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
 from kubedl_tpu.utils.serde import from_dict
 
 
@@ -57,7 +58,8 @@ class Operator:
     def __init__(self, config: Optional[OperatorConfig] = None) -> None:
         self.config = config or OperatorConfig()
         self.store = ObjectStore()
-        self.manager = Manager(self.store)
+        self.runtime_metrics = RuntimeMetrics()
+        self.manager = Manager(self.store, runtime_metrics=self.runtime_metrics)
         self.recorder = EventRecorder(self.store)
         self.metrics_registry = MetricsRegistry()
         self.gang_registry = GangRegistry()

@@ -52,7 +52,14 @@ class OperatorHTTPServer:
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
                 if self.path == "/metrics":
-                    self._send(200, op.metrics_registry.render(), "text/plain; version=0.0.4")
+                    body = op.metrics_registry.render()
+                    rm = getattr(op, "runtime_metrics", None)
+                    if rm is not None:
+                        body += rm.render()
+                    self._send(200, body, "text/plain; version=0.0.4")
+                elif self.path == "/debug/vars":
+                    rm = getattr(op, "runtime_metrics", None)
+                    self._json(200, rm.debug_vars() if rm is not None else {})
                 elif self.path == "/healthz":
                     self._send(200, "ok", "text/plain")
                 elif len(parts) >= 2 and parts[0] == "apis":

@@ -38,6 +38,12 @@ def parse_args(argv=None):
                    type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_INTERVAL", 0)))
     p.add_argument("--checkpoint-keep",
                    type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_KEEP", 3)))
+    # JAX profiler / XProf hook (SURVEY.md §5: "TPU side gets JAX
+    # profiler/XProf hooks" — net-new, the reference has no profiling)
+    p.add_argument("--profile-dir", default=os.environ.get("KUBEDL_PROFILE_DIR", ""))
+    p.add_argument("--profile-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_PROFILE_STEPS", 5)),
+                   help="trace this many steps after warmup into --profile-dir")
     return p.parse_args(argv)
 
 
@@ -131,15 +137,34 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(info.process_id)
     tokens_per_step = args.batch * (args.seq_len - 1)
 
+    # profiler window: [start+1, start+1+profile_steps) — skips the compile step
+    prof_start = start_step + 1 if args.profile_dir else -1
+    prof_stop = prof_start + args.profile_steps
+    tracing = False
+
+    def stop_trace():
+        nonlocal tracing
+        if tracing:
+            jax.profiler.stop_trace()
+            print(f"profile written to {args.profile_dir}", flush=True)
+            tracing = False
+
     t_start = time.perf_counter()
     last_log = t_start
     for step in range(start_step, args.steps):
+        if step == prof_start:
+            jax.profiler.start_trace(args.profile_dir)
+            tracing = True
         batch = jnp.asarray(
             rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
         )
         state, metrics = train_step(state, batch)
+        if tracing and step + 1 >= prof_stop:
+            jax.block_until_ready(metrics["loss"])
+            stop_trace()
         if preempted["flag"]:
             jax.block_until_ready(metrics["loss"])
+            stop_trace()
             save(step + 1, final=True)
             print("preempted: checkpoint saved, exiting retryable", flush=True)
             return EXIT_TPU_PREEMPTED
@@ -155,6 +180,7 @@ def main(argv=None) -> int:
                   f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
 
     jax.block_until_ready(state.step)
+    stop_trace()
     total = time.perf_counter() - t_start
     steps_done = args.steps - start_step
     print(f"done: {steps_done} steps in {total:.1f}s "

@@ -1,0 +1,67 @@
+"""Reconcile-engine health metrics (metrics/runtime_metrics.py) + /debug/vars."""
+import json
+import time
+import urllib.request
+
+from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+
+def test_histogram_render_and_debug_vars():
+    rm = RuntimeMetrics()
+    rm.observe_reconcile("tfjob", 0.003)
+    rm.observe_reconcile("tfjob", 0.2)
+    rm.observe_reconcile("tfjob", 30.0, error=True)
+    rm.observe_requeue("tfjob")
+    rm.register_queue("tfjob", lambda: 2)
+
+    text = rm.render()
+    assert 'kubedl_reconcile_duration_seconds_count{controller="tfjob"} 3' in text
+    assert 'kubedl_reconcile_duration_seconds_bucket{controller="tfjob",le="0.005"} 1' in text
+    assert 'kubedl_reconcile_duration_seconds_bucket{controller="tfjob",le="+Inf"} 3' in text
+    assert 'kubedl_reconcile_errors_total{controller="tfjob"} 1' in text
+    assert 'kubedl_reconcile_requeues_total{controller="tfjob"} 1' in text
+    assert 'kubedl_workqueue_depth{controller="tfjob"} 2' in text
+
+    dv = rm.debug_vars()
+    c = dv["controllers"]["tfjob"]
+    assert c["reconciles"] == 3 and c["errors"] == 1 and c["queue_depth"] == 2
+    assert any("manager" in t or "Main" in t for t in dv["threads"]) or dv["threads"]
+
+
+def test_operator_collects_reconcile_metrics_and_serves_debug_vars():
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
+    from fake_workload import TEST_KIND, TestJobController
+    from kubedl_tpu.operator import Operator, OperatorConfig
+    from kubedl_tpu.server import OperatorHTTPServer
+
+    op = Operator(OperatorConfig())
+    op.register(TestJobController())
+    op.start()
+    srv = OperatorHTTPServer(op, port=0)
+    port = srv.start()
+    try:
+        job = op.apply({
+            "kind": TEST_KIND,
+            "metadata": {"name": "rm-e2e"},
+            "spec": {"replicaSpecs": {"Worker": {
+                "replicas": 1, "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "test-container", "command": ["/bin/true"],
+                }]}},
+            }}},
+        })
+        op.wait_for_condition(job, "Succeeded", timeout=30)
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            text = r.read().decode()
+        assert "kubedl_reconcile_duration_seconds_count" in text
+        assert "kubedl_workqueue_depth" in text
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/vars") as r:
+            dv = json.loads(r.read().decode())
+        ctrl = next(iter(dv["controllers"].values()))
+        assert ctrl["reconciles"] > 0 and ctrl["errors"] == 0
+    finally:
+        srv.stop()
+        op.stop()
