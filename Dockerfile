@@ -1,0 +1,20 @@
+# Two-stage build (parity with the reference's distroless two-stage
+# Dockerfile). Stage 1 builds the optional native extensions; stage 2 is the
+# slim runtime image the operator deployment runs.
+FROM python:3.11-slim AS builder
+WORKDIR /build
+COPY kubedl_tpu/ kubedl_tpu/
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/* \
+    && python -m kubedl_tpu.native.build || true
+
+FROM python:3.11-slim
+WORKDIR /app
+# jax is only needed by the training images, not the operator; install the
+# CPU wheel so the local executor and validation paths work everywhere.
+RUN pip install --no-cache-dir "jax[cpu]" optax orbax-checkpoint pyyaml
+COPY --from=builder /build/kubedl_tpu/ /app/kubedl_tpu/
+COPY config/ /app/config/
+ENV PYTHONPATH=/app PYTHONUNBUFFERED=1
+ENTRYPOINT ["python", "-m", "kubedl_tpu.cli"]
+CMD ["operator", "--bind=0.0.0.0", "--metrics-port=8443"]

@@ -1,0 +1,41 @@
+# Targets mirror the reference's Makefile:15-56 (test/manifests/install/
+# deploy/docker-build) for a Python operator.
+IMG ?= kubedl-tpu/operator:v0.1.0
+PY ?= python
+
+.PHONY: test
+test:
+	$(PY) -m pytest tests/ -x -q
+
+.PHONY: bench
+bench:
+	$(PY) bench.py
+
+.PHONY: manifests
+manifests:
+	$(PY) hack/gen_manifests.py
+
+.PHONY: install
+install: manifests
+	kubectl apply -f config/crd/bases/
+
+.PHONY: uninstall
+uninstall:
+	kubectl delete -f config/crd/bases/
+
+.PHONY: deploy
+deploy: install
+	kubectl apply -f config/manager/all_in_one.yaml
+
+.PHONY: docker-build
+docker-build:
+	docker build -t $(IMG) .
+
+.PHONY: docker-push
+docker-push:
+	docker push $(IMG)
+
+.PHONY: dryrun
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  $(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"

@@ -18,6 +18,8 @@ import time
 import yaml
 
 from kubedl_tpu.api.common import JobConditionType, has_condition, is_failed, is_succeeded
+from kubedl_tpu.api.validation import ValidationError, validate as api_validate
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH
 from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.operator import Operator, OperatorConfig
 from kubedl_tpu.server import OperatorHTTPServer
@@ -39,6 +41,8 @@ def _mk_operator(args) -> Operator:
             object_storage=args.object_storage,
             event_storage=args.event_storage,
             storage_db_path=args.storage_db_path,
+            enable_leader_election=getattr(args, "enable_leader_election", False),
+            leader_lease_path=getattr(args, "leader_lease_path", DEFAULT_LEASE_PATH),
         )
     )
 
@@ -101,7 +105,11 @@ def cmd_run(args) -> int:
 def cmd_operator(args) -> int:
     op = _mk_operator(args)
     op.register_all()
+    if args.enable_leader_election:
+        print(f"acquiring leadership lease at {args.leader_lease_path} ...")
     op.start()
+    if op.elector is not None:
+        print(f"elected leader as {op.elector.identity}")
     server = OperatorHTTPServer(op, host=args.bind, port=args.metrics_port or 8443)
     port = server.start()
     print(f"kubedl-tpu operator serving on http://{args.bind}:{port} "
@@ -134,6 +142,12 @@ def cmd_validate(args) -> int:
 
             job = from_dict(engine.controller.job_type(), m)
             engine.controller.set_defaults(job)
+            try:
+                api_validate(job, engine.controller)
+            except ValidationError as e:
+                print(f"{path}: INVALID — {e}")
+                rc = 1
+                continue
             n = sum(int(s.replicas or 0) for s in engine.controller.replica_specs(job).values())
             print(f"{path}: {canonical} {job.metadata.name} ok ({n} replicas)")
     return rc
@@ -165,6 +179,10 @@ def main(argv=None) -> int:
     p_op = sub.add_parser("operator", help="serve the operator over HTTP")
     p_op.add_argument("--bind", default="127.0.0.1")
     p_op.add_argument("--metrics-port", type=int, default=8443)
+    # ref main.go:56: leader election defaults ON for the deployed operator
+    p_op.add_argument("--enable-leader-election", action=argparse.BooleanOptionalAction,
+                      default=True)
+    p_op.add_argument("--leader-lease-path", default=DEFAULT_LEASE_PATH)
     p_op.set_defaults(fn=cmd_operator)
 
     p_val = sub.add_parser("validate", help="parse and default manifests")

@@ -30,6 +30,8 @@ from kubedl_tpu.gang.interface import GangRegistry
 from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
 from kubedl_tpu.metrics.job_metrics import JobMetrics, MetricsRegistry
 from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+from kubedl_tpu.api.validation import validate
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector
 from kubedl_tpu.utils.serde import from_dict
 
 
@@ -52,6 +54,11 @@ class OperatorConfig:
     event_storage: str = ""
     storage_db_path: str = ":memory:"
     region: str = field(default_factory=lambda: os.environ.get("REGION", ""))
+    # HA: single active operator via a lease (ref main.go:56 --enable-leader-
+    # election, default true there; off by default here because embedded/test
+    # operators are single-instance — the CLI `operator` command enables it)
+    enable_leader_election: bool = False
+    leader_lease_path: str = DEFAULT_LEASE_PATH
 
 
 class Operator:
@@ -72,6 +79,8 @@ class Operator:
         self.reconcilers: Dict[str, JobReconciler] = {}
         self._kind_by_lower: Dict[str, str] = {}
         self._started = False
+        self._stopping = threading.Event()
+        self.elector: Optional[FileLeaseElector] = None
         # storage persistence (ref main.go:97-100): backends resolved at
         # start() so every registered workload gets a persist controller
         self.object_backend = None
@@ -113,14 +122,22 @@ class Operator:
 
     # -- lifecycle -------------------------------------------------------
 
-    def start(self) -> None:
+    def start(self, timeout: Optional[float] = None) -> bool:
+        """Start reconciling. With leader election enabled this blocks as a
+        standby until the lease is won (ref main.go:70-75 semantics) or
+        `timeout`/`stop()` interrupts it; returns False if never elected."""
         if self._started:
-            return
+            return True
+        if self.config.enable_leader_election:
+            self.elector = FileLeaseElector(self.config.leader_lease_path)
+            if not self.elector.acquire(timeout=timeout, stop=self._stopping.is_set):
+                return False
         self._started = True
         self._setup_persistence()
         if self.executor is not None:
             self.executor.start()
         self.manager.start()
+        return True
 
     def _setup_persistence(self) -> None:
         if not (self.config.object_storage or self.config.event_storage):
@@ -159,7 +176,10 @@ class Operator:
         )
 
     def stop(self) -> None:
+        self._stopping.set()
         self.manager.stop()
+        if self.elector is not None:
+            self.elector.release()
         if self.executor is not None:
             self.executor.stop()
         if self.object_backend is not None:
@@ -182,6 +202,10 @@ class Operator:
         job_cls = engine.controller.job_type()
         job = from_dict(job_cls, manifest)
         job.kind = canonical
+        # admission: default then validate (the webhook pair the reference
+        # scaffolds but never implements — api/validation.py)
+        engine.controller.set_defaults(job)
+        validate(job, engine.controller)
         try:
             existing = self.store.get(canonical, job.metadata.namespace, job.metadata.name)
             job.metadata.resource_version = existing.metadata.resource_version

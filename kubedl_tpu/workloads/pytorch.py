@@ -80,6 +80,12 @@ class PyTorchJobController(BaseWorkloadController):
     def needs_service_for_replica(self, rtype: str) -> bool:
         return rtype == REPLICA_MASTER
 
+    def validate_job(self, job) -> List[str]:
+        # admission-time version of the reconcile-time error below
+        if REPLICA_MASTER not in job.spec.replica_specs:
+            return ["spec.pytorchReplicaSpecs: a Master replica spec is required"]
+        return []
+
     def reconcile_orders(self):
         return [ReplicaType.MASTER, ReplicaType.WORKER]
 

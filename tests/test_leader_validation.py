@@ -1,0 +1,140 @@
+"""Leader election (core/leader.py) + admission validation (api/validation.py)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kubedl_tpu.api.validation import ValidationError, validate
+from kubedl_tpu.core.leader import FileLeaseElector
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fake_workload import TEST_KIND, TestJobController  # noqa: E402
+
+
+def test_single_process_reacquire(tmp_path):
+    lease = str(tmp_path / "lease")
+    a = FileLeaseElector(lease, identity="a")
+    assert a.try_acquire() and a.is_leader
+    assert a.try_acquire()  # idempotent
+    a.release()
+    assert not a.is_leader
+    b = FileLeaseElector(lease, identity="b")
+    assert b.try_acquire()
+    assert b.holder() == "b"
+    b.release()
+
+
+def test_standby_takes_over_when_leader_process_dies(tmp_path):
+    """flock is held by a child process; killing it must free the lease."""
+    lease = str(tmp_path / "lease")
+    child = subprocess.Popen(
+        [sys.executable, "-c", (
+            "import sys, time; sys.path.insert(0, %r);"
+            "from kubedl_tpu.core.leader import FileLeaseElector;"
+            "e = FileLeaseElector(%r, identity='child');"
+            "assert e.try_acquire(); print('leader', flush=True);"
+            "time.sleep(60)"
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), lease)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert child.stdout.readline().strip() == "leader"
+        standby = FileLeaseElector(lease, identity="standby", retry_period=0.02)
+        assert not standby.try_acquire()
+
+        won = {}
+
+        def wait():
+            won["ok"] = standby.acquire(timeout=10)
+
+        t = threading.Thread(target=wait)
+        t.start()
+        time.sleep(0.1)
+        child.kill()
+        child.wait()
+        t.join(timeout=10)
+        assert won.get("ok") and standby.is_leader
+        standby.release()
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+
+def test_operator_standby_blocks_until_leader_stops(tmp_path):
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    lease = str(tmp_path / "lease")
+    cfg = dict(enable_leader_election=True, leader_lease_path=lease, run_executor=False)
+    leader = Operator(OperatorConfig(**cfg))
+    leader.register(TestJobController())
+    assert leader.start()
+    standby = Operator(OperatorConfig(**cfg))
+    standby.register(TestJobController())
+    assert not standby.start(timeout=0.3)  # blocked while leader holds lease
+    leader.stop()
+    assert standby.start(timeout=5)
+    standby.stop()
+
+
+def _valid_manifest(name="v-ok"):
+    return {
+        "kind": TEST_KIND,
+        "metadata": {"name": name},
+        "spec": {"replicaSpecs": {"Worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"spec": {"containers": [{
+                "name": "test-container", "command": ["/bin/true"],
+            }]}},
+        }}},
+    }
+
+
+def test_apply_rejects_invalid_spec():
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    op = Operator(OperatorConfig(run_executor=False))
+    op.register(TestJobController())
+    bad = _valid_manifest("v-bad")
+    bad["spec"]["replicaSpecs"]["Worker"]["replicas"] = -2
+    with pytest.raises(ValidationError, match="replicas: must be >= 0"):
+        op.apply(bad)
+    # valid manifest passes admission
+    job = op.apply(_valid_manifest())
+    assert job.metadata.name == "v-ok"
+
+
+def test_validate_collects_field_errors():
+    from kubedl_tpu.utils.serde import from_dict
+
+    ctrl = TestJobController()
+    m = _valid_manifest("v-multi")
+    m["spec"]["replicaSpecs"]["Worker"]["template"]["spec"]["containers"] = []
+    m["spec"]["runPolicy"] = {"backoffLimit": -1}
+    job = from_dict(ctrl.job_type(), m)
+    job.kind = TEST_KIND
+    ctrl.set_defaults(job)
+    with pytest.raises(ValidationError) as ei:
+        validate(job, ctrl)
+    msgs = " ".join(ei.value.errors)
+    assert "containers: required" in msgs and "backoffLimit" in msgs
+
+
+def test_pytorch_requires_master():
+    from kubedl_tpu.workloads.pytorch import PyTorchJobController
+
+    ctrl = PyTorchJobController()
+    from kubedl_tpu.utils.serde import from_dict
+
+    job = from_dict(ctrl.job_type(), {
+        "kind": "PyTorchJob", "metadata": {"name": "pt"},
+        "spec": {"pytorchReplicaSpecs": {"Worker": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{"name": "pytorch"}]}},
+        }}},
+    })
+    ctrl.set_defaults(job)
+    with pytest.raises(ValidationError, match="Master replica spec is required"):
+        validate(job, ctrl)
