@@ -1,0 +1,46 @@
+"""Build the native data loader: g++ -O3 -shared -> _lib/libkdl_dataloader.so.
+
+Invoked automatically on first import of kubedl_tpu.native.loader (cached by
+source mtime) or explicitly via `python -m kubedl_tpu.native.build`.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "dataloader.cc")
+LIB_DIR = os.path.join(_DIR, "_lib")
+LIB = os.path.join(LIB_DIR, "libkdl_dataloader.so")
+
+
+def build(force: bool = False, quiet: bool = False) -> str:
+    """Compile if stale; returns the library path ('' on failure)."""
+    if not force and os.path.exists(LIB) and os.path.getmtime(LIB) >= os.path.getmtime(SRC):
+        return LIB
+    os.makedirs(LIB_DIR, exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-Wall", "-Wextra",
+        SRC, "-o", LIB,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        if not quiet:
+            print(f"native build unavailable: {e}", file=sys.stderr)
+        return ""
+    if proc.returncode != 0:
+        if not quiet:
+            print(f"native build failed:\n{proc.stderr}", file=sys.stderr)
+        return ""
+    return LIB
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    if not path:
+        sys.exit(1)
+    print(path)

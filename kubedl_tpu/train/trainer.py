@@ -32,6 +32,9 @@ def parse_args(argv=None):
     p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--log-every", type=int, default=10)
+    # token shards (flat int32 files; native/loader.py). Unset -> synthetic.
+    p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
+                   help="glob of token shard files, e.g. /data/shard-*.bin")
     p.add_argument("--checkpoint-path",
                    default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
     p.add_argument("--checkpoint-interval",
@@ -134,7 +137,34 @@ def main(argv=None) -> int:
         if final:
             print(f"saved final checkpoint at step {step}", flush=True)
 
+    # input pipeline: native mmap+prefetch loader over token shards, or
+    # synthetic batches when no data path is given
+    loader = None
+    if args.data_path:
+        import glob as globlib
+
+        from kubedl_tpu.native.loader import TokenLoader
+
+        shard_paths = sorted(globlib.glob(args.data_path))
+        if not shard_paths:
+            print(f"no shards match {args.data_path!r}", file=sys.stderr)
+            return 1
+        loader = TokenLoader(
+            shard_paths, batch=args.batch, seq_len=args.seq_len,
+            seed=info.process_id,
+        )
+        print(f"data: {len(shard_paths)} shards, {loader.n_windows} windows, "
+              f"native={loader.is_native}", flush=True)
+
     rng = np.random.default_rng(info.process_id)
+
+    def next_batch():
+        if loader is not None:
+            return jnp.asarray(loader.next())
+        return jnp.asarray(
+            rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
+        )
+
     tokens_per_step = args.batch * (args.seq_len - 1)
 
     # profiler window: [start+1, start+1+profile_steps) — skips the compile step
@@ -155,9 +185,7 @@ def main(argv=None) -> int:
         if step == prof_start:
             jax.profiler.start_trace(args.profile_dir)
             tracing = True
-        batch = jnp.asarray(
-            rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
-        )
+        batch = next_batch()
         state, metrics = train_step(state, batch)
         if tracing and step + 1 >= prof_stop:
             jax.block_until_ready(metrics["loss"])
