@@ -76,12 +76,13 @@ state = init_state(params)
 BATCH, SEQ = 8, 2049
 tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, config.vocab_size)
 state, metrics = train_step(state, tokens)  # compile
-jax.block_until_ready(metrics["loss"])
+jax.device_get(metrics["loss"])  # full sync: on the remote-TPU platform
+# block_until_ready can return before compute finishes; device_get can't
 STEPS = 10
 t0 = time.perf_counter()
 for _ in range(STEPS):
     state, metrics = train_step(state, tokens)
-jax.block_until_ready(metrics["loss"])
+jax.device_get(metrics["loss"])
 dt = time.perf_counter() - t0
 tok_s = STEPS * BATCH * (SEQ - 1) / dt
 nparams = llama.param_count(state.params)

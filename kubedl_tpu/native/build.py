@@ -17,14 +17,20 @@ LIB = os.path.join(LIB_DIR, "libkdl_dataloader.so")
 
 def build(force: bool = False, quiet: bool = False) -> str:
     """Compile if stale; returns the library path ('' on failure)."""
+    if not os.path.exists(SRC):
+        # deployed without sources: use a prebuilt library if present
+        return LIB if os.path.exists(LIB) else ""
     if not force and os.path.exists(LIB) and os.path.getmtime(LIB) >= os.path.getmtime(SRC):
         return LIB
     os.makedirs(LIB_DIR, exist_ok=True)
+    # compile to a private temp path and rename: a concurrent process must
+    # never dlopen a half-written .so (rename is atomic within the dir)
+    tmp = os.path.join(LIB_DIR, f".libkdl_dataloader.{os.getpid()}.so")
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-Wall", "-Wextra",
-        SRC, "-o", LIB,
+        SRC, "-o", tmp,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
@@ -35,7 +41,12 @@ def build(force: bool = False, quiet: bool = False) -> str:
     if proc.returncode != 0:
         if not quiet:
             print(f"native build failed:\n{proc.stderr}", file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return ""
+    os.replace(tmp, LIB)
     return LIB
 
 

@@ -135,7 +135,12 @@ struct Loader {
   }
 
   ~Loader() {
-    closed.store(true);
+    {
+      // store under the lock: a producer between its closed-check and
+      // cv.wait() would otherwise miss the notify and hang the join below
+      std::lock_guard<std::mutex> lk(mu);
+      closed.store(true);
+    }
     cv_produce.notify_all();
     cv_consume.notify_all();
     for (auto& t : threads) if (t.joinable()) t.join();

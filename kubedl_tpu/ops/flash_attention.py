@@ -77,7 +77,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse rides in a [bh, 1, seq] buffer: a (1, 1, block_q) block keeps the
+    # trailing two dims TPU-tileable (second-to-last == array dim 1)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
@@ -96,11 +98,11 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=int(4 * bh * seq * seq * d * (0.5 if causal else 1.0)),
@@ -122,8 +124,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qb = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
     q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
 
     num_kb = pl.cdiv(seq_len, block_k)
@@ -167,8 +169,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32) * sm_scale
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -196,7 +198,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
     q, k, v, out, lse = res
     bh, seq, d = q.shape
-    delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1)  # [bh, seq]
+    # [bh, 1, seq] to match the lse layout (TPU-tileable blocks)
+    delta = jnp.sum(
+        out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1
+    )[:, None, :]
 
     kern = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                 block_k=block_k, seq_len=true_len)
@@ -208,8 +213,8 @@ def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
@@ -224,8 +229,8 @@ def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
-            pl.BlockSpec((1, seq), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
@@ -299,6 +304,13 @@ def flash_attention(
 
     block_q = min(block_q, max(sq, 1))
     block_k = min(block_k, max(sq, 1))
+
+    # Mosaic requires MXU-tileable blocks on real TPU: head_dim and the
+    # Q/K blocks must be lane-aligned (128). Small/odd shapes (tiny test
+    # models, short sequences) take the plain-XLA path — at those sizes the
+    # fused kernel has no advantage anyway. CPU interpret mode is exempt.
+    if not _interpret() and (d % 128 or block_q % 128 or block_k % 128):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
     qf = _pad_seq(q.reshape(b * hq, sq, d), block_q)
     kf = _pad_seq(k.reshape(b * hq, sq, d), block_k)

@@ -53,8 +53,30 @@ def _resolve_local(address: str) -> str:
         return f"127.0.0.1:{port or '8471'}"
 
 
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS=cpu authoritative even when a sitecustomize has
+    already pinned a different platform programmatically (config beats env
+    in JAX). Test/CI pods set the env to get the hermetic virtual-device
+    CPU mesh; without this they would silently dial the real accelerator."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want != "cpu":
+        return
+    import jax
+
+    if (jax.config.jax_platforms or "") == "cpu":
+        return
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+
 def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
     """Idempotently initialize jax.distributed from the injected env."""
+    _honor_platform_env()
     info = info or process_info()
     if not info.is_distributed or info.coordinator_address is None:
         return info

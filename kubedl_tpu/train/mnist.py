@@ -78,13 +78,14 @@ def main(argv=None) -> int:
     x = jax.device_put(jnp.asarray(x_host), data_sharded)
     y = jax.device_put(jnp.asarray(y_host), data_sharded)
 
-    # compile, then time
+    # compile, then time; device_get forces a real device sync (on the
+    # remote-TPU platform block_until_ready can return early)
     params, opt_state, loss = train_step(params, opt_state, x, y)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         params, opt_state, loss = train_step(params, opt_state, x, y)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     dt = time.perf_counter() - t0
     steps_per_sec = args.steps / dt
     print(f"steps={args.steps} batch={batch} loss={float(loss):.4f} "

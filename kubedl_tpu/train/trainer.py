@@ -35,6 +35,9 @@ def parse_args(argv=None):
     # token shards (flat int32 files; native/loader.py). Unset -> synthetic.
     p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
                    help="glob of token shard files, e.g. /data/shard-*.bin")
+    p.add_argument("--data-seed", type=int,
+                   default=int(os.environ.get("KUBEDL_DATA_SEED", 0)),
+                   help="shared shuffle seed (same on every process)")
     p.add_argument("--checkpoint-path",
                    default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
     p.add_argument("--checkpoint-interval",
@@ -138,7 +141,10 @@ def main(argv=None) -> int:
             print(f"saved final checkpoint at step {step}", flush=True)
 
     # input pipeline: native mmap+prefetch loader over token shards, or
-    # synthetic batches when no data path is given
+    # synthetic batches when no data path is given. All processes share one
+    # seed/permutation and stride it by rank (batch id = step*world + rank),
+    # so the global batch is disjoint across data-parallel processes and a
+    # checkpoint resume at start_step continues the schedule, not replays it.
     loader = None
     if args.data_path:
         import glob as globlib
@@ -150,17 +156,18 @@ def main(argv=None) -> int:
             print(f"no shards match {args.data_path!r}", file=sys.stderr)
             return 1
         loader = TokenLoader(
-            shard_paths, batch=args.batch, seq_len=args.seq_len,
-            seed=info.process_id,
+            shard_paths, batch=args.batch, seq_len=args.seq_len, seed=args.data_seed,
         )
         print(f"data: {len(shard_paths)} shards, {loader.n_windows} windows, "
               f"native={loader.is_native}", flush=True)
 
     rng = np.random.default_rng(info.process_id)
 
-    def next_batch():
+    def next_batch(step: int):
         if loader is not None:
-            return jnp.asarray(loader.next())
+            return jnp.asarray(
+                loader.batch_at(step * info.num_processes + info.process_id)
+            )
         return jnp.asarray(
             rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
         )
@@ -185,7 +192,7 @@ def main(argv=None) -> int:
         if step == prof_start:
             jax.profiler.start_trace(args.profile_dir)
             tracing = True
-        batch = next_batch()
+        batch = next_batch(step)
         state, metrics = train_step(state, batch)
         if tracing and step + 1 >= prof_stop:
             jax.block_until_ready(metrics["loss"])
@@ -207,7 +214,7 @@ def main(argv=None) -> int:
             print(f"step {step + 1}: loss={loss_v:.4f} "
                   f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
 
-    jax.block_until_ready(state.step)
+    jax.device_get(state.step)  # full sync (remote platforms)
     stop_trace()
     total = time.perf_counter() - t_start
     steps_done = args.steps - start_step

@@ -1,0 +1,96 @@
+"""E2E preemption -> checkpoint -> restart -> resume, through the full stack.
+
+The flagship recovery story (SURVEY.md §5 checkpoint/resume): a JAXJob
+worker running the real trainer is SIGTERMed mid-run (how TPU maintenance/
+preemption surfaces); the trainer saves an Orbax checkpoint and exits with
+the retryable preemption code; the engine's ExitCode restart policy
+recreates the pod; the restarted trainer restores and finishes. The job
+must pass through Restarting and end Succeeded with a final-step checkpoint.
+"""
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubedl_tpu.operator import Operator, OperatorConfig
+
+STEPS = 60
+INTERVAL = 5
+
+
+def _latest_step(ckpt_dir: str):
+    try:
+        steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def test_preempted_trainer_resumes_from_checkpoint(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    op = Operator(OperatorConfig())
+    from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+    op.register(JAXJobController())
+    op.start()
+    try:
+        job = op.apply({
+            "apiVersion": "kubedl-tpu.io/v1alpha1",
+            "kind": "JAXJob",
+            "metadata": {"name": "preempt-e2e"},
+            "spec": {
+                "mesh": {"data": -1},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 1,
+                    "restartPolicy": "ExitCode",
+                    "template": {"spec": {"containers": [{
+                        "name": "jax",
+                        "command": [
+                            sys.executable, "-m", "kubedl_tpu.train.trainer",
+                            "--model", "tiny", "--steps", str(STEPS),
+                            "--batch", "8", "--seq-len", "33",
+                            "--checkpoint-path", ckpt,
+                            "--checkpoint-interval", str(INTERVAL),
+                            "--log-every", "1000",
+                        ],
+                    }]}},
+                }},
+            },
+        })
+
+        # wait for the first interval checkpoint, proving the trainer is
+        # mid-run, then preempt it the way TPU maintenance does
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = _latest_step(ckpt)
+            if s is not None and s < STEPS:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("trainer never wrote an interval checkpoint")
+
+        entry = None
+        for key, e in list(op.executor._running.items()):
+            if "preempt-e2e" in key:
+                entry = e
+                break
+        assert entry is not None, "pod process not found"
+        for proc in entry.procs.values():
+            os.kill(proc.pid, signal.SIGTERM)
+
+        assert op.wait_for_condition(job, "Succeeded", timeout=180), (
+            "job did not succeed after preemption; latest ckpt step: "
+            f"{_latest_step(ckpt)}"
+        )
+        # Restarting is scrubbed from conditions once Running returns
+        # (Running<->Restarting are mutually exclusive, ref pkg/util/
+        # status.go:88-137), so assert on the monotonic restart counter.
+        jm = op.metrics_registry.get("JAXJob")
+        assert jm.restarted >= 1, "preemption should count a restart"
+        assert _latest_step(ckpt) == STEPS
+    finally:
+        op.stop()
