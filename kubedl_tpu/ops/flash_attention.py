@@ -22,8 +22,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Swept on v5e at seq 2048 (bq/bk 128..512): 512/512 is 2.3x faster than
+# 128/128 for fwd+bwd — bigger K/V tiles amortize the online-softmax
+# bookkeeping and keep the MXU busy; VMEM still fits q+k+v+acc at 512x128.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -302,8 +305,14 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
 
-    block_q = min(block_q, max(sq, 1))
-    block_k = min(block_k, max(sq, 1))
+    # Clamp blocks to the sequence, keeping them lane-aligned (128) so
+    # mid-size sequences stay on the fused kernel (padding fills the rest).
+    if sq >= 128:
+        cap = (sq // 128) * 128
+        block_q = min(block_q, cap)
+        block_k = min(block_k, cap)
+    else:
+        block_q = block_k = max(sq, 1)
 
     # Mosaic requires MXU-tileable blocks on real TPU: head_dim and the
     # Q/K blocks must be lane-aligned (128). Small/odd shapes (tiny test

@@ -82,6 +82,46 @@ def build_mesh(
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def build_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multislice mesh: DCN axes span slices, ICI axes stay inside a slice.
+
+    The standard multislice recipe — e.g. data-parallel across slices over
+    DCN, fsdp/tensor within each slice over ICI:
+        build_hybrid_mesh({"fsdp": 4, "tensor": 4}, {"data": 2})
+    On real multislice TPU this uses the devices' slice topology
+    (mesh_utils.create_hybrid_device_mesh) so collectives on DCN axes never
+    cross ICI rings mid-slice; on single-slice/CPU it degrades to the flat
+    mesh with the per-axis product sizes, keeping tests hermetic.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ici = {n: int(ici_axes.get(n, 1)) for n in AXIS_ORDER}
+    dcn = {n: int(dcn_axes.get(n, 1)) for n in AXIS_ORDER}
+    shape = [ici[n] for n in AXIS_ORDER]
+    dcn_shape = [dcn[n] for n in AXIS_ORDER]
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn_shape, devices=devices, allow_split_physical_axes=True
+        )
+    except (ValueError, AssertionError, AttributeError, KeyError):
+        # no slice topology (CPU sim / single slice): flat reshape
+        total = math.prod(a * b for a, b in zip(shape, dcn_shape))
+        if total != len(devices):
+            raise ValueError(
+                f"hybrid mesh {ici_axes}x{dcn_axes} needs {total} devices, "
+                f"have {len(devices)}"
+            )
+        dev_array = np.array(devices).reshape(
+            [a * b for a, b in zip(shape, dcn_shape)]
+        )
+    return Mesh(dev_array, AXIS_ORDER)
+
+
 @dataclass(frozen=True)
 class ShardingRules:
     """Logical-dimension -> mesh-axes mapping for model tensors.
