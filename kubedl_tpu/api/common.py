@@ -142,6 +142,9 @@ class SchedulingPolicy:
     tpu_slice: str = ""
     # Physical topology request, e.g. "2x4" / "4x4x4".
     tpu_topology: str = ""
+    # Admission priority: higher wins a freed slice; ties go FIFO by gang
+    # creation (net-new — the reference delegates ordering to kube-batch).
+    priority: int = 0
 
 
 @dataclass

@@ -59,6 +59,8 @@ class _GangState:
     tpu_chips: int = 0
     requested_slice: str = ""
     slice_name: Optional[str] = None
+    priority: int = 0
+    seq: int = 0  # admission order for FIFO tie-break
 
 
 class TPUSliceAdmitter(GangScheduler):
@@ -73,6 +75,7 @@ class TPUSliceAdmitter(GangScheduler):
         self._gangs: Dict[str, _GangState] = {}
         # implicit single-pod reservations: pod key -> slice name
         self._solo: Dict[str, str] = {}
+        self._seq = 0  # monotonic gang admission counter
 
     @classmethod
     def with_pool(cls, store: ObjectStore, slice_types: List[str]) -> "TPUSliceAdmitter":
@@ -96,20 +99,25 @@ class TPUSliceAdmitter(GangScheduler):
                          if getattr(job.spec, "run_policy", None) else None)
                 min_member = total
                 requested_slice = ""
+                priority = 0
                 if sched is not None:
                     # Honor MinAvailable (the reference ignored it).
                     if sched.min_available:
                         min_member = min(sched.min_available, total)
                     requested_slice = sched.tpu_slice
+                    priority = int(sched.priority or 0)
                 chips = sum(
                     int(s.replicas or 0) * s.template.spec.tpu_chips()
                     for s in replicas.values()
                 )
+                self._seq += 1
                 state = _GangState(
-                    min_member=min_member, tpu_chips=chips, requested_slice=requested_slice
+                    min_member=min_member, tpu_chips=chips,
+                    requested_slice=requested_slice,
+                    priority=priority, seq=self._seq,
                 )
                 self._gangs[key] = state
-            self._try_reserve(key, state)
+            self._reserve_waiting()
         self._mirror_podgroup(job, state)
         return state
 
@@ -154,9 +162,9 @@ class TPUSliceAdmitter(GangScheduler):
             if state.tpu_chips <= 0:
                 return Placement(node_name="local-cpu")
             if state.slice_name is None:
-                self._try_reserve(gang_key, state)
+                self._reserve_waiting()
             if state.slice_name is None:
-                return None  # no slice free; whole gang stays Pending
+                return None  # no slice free (or higher-priority gangs ahead)
             info = self._slices[state.slice_name]
             return self._place_on_slice(pod, info)
 
@@ -177,6 +185,20 @@ class TPUSliceAdmitter(GangScheduler):
 
     def _free_slices(self) -> List[SliceInfo]:
         return [s for s in self._slices.values() if s.reserved_by is None]
+
+    def _reserve_waiting(self) -> None:
+        """Grant free slices to waiting gangs in (priority desc, FIFO) order
+        so a freed slice goes to the front of the queue, not to whichever
+        gang's executor poll happens to run next."""
+        waiting = sorted(
+            (
+                (k, s) for k, s in self._gangs.items()
+                if s.slice_name is None and s.tpu_chips > 0
+            ),
+            key=lambda kv: (-kv[1].priority, kv[1].seq),
+        )
+        for key, state in waiting:
+            self._try_reserve(key, state)
 
     def _try_reserve(self, key: str, state: _GangState) -> None:
         if state.slice_name is not None or state.tpu_chips <= 0:

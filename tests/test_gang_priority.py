@@ -1,0 +1,70 @@
+"""Gang admission queueing: priority wins a freed slice, ties go FIFO
+(gang/slice_admitter.py _reserve_waiting)."""
+from kubedl_tpu.api.common import ReplicaSpec, RunPolicy, SchedulingPolicy
+from kubedl_tpu.api.job import BaseJob, BaseJobSpec
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import (
+    Container,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+
+
+def _job(name: str, chips: int = 8, priority: int = 0) -> BaseJob:
+    tmpl = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", resources=ResourceRequirements(
+            limits={"google.com/tpu": chips}))
+    ]))
+    return BaseJob(
+        metadata=ObjectMeta(name=name, namespace="default"),
+        spec=BaseJobSpec(
+            replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)},
+            run_policy=RunPolicy(
+                scheduling_policy=SchedulingPolicy(priority=priority)
+            ),
+        ),
+        kind="TestJob",
+    )
+
+
+def _admitter():
+    return TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8"])
+
+
+def test_fifo_when_equal_priority():
+    adm = _admitter()
+    a, b = _job("a"), _job("b")
+    ga = adm.create_gang(a, a.spec.replica_specs)
+    gb = adm.create_gang(b, b.spec.replica_specs)
+    assert ga.slice_name and gb.slice_name is None  # one slice, first wins
+    adm.delete_gang(a)
+    adm._reserve_waiting()
+    assert adm.get_gang("default", "b").slice_name  # freed slice goes to b
+
+
+def test_priority_beats_fifo():
+    adm = _admitter()
+    holder = _job("holder")
+    gh = adm.create_gang(holder, holder.spec.replica_specs)
+    assert gh.slice_name
+    low = _job("low", priority=1)
+    high = _job("high", priority=5)
+    adm.create_gang(low, low.spec.replica_specs)       # queued first
+    adm.create_gang(high, high.spec.replica_specs)     # queued later, higher prio
+    adm.delete_gang(holder)
+    adm._reserve_waiting()
+    assert adm.get_gang("default", "high").slice_name, "priority must win"
+    assert adm.get_gang("default", "low").slice_name is None
+
+
+def test_small_gang_not_blocked_by_unsatisfiable_high_priority():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8"])
+    giant = _job("giant", chips=32, priority=9)  # no slice can ever fit it
+    small = _job("small", chips=8)
+    adm.create_gang(giant, giant.spec.replica_specs)
+    adm.create_gang(small, small.spec.replica_specs)
+    assert adm.get_gang("default", "giant").slice_name is None
+    assert adm.get_gang("default", "small").slice_name  # no head-of-line block
