@@ -30,10 +30,14 @@ ENV_MESH = "KUBEDL_MESH"
 
 
 def parse_mesh_env(value: Optional[str] = None) -> Dict[str, int]:
-    """Parse "data=2,fsdp=4,tensor=1,..." (the operator-injected KUBEDL_MESH)."""
+    """Parse "data=2,fsdp=4,tensor=1,..." (the operator-injected KUBEDL_MESH).
+
+    Unset/empty means pure data parallelism over every visible device
+    (data=-1), so programs run out of the box on any chip count."""
     value = value if value is not None else os.environ.get(ENV_MESH, "")
     axes = {name: 1 for name in AXIS_ORDER}
     if not value:
+        axes["data"] = -1
         return axes
     for part in value.split(","):
         if not part.strip():

@@ -58,7 +58,12 @@ def make_train_step(
     param_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), param_spec_tree
     )
-    batch_sharding = NamedSharding(mesh, batch_spec)
+    # batch_spec may be one P or a pytree of Ps (e.g. (images, labels));
+    # P subclasses tuple, so guard it as a leaf
+    batch_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), batch_spec,
+        is_leaf=lambda x: isinstance(x, P),
+    )
     repl = NamedSharding(mesh, P())
 
     def _init(params):
