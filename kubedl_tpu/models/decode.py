@@ -1,0 +1,131 @@
+"""KV-cache autoregressive decoding for the Llama family.
+
+Inference companion to models/llama.py, built the XLA way: static-shape
+caches ([b, kv_heads, max_len, head_dim], dynamic_update_slice writes) and a
+`lax.scan` token loop — no data-dependent Python control flow, so the whole
+generation compiles once and replays from the HLO cache for any prompt of
+the same padded shape. Attention over the cache is one masked dot product
+(decode is bandwidth-bound, a fused kernel buys nothing at t_q = 1).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.models.llama import LlamaConfig, _lm_head, _rope, rms_norm
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Dict:
+    """Per-layer K/V buffers, bf16 like the weights."""
+    shape = (batch, config.n_kv_heads, max_len, config.head_dim)
+    return {
+        "k": jnp.zeros((config.n_layers,) + shape, config.dtype),
+        "v": jnp.zeros((config.n_layers,) + shape, config.dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(q, ck, cv, length, n_rep):
+    """q [b,hq,1,d] vs cache [b,hkv,L,d]; positions >= length are masked."""
+    if n_rep > 1:
+        ck = jnp.repeat(ck, n_rep, axis=1)
+        cv = jnp.repeat(cv, n_rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32))
+    s = s / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    k_pos = jnp.arange(ck.shape[2])
+    s = jnp.where(k_pos[None, None, None, :] < length, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32))
+
+
+def decode_step(
+    params: Dict,
+    token: jax.Array,  # [b] int32
+    cache: Dict,
+    config: LlamaConfig,
+) -> Tuple[jax.Array, Dict]:
+    """One decode step: returns (logits [b, vocab], updated cache)."""
+    c = config
+    b = token.shape[0]
+    pos = cache["length"]
+    positions = jnp.full((b, 1), pos, jnp.int32)
+
+    x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
+    new_k, new_v = [], []
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        ck = jax.lax.dynamic_update_slice_in_dim(cache["k"][i], k.astype(c.dtype), pos, 2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cache["v"][i], v.astype(c.dtype), pos, 2)
+        new_k.append(ck)
+        new_v.append(cv)
+        attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
+        x = x + (attn.astype(c.dtype) @ layer["wo"]).astype(c.dtype)
+        # dense FFN (decode path targets dense checkpoints)
+        h2 = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        gate = jax.nn.silu((h2 @ layer["w1"]).astype(jnp.float32)).astype(h2.dtype)
+        up = h2 @ layer["w3"]
+        x = x + ((gate * up) @ layer["w2"]).astype(c.dtype)
+
+    cache = {
+        "k": jnp.stack(new_k),
+        "v": jnp.stack(new_v),
+        "length": pos + 1,
+    }
+    logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
+    return logits, cache
+
+
+def prefill(params: Dict, tokens: jax.Array, cache: Dict, config: LlamaConfig):
+    """Feed a [b, t] prompt through the cache one token at a time (scan);
+    returns (logits after the last prompt token, cache)."""
+
+    def body(carry, tok):
+        cache = carry
+        logits, cache = decode_step(params, tok, cache, config)
+        return cache, logits
+
+    cache, logits_seq = jax.lax.scan(body, cache, tokens.T)
+    return logits_seq[-1], cache
+
+
+def generate(
+    params: Dict,
+    prompt: jax.Array,  # [b, t] int32
+    config: LlamaConfig,
+    max_new_tokens: int,
+    max_len: Optional[int] = None,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens]."""
+    b, t = prompt.shape
+    max_len = max_len or (t + max_new_tokens)
+    cache = init_kv_cache(config, b, max_len)
+    logits, cache = prefill(params, prompt, cache, config)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    def pick(logits, k):
+        if temperature > 0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def body(carry, k):
+        logits, cache = carry
+        tok = pick(logits, k).astype(jnp.int32)
+        logits, cache = decode_step(params, tok, cache, config)
+        return (logits, cache), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), toks = jax.lax.scan(body, (logits, cache), keys)
+    return toks.T  # [b, max_new_tokens]

@@ -1,0 +1,62 @@
+"""KV-cache decoding (models/decode.py) must agree with the training-path
+forward — the cache is an optimization, not a different model."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import decode, llama
+
+
+def _setup(batch=2, t=7):
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, t), 0, config.vocab_size)
+    return config, params, tokens
+
+
+def test_prefill_matches_full_forward():
+    config, params, tokens = _setup()
+    full = llama.forward(params, tokens, config)  # [b, t, vocab]
+    cache = decode.init_kv_cache(config, tokens.shape[0], 16)
+    last, cache = decode.prefill(params, tokens, cache, config)
+    np.testing.assert_allclose(
+        np.asarray(last), np.asarray(full[:, -1]), rtol=1e-4, atol=1e-4
+    )
+    assert int(cache["length"]) == tokens.shape[1]
+
+
+def test_decode_step_matches_incremental_forward():
+    config, params, tokens = _setup(t=5)
+    cache = decode.init_kv_cache(config, tokens.shape[0], 8)
+    # feed one token at a time; step logits must equal the full forward's
+    # logits at that position
+    full = llama.forward(params, tokens, config)
+    for i in range(tokens.shape[1]):
+        logits, cache = decode.decode_step(params, tokens[:, i], cache, config)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]), rtol=1e-4, atol=1e-4,
+            err_msg=f"position {i}",
+        )
+
+
+def test_greedy_generate_matches_teacher_forced_argmax():
+    config, params, tokens = _setup(batch=1, t=4)
+    out = decode.generate(params, tokens, config, max_new_tokens=3)
+    assert out.shape == (1, 3)
+    # replay with the full forward: next token = argmax at the last position
+    seq = tokens
+    for i in range(3):
+        logits = llama.forward(params, seq, config)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
+        assert int(nxt[0, 0]) == int(out[0, i]), f"step {i}"
+        seq = jnp.concatenate([seq, nxt], axis=1)
+
+
+def test_sampled_generate_shape_and_range():
+    config, params, tokens = _setup(batch=2, t=3)
+    out = decode.generate(
+        params, tokens, config, max_new_tokens=4, temperature=0.8,
+        key=jax.random.PRNGKey(7),
+    )
+    assert out.shape == (2, 4)
+    assert int(out.min()) >= 0 and int(out.max()) < config.vocab_size
