@@ -1,0 +1,49 @@
+"""Real multi-PROCESS rendezvous through the operator: a 2-worker JAXJob
+whose pods each run jax.distributed.initialize from the injected coordinator
+env and execute a cross-process collective. This is process-level
+distribution in CI — beyond the reference's test strategy, which only
+asserts on generated env JSON (SURVEY.md §4 item 8)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubedl_tpu.operator import Operator, OperatorConfig
+from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+
+@pytest.mark.parametrize("replicas", [2])
+def test_two_process_jaxjob_rendezvous_and_collective(replicas, tmp_path):
+    op = Operator(OperatorConfig())
+    op.register(JAXJobController())
+    op.start()
+    try:
+        job = op.apply({
+            "apiVersion": "kubedl-tpu.io/v1alpha1",
+            "kind": "JAXJob",
+            "metadata": {"name": "dist-smoke"},
+            "spec": {
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": replicas,
+                    "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [{
+                        "name": "jax",
+                        "command": [
+                            sys.executable, "-m",
+                            "kubedl_tpu.train.smoke_distributed",
+                        ],
+                        # each process gets its own single CPU device so the
+                        # collective genuinely crosses process boundaries
+                        "env": {"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+                    }]}},
+                }},
+            },
+        })
+        ok = op.wait_for_condition(job, "Succeeded", timeout=120)
+        if not ok:
+            fresh = op.get_job("JAXJob", "default", "dist-smoke")
+            pytest.fail(f"rendezvous job did not succeed: {fresh.status.conditions}")
+    finally:
+        op.stop()
