@@ -48,13 +48,19 @@ def make_train_step(
     param_spec_tree: Any,
     batch_spec: P,
     rules: Optional[ShardingRules] = None,
+    accum_steps: int = 1,
 ) -> Tuple[Callable, Callable]:
     """Returns (init_state, train_step), both jitted over the mesh.
 
     init_state(params) -> TrainState with sharded params/opt state.
     train_step(state, batch) -> (state, metrics) with donated state.
+    accum_steps > 1 accumulates gradients over that many micro-steps
+    before applying the update (optax.MultiSteps) — the HBM-for-batch
+    trade when the global batch doesn't fit.
     """
     rules = rules or ShardingRules()
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
     param_sharding = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), param_spec_tree
     )

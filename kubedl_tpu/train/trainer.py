@@ -31,6 +31,9 @@ def parse_args(argv=None):
     p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
     p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--accum-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_ACCUM_STEPS", 1)),
+                   help="gradient accumulation micro-steps per update")
     p.add_argument("--log-every", type=int, default=10)
     # token shards (flat int32 files; native/loader.py). Unset -> synthetic.
     p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
@@ -98,7 +101,8 @@ def main(argv=None) -> int:
     tx = optax.adamw(args.lr, weight_decay=0.01)
     try:
         init_state, train_step = make_train_step(
-            loss, tx, mesh, spec_tree, rules.spec("batch", None), rules
+            loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,
+            accum_steps=args.accum_steps,
         )
         state = init_state(params)
     except Exception as e:

@@ -149,3 +149,41 @@ def test_llama_train_step_with_context_parallelism():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, cfg.vocab_size)
     state, metrics = train_step(state, tokens)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_grad_accumulation_matches_big_batch():
+    """accum_steps=2 on half batches must equal one step on the full batch."""
+    import optax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    mesh = build_mesh({"data": 8})
+    rules = ShardingRules()
+    params = llama.init(config, jax.random.PRNGKey(0))
+    spec_tree = llama.param_specs(config, rules)
+
+    def loss(p, tokens):
+        return llama.loss_fn(p, tokens, config, mesh=mesh, rules=rules)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (16, 17), 0, config.vocab_size)
+
+    init_a, step_a = make_train_step(
+        loss, optax.sgd(1e-2), mesh, spec_tree, rules.spec("batch", None), rules
+    )
+    state_a = init_a(params)
+    state_a, _ = step_a(state_a, tokens)
+
+    init_b, step_b = make_train_step(
+        loss, optax.sgd(1e-2), mesh, spec_tree, rules.spec("batch", None), rules,
+        accum_steps=2,
+    )
+    state_b = init_b(params)
+    state_b, _ = step_b(state_b, tokens[:8])
+    state_b, _ = step_b(state_b, tokens[8:])
+
+    a = jax.tree_util.tree_leaves(state_a.params)
+    b = jax.tree_util.tree_leaves(state_b.params)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5)
