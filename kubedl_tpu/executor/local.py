@@ -141,6 +141,8 @@ class LocalPodExecutor:
             # 2. init containers run sequentially to completion
             for c in pod.spec.init_containers:
                 rc = self._run_container(entry, c, volumes, placement, wait=True)
+                if rc is not None and rc < 0:
+                    rc = 128 - rc  # signal death -> kubelet-style 128+signum
                 if rc != 0:
                     self._set_status(
                         key, PodPhase.FAILED,
@@ -165,7 +167,11 @@ class LocalPodExecutor:
                 )
                 exit_codes = {}
                 for name, proc in list(entry.procs.items()):
-                    exit_codes[name] = proc.wait()
+                    rc = proc.wait()
+                    # signal deaths surface as negative returncodes from
+                    # Popen; kubelets report 128+signum (SIGTERM -> 143,
+                    # which the ExitCode policy treats as retryable)
+                    exit_codes[name] = 128 - rc if rc < 0 else rc
                 if entry.stop or self._stop.is_set():
                     return
                 failed = {n: rc for n, rc in exit_codes.items() if rc != 0}
