@@ -59,6 +59,7 @@ class LocalPodExecutor:
         scheduler=None,
         restart_backoff: float = 0.05,
         launch_hook=None,
+        log_dir: Optional[str] = None,
     ) -> None:
         self.store = store
         # Optional TPU-slice scheduler (gang admission): pod stays Pending
@@ -66,11 +67,43 @@ class LocalPodExecutor:
         self.scheduler = scheduler
         self.restart_backoff = restart_backoff
         self.launch_hook = launch_hook  # test seam: fn(pod) -> env overrides
+        # container stdout/stderr land here (kubectl-logs equivalent),
+        # appended across in-place restarts, removed when the pod is deleted
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="kubedl-logs-")
         self._running: Dict[str, _RunningPod] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watch = None
         self._thread: Optional[threading.Thread] = None
+
+    # -- logs ------------------------------------------------------------
+
+    def _pod_log_dir(self, namespace: str, name: str) -> str:
+        return os.path.join(self.log_dir, f"{namespace}_{name}")
+
+    def read_logs(
+        self, namespace: str, name: str, container: Optional[str] = None,
+        tail: Optional[int] = None,
+    ) -> str:
+        """Concatenated logs of one pod (optionally one container)."""
+        d = self._pod_log_dir(namespace, name)
+        try:
+            files = sorted(os.listdir(d))
+        except OSError:
+            return ""
+        if container is not None:
+            files = [f for f in files if f == f"{container}.log"]
+        chunks = []
+        for f in files:
+            try:
+                with open(os.path.join(d, f), "r", errors="replace") as fh:
+                    chunks.append(fh.read())
+            except OSError:
+                continue
+        text = "".join(chunks)
+        if tail is not None:
+            text = "\n".join(text.splitlines()[-tail:])
+        return text
 
     # -- lifecycle -------------------------------------------------------
 
@@ -105,6 +138,12 @@ class LocalPodExecutor:
                     self._kill(entry)
                 if self.scheduler is not None:
                     self.scheduler.release(ev.obj)
+                shutil.rmtree(
+                    self._pod_log_dir(
+                        ev.obj.metadata.namespace, ev.obj.metadata.name
+                    ),
+                    ignore_errors=True,
+                )
 
     def _maybe_launch(self, key: str, pod: Pod) -> None:
         with self._lock:
@@ -268,11 +307,17 @@ class LocalPodExecutor:
             else:
                 argv = ["true"]
         cwd = container.working_dir or entry.workdir
-        proc = subprocess.Popen(
-            argv, env=env, cwd=cwd,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True,
-        )
+        log_dir = self._pod_log_dir(pod.metadata.namespace, pod.metadata.name)
+        os.makedirs(log_dir, exist_ok=True)
+        log_fh = open(os.path.join(log_dir, f"{container.name}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, env=env, cwd=cwd,
+                stdout=log_fh, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        finally:
+            log_fh.close()  # child holds its own fd
         if wait:
             return proc.wait()
         entry.procs[container.name] = proc
