@@ -1,143 +1,436 @@
 """Benchmark — prints ONE JSON line for the driver.
 
 Headline metric (BASELINE.md): p50 job-launch delay through the full
-operator stack (job created -> first pod Ready), against the reference
-north-star target of 60 s on GKE. Extras: flagship Llama training
-throughput and MNIST steps/s on the real chip (measured in a subprocess so
-a wedged TPU tunnel degrades to the control-plane metric instead of
-hanging the bench).
+operator stack (job created -> first pod Ready), measured over the REAL
+example manifests (examples/tf_job_mnist.yaml + examples/jax_job_mnist.yaml),
+against the reference north-star target of 60 s on GKE.
+
+Extras come from a single TPU child process that streams one JSON line per
+milestone (probe -> flash check -> embedding -> mnist -> llama) into a
+results file, so a wedged TPU tunnel or a blown budget degrades to partial
+numbers instead of erasing everything (round-1 failure mode: both extras
+`timeout`). The child enables the JAX persistent compilation cache so a
+retried round pays compile costs once.
+
+The axon remote-TPU platform resolves async dispatch on enqueue-ack, so all
+timing syncs with jax.device_get (never block_until_ready).
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
 import statistics
 import subprocess
 import sys
 import time
 
+REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_LAUNCH_DELAY_S = 60.0  # BASELINE.json north star: p50 < 60 s
+CACHE_DIR = os.path.join(REPO, ".bench_cache")
+
+# Stage budgets (seconds). The TPU child owns TOTAL; the parent only kills it
+# after TOTAL + KILL_GRACE so milestones decide their own pacing.
+TOTAL_TPU_BUDGET = float(os.environ.get("KUBEDL_BENCH_TPU_BUDGET", "1500"))
+KILL_GRACE = 45.0
 
 
-def bench_launch_delay(jobs: int = 5):
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+# ---------------------------------------------------------------------------
+# Headline: launch delay over the real example manifests (VERDICT r1 item 7)
+# ---------------------------------------------------------------------------
+
+
+def _load_manifest(name):
+    import yaml
+
+    with open(os.path.join(REPO, "examples", name)) as f:
+        docs = [m for m in yaml.safe_load_all(f) if m]
+    return docs
+
+
+def _trim_for_bench(manifest):
+    """Force the training command onto CPU with few steps: the launch-delay
+    metric measures the operator+executor path (create -> first pod Ready),
+    not the training itself, and the TPU chip belongs to the TPU child."""
+    spec = manifest["spec"]
+    replica_key = next(k for k in spec if k.endswith("ReplicaSpecs"))
+    for rspec in spec[replica_key].values():
+        for c in rspec["template"]["spec"]["containers"]:
+            env = dict(c.get("env") or {})
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("XLA_FLAGS", None)
+            c["env"] = env
+            cmd = list(c.get("command") or [])
+            if "--steps" in cmd:
+                cmd[cmd.index("--steps") + 1] = "2"
+            c["command"] = cmd
+    return manifest
+
+
+def bench_launch_delay(iterations: int = 3):
     from kubedl_tpu.operator import Operator, OperatorConfig
-    from fake_workload import TEST_KIND, TestJobController
+
+    manifests = []
+    for fname in ("tf_job_mnist.yaml", "jax_job_mnist.yaml"):
+        manifests.extend(_trim_for_bench(m) for m in _load_manifest(fname))
 
     op = Operator(OperatorConfig())
-    op.register(TestJobController())
+    op.register_all()
     op.start()
-    delays = []
+    delays, kinds = [], set()
     try:
-        for i in range(jobs):
-            name = f"bench-{i}"
-            manifest = {
-                "kind": TEST_KIND,
-                "metadata": {"name": name},
-                "spec": {"replicaSpecs": {"Worker": {
-                    "replicas": 2, "restartPolicy": "Never",
-                    "template": {"spec": {"containers": [{
-                        # long enough for the Running transition (and its
-                        # launch-delay observation) to be reconciled
-                        "name": "test-container", "command": ["/bin/sh", "-c", "sleep 0.5"],
-                    }]}},
-                }}},
-            }
-            job = op.apply(manifest)
-            op.wait_for_condition(job, "Succeeded", timeout=30)
-        jm = op.metrics_registry.get(TEST_KIND)
-        delays = [d for _, d in jm.first_launch_delays]
+        for i in range(iterations):
+            jobs = []
+            for m in manifests:
+                m = json.loads(json.dumps(m))  # deep copy per iteration
+                m["metadata"]["name"] = f"{m['metadata']['name']}-r{i}"
+                jobs.append(op.apply(m))
+                kinds.add(m["kind"])
+            for job in jobs:
+                op.wait_for_condition(job, "Succeeded", timeout=120)
+        for kind in kinds:
+            jm = op.metrics_registry.get(op._kind_by_lower[kind.lower()])
+            if jm is not None:
+                delays.extend(d for _, d in jm.first_launch_delays)
     finally:
         op.stop()
-    return statistics.median(delays) if delays else None
+    return (statistics.median(delays) if delays else None), sorted(kinds), len(delays)
 
 
-_LLAMA_SNIPPET = r"""
-import json, time, sys
-import jax, jax.numpy as jnp, numpy as np, optax
-from kubedl_tpu.models import llama
-from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
-from kubedl_tpu.parallel.train_step import make_train_step
-
-config = llama.LlamaConfig(
-    vocab_size=32000, d_model=2048, n_layers=16, n_heads=16, n_kv_heads=16,
-    d_ff=5632, max_seq_len=2048, remat=True)
-rules = ShardingRules()
-mesh = build_mesh({"data": len(jax.devices())})
-params = llama.init(config, jax.random.PRNGKey(0))
-spec_tree = llama.param_specs(config, rules)
-
-def loss(params, batch):
-    return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
-
-init_state, train_step = make_train_step(
-    loss, optax.adamw(3e-4), mesh, spec_tree, rules.spec("batch", None), rules)
-state = init_state(params)
-BATCH, SEQ = 8, 2049
-tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0, config.vocab_size)
-state, metrics = train_step(state, tokens)  # compile
-jax.device_get(metrics["loss"])  # full sync: on the remote-TPU platform
-# block_until_ready can return before compute finishes; device_get can't
-STEPS = 10
-t0 = time.perf_counter()
-for _ in range(STEPS):
-    state, metrics = train_step(state, tokens)
-jax.device_get(metrics["loss"])
-dt = time.perf_counter() - t0
-tok_s = STEPS * BATCH * (SEQ - 1) / dt
-nparams = llama.param_count(state.params)
-flops_per_tok = 6 * nparams
-mfu_denom = 197e12  # v5e bf16 peak flop/s per chip
-print(json.dumps({
-    "llama_tokens_per_sec": tok_s,
-    "llama_params": nparams,
-    "llama_step_s": dt / STEPS,
-    "llama_mfu": tok_s * flops_per_tok / mfu_denom,
-    "device": str(jax.devices()[0]),
-}))
-"""
-
-_MNIST_SNIPPET = r"""
-import json, time
-import sys
-from kubedl_tpu.train import mnist
-import io, contextlib
-buf = io.StringIO()
-with contextlib.redirect_stdout(buf):
-    mnist.main(["--steps", "200", "--batch", "512"])
-line = buf.getvalue().strip().splitlines()[-1]
-sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
-print(json.dumps({"mnist_steps_per_sec": sps}))
-"""
+# ---------------------------------------------------------------------------
+# TPU child: streams one JSON line per milestone into the results file
+# ---------------------------------------------------------------------------
 
 
-def _run_snippet(snippet: str, timeout: float):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (
-        os.path.dirname(os.path.abspath(__file__)) + os.pathsep + env.get("PYTHONPATH", "")
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", snippet],
-            capture_output=True, text=True, timeout=timeout, env=env,
+def _emit(out, key, payload):
+    payload = {"k": key, **payload}
+    out.write(json.dumps(payload) + "\n")
+    out.flush()
+    os.fsync(out.fileno())
+
+
+def _tpu_child(results_path: str) -> int:
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    import jax
+
+    if os.environ.get("KUBEDL_BENCH_FORCE_CPU"):
+        # sitecustomize pins jax_platforms to the remote TPU and config
+        # beats the JAX_PLATFORMS env var, so testing needs this knob.
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    deadline = time.monotonic() + TOTAL_TPU_BUDGET
+    out = open(results_path, "a")
+
+    def left():
+        return deadline - time.monotonic()
+
+    # -- 1. probe: dial the tunnel with a tiny matmul -----------------------
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    float(jax.device_get(jnp.sum((x @ x).astype(jnp.float32))))
+    _emit(out, "probe", {"device": str(dev), "dial_s": round(time.perf_counter() - t0, 2)})
+
+    is_tpu = dev.platform != "cpu"
+    peak_flops = 197e12 if is_tpu else 1e12  # v5e bf16 peak per chip
+    small = bool(os.environ.get("KUBEDL_BENCH_SMALL"))  # CPU smoke shapes
+
+    # -- 2. flash attention: numeric check + timing on the chip -------------
+    def flash_milestone():
+        from kubedl_tpu.ops.flash_attention import attention_reference, flash_attention
+
+        b, h, s, d = (1, 2, 256, 128) if small else (4, 8, 1024, 128)
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True).astype(jnp.float32))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True).astype(jnp.float32))
+
+        o_f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+        o_r = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))(q, k, v)
+        fwd_err = float(jax.device_get(jnp.max(jnp.abs(
+            o_f.astype(jnp.float32) - o_r.astype(jnp.float32)))))
+        g_f = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_r = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+        bwd_err = max(
+            float(jax.device_get(jnp.max(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)))))
+            for a, b_ in zip(g_f, g_r)
         )
-        if proc.returncode != 0:
-            return {"error": (proc.stderr or "")[-300:]}
-        for line in reversed(proc.stdout.strip().splitlines()):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-        return {"error": "no json output"}
-    except subprocess.TimeoutExpired:
-        return {"error": "timeout"}
+
+        fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        jax.device_get(fwd(q, k, v))  # warm
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = fwd(q, k, v)
+        jax.device_get(o)
+        dt = (time.perf_counter() - t0) / iters
+        # causal fwd: 2 matmuls * b*h*s^2*d MACs, half masked
+        flops = 2 * 2 * b * h * s * s * d / 2
+        # reference timing for speedup
+        ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
+        jax.device_get(ref(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = ref(q, k, v)
+        jax.device_get(o)
+        dt_ref = (time.perf_counter() - t0) / iters
+        _emit(out, "flash", {
+            "flash_max_err": round(fwd_err, 5),
+            "flash_bwd_max_err": round(bwd_err, 5),
+            "flash_tflops": round(flops / dt / 1e12, 2),
+            "flash_us": round(dt * 1e6, 1),
+            "ref_us": round(dt_ref * 1e6, 1),
+            "speedup_vs_unfused": round(dt_ref / dt, 2),
+            "shape": [b, h, s, d],
+        })
+
+    # -- 3. sharded embedding lookup+update vs dense gather baseline --------
+    def embedding_milestone():
+        import optax
+
+        from kubedl_tpu.models.embedding import init_table, sparse_lookup
+        from kubedl_tpu.parallel.mesh import build_mesh
+
+        mesh = build_mesh({"tensor": len(jax.devices())})
+        V, d, B, L = (1 << 14, 64, 256, 16) if small else (1 << 20, 128, 4096, 32)
+        table = init_table(jax.random.PRNGKey(0), V, d)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (B, L), 0, V)
+        tx = optax.sgd(0.1)
+        opt = tx.init(table)
+
+        def step(table, opt, ids):
+            def loss(tab):
+                emb = sparse_lookup(tab, ids, mesh, combiner="sum")
+                return jnp.sum(emb.astype(jnp.float32) ** 2)
+
+            g = jax.grad(loss)(table)
+            up, opt = tx.update(g, opt)
+            return optax.apply_updates(table, up), opt
+
+        step_j = jax.jit(step, donate_argnums=(0, 1))
+        table, opt = step_j(table, opt, ids)  # compile
+        jax.device_get(jnp.sum(table[:1]))
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            table, opt = step_j(table, opt, ids)
+        jax.device_get(jnp.sum(table[:1]))
+        dt = (time.perf_counter() - t0) / iters
+
+        # dense gather baseline (whole-table one-hot-free take, no sharding)
+        def step_dense(table, opt, ids):
+            def loss(tab):
+                emb = jnp.sum(jnp.take(tab, ids.reshape(-1), axis=0)
+                              .reshape(B, L, d), axis=1)
+                return jnp.sum(emb.astype(jnp.float32) ** 2)
+
+            g = jax.grad(loss)(table)
+            up, opt = tx.update(g, opt)
+            return optax.apply_updates(table, up), opt
+
+        table2 = init_table(jax.random.PRNGKey(0), V, d)
+        opt2 = tx.init(table2)
+        dense_j = jax.jit(step_dense, donate_argnums=(0, 1))
+        table2, opt2 = dense_j(table2, opt2, ids)
+        jax.device_get(jnp.sum(table2[:1]))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            table2, opt2 = dense_j(table2, opt2, ids)
+        jax.device_get(jnp.sum(table2[:1]))
+        dt_dense = (time.perf_counter() - t0) / iters
+        _emit(out, "embedding", {
+            "embedding_lookups_per_sec": round(B * L / dt, 0),
+            "embedding_step_ms": round(dt * 1e3, 3),
+            "dense_gather_step_ms": round(dt_dense * 1e3, 3),
+            "table": [V, d], "batch": [B, L],
+        })
+
+    # -- 4. MNIST steps/sec -------------------------------------------------
+    def mnist_milestone():
+        import contextlib
+        import io
+
+        from kubedl_tpu.train import mnist
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            mnist.main(["--steps", "20" if small else "200", "--batch", "512"])
+        line = buf.getvalue().strip().splitlines()[-1]
+        sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
+        _emit(out, "mnist", {"mnist_steps_per_sec": sps})
+
+    # -- 5. llama throughput/MFU (small proof first, then the 1B target) ----
+    def llama_milestone(config_name, batch, seq, steps, key):
+        import optax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+        from kubedl_tpu.parallel.train_step import make_train_step
+
+        configs = {
+            "tiny": llama.LlamaConfig.tiny(use_flash=False),
+            "150m": llama.LlamaConfig(
+                vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+                n_kv_heads=8, d_ff=2816, max_seq_len=seq, remat=True),
+            "1b": llama.LlamaConfig.bench_1b(),
+        }
+        config = configs[config_name]
+        rules = ShardingRules()
+        mesh = build_mesh({"data": len(jax.devices())})
+        params = llama.init(config, jax.random.PRNGKey(0))
+        spec_tree = llama.param_specs(config, rules)
+
+        def loss(params, batch_tokens):
+            return llama.loss_fn(params, batch_tokens, config, mesh=mesh, rules=rules)
+
+        init_state, train_step = make_train_step(
+            loss, optax.adamw(3e-4), mesh, spec_tree, rules.spec("batch", None), rules)
+        state = init_state(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                                    config.vocab_size)
+        t0 = time.perf_counter()
+        state, metrics = train_step(state, tokens)
+        jax.device_get(metrics["loss"])
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train_step(state, tokens)
+        jax.device_get(metrics["loss"])
+        dt = time.perf_counter() - t0
+        tok_s = steps * batch * seq / dt
+        nparams = llama.param_count(state.params)
+        mfu = tok_s * 6 * nparams / peak_flops
+        _emit(out, key, {
+            f"llama_{config_name}_tokens_per_sec": round(tok_s, 0),
+            f"llama_{config_name}_step_s": round(dt / steps, 3),
+            f"llama_{config_name}_mfu": round(mfu, 4),
+            f"llama_{config_name}_compile_s": round(compile_s, 1),
+            "params": nparams, "loss": round(float(metrics["loss"]), 3),
+        })
+        del state, params
+        return mfu
+
+    milestones = [
+        ("flash", flash_milestone, 200),
+        ("embedding", embedding_milestone, 150),
+        ("mnist", mnist_milestone, 250),
+    ]
+    for name, fn, min_budget in milestones:
+        if left() < min_budget:
+            _emit(out, name, {"skipped": f"budget exhausted ({left():.0f}s left)"})
+            continue
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - report, keep going
+            _emit(out, name, {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    # Llama: prove the path on a ~150M model, then attempt the 1B target
+    # with whatever budget remains (it needs most of it for first compile).
+    try:
+        if left() > 120:
+            llama_milestone("tiny" if small else "150m",
+                            batch=2 if small else 8, seq=128 if small else 1024,
+                            steps=3 if small else 10, key="llama_150m")
+        else:
+            _emit(out, "llama_150m", {"skipped": f"budget exhausted ({left():.0f}s left)"})
+    except Exception as e:  # noqa: BLE001
+        _emit(out, "llama_150m", {"error": f"{type(e).__name__}: {e}"[:300]})
+    try:
+        if small:
+            _emit(out, "llama_1b", {"skipped": "KUBEDL_BENCH_SMALL set"})
+        elif left() > 240:
+            llama_milestone("1b", batch=8, seq=2048, steps=10, key="llama_1b")
+        else:
+            _emit(out, "llama_1b", {"skipped": f"budget exhausted ({left():.0f}s left)",
+                                    "fallback": "llama_150m"})
+    except Exception as e:  # noqa: BLE001
+        _emit(out, "llama_1b", {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    _emit(out, "done", {"budget_left_s": round(left(), 1)})
+    out.close()
+    return 0
+
+
+def _run_tpu_child(results_path: str):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    open(results_path, "w").close()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tpu-child", results_path],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    return proc
+
+
+def _collect_results(results_path: str):
+    extras = {}
+    try:
+        with open(results_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                key = rec.pop("k", "unknown")
+                extras[key] = rec
+    except FileNotFoundError:
+        pass
+    return extras
 
 
 def main() -> int:
-    extras = {}
-    p50 = bench_launch_delay()
-    extras["llama"] = _run_snippet(_LLAMA_SNIPPET, timeout=600)
-    extras["mnist"] = _run_snippet(_MNIST_SNIPPET, timeout=300)
+    if len(sys.argv) > 2 and sys.argv[1] == "--tpu-child":
+        return _tpu_child(sys.argv[2])
+
+    results_path = os.path.join(REPO, ".bench_results.jsonl")
+    child = _run_tpu_child(results_path)
+    t_child0 = time.monotonic()
+
+    try:
+        p50, kinds, n = bench_launch_delay()
+    except Exception:
+        # Never orphan the TPU child — it would hold the tunnel for the
+        # whole budget after the parent dies.
+        child.send_signal(signal.SIGINT)
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+        raise
+
+    # Wait for the TPU child within its budget (+grace), then stop it.
+    # SIGINT first: killing an axon client mid-compile can wedge the tunnel.
+    while child.poll() is None and time.monotonic() - t_child0 < TOTAL_TPU_BUDGET + KILL_GRACE:
+        time.sleep(2)
+    timed_out = child.poll() is None
+    if timed_out:
+        child.send_signal(signal.SIGINT)
+        try:
+            child.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait(timeout=10)
+
+    extras = _collect_results(results_path)
+    if timed_out:
+        extras["tpu_child"] = {"error": "budget exceeded; partial results kept"}
+    elif child.returncode not in (0, None):
+        extras.setdefault("tpu_child", {"error": f"exit {child.returncode}"})
+    extras["launch_bench"] = {"manifests": kinds, "samples": n}
 
     result = {
         "metric": "job_launch_delay_p50",

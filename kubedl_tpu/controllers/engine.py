@@ -67,6 +67,10 @@ log = logging.getLogger("kubedl_tpu.engine")
 
 EXIT_CODE_MAGIC = 0xBEEF  # "no terminated default container seen" sentinel
 
+# Failure-retry pacing (ref BackoffStatesQueue rate limiter defaults).
+BACKOFF_BASE_DELAY_S = 0.005
+BACKOFF_MAX_DELAY_S = 60.0
+
 
 @dataclass
 class EngineConfig:
@@ -104,6 +108,11 @@ class JobReconciler:
         self.config = config or EngineConfig()
         self.expectations = ControllerExpectations()
         self.runner: Optional[ControllerRunner] = None
+        # Dedicated failure-backoff states (ref job_controller.go:85-88
+        # BackoffStatesQueue) — counts only observed pod failures, never
+        # status-write conflicts, so conflict churn can't burn the
+        # backoff limit.
+        self._failure_backoff: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # Watch wiring (ref tfjob_controller.go:128-164 and pod.go:53-163)
@@ -119,6 +128,7 @@ class JobReconciler:
         job = event.obj
         key = f"{job.metadata.namespace}/{job.metadata.name}"
         if event.type == DELETED:
+            self._failure_backoff.pop(key, None)
             for rt in self.controller.replica_specs(job):
                 self.expectations.delete_expectations(pods_expectation_key(key, rt))
                 self.expectations.delete_expectations(services_expectation_key(key, rt))
@@ -221,7 +231,7 @@ class JobReconciler:
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
 
-        previous_retry = self.runner.queue.num_requeues(key) if self.runner else 0
+        previous_retry = self._failure_backoff.get(key, 0)
         active_pods = utils.filter_active_pods(pods)
         active = len(active_pods)
         failed = utils.filter_pod_count(pods, PodPhase.FAILED)
@@ -230,8 +240,8 @@ class JobReconciler:
 
         job_exceeds_limit = False
         failure_message = ""
+        job_has_new_failure = failed > prev_failed
         if run_policy.backoff_limit is not None:
-            job_has_new_failure = failed > prev_failed
             exceeds_backoff = (
                 job_has_new_failure
                 and active != total_replicas
@@ -285,6 +295,16 @@ class JobReconciler:
 
         if status != old_status:
             self._write_status(job, status)
+        if job_has_new_failure:
+            # Count the failure and pace the retry exponentially; a
+            # status-write Conflict requeue deliberately does NOT reach
+            # this counter (it raises out of _write_status above).
+            self._failure_backoff[key] = previous_retry + 1
+            return Result(
+                requeue_after=min(
+                    BACKOFF_BASE_DELAY_S * (2 ** previous_retry), BACKOFF_MAX_DELAY_S
+                )
+            )
         return Result()
 
     # ------------------------------------------------------------------
@@ -295,6 +315,8 @@ class JobReconciler:
         self, job, replicas, status, old_status, run_policy, pods,
         job_exceeds_limit: bool, failure_message: str,
     ) -> Result:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        self._failure_backoff.pop(key, None)  # terminal: forget backoff state
         self._delete_pods_and_services(run_policy, job, pods)
 
         result = self._cleanup_job(run_policy, status, job)
@@ -550,14 +572,53 @@ class JobReconciler:
             block_owner_deletion=True,
         )
 
+    def _selector_matches(self, job, obj) -> bool:
+        selector = utils.gen_labels(job.metadata.name)
+        return all(obj.metadata.labels.get(k) == v for k, v in selector.items())
+
+    def _can_adopt(self, job) -> bool:
+        """Uncached deletion-timestamp recheck before the first adoption
+        (ref pkg/job_controller/util.go:33-49 RecheckDeletionTimestamp):
+        adopting while the job is being deleted would resurrect orphans."""
+        try:
+            fresh = self.store.get(
+                self.controller.kind, job.metadata.namespace, job.metadata.name
+            )
+        except NotFound:
+            return False
+        return fresh.metadata.deletion_timestamp is None
+
     def _claim(self, job, objs):
-        """Adopt label-matched orphans; drop objects owned by someone else."""
+        """Adopt matching orphans / release owned objects whose labels
+        drifted (ref pkg/job_controller/service_ref_manager.go:48-110
+        ClaimServices semantics, shared by the pod path)."""
         claimed = []
+        can_adopt: Optional[bool] = None  # lazily checked, at most once
         for obj in objs:
+            matches = self._selector_matches(job, obj)
             ref = obj.metadata.controller_ref()
             if ref is not None:
-                if ref.uid == job.metadata.uid:
+                if ref.uid != job.metadata.uid:
+                    continue  # owned by someone else
+                if matches:
                     claimed.append(obj)
+                    continue
+                # Owned but labels drifted: release so another controller
+                # (or nobody) can own it; ignore races — next pass retries.
+                obj.metadata.owner_references = [
+                    r for r in obj.metadata.owner_references
+                    if r.uid != job.metadata.uid
+                ]
+                try:
+                    self.store.update(obj)
+                except (Conflict, NotFound):
+                    pass
+                continue
+            if not matches or obj.metadata.deletion_timestamp is not None:
+                continue
+            if can_adopt is None:
+                can_adopt = self._can_adopt(job)
+            if not can_adopt:
                 continue
             obj.metadata.owner_references.append(self._owner_ref(job))
             try:
@@ -568,19 +629,13 @@ class JobReconciler:
         return claimed
 
     def get_pods_for_job(self, job) -> List[Pod]:
-        pods = self.store.list(
-            "Pod",
-            namespace=job.metadata.namespace,
-            label_selector=utils.gen_labels(job.metadata.name),
-        )
+        # List the whole namespace (not just selector matches) so owned
+        # objects whose labels drifted are seen and released.
+        pods = self.store.list("Pod", namespace=job.metadata.namespace)
         return self._claim(job, pods)
 
     def get_services_for_job(self, job) -> List[Service]:
-        services = self.store.list(
-            "Service",
-            namespace=job.metadata.namespace,
-            label_selector=utils.gen_labels(job.metadata.name),
-        )
+        services = self.store.list("Service", namespace=job.metadata.namespace)
         return self._claim(job, services)
 
     # ------------------------------------------------------------------

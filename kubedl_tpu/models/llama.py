@@ -247,7 +247,12 @@ def forward_and_aux(
 
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    x = params["embed"][tokens].astype(config.dtype)
+    # FSDP-gather the table's embed dim before the lookup: a gather whose
+    # output inherits a feature-dim sharding forces SPMD into an involuntary
+    # full rematerialization when the result is then batch-sharded; with the
+    # embed dim unsharded the output reshards by a cheap dynamic-slice.
+    tbl = constrain(params["embed"], "vocab", None)
+    x = tbl[tokens].astype(config.dtype)
     x = constrain(x, "batch", "seq", None)
 
     def layer_fn(carry, layer):

@@ -243,3 +243,181 @@ def test_succeeded_moves_active_to_succeeded_counts():
     status = store.get(TEST_KIND, "default", "test-job").status
     assert status.replica_statuses["Worker"].succeeded == 2
     assert status.replica_statuses["Worker"].active == 0
+
+
+# ---------------------------------------------------------------------------
+# Adoption / release parity (ref service_ref_manager.go:48-110, util.go:33-49)
+# ---------------------------------------------------------------------------
+
+
+def test_claim_releases_owned_pod_on_label_drift():
+    store, ctrl, engine = make_engine()
+    job = store.create(make_test_job(workers=1, masters=0))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    pod = store.get("Pod", "default", "test-job-worker-0")
+    assert pod.metadata.controller_ref() is not None
+    pod.metadata.labels["job-name"] = "someone-else"
+    store.update(pod)
+
+    claimed = engine.get_pods_for_job(store.get(TEST_KIND, "default", "test-job"))
+    assert claimed == []
+    released = store.get("Pod", "default", "test-job-worker-0")
+    assert released.metadata.controller_ref() is None
+
+
+def test_claim_adopts_matching_orphan():
+    store, ctrl, engine = make_engine()
+    job = store.create(make_test_job(workers=1, masters=0))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    pod = store.get("Pod", "default", "test-job-worker-0")
+    pod.metadata.owner_references = []
+    store.update(pod)
+
+    claimed = engine.get_pods_for_job(store.get(TEST_KIND, "default", "test-job"))
+    assert [p.metadata.name for p in claimed] == ["test-job-worker-0"]
+    adopted = store.get("Pod", "default", "test-job-worker-0")
+    ref = adopted.metadata.controller_ref()
+    assert ref is not None and ref.uid == job.metadata.uid
+
+
+def test_claim_refuses_adoption_while_job_deleting():
+    store, ctrl, engine = make_engine()
+    job = store.create(make_test_job(workers=1, masters=0))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    pod = store.get("Pod", "default", "test-job-worker-0")
+    pod.metadata.owner_references = []
+    store.update(pod)
+    # Mark the stored job as deleting; the stale in-hand copy has no
+    # deletion timestamp, so only the uncached recheck can catch it.
+    fresh = store.get(TEST_KIND, "default", "test-job")
+    stale = store.get(TEST_KIND, "default", "test-job")
+    fresh.metadata.deletion_timestamp = 12345.0
+    store.update(fresh)
+
+    claimed = engine.get_pods_for_job(stale)
+    assert claimed == []
+    orphan = store.get("Pod", "default", "test-job-worker-0")
+    assert orphan.metadata.controller_ref() is None
+
+
+def test_claim_skips_deleting_orphan():
+    store, ctrl, engine = make_engine()
+    job = store.create(make_test_job(workers=1, masters=0))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    pod = store.get("Pod", "default", "test-job-worker-0")
+    pod.metadata.owner_references = []
+    pod.metadata.deletion_timestamp = 12345.0
+    store.update(pod)
+
+    claimed = engine.get_pods_for_job(store.get(TEST_KIND, "default", "test-job"))
+    assert claimed == []
+
+
+# ---------------------------------------------------------------------------
+# Failure-backoff counting decoupled from conflict requeues
+# (ref job_controller.go:85-88 BackoffStatesQueue)
+# ---------------------------------------------------------------------------
+
+
+def fail_worker(store, name, exit_code=1):
+    set_pod_phase(store, store.get("Pod", "default", name), PodPhase.FAILED, exit_code=exit_code)
+
+
+def test_backoff_counter_increments_only_on_new_failures():
+    store, ctrl, engine = make_engine()
+    job = store.create(
+        make_test_job(
+            workers=1, masters=0, restart_policy=RestartPolicy.EXIT_CODE,
+            run_policy=RunPolicy(backoff_limit=5),
+        )
+    )
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+    assert engine._failure_backoff.get(job.key, 0) == 0
+
+    fail_worker(store, "test-job-worker-0", exit_code=137)  # retryable -> restart
+    res = engine.reconcile(job.key)
+    assert engine._failure_backoff[job.key] == 1
+    assert res.requeue_after is not None and res.requeue_after > 0
+
+    # Churn without new failures (conflict-style requeues): counter frozen.
+    for _ in range(10):
+        observe_all(engine, job)
+        engine.reconcile(job.key)
+    assert engine._failure_backoff[job.key] == 1
+
+
+def test_status_conflict_churn_does_not_burn_backoff_limit():
+    store, ctrl, engine = make_engine()
+    job = store.create(
+        make_test_job(
+            workers=2, masters=0, restart_policy=RestartPolicy.EXIT_CODE,
+            run_policy=RunPolicy(backoff_limit=3),
+        )
+    )
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    # Fail one worker with a retryable code -> counted once.
+    fail_worker(store, "test-job-worker-0", exit_code=137)
+    engine.reconcile(job.key)
+    assert engine._failure_backoff[job.key] == 1
+    observe_all(engine, job)
+
+    # Simulate status-write conflict churn: a genuine status change (the
+    # other worker turns Running) keeps hitting injected Conflicts. The
+    # engine requeues each time, but must not count these as retries.
+    from kubedl_tpu.core.store import Conflict
+
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-1"), PodPhase.RUNNING)
+    real_update = store.update
+    conflicts = {"n": 0}
+
+    def flaky_update(obj):
+        if getattr(obj, "kind", "") == TEST_KIND and conflicts["n"] < 5:
+            conflicts["n"] += 1
+            raise Conflict("injected")
+        return real_update(obj)
+
+    store.update = flaky_update
+    try:
+        for _ in range(8):
+            res = engine.reconcile(job.key)
+            observe_all(engine, job)
+    finally:
+        store.update = real_update
+    assert conflicts["n"] == 5
+    assert engine._failure_backoff[job.key] == 1
+    status = store.get(TEST_KIND, "default", "test-job").status
+    assert not is_failed(status)
+
+
+def test_backoff_limit_exceeded_by_repeated_failures():
+    store, ctrl, engine = make_engine()
+    job = store.create(
+        make_test_job(
+            workers=1, masters=0, restart_policy=RestartPolicy.EXIT_CODE,
+            run_policy=RunPolicy(backoff_limit=2),
+        )
+    )
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    for i in range(3):
+        fail_worker(store, "test-job-worker-0", exit_code=137)  # retryable
+        engine.reconcile(job.key)  # deletes pod (ExitCode restart), counts failure
+        observe_all(engine, job)
+        engine.reconcile(job.key)  # recreates pod
+        observe_all(engine, job)
+    status = store.get(TEST_KIND, "default", "test-job").status
+    assert is_failed(status)
+    # terminal path forgets the backoff state
+    assert job.key not in engine._failure_backoff
