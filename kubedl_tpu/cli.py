@@ -43,6 +43,8 @@ def _mk_operator(args) -> Operator:
             storage_db_path=args.storage_db_path,
             enable_leader_election=getattr(args, "enable_leader_election", False),
             leader_lease_path=getattr(args, "leader_lease_path", DEFAULT_LEASE_PATH),
+            kube_api_url=getattr(args, "kube_api_url", ""),
+            kube_namespace=getattr(args, "kube_namespace", "default"),
         )
     )
 
@@ -183,6 +185,10 @@ def main(argv=None) -> int:
     p_op.add_argument("--enable-leader-election", action=argparse.BooleanOptionalAction,
                       default=True)
     p_op.add_argument("--leader-lease-path", default=DEFAULT_LEASE_PATH)
+    p_op.add_argument("--kube-api-url", default="",
+                      help="reconcile real cluster objects through this "
+                           "kube-apiserver ('in-cluster' = service account)")
+    p_op.add_argument("--kube-namespace", default="default")
     p_op.set_defaults(fn=cmd_operator)
 
     p_val = sub.add_parser("validate", help="parse and default manifests")

@@ -62,6 +62,7 @@ from kubedl_tpu.core.expectations import ControllerExpectations
 from kubedl_tpu.core.manager import ControllerRunner, Result
 from kubedl_tpu.core.store import ADDED, DELETED, MODIFIED, AlreadyExists, Conflict, NotFound, ObjectStore
 from kubedl_tpu.utils.exit_codes import is_retryable_exit_code
+from kubedl_tpu.utils.joblog import job_logger
 
 log = logging.getLogger("kubedl_tpu.engine")
 
@@ -76,6 +77,9 @@ BACKOFF_MAX_DELAY_S = 60.0
 class EngineConfig:
     enable_gang_scheduling: bool = False
     cluster_domain: str = ""  # CUSTOM_CLUSTER_DOMAIN equivalent
+    # Pod-template mutation hooks applied after set_cluster_spec, e.g. the
+    # GKE TPU adapter (k8s/gke.py): fn(job, template, rt, index, spec)
+    pod_mutators: List = field(default_factory=list)
 
 
 def pods_expectation_key(job_key: str, rt: str) -> str:
@@ -396,10 +400,11 @@ class JobReconciler:
         num_replicas = int(spec.replicas or 0)
         initialize_replica_statuses(status, [rt])
 
+        jlog = job_logger(log, job, rtype=rt)
         slices = utils.get_pod_slices(typed_pods, num_replicas)
         for index, pod_slice in enumerate(slices):
             if len(pod_slice) > 1:
-                log.warning("too many pods for %s %s-%d", job.metadata.name, rt, index)
+                jlog.warning("too many pods for index %d", index)
             elif not pod_slice:
                 master_role = self.controller.is_master_role(replicas, rt, index)
                 try:
@@ -426,7 +431,9 @@ class JobReconciler:
                         break
                 if spec.restart_policy == RestartPolicy.EXIT_CODE:
                     if pod.status.phase == PodPhase.FAILED and is_retryable_exit_code(exit_code):
-                        log.info("restarting pod %s (exit %d)", pod.metadata.name, exit_code)
+                        job_logger(log, job, rtype=rt, index=index, pod=pod.metadata.name).info(
+                            "restarting pod (exit %d)", exit_code
+                        )
                         self._delete_pod(job, pod)
                         restart[0] = True
                         if self.metrics:
@@ -447,6 +454,8 @@ class JobReconciler:
         template.metadata.labels.update(labels)
 
         self.controller.set_cluster_spec(job, template, rt, index)
+        for mutate in self.config.pod_mutators:
+            mutate(job, template, rt, index, spec)
 
         if template.spec.restart_policy != PodRestartPolicy.NEVER:
             self.recorder.warning(
@@ -513,7 +522,7 @@ class JobReconciler:
                 slices[index].append(svc)
         for index, svc_slice in enumerate(slices):
             if len(svc_slice) > 1:
-                log.warning("too many services for %s %s-%d", job.metadata.name, rt, index)
+                job_logger(log, job, rtype=rt).warning("too many services for index %d", index)
             elif not svc_slice:
                 self._create_new_service(job, rt, index, spec)
 

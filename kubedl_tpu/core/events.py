@@ -59,6 +59,15 @@ class EventRecorder:
         self._store = store
         self._lock = threading.Lock()
         self._seq = 0
+        # correlator cache: (ns, name, kind, reason, message) -> event name.
+        # Like client-go's EventCorrelator this is per-recorder in-memory
+        # state — it turns the repeat-coalesce path into one GET+PUT instead
+        # of an O(events) namespace LIST per emitted event (which would be a
+        # full HTTP round-trip against the kube-apiserver store). Bounded
+        # FIFO (dict preserves insertion order): eviction only costs a
+        # missed coalesce, never correctness.
+        self._names: dict = {}
+        self._names_cap = 4096
 
     def event(self, obj, etype: str, reason: str, message: str) -> None:
         ref = ObjectReference(
@@ -68,25 +77,21 @@ class EventRecorder:
             uid=obj.metadata.uid,
         )
         ts = now()
+        key = (ref.namespace, ref.name, ref.kind, reason, message)
         with self._lock:
-            # coalesce repeats, like the k8s event correlator
-            for ev in self._store.list("Event", namespace=ref.namespace):
-                io = ev.involved_object
-                if (io.name, io.kind, ev.reason, ev.message) == (
-                    ref.name,
-                    ref.kind,
-                    reason,
-                    message,
-                ):
-                    ev.count += 1
-                    ev.last_timestamp = ts
-                    try:
-                        self._store.update(ev)
-                    except Exception:
-                        pass
-                    return
+            cached_name = self._names.get(key)
             self._seq += 1
             name = f"{ref.name}.{self._seq:08x}"
+        if cached_name is not None:
+            # coalesce repeats, like the k8s event correlator
+            try:
+                ev = self._store.get("Event", ref.namespace, cached_name)
+                ev.count += 1
+                ev.last_timestamp = ts
+                self._store.update(ev)
+                return
+            except Exception:
+                pass  # event expired/conflicted: fall through to a new one
         ev = Event(
             metadata=ObjectMeta(name=name, namespace=ref.namespace),
             involved_object=ref,
@@ -98,6 +103,10 @@ class EventRecorder:
         )
         try:
             self._store.create(ev)
+            with self._lock:
+                while len(self._names) >= self._names_cap:
+                    self._names.pop(next(iter(self._names)))
+                self._names[key] = name
         except Exception:
             pass
 

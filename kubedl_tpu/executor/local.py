@@ -250,7 +250,9 @@ class LocalPodExecutor:
                 self._set_status(key, phase, statuses, placement=placement)
                 return
         except Exception:
-            log.exception("executor failed running pod %s", key)
+            from kubedl_tpu.utils.joblog import pod_logger
+
+            pod_logger(log, entry.pod).exception("executor failed running pod")
             self._set_status(
                 key, PodPhase.FAILED,
                 [ContainerStatus(name="executor", terminated=ContainerStateTerminated(exit_code=127, reason="ExecutorError"))],

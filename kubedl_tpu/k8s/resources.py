@@ -1,0 +1,88 @@
+"""Kind -> REST resource mapping (the scheme/RESTMapper subset we need).
+
+Ref: the reference registers its types into a runtime.Scheme
+(api/apis.go:44-48) and controller-runtime derives REST paths from the
+GroupVersionKind. Here the mapping is explicit: each kind carries its
+group/version/plural and the dataclass used to (de)serialize it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Type
+
+
+@dataclass(frozen=True)
+class ResourceInfo:
+    kind: str
+    api_version: str  # "v1" or "group/version"
+    plural: str
+    cls: Optional[Type] = None  # dataclass for typed decode; None = raw dict
+
+    @property
+    def group(self) -> str:
+        return self.api_version.rpartition("/")[0]
+
+    @property
+    def version(self) -> str:
+        return self.api_version.rpartition("/")[2]
+
+    def base_path(self) -> str:
+        if self.group:
+            return f"/apis/{self.group}/{self.version}"
+        return "/api/v1"
+
+    def path(self, namespace: str, name: Optional[str] = None) -> str:
+        p = f"{self.base_path()}/namespaces/{namespace}/{self.plural}"
+        return f"{p}/{name}" if name else p
+
+
+_REGISTRY: Dict[str, ResourceInfo] = {}
+
+
+def register_kind(
+    kind: str, api_version: str, plural: str, cls: Optional[Type] = None
+) -> ResourceInfo:
+    info = ResourceInfo(kind=kind, api_version=api_version, plural=plural, cls=cls)
+    _REGISTRY[kind] = info
+    return info
+
+
+def resource_for(kind: str) -> ResourceInfo:
+    info = _REGISTRY.get(kind)
+    if info is None:
+        raise KeyError(f"kind {kind!r} not registered (known: {sorted(_REGISTRY)})")
+    return info
+
+
+def registered_kinds() -> Dict[str, ResourceInfo]:
+    return dict(_REGISTRY)
+
+
+def _register_builtins() -> None:
+    from kubedl_tpu.api.pod import Pod, Service
+    from kubedl_tpu.core.events import Event
+    from kubedl_tpu.gang.slice_admitter import PodGroup
+
+    register_kind("Pod", "v1", "pods", Pod)
+    register_kind("Service", "v1", "services", Service)
+    register_kind("Event", "v1", "events", Event)
+    # the gang admitter's observable mirror object (ref kube-batch PodGroup)
+    register_kind("PodGroup", "scheduling.kubedl-tpu.io/v1alpha1", "podgroups", PodGroup)
+
+
+def register_workload_kinds() -> None:
+    """Register every compiled-in workload CRD (lazy: avoids an import cycle
+    with controllers/registry at module import time)."""
+    from kubedl_tpu.controllers.registry import enabled_controllers
+
+    for ctrl in enabled_controllers("*"):
+        if ctrl.kind not in _REGISTRY:
+            register_kind(
+                ctrl.kind,
+                ctrl.api_version,
+                ctrl.kind.lower() + "s",
+                ctrl.job_type(),
+            )
+
+
+_register_builtins()

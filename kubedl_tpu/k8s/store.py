@@ -1,0 +1,244 @@
+"""KubeObjectStore — the core ObjectStore surface over a kube-apiserver.
+
+The reconcile engine (controllers/engine.py) and manager (core/manager.py)
+run unmodified over either store: create/get/update/delete/list raise the
+same NotFound/AlreadyExists/Conflict, and watch() yields the same
+WatchEvent stream (initial list replayed as ADDED, informer-style, then
+live events with reconnect-on-drop). Objects cross the boundary as the
+same typed dataclasses; serde translates to/from the k8s JSON wire, with
+resourceVersion mapped str<->int at this edge.
+
+Ref: this replaces what controller-runtime's client+informer cache do for
+the reference (L0, SURVEY.md §1).
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+from kubedl_tpu.core.store import (
+    ADDED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    StoreError,
+    WatchEvent,
+)
+from kubedl_tpu.k8s.client import KubeApiError, KubeClient
+from kubedl_tpu.k8s.resources import register_workload_kinds, resource_for
+from kubedl_tpu.utils.serde import from_dict, to_dict
+
+log = logging.getLogger("kubedl_tpu.k8s.store")
+
+
+def _encode(obj) -> Dict:
+    info = resource_for(obj.kind)
+    body = to_dict(obj)
+    body["apiVersion"] = info.api_version
+    body["kind"] = obj.kind
+    meta = body.setdefault("metadata", {})
+    rv = meta.pop("resourceVersion", None)
+    if rv:
+        meta["resourceVersion"] = str(rv)
+    return body
+
+
+def _decode(kind: str, body: Dict):
+    info = resource_for(kind)
+    body = dict(body)
+    meta = dict(body.get("metadata") or {})
+    rv = meta.get("resourceVersion")
+    if rv is not None:
+        meta["resourceVersion"] = int(rv)
+    body["metadata"] = meta
+    if info.cls is None:
+        return body
+    obj = from_dict(info.cls, body)
+    obj.kind = kind
+    return obj
+
+
+def _selector_param(label_selector: Optional[Dict[str, str]]) -> Dict[str, str]:
+    if not label_selector:
+        return {}
+    return {"labelSelector": ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))}
+
+
+class KubeObjectStore:
+    def __init__(self, client: KubeClient, namespace: str = "default") -> None:
+        register_workload_kinds()
+        self.client = client
+        self.default_namespace = namespace
+        self._watchers: List["KubeWatch"] = []
+
+    # -- CRUD (same contract as core.store.ObjectStore) -------------------
+
+    def create(self, obj):
+        info = resource_for(obj.kind)
+        try:
+            body = self.client.request(
+                "POST", info.path(obj.metadata.namespace), body=_encode(obj)
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
+    def get(self, kind: str, namespace: str, name: str):
+        info = resource_for(kind)
+        try:
+            body = self.client.request("GET", info.path(namespace, name))
+        except KubeApiError as e:
+            raise _map_error(e, kind, f"{namespace}/{name}") from e
+        return _decode(kind, body)
+
+    def update(self, obj):
+        info = resource_for(obj.kind)
+        try:
+            body = self.client.request(
+                "PUT",
+                info.path(obj.metadata.namespace, obj.metadata.name),
+                body=_encode(obj),
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
+    def delete(self, kind: str, namespace: str, name: str):
+        info = resource_for(kind)
+        try:
+            body = self.client.request("DELETE", info.path(namespace, name))
+        except KubeApiError as e:
+            raise _map_error(e, kind, f"{namespace}/{name}") from e
+        return _decode(kind, body) if body else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        info = resource_for(kind)
+        ns = namespace if namespace is not None else self.default_namespace
+        try:
+            body = self.client.request(
+                "GET", info.path(ns), params=_selector_param(label_selector)
+            )
+        except KubeApiError as e:
+            raise _map_error(e, kind, ns) from e
+        items = []
+        for item in body.get("items", []):
+            items.append(_decode(kind, item))
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return items
+
+    # -- discovery (workload gate `auto`, ref workload_gate.go:26-107) ----
+
+    def has_kind(self, kind: str) -> bool:
+        """True iff the API server serves this kind's CRD.
+
+        A 404 means "group/version not installed" -> False; any other
+        error (apiserver blip, RBAC) raises, so a caller doing startup
+        discovery fails loudly instead of silently disabling every
+        workload (the operator pod then restarts and retries)."""
+        info = resource_for(kind)
+        try:
+            body = self.client.request("GET", info.base_path())
+        except KubeApiError as e:
+            if e.status == 404:
+                return False
+            raise StoreError(f"discovery for {kind} failed: {e}") from e
+        return any(r.get("kind") == kind for r in (body or {}).get("resources", []))
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None) -> "KubeWatch":
+        w = KubeWatch(self, kinds or [])
+        self._watchers.append(w)
+        w.start()
+        return w
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _map_error(e: KubeApiError, kind: str, key: str) -> StoreError:
+    if e.status == 404:
+        return NotFound(f"{kind} {key} not found")
+    if e.status == 409 and "already exists" in e.message.lower():
+        return AlreadyExists(f"{kind} {key} already exists")
+    if e.status == 409:
+        return Conflict(f"{kind} {key}: {e.message}")
+    return StoreError(f"{kind} {key}: {e}")
+
+
+class KubeWatch:
+    """One list+watch thread per kind, multiplexed into a single queue —
+    the informer pattern. Reconnects with the last seen resourceVersion;
+    relists on 410 Gone."""
+
+    def __init__(self, store: KubeObjectStore, kinds: List[str]) -> None:
+        self._store = store
+        self._kinds = kinds
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for kind in self._kinds:
+            t = threading.Thread(
+                target=self._pump, args=(kind,), name=f"kubewatch-{kind}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, kind: str) -> None:
+        info = resource_for(kind)
+        ns = self._store.default_namespace
+        rv: Optional[str] = None
+        while not self._stopped.is_set():
+            try:
+                if rv is None:
+                    body = self._store.client.request("GET", info.path(ns))
+                    rv = str((body.get("metadata") or {}).get("resourceVersion", "0"))
+                    for item in body.get("items", []):
+                        self._offer(ADDED, kind, item)
+                for etype, obj in self._store.client.watch(
+                    info.path(ns), params={"resourceVersion": rv}
+                ):
+                    if self._stopped.is_set():
+                        return
+                    if etype == "ERROR":
+                        rv = None  # 410 Gone mid-stream: relist
+                        break
+                    item_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if item_rv is not None:
+                        rv = str(item_rv)
+                    self._offer(etype, kind, obj)
+            except KubeApiError as e:
+                if e.status == 410:
+                    rv = None
+                self._stopped.wait(0.2)
+            except Exception:  # noqa: BLE001 — transport blips: back off, retry
+                if not self._stopped.is_set():
+                    self._stopped.wait(0.5)
+
+    def _offer(self, etype: str, kind: str, body: Dict) -> None:
+        try:
+            obj = _decode(kind, body)
+        except Exception:  # noqa: BLE001 — skip undecodable objects
+            log.warning("undecodable %s watch event dropped", kind)
+            return
+        self._q.put(WatchEvent(type=etype, kind=kind, obj=obj))
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._q.put(None)

@@ -119,6 +119,10 @@ class RuntimeMetrics:
                     "mean_seconds": round(mean, 6),
                 }
             for name, fn in self._queue_depth.items():
-                out["controllers"].setdefault(name, {})["queue_depth"] = fn()
+                try:
+                    depth = fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    depth = -1
+                out["controllers"].setdefault(name, {})["queue_depth"] = depth
         out["threads"] = [t.name for t in threading.enumerate()]
         return out

@@ -20,7 +20,12 @@ NEG_INF = -1e30
 
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Dict:
-    """Per-layer K/V buffers, bf16 like the weights."""
+    """Per-layer K/V buffers, bf16 like the weights.
+
+    The cache carries ONE scalar `length` for the whole batch: prefill and
+    generate assume every prompt in the batch has the same unpadded length.
+    Padded/ragged prompts would attend to pad tokens with wrong RoPE
+    positions — batch prompts of equal length (or generate per-row)."""
     shape = (batch, config.n_kv_heads, max_len, config.head_dim)
     return {
         "k": jnp.zeros((config.n_layers,) + shape, config.dtype),
@@ -107,7 +112,10 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens]."""
+    """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens].
+
+    All prompts in the batch must share one unpadded length `t` (the KV
+    cache tracks a single scalar length — see init_kv_cache)."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
     cache = init_kv_cache(config, b, max_len)

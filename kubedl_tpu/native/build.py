@@ -15,23 +15,37 @@ LIB_DIR = os.path.join(_DIR, "_lib")
 LIB = os.path.join(LIB_DIR, "libkdl_dataloader.so")
 
 
-def build(force: bool = False, quiet: bool = False) -> str:
-    """Compile if stale; returns the library path ('' on failure)."""
+def build(force: bool = False, quiet: bool = False, sanitize: str = "") -> str:
+    """Compile if stale; returns the library path ('' on failure).
+
+    sanitize="thread"|"address" builds a separate instrumented library
+    (_lib/libkdl_dataloader.tsan.so / .asan.so) — the repo's -race
+    equivalent for the one concurrent native component (SURVEY.md §5
+    race-detection row; the reference has no native code to sanitize).
+    """
+    lib = LIB
+    if sanitize:
+        flag = {"thread": "tsan", "address": "asan"}[sanitize]
+        lib = os.path.join(LIB_DIR, f"libkdl_dataloader.{flag}.so")
     if not os.path.exists(SRC):
         # deployed without sources: use a prebuilt library if present
-        return LIB if os.path.exists(LIB) else ""
-    if not force and os.path.exists(LIB) and os.path.getmtime(LIB) >= os.path.getmtime(SRC):
-        return LIB
+        return lib if os.path.exists(lib) else ""
+    if not force and os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(SRC):
+        return lib
     os.makedirs(LIB_DIR, exist_ok=True)
     # compile to a private temp path and rename: a concurrent process must
     # never dlopen a half-written .so (rename is atomic within the dir)
     tmp = os.path.join(LIB_DIR, f".libkdl_dataloader.{os.getpid()}.so")
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-std=c++17", "-shared", "-fPIC", "-pthread",
         "-Wall", "-Wextra",
-        SRC, "-o", tmp,
     ]
+    if sanitize:
+        cmd += [f"-fsanitize={sanitize}", "-O1", "-g", "-fno-omit-frame-pointer"]
+    else:
+        cmd += ["-O3"]
+    cmd += [SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -46,12 +60,17 @@ def build(force: bool = False, quiet: bool = False) -> str:
         except OSError:
             pass
         return ""
-    os.replace(tmp, LIB)
-    return LIB
+    os.replace(tmp, lib)
+    return lib
 
 
 if __name__ == "__main__":
-    path = build(force="--force" in sys.argv)
+    san = ""
+    if "--tsan" in sys.argv:
+        san = "thread"
+    elif "--asan" in sys.argv:
+        san = "address"
+    path = build(force="--force" in sys.argv, sanitize=san)
     if not path:
         sys.exit(1)
     print(path)

@@ -117,18 +117,24 @@ struct Loader {
     int slot;
     {
       std::unique_lock<std::mutex> lk(mu);
-      id = next_consume;
+      if (closed.load()) return -1;
+      // Claim the batch id BEFORE waiting: two concurrent consumers must
+      // never wait on the same id, or the loser clears slot_ready for the
+      // slot's NEXT tenant and rewinds next_consume (ring corruption +
+      // deadlock — caught by tests/test_native_tsan.py).
+      id = next_consume++;
       slot = (int)(id % n_slots);
       while (!(slot_ready[slot] && slot_id[slot] == id)) {
         if (closed.load()) return -1;
         cv_consume.wait(lk);
       }
     }
+    // Copy outside the lock: producers can't touch this slot until
+    // slot_ready is cleared below.
     std::memcpy(out, slots[slot].data(), sizeof(int32_t) * (size_t)batch * seq);
     {
       std::lock_guard<std::mutex> lk(mu);
       slot_ready[slot] = false;
-      next_consume = id + 1;
     }
     cv_produce.notify_all();
     return 0;
@@ -189,7 +195,9 @@ void* kdl_open(const char** paths, int n_paths, int batch, int seq,
   L->mul = a;
   L->add = (seed * 2862933555777941757ULL + 3037000493ULL) % L->n_windows;
 
-  if (n_threads <= 0) n_threads = 2;
+  // n_threads == 0 disables the prefetch producers entirely (random-access
+  // batch_at() still works synchronously); negative means "default".
+  if (n_threads < 0) n_threads = 2;
   if (n_slots < n_threads + 1) n_slots = n_threads + 1;
   L->n_slots = n_slots;
   L->slots.assign(n_slots, std::vector<int32_t>((size_t)batch * seq));

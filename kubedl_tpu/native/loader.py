@@ -31,7 +31,9 @@ def _native_lib():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    path = _build_native(quiet=True)
+    # test seam: point at an alternate build (e.g. the TSan-instrumented
+    # library from `python -m kubedl_tpu.native.build --tsan`)
+    path = os.environ.get("KUBEDL_NATIVE_LIB") or _build_native(quiet=True)
     if not path:
         return None
     lib = ctypes.CDLL(path)
@@ -128,12 +130,14 @@ class TokenLoader:
         batch: int,
         seq_len: int,
         seed: int = 0,
-        n_threads: int = 2,
+        n_threads: int = 2,  # 0 = no prefetch threads (random-access use)
         n_slots: int = 0,
         force_python: bool = False,
     ):
         self.batch, self.seq = int(batch), int(seq_len)
         self._h = None
+        self._n_threads = int(n_threads)
+        self._next_id = 0
         self._fallback: Optional[PyTokenLoader] = None
         lib = None if force_python else _native_lib()
         if lib is not None:
@@ -160,6 +164,12 @@ class TokenLoader:
 
     def next(self) -> np.ndarray:
         if self._h is not None:
+            if self._n_threads == 0:
+                # no producer threads exist: kdl_next would wait forever on
+                # a ring nobody fills — serve sequentially via batch_at
+                out = self.batch_at(self._next_id)
+                self._next_id += 1
+                return out
             out = np.empty((self.batch, self.seq), np.int32)
             rc = self._lib.kdl_next(
                 self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))

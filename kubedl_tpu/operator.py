@@ -59,12 +59,32 @@ class OperatorConfig:
     # operators are single-instance — the CLI `operator` command enables it)
     enable_leader_election: bool = False
     leader_lease_path: str = DEFAULT_LEASE_PATH
+    # Kubernetes mode: reconcile real Pod/Service objects on a cluster
+    # through the kube-apiserver instead of the in-process store + local
+    # executor (ref main.go:70-75 manager-over-client-go). "in-cluster"
+    # resolves the service-account config; otherwise an apiserver URL.
+    kube_api_url: str = ""
+    kube_namespace: str = "default"
 
 
 class Operator:
-    def __init__(self, config: Optional[OperatorConfig] = None) -> None:
+    def __init__(self, config: Optional[OperatorConfig] = None, store=None) -> None:
         self.config = config or OperatorConfig()
-        self.store = ObjectStore()
+        if store is not None:
+            self.store = store
+        elif self.config.kube_api_url:
+            from kubedl_tpu.k8s import KubeClient, KubeObjectStore
+
+            url = self.config.kube_api_url
+            client = (
+                KubeClient.resolve() if url == "in-cluster" else KubeClient.resolve(url)
+            )
+            self.store = KubeObjectStore(client, namespace=self.config.kube_namespace)
+        else:
+            self.store = ObjectStore()
+        if self.kube_mode:
+            # the cluster's kubelets run pods; no local executor
+            self.config.run_executor = False
         self.runtime_metrics = RuntimeMetrics()
         self.manager = Manager(self.store, runtime_metrics=self.runtime_metrics)
         self.recorder = EventRecorder(self.store)
@@ -93,6 +113,11 @@ class Operator:
         """Register one workload controller (ref controllers/controllers.go:31-47)."""
         from kubedl_tpu.codesync import CodeSyncer
 
+        mutators = []
+        if self.kube_mode:
+            from kubedl_tpu.k8s.gke import gke_tpu_mutator
+
+            mutators.append(gke_tpu_mutator)
         engine = JobReconciler(
             self.store,
             controller,
@@ -103,6 +128,7 @@ class Operator:
             config=EngineConfig(
                 enable_gang_scheduling=self.config.enable_gang_scheduling,
                 cluster_domain=self.config.cluster_domain,
+                pod_mutators=mutators,
             ),
         )
         controller.engine = engine
@@ -114,10 +140,29 @@ class Operator:
         self._kind_by_lower[controller.kind.lower()] = controller.kind
         return engine
 
+    @property
+    def kube_mode(self) -> bool:
+        from kubedl_tpu.k8s.store import KubeObjectStore
+
+        return isinstance(self.store, KubeObjectStore)
+
     def register_all(self) -> None:
         from kubedl_tpu.controllers.registry import enabled_controllers
 
-        for controller in enabled_controllers(self.config.workloads):
+        # In kube mode the "auto" gate probes the discovery API for each
+        # CRD, like the reference (ref workload_gate.go:26-107). Discovery
+        # errors propagate (StoreError): better to crash-loop at startup
+        # than come up silently reconciling nothing.
+        discover = self.store.has_kind if self.kube_mode else None
+        controllers = enabled_controllers(self.config.workloads, discover=discover)
+        if discover is not None and not controllers:
+            import logging
+
+            logging.getLogger("kubedl_tpu.operator").warning(
+                "workload gate %r enabled no controllers (no matching CRDs "
+                "served by the API server)", self.config.workloads,
+            )
+        for controller in controllers:
             self.register(controller)
 
     # -- lifecycle -------------------------------------------------------

@@ -29,6 +29,25 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
+_warned_shapes: set = set()
+
+
+def _warn_unfused_fallback(d: int, block_q: int, block_k: int) -> None:
+    """One warning per shape when use_flash silently degrades to unfused
+    attention (e.g. head_dim 64 on ViT-B/16 or small GQA configs) — a
+    masked perf regression otherwise invisible on real TPU."""
+    key = (d, block_q, block_k)
+    if key in _warned_shapes:
+        return
+    _warned_shapes.add(key)
+    import warnings
+
+    warnings.warn(
+        f"flash_attention: head_dim={d} / blocks ({block_q},{block_k}) not "
+        f"128-aligned for the TPU MXU; falling back to unfused attention",
+        stacklevel=3,
+    )
+
 
 def _interpret() -> bool:
     """Pallas TPU kernels run in interpret mode on CPU (tests/virtual mesh)."""
@@ -319,6 +338,7 @@ def flash_attention(
     # models, short sequences) take the plain-XLA path — at those sizes the
     # fused kernel has no advantage anyway. CPU interpret mode is exempt.
     if not _interpret() and (d % 128 or block_q % 128 or block_k % 128):
+        _warn_unfused_fallback(d, block_q, block_k)
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
     qf = _pad_seq(q.reshape(b * hq, sq, d), block_q)

@@ -39,8 +39,12 @@ def new_event_backend(name: str, **kwargs) -> EventStorageBackend:
 
 def register_default_backends() -> None:
     """Ref registry.go RegisterStorageBackends called from main.go:97."""
+    from kubedl_tpu.storage.jsonl_backend import JSONLBackend
+
     register_object_backend("sqlite", SQLiteBackend)
     register_event_backend("sqlite", SQLiteBackend)
+    register_object_backend("jsonl", JSONLBackend)
+    register_event_backend("jsonl", JSONLBackend)
 
 
 register_default_backends()

@@ -161,22 +161,37 @@ def main(argv=None) -> int:
             return 1
         loader = TokenLoader(
             shard_paths, batch=args.batch, seq_len=args.seq_len, seed=args.data_seed,
+            # the trainer only random-accesses batch_at(); prefetch threads
+            # would fill ring slots nobody consumes
+            n_threads=0,
         )
         print(f"data: {len(shard_paths)} shards, {loader.n_windows} windows, "
               f"native={loader.is_native}", flush=True)
 
     rng = np.random.default_rng(info.process_id)
+    batch_sharding = rules.sharding(mesh, "batch", None)
+    global_batch = args.batch * info.num_processes
 
     def next_batch(step: int):
+        """Global [world*batch, seq] array from per-process local rows.
+
+        Each process loads ONLY its own rows (rank-strided window ids) and
+        contributes them via make_array_from_process_local_data — jnp.asarray
+        would device-commit locally and cannot reshard onto the other
+        processes' non-addressable devices on a multi-host mesh."""
         if loader is not None:
-            return jnp.asarray(
-                loader.batch_at(step * info.num_processes + info.process_id)
+            local = loader.batch_at(step * info.num_processes + info.process_id)
+        else:
+            local = rng.integers(
+                0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32
             )
-        return jnp.asarray(
-            rng.integers(0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32)
+        if info.num_processes == 1:
+            return jnp.asarray(local)
+        return jax.make_array_from_process_local_data(
+            batch_sharding, np.asarray(local), (global_batch, args.seq_len)
         )
 
-    tokens_per_step = args.batch * (args.seq_len - 1)
+    tokens_per_step = global_batch * (args.seq_len - 1)
 
     # profiler window: [start+1, start+1+profile_steps) — skips the compile step
     prof_start = start_step + 1 if args.profile_dir else -1

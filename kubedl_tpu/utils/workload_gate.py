@@ -2,8 +2,11 @@
 
 Expression grammar, same as the reference's --workloads flag /
 WORKLOADS_ENABLE env (env wins): comma-separated names, "*" for all,
-"-name" to subtract. "auto" (reference default: probe the discovery API for
-the CRD) maps here to "*" since all kinds are compiled in.
+"-name" to subtract. "auto" (reference default) enables everything when
+running standalone (all kinds are compiled in); against a real
+kube-apiserver the registry additionally probes the discovery API for the
+CRD (controllers/registry.enabled_controllers `discover` hook), matching
+the reference's behavior.
 """
 from __future__ import annotations
 
@@ -11,6 +14,11 @@ import os
 from typing import List, Set
 
 ENV_WORKLOADS_ENABLE = "WORKLOADS_ENABLE"
+
+
+def effective_expr(expr: str) -> str:
+    """The expression after the env override (env wins, ref :26-33)."""
+    return os.environ.get(ENV_WORKLOADS_ENABLE) or expr
 
 
 def is_workload_enabled(name: str, expr: str) -> bool:

@@ -1,0 +1,51 @@
+"""Structured per-job logger (ref pkg/util/logger.go:26-60): every line
+carries kind/job/rtype/index fields so one job's history is greppable."""
+import logging
+
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import Pod
+from kubedl_tpu.utils.joblog import job_logger, pod_logger
+
+from fake_workload import make_test_job
+
+
+def capture(adapter, msg, *args):
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            records.append(self.format(record))
+
+    h = H()
+    logger = adapter.logger
+    logger.addHandler(h)
+    logger.setLevel(logging.DEBUG)
+    try:
+        adapter.info(msg, *args)
+    finally:
+        logger.removeHandler(h)
+    return records[0]
+
+
+def test_job_logger_appends_context_fields():
+    log = logging.getLogger("test.joblog")
+    job = make_test_job(name="mnist")
+    job.metadata.uid = "u-1"
+    line = capture(job_logger(log, job, rtype="Worker", index=2), "restarting pod (exit %d)", 137)
+    assert "restarting pod (exit 137)" in line
+    assert "kind=TestJob" in line
+    assert "job=default/mnist" in line
+    assert "uid=u-1" in line
+    assert "rtype=worker" in line
+    assert "index=2" in line
+
+
+def test_pod_logger_pulls_fields_from_labels():
+    log = logging.getLogger("test.joblog")
+    pod = Pod(metadata=ObjectMeta(
+        name="mnist-worker-0", namespace="default",
+        labels={"job-name": "mnist", "replica-type": "worker", "replica-index": "0"},
+    ))
+    line = capture(pod_logger(log, pod), "executor failed running pod")
+    assert "pod=default/mnist-worker-0" in line
+    assert "job=mnist" in line and "rtype=worker" in line and "index=0" in line

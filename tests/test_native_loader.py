@@ -98,3 +98,14 @@ def test_trainer_runs_on_sharded_data(tmp_path, capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 0
     assert "data: 2 shards" in out and "done: 3 steps" in out
+
+
+def test_next_works_without_prefetch_threads(shards):
+    """n_threads=0 = no producers; next() must serve sequentially via the
+    synchronous path instead of waiting on a ring nobody fills."""
+    from kubedl_tpu.native.loader import TokenLoader
+
+    with TokenLoader(shards, batch=2, seq_len=16, n_threads=0) as a, \
+         TokenLoader(shards, batch=2, seq_len=16, n_threads=2) as b:
+        for _ in range(5):
+            np.testing.assert_array_equal(a.next(), b.next())

@@ -1,0 +1,77 @@
+"""ThreadSanitizer pass over the native data loader — the repo's `-race`
+equivalent (SURVEY.md §5: reference runs no sanitizers; our one concurrent
+native component gets TSan in CI). Builds the instrumented library, hammers
+concurrent next()/batch_at() from a subprocess with libtsan preloaded, and
+fails on any ThreadSanitizer report."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kubedl_tpu.native.build import build
+
+
+def _libtsan():
+    try:
+        out = subprocess.run(
+            [os.environ.get("CXX", "g++"), "-print-file-name=libtsan.so"],
+            capture_output=True, text=True, timeout=30,
+        ).stdout.strip()
+    except OSError:
+        return None
+    return out if out and os.path.isabs(out) and os.path.exists(out) else None
+
+
+DRIVER = r"""
+import sys, threading
+import numpy as np
+from kubedl_tpu.native.loader import TokenLoader
+
+shard = sys.argv[1]
+loader = TokenLoader([shard], batch=4, seq_len=33, n_threads=2)
+assert loader.is_native, "tsan lib failed to load"
+
+def sequential():
+    for _ in range(200):
+        loader.next()
+
+def random_access():
+    for i in range(200):
+        loader.batch_at(i)
+
+threads = [threading.Thread(target=sequential) for _ in range(2)]
+threads += [threading.Thread(target=random_access) for _ in range(2)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+loader.close()
+print("tsan-drive-ok")
+"""
+
+
+def test_loader_concurrency_under_tsan(tmp_path):
+    libtsan = _libtsan()
+    if libtsan is None:
+        pytest.skip("libtsan.so not available")
+    tsan_lib = build(sanitize="thread", quiet=True)
+    if not tsan_lib:
+        pytest.skip("tsan build unavailable")
+
+    shard = str(tmp_path / "shard.bin")
+    np.arange(10_000, dtype="<i4").tofile(shard)
+
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = libtsan
+    env["KUBEDL_NATIVE_LIB"] = tsan_lib
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["TSAN_OPTIONS"] = "exitcode=66 report_thread_leaks=0"
+    proc = subprocess.run(
+        [sys.executable, "-c", DRIVER, shard],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-3000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-3000:])
+    assert "tsan-drive-ok" in proc.stdout
