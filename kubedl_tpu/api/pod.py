@@ -48,6 +48,10 @@ class Container:
     args: List[str] = field(default_factory=list)
     working_dir: str = ""
     env: Dict[str, str] = field(default_factory=dict)
+    # k8s envVar entries that aren't plain name/value (valueFrom secret/
+    # configmap refs) — preserved verbatim for apiserver round-trips
+    # (k8s/store.py wire translation); the local executor ignores them.
+    env_raw: List[Dict] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     volume_mounts: List["VolumeMount"] = field(default_factory=list)

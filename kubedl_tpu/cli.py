@@ -107,12 +107,18 @@ def cmd_run(args) -> int:
 def cmd_operator(args) -> int:
     op = _mk_operator(args)
     op.register_all()
+    # Construct the server BEFORE op.start(): its token validation can
+    # raise (non-loopback bind without a token), and failing here must not
+    # leave a leader lease held or manager threads running.
+    server = OperatorHTTPServer(
+        op, host=args.bind, port=args.metrics_port or 8443,
+        token=getattr(args, "api_token", None),
+    )
     if args.enable_leader_election:
         print(f"acquiring leadership lease at {args.leader_lease_path} ...")
     op.start()
     if op.elector is not None:
         print(f"elected leader as {op.elector.identity}")
-    server = OperatorHTTPServer(op, host=args.bind, port=args.metrics_port or 8443)
     port = server.start()
     print(f"kubedl-tpu operator serving on http://{args.bind}:{port} "
           f"(kinds: {sorted(op.reconcilers)})")
@@ -189,6 +195,9 @@ def main(argv=None) -> int:
                       help="reconcile real cluster objects through this "
                            "kube-apiserver ('in-cluster' = service account)")
     p_op.add_argument("--kube-namespace", default="default")
+    p_op.add_argument("--api-token", default=None,
+                      help="bearer token for the HTTP API (env KUBEDL_API_TOKEN); "
+                           "REQUIRED for non-loopback --bind")
     p_op.set_defaults(fn=cmd_operator)
 
     p_val = sub.add_parser("validate", help="parse and default manifests")

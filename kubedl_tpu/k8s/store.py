@@ -33,6 +33,105 @@ from kubedl_tpu.utils.serde import from_dict, to_dict
 log = logging.getLogger("kubedl_tpu.k8s.store")
 
 
+# -- k8s wire translation ---------------------------------------------------
+# Internal API types diverge from the k8s wire in three places: env is a
+# plain dict (k8s: list of {name, value}), resource quantities are floats
+# (k8s: strings like "500m"/"1Gi"), and resourceVersion is an int (k8s:
+# string). Translate at this edge so a REAL apiserver accepts our pods.
+
+_QUANTITY_SUFFIX = {
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+
+
+def _quantity_to_float(q) -> float:
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    for suf in sorted(_QUANTITY_SUFFIX, key=len, reverse=True):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * _QUANTITY_SUFFIX[suf]
+    return float(s)
+
+
+def _float_to_quantity(v: float) -> str:
+    if float(v).is_integer():
+        return str(int(v))
+    milli = v * 1000
+    if milli.is_integer():
+        return f"{int(milli)}m"
+    return str(v)
+
+
+def _pod_spec_to_wire(spec: Dict) -> None:
+    for key in ("containers", "initContainers"):
+        for c in spec.get(key) or []:
+            env = c.get("env")
+            if isinstance(env, dict):
+                # envRaw entries (valueFrom etc., preserved by decode) go
+                # first; plain vars follow in INSERTION order — kubelet
+                # expands $(VAR) only from earlier entries, so sorting
+                # would break dependent env vars.
+                raw = c.pop("envRaw", None) or []
+                raw_names = {e.get("name") for e in raw}
+                c["env"] = list(raw) + [
+                    {"name": k, "value": str(v)}
+                    for k, v in env.items() if k not in raw_names
+                ]
+            res = c.get("resources")
+            if isinstance(res, dict):
+                for rk in ("requests", "limits"):
+                    if isinstance(res.get(rk), dict):
+                        res[rk] = {k: _float_to_quantity(v) for k, v in res[rk].items()}
+
+
+def _pod_spec_from_wire(spec: Dict) -> None:
+    for key in ("containers", "initContainers"):
+        for c in spec.get(key) or []:
+            env = c.get("env")
+            if isinstance(env, list):
+                # split: plain name/value pairs -> the internal dict;
+                # valueFrom-style entries -> envRaw so an update round-trip
+                # can't strip a secretKeyRef into an empty string
+                plain, raw = {}, []
+                for e in env:
+                    if "name" not in e:
+                        continue
+                    if set(e) <= {"name", "value"}:
+                        plain[e["name"]] = e.get("value", "")
+                    else:
+                        raw.append(e)
+                c["env"] = plain
+                if raw:
+                    c["envRaw"] = raw
+            res = c.get("resources")
+            if isinstance(res, dict):
+                for rk in ("requests", "limits"):
+                    if isinstance(res.get(rk), dict):
+                        res[rk] = {
+                            k: _quantity_to_float(v) for k, v in res[rk].items()
+                        }
+
+
+def _walk_pod_specs(body: Dict, kind: str, fn) -> None:
+    if kind == "Pod":
+        if isinstance(body.get("spec"), dict):
+            fn(body["spec"])
+        return
+    # workload kinds: every replica template carries a pod spec
+    spec = body.get("spec")
+    if not isinstance(spec, dict):
+        return
+    for k, v in spec.items():
+        if k.endswith("ReplicaSpecs") or k == "replicaSpecs":
+            for rspec in (v or {}).values():
+                tmpl_spec = ((rspec or {}).get("template") or {}).get("spec")
+                if isinstance(tmpl_spec, dict):
+                    fn(tmpl_spec)
+
+
 def _encode(obj) -> Dict:
     info = resource_for(obj.kind)
     body = to_dict(obj)
@@ -42,6 +141,7 @@ def _encode(obj) -> Dict:
     rv = meta.pop("resourceVersion", None)
     if rv:
         meta["resourceVersion"] = str(rv)
+    _walk_pod_specs(body, obj.kind, _pod_spec_to_wire)
     return body
 
 
@@ -53,6 +153,7 @@ def _decode(kind: str, body: Dict):
     if rv is not None:
         meta["resourceVersion"] = int(rv)
     body["metadata"] = meta
+    _walk_pod_specs(body, kind, _pod_spec_from_wire)
     if info.cls is None:
         return body
     obj = from_dict(info.cls, body)

@@ -11,10 +11,17 @@ object CRUD. Standalone, this server provides both:
   POST /apis/<kind>                 apply a manifest (create-or-update)
   DELETE /apis/<kind>/<ns>/<name>   delete a job
   GET  /events/<ns>                 recent events in a namespace
+
+Auth: loopback binds are open; any other bind REQUIRES a bearer token
+(`token=` arg or KUBEDL_API_TOKEN env) — the reference inherits
+kube-apiserver authn/z, so an unauthenticated non-local surface would be
+a regression. /healthz stays unauthenticated for probes.
 """
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -24,19 +31,46 @@ from kubedl_tpu.utils.serde import to_dict
 
 
 class OperatorHTTPServer:
-    def __init__(self, operator, host: str = "127.0.0.1", port: int = 8443) -> None:
+    def __init__(
+        self,
+        operator,
+        host: str = "127.0.0.1",
+        port: int = 8443,
+        token: Optional[str] = None,
+    ) -> None:
         self.operator = operator
         self.host = host
         self.port = port
+        self.token = token if token is not None else os.environ.get("KUBEDL_API_TOKEN", "")
+        if not self.token and host not in ("127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                f"refusing to serve the operator API on {host!r} without a "
+                "bearer token (set --api-token or KUBEDL_API_TOKEN)"
+            )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> int:
         op = self.operator
+        token = self.token
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
                 pass
+
+            def _authorized(self) -> bool:
+                if not token or self.path == "/healthz":
+                    return True
+                supplied = self.headers.get("Authorization", "")
+                # compare bytes: str compare_digest requires ASCII and would
+                # raise (not 401) on an exotic header
+                if hmac.compare_digest(
+                    supplied.encode("utf-8", "surrogateescape"),
+                    f"Bearer {token}".encode(),
+                ):
+                    return True
+                self._send(401, '{"error": "unauthorized"}')
+                return False
 
             def _send(self, code: int, body: str, ctype: str = "application/json"):
                 data = body.encode()
@@ -50,6 +84,8 @@ class OperatorHTTPServer:
                 self._send(code, json.dumps(obj, indent=1))
 
             def do_GET(self):
+                if not self._authorized():
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if self.path == "/metrics":
                     body = op.metrics_registry.render()
@@ -82,6 +118,8 @@ class OperatorHTTPServer:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if len(parts) == 2 and parts[0] == "apis":
                     length = int(self.headers.get("Content-Length", "0"))
@@ -96,6 +134,8 @@ class OperatorHTTPServer:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
             def do_DELETE(self):
+                if not self._authorized():
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if len(parts) == 4 and parts[0] == "apis":
                     kind = op._kind_by_lower.get(parts[1].lower(), parts[1])

@@ -295,3 +295,96 @@ def test_operator_converges_tfjob_over_kube_store(srv):
     finally:
         stop.set()
         op.stop()
+
+
+def test_pod_wire_format_matches_kubernetes_conventions(srv, store):
+    """What goes over HTTP must be schema-valid for a REAL apiserver:
+    env as a list of {name, value}, resource quantities as strings."""
+    pod = make_pod("wire", tpu=4)
+    pod.spec.containers[0].env = {"B": "2", "A": "1"}
+    pod.spec.containers[0].resources.requests = {"cpu": 0.5, "memory": 2 * 1024**3}
+    store.create(pod)
+
+    raw = KubeClient(srv.url).request("GET", "/api/v1/namespaces/default/pods/wire")
+    c = raw["spec"]["containers"][0]
+    # insertion order preserved (kubelet expands $(VAR) from earlier entries)
+    assert c["env"] == [{"name": "B", "value": "2"}, {"name": "A", "value": "1"}]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert c["resources"]["requests"]["cpu"] == "500m"
+    assert c["resources"]["requests"]["memory"] == str(2 * 1024**3)
+    assert isinstance(raw["metadata"]["resourceVersion"], str)
+
+    # and the typed decode round-trips back to the internal shapes
+    got = store.get("Pod", "default", "wire")
+    assert got.spec.containers[0].env == {"A": "1", "B": "2"}
+    assert got.spec.containers[0].resources.requests["cpu"] == 0.5
+    assert got.spec.containers[0].resources.tpu_chips() == 4
+
+
+def test_workload_template_env_translated_on_wire(srv):
+    """Replica templates inside workload CRDs get the same env/quantity
+    translation (a TFJob's pod template is what GKE webhooks inspect)."""
+    from kubedl_tpu.k8s.client import KubeClient as KC
+
+    kstore = KubeObjectStore(KubeClient(srv.url))
+    from kubedl_tpu.workloads.tensorflow import TFJobController
+    from kubedl_tpu.utils.serde import from_dict
+
+    ctrl = TFJobController()
+    job = from_dict(ctrl.job_type(), TFJOB)
+    job.kind = "TFJob"
+    job.metadata.name = "wire-tf"
+    ctrl.set_defaults(job)
+    kstore.create(job)
+
+    raw = KC(srv.url).request(
+        "GET", "/apis/kubeflow.org/v1/namespaces/default/tfjobs/wire-tf"
+    )
+    c = raw["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    got = kstore.get("TFJob", "default", "wire-tf")
+    worker = got.spec.replica_specs["Worker"]
+    assert worker.template.spec.containers[0].resources.tpu_chips() == 4
+
+
+def test_value_from_env_survives_update_roundtrip(srv, store):
+    """valueFrom entries (secretKeyRef etc.) must survive get+update —
+    flattening them to empty strings would strip secrets on write-back."""
+    raw_pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "vf", "namespace": "default"},
+        "spec": {"containers": [{
+            "name": "main", "image": "img",
+            "env": [
+                {"name": "B_HOST", "value": "svc"},
+                {"name": "TOKEN", "valueFrom": {"secretKeyRef": {"name": "s", "key": "t"}}},
+                {"name": "A_URL", "value": "http://$(B_HOST)/"},
+            ],
+        }]},
+    }
+    KubeClient(srv.url).request("POST", "/api/v1/namespaces/default/pods", body=raw_pod)
+
+    pod = store.get("Pod", "default", "vf")
+    assert pod.spec.containers[0].env == {"B_HOST": "svc", "A_URL": "http://$(B_HOST)/"}
+    assert pod.spec.containers[0].env_raw[0]["valueFrom"]["secretKeyRef"]["name"] == "s"
+
+    pod.metadata.labels["touched"] = "1"
+    store.update(pod)
+    wire = KubeClient(srv.url).request("GET", "/api/v1/namespaces/default/pods/vf")
+    env = wire["spec"]["containers"][0]["env"]
+    assert {"name": "TOKEN", "valueFrom": {"secretKeyRef": {"name": "s", "key": "t"}}} in env
+    # dependent-var ordering preserved: B_HOST defined before A_URL
+    names = [e["name"] for e in env]
+    assert names.index("B_HOST") < names.index("A_URL")
+
+
+def test_quantity_parsing_covers_k8s_suffixes(store):
+    from kubedl_tpu.k8s.store import _float_to_quantity, _quantity_to_float
+
+    assert _quantity_to_float("100n") == pytest.approx(1e-7)
+    assert _quantity_to_float("50u") == pytest.approx(5e-5)
+    assert _quantity_to_float("500m") == 0.5
+    assert _quantity_to_float("2Gi") == 2 * 1024**3
+    assert _quantity_to_float("1E") == 1e18
+    assert _quantity_to_float(_float_to_quantity(0.5)) == 0.5
+    assert _quantity_to_float(_float_to_quantity(4)) == 4

@@ -47,3 +47,60 @@ def test_two_process_jaxjob_rendezvous_and_collective(replicas, tmp_path):
             pytest.fail(f"rendezvous job did not succeed: {fresh.status.conditions}")
     finally:
         op.stop()
+
+
+def test_two_process_trainer_builds_global_batch(tmp_path):
+    """The trainer's data path on a REAL 2-process mesh: each process loads
+    only its rank-strided rows and contributes them via
+    make_array_from_process_local_data (ADVICE r1 medium — jnp.asarray
+    cannot reshard onto non-addressable devices multi-host)."""
+    import numpy as np
+
+    from kubedl_tpu.native.loader import write_shard
+
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        write_shard(str(tmp_path / f"s{i}.bin"),
+                    rng.integers(0, 256, 8192, dtype=np.int32))
+
+    op = Operator(OperatorConfig())
+    op.register(JAXJobController())
+    op.start()
+    try:
+        job = op.apply({
+            "apiVersion": "kubedl-tpu.io/v1alpha1",
+            "kind": "JAXJob",
+            "metadata": {"name": "dist-train"},
+            "spec": {
+                "mesh": {"data": -1},
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 2,
+                    "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [{
+                        "name": "jax",
+                        "command": [
+                            sys.executable, "-m", "kubedl_tpu.train.trainer",
+                            "--model", "tiny", "--steps", "2",
+                            "--batch", "4", "--seq-len", "33",
+                            "--data-path", str(tmp_path / "s*.bin"),
+                            "--log-every", "1",
+                        ],
+                        # 2 CPU devices per process -> 4 global; the jit's
+                        # in_shardings span both processes
+                        "env": {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+                    }]}},
+                }},
+            },
+        })
+        ok = op.wait_for_condition(job, "Succeeded", timeout=240)
+        if not ok:
+            fresh = op.get_job("JAXJob", "default", "dist-train")
+            logs = ""
+            if op.executor is not None:
+                for idx in range(2):
+                    logs += f"\n--- worker-{idx} ---\n" + op.executor.read_logs(
+                        "default", f"dist-train-worker-{idx}"
+                    )[-2000:]
+            pytest.fail(f"trainer job did not succeed: {fresh.status.conditions}{logs}")
+    finally:
+        op.stop()
