@@ -59,6 +59,7 @@ def test_repeated_preemption_still_succeeds(tmp_path):
             },
         })
 
+        jm = op.metrics_registry.get("JAXJob")
         kills = 0
         killed_at = -1
         deadline = time.monotonic() + 240
@@ -77,9 +78,18 @@ def test_repeated_preemption_still_succeeds(tmp_path):
                             os.kill(proc.pid, signal.SIGTERM)
                         except ProcessLookupError:
                             continue
-                    kills += 1
-                    killed_at = s
-                    time.sleep(1.0)
+                    # A signal can land on a pid that already exited (or a
+                    # zombie), making the round a no-op. The engine's
+                    # restarted counter is the authoritative proof a
+                    # preemption-restart actually happened, so only count
+                    # the round once it ticks.
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < 20:
+                        if jm.restarted > kills:
+                            kills += 1
+                            killed_at = s
+                            break
+                        time.sleep(0.2)
             time.sleep(0.2)
         assert kills == KILLS, f"only injected {kills}/{KILLS} preemptions"
 
