@@ -167,24 +167,40 @@ def _tpu_child(results_path: str) -> int:
             for a, b_ in zip(g_f, g_r)
         )
 
-        fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-        jax.device_get(fwd(q, k, v))  # warm
-        iters = 20
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = fwd(q, k, v)
-        jax.device_get(o)
-        dt = (time.perf_counter() - t0) / iters
+        # Timing: the remote-TPU tunnel costs ~1 ms per dispatch and
+        # hundreds of jittery ms per device_get of a full tensor, so
+        # sub-ms kernels are timed with an on-device lax.scan loop that
+        # returns ONE scalar, differencing two loop lengths to cancel
+        # every fixed cost. Each iteration perturbs q so XLA can neither
+        # CSE nor dead-code-eliminate the kernel calls.
+        import functools
+        import statistics as stats
+
+        def timed(attn_fn, n1=100, n2=300, reps=5):
+            @functools.partial(jax.jit, static_argnames="n")
+            def loop(q, k, v, n):
+                def body(qq, _):
+                    o = attn_fn(qq, k, v)
+                    return qq + (o * 1e-4).astype(qq.dtype), ()
+                out, _ = jax.lax.scan(body, q, None, length=n)
+                return jnp.sum(out.astype(jnp.float32))
+
+            jax.device_get(loop(q, k, v, n=n1))
+            jax.device_get(loop(q, k, v, n=n2))
+            diffs = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.device_get(loop(q, k, v, n=n1))
+                t1 = time.perf_counter()
+                jax.device_get(loop(q, k, v, n=n2))
+                t2 = time.perf_counter()
+                diffs.append(((t2 - t1) - (t1 - t0)) / (n2 - n1))
+            return stats.median(diffs)
+
+        dt = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
         # causal fwd: 2 matmuls * b*h*s^2*d MACs, half masked
         flops = 2 * 2 * b * h * s * s * d / 2
-        # reference timing for speedup
-        ref = jax.jit(lambda q, k, v: attention_reference(q, k, v, causal=True))
-        jax.device_get(ref(q, k, v))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = ref(q, k, v)
-        jax.device_get(o)
-        dt_ref = (time.perf_counter() - t0) / iters
+        dt_ref = timed(lambda q, k, v: attention_reference(q, k, v, causal=True))
         _emit(out, "flash", {
             "flash_max_err": round(fwd_err, 5),
             "flash_bwd_max_err": round(bwd_err, 5),

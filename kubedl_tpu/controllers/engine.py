@@ -272,6 +272,14 @@ class JobReconciler:
                 job_exceeds_limit, failure_message,
             )
 
+        if self.controller.restart_whole_gang(job, replicas):
+            failed_retryable = self._gang_failed_retryable(replicas, pods)
+            if failed_retryable:
+                return self._restart_gang(
+                    job, replicas, status, old_status, pods, failed_retryable,
+                    previous_retry, job_has_new_failure,
+                )
+
         restart = [False]
         for rtype in self.controller.reconcile_orders():
             rt_key = str(rtype.value)
@@ -303,6 +311,82 @@ class JobReconciler:
             # Count the failure and pace the retry exponentially; a
             # status-write Conflict requeue deliberately does NOT reach
             # this counter (it raises out of _write_status above).
+            self._failure_backoff[key] = previous_retry + 1
+            return Result(
+                requeue_after=min(
+                    BACKOFF_BASE_DELAY_S * (2 ** previous_retry), BACKOFF_MAX_DELAY_S
+                )
+            )
+        return Result()
+
+    # ------------------------------------------------------------------
+    # Slice gang restart (net-new; SURVEY.md §5 slice-level health)
+    # ------------------------------------------------------------------
+
+    def _gang_failed_retryable(self, replicas, pods: List[Pod]) -> List[Pod]:
+        """Failed pods whose replica policy is ExitCode with a retryable code.
+
+        Returns [] when ANY failure is permanent: a deterministic crash on
+        one rank tears down its peers with SIGTERM (retryable 143), and a
+        gang restart keyed on those peers would delete the evidence and
+        loop the slice forever — the normal per-pod path must instead leave
+        the permanently-failed pod in place so the job fails."""
+        retryable = []
+        for rt_key, spec in replicas.items():
+            if spec.restart_policy != RestartPolicy.EXIT_CODE:
+                continue
+            for pod in utils.filter_pods_for_replica_type(pods, rt_key):
+                if pod.status.phase != PodPhase.FAILED:
+                    continue
+                code = self._default_container_exit_code(pod)
+                if code == EXIT_CODE_MAGIC:
+                    continue
+                if is_retryable_exit_code(code):
+                    retryable.append(pod)
+                else:
+                    return []
+        return retryable
+
+    def _restart_gang(
+        self, job, replicas, status, old_status, pods: List[Pod],
+        failed_pods: List[Pod], previous_retry: int, job_has_new_failure: bool,
+    ) -> Result:
+        """Delete EVERY non-succeeded pod so the slice re-forms atomically.
+
+        A TPU slice admits all-or-nothing and every rank blocks in
+        jax.distributed.initialize at startup — restarting only the failed
+        index (ref pod.go:296-304) would leave that rank hanging against
+        peers that are mid-run. One restart event, not one per pod."""
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        for pod in failed_pods:
+            self.recorder.normal(
+                job,
+                ev.REASON_EXIT_WITH_CODE,
+                f"Pod: {pod.metadata.namespace}.{pod.metadata.name} exited "
+                f"with code {self._default_container_exit_code(pod)}",
+            )
+        job_logger(log, job).info(
+            "restarting whole gang (%d pods) after %d retryable failure(s)",
+            len(pods), len(failed_pods),
+        )
+        self.recorder.normal(
+            job,
+            "SliceRestarting",
+            f"Retryable failure in {len(failed_pods)} gang replica(s); "
+            f"restarting all replicas so the slice re-forms",
+        )
+        for rt_key in replicas:
+            initialize_replica_statuses(status, [rt_key])
+            for pod in utils.filter_pods_for_replica_type(pods, rt_key):
+                update_job_replica_statuses(status, rt_key, pod)
+                if pod.status.phase != PodPhase.SUCCEEDED:
+                    self._delete_pod(job, pod)
+        if self.metrics:
+            self.metrics.restarted_inc()
+        self.controller.update_job_status(job, replicas, status, True)
+        if status != old_status:
+            self._write_status(job, status)
+        if job_has_new_failure:
             self._failure_backoff[key] = previous_retry + 1
             return Result(
                 requeue_after=min(
@@ -418,17 +502,14 @@ class JobReconciler:
                     raise
             else:
                 pod = pod_slice[0]
-                exit_code = EXIT_CODE_MAGIC
-                for cs in pod.status.container_statuses:
-                    if cs.name == self.controller.default_container_name and cs.terminated:
-                        exit_code = cs.terminated.exit_code
-                        self.recorder.normal(
-                            job,
-                            ev.REASON_EXIT_WITH_CODE,
-                            f"Pod: {pod.metadata.namespace}.{pod.metadata.name} "
-                            f"exited with code {exit_code}",
-                        )
-                        break
+                exit_code = self._default_container_exit_code(pod)
+                if exit_code != EXIT_CODE_MAGIC:
+                    self.recorder.normal(
+                        job,
+                        ev.REASON_EXIT_WITH_CODE,
+                        f"Pod: {pod.metadata.namespace}.{pod.metadata.name} "
+                        f"exited with code {exit_code}",
+                    )
                 if spec.restart_policy == RestartPolicy.EXIT_CODE:
                     if pod.status.phase == PodPhase.FAILED and is_retryable_exit_code(exit_code):
                         job_logger(log, job, rtype=rt, index=index, pod=pod.metadata.name).info(
@@ -488,6 +569,14 @@ class JobReconciler:
             self.recorder.warning(job, ev.REASON_FAILED_CREATE_POD, f"Error creating: {e}")
             raise
         self.recorder.normal(job, ev.REASON_SUCCESSFUL_CREATE_POD, f"Created pod: {pod.metadata.name}")
+
+    def _default_container_exit_code(self, pod: Pod) -> int:
+        """Exit code of the workload's default container, or EXIT_CODE_MAGIC
+        when no terminated state has been observed (ref pod.go:285-294)."""
+        for cs in pod.status.container_statuses:
+            if cs.name == self.controller.default_container_name and cs.terminated:
+                return cs.terminated.exit_code
+        return EXIT_CODE_MAGIC
 
     def _delete_pod(self, job, pod: Pod) -> None:
         key = f"{job.metadata.namespace}/{job.metadata.name}"

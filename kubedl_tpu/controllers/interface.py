@@ -90,6 +90,16 @@ class WorkloadController(abc.ABC):
         """Whether replicas of `rtype` get a headless Service (per-replica DNS)."""
         return True
 
+    def restart_whole_gang(self, job, replicas: Dict[str, ReplicaSpec]) -> bool:
+        """Whether a retryable replica failure restarts ALL replicas.
+
+        TPU-slice semantics (SURVEY.md §5 slice-level health): a lone
+        restarted rank can never rejoin a running JAX coordination-service
+        barrier, and a slice readmits atomically — so gang-rendezvous
+        workloads restart as a unit. Default False keeps the reference's
+        per-pod delete+recreate (ref pod.go:296-304)."""
+        return False
+
     # -- status machine ---------------------------------------------------
 
     @abc.abstractmethod

@@ -1,11 +1,18 @@
 """KV-cache autoregressive decoding for the Llama family.
 
-Inference companion to models/llama.py, built the XLA way: static-shape
-caches ([b, kv_heads, max_len, head_dim], dynamic_update_slice writes) and a
-`lax.scan` token loop — no data-dependent Python control flow, so the whole
-generation compiles once and replays from the HLO cache for any prompt of
-the same padded shape. Attention over the cache is one masked dot product
-(decode is bandwidth-bound, a fused kernel buys nothing at t_q = 1).
+Inference companion to models/llama.py, built the XLA way:
+
+  * static-shape caches ([b, kv_heads, max_len, head_dim]) with per-row
+    `lengths` [b] — ragged (right-padded) prompt batches decode correctly,
+    each row masking and writing at its own position;
+  * one-pass prefill: the whole [b, t] prompt runs through a single
+    full-sequence forward (large MXU matmuls, flash attention), writing
+    every K/V row at once — not a token-at-a-time loop;
+  * a `lax.scan` token loop for generation — no data-dependent Python
+    control flow, so the whole generation compiles once and replays from
+    the HLO cache for any prompt of the same padded shape;
+  * attention over the cache is one masked dot product (decode is
+    bandwidth-bound at t_q = 1; a fused kernel buys nothing there).
 """
 from __future__ import annotations
 
@@ -14,35 +21,40 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from kubedl_tpu.models.llama import LlamaConfig, _lm_head, _rope, rms_norm
+from kubedl_tpu.models.llama import (
+    LlamaConfig,
+    _lm_head,
+    _mlp_block,
+    _rope,
+    rms_norm,
+)
 
 NEG_INF = -1e30
 
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Dict:
-    """Per-layer K/V buffers, bf16 like the weights.
+    """Per-layer K/V buffers (model dtype) + per-row write positions.
 
-    The cache carries ONE scalar `length` for the whole batch: prefill and
-    generate assume every prompt in the batch has the same unpadded length.
-    Padded/ragged prompts would attend to pad tokens with wrong RoPE
-    positions — batch prompts of equal length (or generate per-row)."""
+    `lengths` [b] tracks each row's number of valid cache entries, so a
+    batch may mix prompt lengths (right-padded): row i attends only
+    k_pos < lengths[i] and writes its next token at position lengths[i]."""
     shape = (batch, config.n_kv_heads, max_len, config.head_dim)
     return {
         "k": jnp.zeros((config.n_layers,) + shape, config.dtype),
         "v": jnp.zeros((config.n_layers,) + shape, config.dtype),
-        "length": jnp.zeros((), jnp.int32),
+        "lengths": jnp.zeros((batch,), jnp.int32),
     }
 
 
-def _attend_cached(q, ck, cv, length, n_rep):
-    """q [b,hq,1,d] vs cache [b,hkv,L,d]; positions >= length are masked."""
+def _attend_cached(q, ck, cv, lengths, n_rep):
+    """q [b,hq,1,d] vs cache [b,hkv,L,d]; row i masks positions >= lengths[i]."""
     if n_rep > 1:
         ck = jnp.repeat(ck, n_rep, axis=1)
         cv = jnp.repeat(cv, n_rep, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32))
     s = s / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
     k_pos = jnp.arange(ck.shape[2])
-    s = jnp.where(k_pos[None, None, None, :] < length, s, NEG_INF)
+    s = jnp.where(k_pos[None, None, None, :] < lengths[:, None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32))
 
@@ -53,11 +65,21 @@ def decode_step(
     cache: Dict,
     config: LlamaConfig,
 ) -> Tuple[jax.Array, Dict]:
-    """One decode step: returns (logits [b, vocab], updated cache)."""
+    """One decode step: returns (logits [b, vocab], updated cache).
+
+    Each row writes at its own position: a vmapped dynamic_update_slice
+    gives per-row offsets and lowers to a scatter XLA updates in place —
+    a one-hot select over the whole cache would pay O(max_len) traffic
+    per stored row on this bandwidth-bound path."""
     c = config
     b = token.shape[0]
-    pos = cache["length"]
-    positions = jnp.full((b, 1), pos, jnp.int32)
+    pos = cache["lengths"]  # [b]
+    positions = pos[:, None]  # [b, 1] — per-row RoPE positions
+    write_row = jax.vmap(
+        lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
+            cache_row, new_row, p, axis=1
+        )
+    )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
 
     x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
     new_k, new_v = [], []
@@ -68,58 +90,96 @@ def decode_step(
         v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        ck = jax.lax.dynamic_update_slice_in_dim(cache["k"][i], k.astype(c.dtype), pos, 2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cache["v"][i], v.astype(c.dtype), pos, 2)
+        ck = write_row(cache["k"][i], k.astype(c.dtype), pos)
+        cv = write_row(cache["v"][i], v.astype(c.dtype), pos)
         new_k.append(ck)
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
         x = x + (attn.astype(c.dtype) @ layer["wo"]).astype(c.dtype)
-        # dense FFN (decode path targets dense checkpoints)
-        h2 = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        gate = jax.nn.silu((h2 @ layer["w1"]).astype(jnp.float32)).astype(h2.dtype)
-        up = h2 @ layer["w3"]
-        x = x + ((gate * up) @ layer["w2"]).astype(c.dtype)
+        x, _ = _mlp_block(x, layer, c)
 
     cache = {
         "k": jnp.stack(new_k),
         "v": jnp.stack(new_v),
-        "length": pos + 1,
+        "lengths": pos + 1,
     }
     logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
     return logits, cache
 
 
-def prefill(params: Dict, tokens: jax.Array, cache: Dict, config: LlamaConfig):
-    """Feed a [b, t] prompt through the cache one token at a time (scan);
-    returns (logits after the last prompt token, cache)."""
+def prefill(
+    params: Dict,
+    tokens: jax.Array,  # [b, t] int32, right-padded when ragged
+    cache: Dict,
+    config: LlamaConfig,
+    lengths: Optional[jax.Array] = None,  # [b] unpadded lengths; default t
+):
+    """One full-sequence forward over the prompt, writing all K/V at once.
 
-    def body(carry, tok):
-        cache = carry
-        logits, cache = decode_step(params, tok, cache, config)
-        return cache, logits
+    Returns (logits at each row's last real token [b, vocab], cache).
+    Right-padding is safe under a causal mask: a real query at position
+    i < lengths[row] only attends keys <= i, which are all real; pad
+    positions' K/V are never attended (per-row mask) and are overwritten
+    as generation advances."""
+    c = config
+    b, t = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
 
-    cache, logits_seq = jax.lax.scan(body, cache, tokens.T)
-    return logits_seq[-1], cache
+    if c.use_flash:
+        from kubedl_tpu.ops.flash_attention import flash_attention as _attn
+    else:
+        from kubedl_tpu.ops.flash_attention import attention_reference as _attn
+
+    x = params["embed"][tokens].astype(c.dtype)
+    ks, vs = [], []
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        ks.append(k.astype(c.dtype))
+        vs.append(v.astype(c.dtype))
+        # GQA broadcast happens inside the attention entry points
+        attn = _attn(q, k, v, causal=True)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
+        x = x + (attn.astype(c.dtype) @ layer["wo"]).astype(c.dtype)
+        x, _ = _mlp_block(x, layer, c)
+
+    cache = {
+        "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], jnp.stack(ks), 0, axis=3),
+        "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], jnp.stack(vs), 0, axis=3),
+        "lengths": lengths,
+    }
+    logits_all = _lm_head(x, params, c)  # [b, t, vocab]
+    last = jnp.take_along_axis(
+        logits_all, (lengths - 1)[:, None, None], axis=1
+    )[:, 0]
+    return last, cache
 
 
 def generate(
     params: Dict,
-    prompt: jax.Array,  # [b, t] int32
+    prompt: jax.Array,  # [b, t] int32, right-padded when ragged
     config: LlamaConfig,
     max_new_tokens: int,
     max_len: Optional[int] = None,
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
+    lengths: Optional[jax.Array] = None,  # [b] unpadded prompt lengths
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens].
 
-    All prompts in the batch must share one unpadded length `t` (the KV
-    cache tracks a single scalar length — see init_kv_cache)."""
+    Ragged batches: pass right-padded `prompt` plus per-row `lengths`;
+    row i's continuation starts after its own last real token."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
     cache = init_kv_cache(config, b, max_len)
-    logits, cache = prefill(params, prompt, cache, config)
+    logits, cache = prefill(params, prompt, cache, config, lengths=lengths)
     if key is None:
         key = jax.random.PRNGKey(0)
 

@@ -40,11 +40,11 @@ def _chunk_attention(q, k, v, sm_scale, causal_mode, q_offset, k_offset):
     """
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    # bf16 inputs straight into the MXU (full-rate); f32 accumulation via
+    # preferred_element_type — casting to f32 first would run the MXU at
+    # its reduced f32 rate.
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk",
-        q.astype(jnp.float32),
-        k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
     q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
     k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
@@ -61,7 +61,9 @@ def _chunk_attention(q, k, v, sm_scale, causal_mode, q_offset, k_offset):
     m_safe = jnp.maximum(m, NEG_INF / 2)
     p = jnp.exp(s - m_safe[..., None])
     l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
     lse = jnp.where(l > 0, m_safe + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
     out = jnp.where(l[..., None] > 0, out / jnp.maximum(l[..., None], 1e-30), 0.0)
     return out, lse

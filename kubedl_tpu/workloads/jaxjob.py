@@ -111,6 +111,12 @@ class JAXJobController(BaseWorkloadController):
     def default_restart_policy(self, rtype: str) -> RestartPolicy:
         return RestartPolicy.EXIT_CODE
 
+    def restart_whole_gang(self, job, replicas) -> bool:
+        """Multi-worker SPMD jobs restart as a slice: every rank blocks in
+        jax.distributed.initialize at startup, so a lone restarted worker
+        would hang against peers that are mid-run."""
+        return sum(int(s.replicas or 0) for s in replicas.values()) > 1
+
     @property
     def master_types(self) -> List[str]:
         return []

@@ -22,7 +22,7 @@ def test_prefill_matches_full_forward():
     np.testing.assert_allclose(
         np.asarray(last), np.asarray(full[:, -1]), rtol=1e-4, atol=1e-4
     )
-    assert int(cache["length"]) == tokens.shape[1]
+    assert [int(x) for x in cache["lengths"]] == [tokens.shape[1]] * tokens.shape[0]
 
 
 def test_decode_step_matches_incremental_forward():
@@ -50,6 +50,54 @@ def test_greedy_generate_matches_teacher_forced_argmax():
         nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None].astype(jnp.int32)
         assert int(nxt[0, 0]) == int(out[0, i]), f"step {i}"
         seq = jnp.concatenate([seq, nxt], axis=1)
+
+
+def test_ragged_prefill_matches_per_row_forward():
+    """Right-padded ragged batch: each row's last-token logits and greedy
+    continuation must match running that row alone, unpadded."""
+    config, params, _ = _setup()
+    row_lens = [3, 6]
+    t_max = max(row_lens)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(10 + i), (1, n), 0, config.vocab_size)
+        for i, n in enumerate(row_lens)
+    ]
+    padded = jnp.concatenate(
+        [jnp.pad(r, ((0, 0), (0, t_max - r.shape[1]))) for r in rows], axis=0
+    )
+    lengths = jnp.asarray(row_lens, jnp.int32)
+
+    cache = decode.init_kv_cache(config, 2, 16)
+    last, cache = decode.prefill(params, padded, cache, config, lengths=lengths)
+    for i, r in enumerate(rows):
+        solo = llama.forward(params, r, config)[:, -1]
+        np.testing.assert_allclose(
+            np.asarray(last[i]), np.asarray(solo[0]), rtol=1e-4, atol=1e-4,
+            err_msg=f"row {i} (len {row_lens[i]})",
+        )
+    assert [int(x) for x in cache["lengths"]] == row_lens
+
+
+def test_ragged_generate_matches_solo_generate():
+    config, params, _ = _setup()
+    row_lens = [2, 5]
+    t_max = max(row_lens)
+    rows = [
+        jax.random.randint(jax.random.PRNGKey(20 + i), (1, n), 0, config.vocab_size)
+        for i, n in enumerate(row_lens)
+    ]
+    padded = jnp.concatenate(
+        [jnp.pad(r, ((0, 0), (0, t_max - r.shape[1]))) for r in rows], axis=0
+    )
+    out = decode.generate(
+        params, padded, config, max_new_tokens=3,
+        lengths=jnp.asarray(row_lens, jnp.int32), max_len=16,
+    )
+    for i, r in enumerate(rows):
+        solo = decode.generate(params, r, config, max_new_tokens=3, max_len=16)
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), np.asarray(solo[0]), err_msg=f"row {i}"
+        )
 
 
 def test_sampled_generate_shape_and_range():

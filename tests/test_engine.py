@@ -421,3 +421,119 @@ def test_backoff_limit_exceeded_by_repeated_failures():
     assert is_failed(status)
     # terminal path forgets the backoff state
     assert job.key not in engine._failure_backoff
+
+
+# ---------------------------------------------------------------------------
+# Slice gang restart (net-new; SURVEY.md §5 slice-level health)
+# ---------------------------------------------------------------------------
+
+
+class GangTestController(TestJobController):
+    """TestJob variant with slice-atomic restart semantics (like a
+    multi-worker JAXJob, whose ranks all block in jax.distributed.initialize)."""
+
+    def restart_whole_gang(self, job, replicas):
+        return True
+
+
+def make_gang_engine():
+    from kubedl_tpu.metrics.job_metrics import JobMetrics
+
+    store = ObjectStore()
+    ctrl = GangTestController()
+    metrics = JobMetrics(TEST_KIND)
+    engine = JobReconciler(store, ctrl, metrics=metrics)
+    ctrl.engine = engine
+    return store, ctrl, engine, metrics
+
+
+def test_gang_restart_deletes_all_pods_on_retryable_failure():
+    store, ctrl, engine, metrics = make_gang_engine()
+    job = store.create(make_test_job(workers=3, masters=0,
+                                     restart_policy=RestartPolicy.EXIT_CODE))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+    assert len(store.list("Pod")) == 3
+
+    pod = store.get("Pod", "default", "test-job-worker-1")
+    set_pod_phase(store, pod, PodPhase.FAILED, exit_code=143)  # retryable
+    engine.reconcile(job.key)
+
+    # the WHOLE gang is deleted, not just the failed index
+    assert store.list("Pod") == []
+    status = store.get(TEST_KIND, "default", "test-job").status
+    assert not is_failed(status)
+    # one restart event for the slice, not one per pod
+    assert metrics.restarted == 1
+
+    observe_all(engine, job)
+    engine.reconcile(job.key)
+    assert len(store.list("Pod")) == 3
+
+
+def test_gang_restart_not_triggered_by_permanent_failure():
+    store, ctrl, engine, metrics = make_gang_engine()
+    job = store.create(make_test_job(workers=2, masters=0,
+                                     restart_policy=RestartPolicy.EXIT_CODE))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    pod = store.get("Pod", "default", "test-job-worker-0")
+    set_pod_phase(store, pod, PodPhase.FAILED, exit_code=1)  # permanent
+    engine.reconcile(job.key)
+
+    # the healthy peer is NOT deleted; no slice restart happened
+    assert store.get("Pod", "default", "test-job-worker-1") is not None
+    assert metrics.restarted == 0
+
+
+def test_gang_restart_spares_succeeded_pods():
+    store, ctrl, engine, metrics = make_gang_engine()
+    job = store.create(make_test_job(workers=3, masters=0,
+                                     restart_policy=RestartPolicy.EXIT_CODE))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-0"),
+                  PodPhase.SUCCEEDED, exit_code=0)
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-1"),
+                  PodPhase.FAILED, exit_code=137)  # retryable
+    engine.reconcile(job.key)
+
+    remaining = sorted(p.metadata.name for p in store.list("Pod"))
+    assert remaining == ["test-job-worker-0"]  # succeeded pod kept
+    assert metrics.restarted == 1
+
+
+def test_jaxjob_gang_restart_only_when_multi_worker():
+    from kubedl_tpu.api.common import ReplicaSpec
+    from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+    ctrl = JAXJobController()
+    multi = {"Worker": ReplicaSpec(replicas=4)}
+    single = {"Worker": ReplicaSpec(replicas=1)}
+    assert ctrl.restart_whole_gang(None, multi) is True
+    assert ctrl.restart_whole_gang(None, single) is False
+
+
+def test_gang_restart_suppressed_when_any_failure_is_permanent():
+    """A deterministic crash (permanent code) tears its peers down with
+    SIGTERM (retryable) — the gang path must stand aside so the normal
+    per-pod path fails the job instead of looping the slice forever."""
+    store, ctrl, engine, metrics = make_gang_engine()
+    job = store.create(make_test_job(workers=2, masters=0,
+                                     restart_policy=RestartPolicy.EXIT_CODE))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-0"),
+                  PodPhase.FAILED, exit_code=1)    # permanent crash
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-1"),
+                  PodPhase.FAILED, exit_code=143)  # peer torn down
+    engine.reconcile(job.key)
+
+    # no gang restart: the permanently-failed pod is preserved as evidence
+    # (the per-pod path may still restart the 143 peer — reference parity)
+    assert store.get("Pod", "default", "test-job-worker-0") is not None
+    events = store.list("Event")
+    assert not any(e.reason == "SliceRestarting" for e in events)
