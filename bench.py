@@ -286,6 +286,30 @@ def _tpu_child(results_path: str) -> int:
         sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
         _emit(out, "mnist", {"mnist_steps_per_sec": sps})
 
+    # -- 4b. autoregressive decode throughput (KV cache, models/decode.py) --
+    def decode_milestone():
+        from kubedl_tpu.models import decode as dec, llama
+
+        config = (llama.LlamaConfig.tiny(use_flash=False) if small
+                  else llama.LlamaConfig.bench_150m(max_seq_len=512, remat=False))
+        b, t, new = (2, 8, 8) if small else (8, 128, 128)
+        params = llama.init(config, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, config.vocab_size)
+        gen = jax.jit(lambda p, pr: dec.generate(
+            p, pr, config, max_new_tokens=new, max_len=t + new))
+        jax.device_get(gen(params, prompt))  # compile
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            toks = gen(params, prompt)
+        jax.device_get(toks)
+        dt = (time.perf_counter() - t0) / iters
+        _emit(out, "decode", {
+            "decode_tokens_per_sec": round(b * new / dt, 0),
+            "decode_ms_per_token": round(dt / new * 1e3, 3),
+            "batch": b, "prompt_len": t, "new_tokens": new,
+        })
+
     # -- 5. llama throughput/MFU (small proof first, then the 1B target) ----
     def llama_milestone(config_name, batch, seq, steps, key):
         import optax
@@ -296,10 +320,14 @@ def _tpu_child(results_path: str) -> int:
 
         configs = {
             "tiny": llama.LlamaConfig.tiny(use_flash=False),
-            "150m": llama.LlamaConfig(
-                vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
-                n_kv_heads=8, d_ff=2816, max_seq_len=seq, remat=True),
-            "1b": llama.LlamaConfig.bench_1b(),
+            # remat off: at 150m the activations fit v5e HBM easily and
+            # recompute costs ~15% of the step (A/B'd on chip: 0.64 vs
+            # 0.54 MFU)
+            "150m": llama.LlamaConfig.bench_150m(max_seq_len=seq, remat=False),
+            # remat off + s=1024: activations fit alongside params+adam on
+            # 16 GB, and recompute was costing ~35% (chip sweep: 0.68 MFU
+            # at b8/s1024 remat=F vs 0.51 at b8/s2048 remat=T)
+            "1b": llama.LlamaConfig.bench_1b(remat=False, max_seq_len=1024),
         }
         config = configs[config_name]
         rules = ShardingRules()
@@ -341,6 +369,7 @@ def _tpu_child(results_path: str) -> int:
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
         ("mnist", mnist_milestone, 250),
+        ("decode", decode_milestone, 150),
     ]
     for name, fn, min_budget in milestones:
         if left() < min_budget:
@@ -366,7 +395,7 @@ def _tpu_child(results_path: str) -> int:
         if small:
             _emit(out, "llama_1b", {"skipped": "KUBEDL_BENCH_SMALL set"})
         elif left() > 240:
-            llama_milestone("1b", batch=8, seq=2048, steps=10, key="llama_1b")
+            llama_milestone("1b", batch=8, seq=1024, steps=10, key="llama_1b")
         else:
             _emit(out, "llama_1b", {"skipped": f"budget exhausted ({left():.0f}s left)",
                                     "fallback": "llama_150m"})

@@ -305,6 +305,15 @@ class JobReconciler:
                 self.metrics.all_pods_launch_delay(job, pods, status)
             self.metrics.observe_status(key, status)
 
+        return self._write_status_and_pace_retry(
+            job, status, old_status, key, previous_retry, job_has_new_failure
+        )
+
+    def _write_status_and_pace_retry(
+        self, job, status, old_status, key: str,
+        previous_retry: int, job_has_new_failure: bool,
+    ) -> Result:
+        """Shared tail of the normal and gang-restart reconcile paths."""
         if status != old_status:
             self._write_status(job, status)
         if job_has_new_failure:
@@ -339,11 +348,12 @@ class JobReconciler:
                 if pod.status.phase != PodPhase.FAILED:
                     continue
                 code = self._default_container_exit_code(pod)
-                if code == EXIT_CODE_MAGIC:
-                    continue
-                if is_retryable_exit_code(code):
+                if code != EXIT_CODE_MAGIC and is_retryable_exit_code(code):
                     retryable.append(pod)
                 else:
+                    # Permanent code OR no observed exit code (eviction,
+                    # node loss): the per-pod path treats both as
+                    # non-retryable, so the gang path must stand aside too.
                     return []
         return retryable
 
@@ -365,35 +375,30 @@ class JobReconciler:
                 f"Pod: {pod.metadata.namespace}.{pod.metadata.name} exited "
                 f"with code {self._default_container_exit_code(pod)}",
             )
-        job_logger(log, job).info(
-            "restarting whole gang (%d pods) after %d retryable failure(s)",
-            len(pods), len(failed_pods),
-        )
         self.recorder.normal(
             job,
             "SliceRestarting",
             f"Retryable failure in {len(failed_pods)} gang replica(s); "
             f"restarting all replicas so the slice re-forms",
         )
+        deleted = 0
         for rt_key in replicas:
             initialize_replica_statuses(status, [rt_key])
             for pod in utils.filter_pods_for_replica_type(pods, rt_key):
                 update_job_replica_statuses(status, rt_key, pod)
                 if pod.status.phase != PodPhase.SUCCEEDED:
                     self._delete_pod(job, pod)
+                    deleted += 1
+        job_logger(log, job).info(
+            "restarted whole gang (%d of %d pods deleted) after %d retryable failure(s)",
+            deleted, len(pods), len(failed_pods),
+        )
         if self.metrics:
             self.metrics.restarted_inc()
         self.controller.update_job_status(job, replicas, status, True)
-        if status != old_status:
-            self._write_status(job, status)
-        if job_has_new_failure:
-            self._failure_backoff[key] = previous_retry + 1
-            return Result(
-                requeue_after=min(
-                    BACKOFF_BASE_DELAY_S * (2 ** previous_retry), BACKOFF_MAX_DELAY_S
-                )
-            )
-        return Result()
+        return self._write_status_and_pace_retry(
+            job, status, old_status, key, previous_retry, job_has_new_failure
+        )
 
     # ------------------------------------------------------------------
     # Terminal path (ref job.go:158-204, 321-345)

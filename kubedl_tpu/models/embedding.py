@@ -116,6 +116,26 @@ def sparse_lookup(
     if weights is None:
         weights = jnp.ones(ids.shape, jnp.float32)
 
+    def pool(emb, ids_l, w_l):
+        mask = (ids_l >= 0).astype(jnp.float32)
+        wm = (w_l * mask)[..., None].astype(emb.dtype)
+        if combiner is None:
+            return emb * wm
+        pooled = jnp.sum(emb * wm, axis=-2)
+        if combiner == "mean":
+            denom = jnp.sum(wm, axis=-2)
+            pooled = pooled / jnp.maximum(denom, jnp.asarray(1e-9, denom.dtype))
+        return pooled
+
+    if n_shards == 1:
+        # Single-shard fast path: the ownership mask and psum are no-ops,
+        # and skipping shard_map lets XLA fuse the plain gather+pool (the
+        # padded -1 ids still gather row 0 but are zeroed by the mask).
+        d = table.shape[1]
+        safe = jnp.maximum(ids, 0)
+        emb = jnp.take(table, safe.reshape(-1), axis=0).reshape(*ids.shape, d)
+        return pool(emb, ids, weights)
+
     bspec = P(batch_axes) if isinstance(batch_axes, str) else P(tuple(batch_axes))
     ids_spec = P(bspec[0], None)
     out_spec = ids_spec if combiner else P(bspec[0], None, None)
@@ -129,15 +149,7 @@ def sparse_lookup(
         emb = jnp.take(tab, safe.reshape(-1), axis=0).reshape(*ids_l.shape, d)
         emb = jnp.where(owned[..., None], emb, jnp.zeros((), tab.dtype))
         emb = jax.lax.psum(emb, axis)
-        mask = (ids_l >= 0).astype(jnp.float32)
-        wm = (w_l * mask)[..., None].astype(emb.dtype)
-        if combiner is None:
-            return emb * wm
-        pooled = jnp.sum(emb * wm, axis=-2)
-        if combiner == "mean":
-            denom = jnp.sum(wm, axis=-2)
-            pooled = pooled / jnp.maximum(denom, jnp.asarray(1e-9, denom.dtype))
-        return pooled
+        return pool(emb, ids_l, w_l)
 
     return jax.shard_map(
         body,

@@ -76,12 +76,24 @@ class LlamaConfig:
         return LlamaConfig(**defaults)
 
     @staticmethod
-    def bench_1b() -> "LlamaConfig":
+    def bench_150m(**kw) -> "LlamaConfig":
+        """~170M params — the single-chip quick-proof bench size."""
+        defaults = dict(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+            n_kv_heads=8, d_ff=2816, max_seq_len=1024,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def bench_1b(**kw) -> "LlamaConfig":
         """~1.1B params — fits one v5e chip (16 GB HBM) in bf16 + optimizer."""
-        return LlamaConfig(
+        defaults = dict(
             vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
             n_kv_heads=16, d_ff=5632, max_seq_len=2048,
         )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
 
 
 # ---------------------------------------------------------------------------

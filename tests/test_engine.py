@@ -537,3 +537,24 @@ def test_gang_restart_suppressed_when_any_failure_is_permanent():
     assert store.get("Pod", "default", "test-job-worker-0") is not None
     events = store.list("Event")
     assert not any(e.reason == "SliceRestarting" for e in events)
+
+
+def test_gang_restart_suppressed_when_exit_code_unobserved():
+    """A FAILED pod with no terminated container status (eviction/node
+    loss) is non-retryable on the per-pod path; the gang path must treat
+    it the same instead of deleting the evidence and looping the slice."""
+    store, ctrl, engine, metrics = make_gang_engine()
+    job = store.create(make_test_job(workers=2, masters=0,
+                                     restart_policy=RestartPolicy.EXIT_CODE))
+    engine.reconcile(job.key)
+    observe_all(engine, job)
+
+    # no exit_code: phase flips to FAILED with no container statuses
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-0"),
+                  PodPhase.FAILED)
+    set_pod_phase(store, store.get("Pod", "default", "test-job-worker-1"),
+                  PodPhase.FAILED, exit_code=143)
+    engine.reconcile(job.key)
+
+    assert store.get("Pod", "default", "test-job-worker-0") is not None
+    assert not any(e.reason == "SliceRestarting" for e in store.list("Event"))
