@@ -48,8 +48,20 @@ class LlamaConfig:
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # None = full recompute; "dots" saves matmul outputs and recomputes
+    # only elementwise ops (jax dots_with_no_batch_dims_saveable) — most
+    # of remat's HBM win at a fraction of its ~15-35% step-time cost
+    remat_policy: Optional[str] = None
     use_flash: bool = True
     tie_embeddings: bool = False
+    # >1: compute the training loss over this many vocab chunks instead of
+    # materializing [b, t, vocab] f32 logits (a 1 GB HBM round-trip at
+    # b8/s1024/V32k) — each chunk's lm_head matmul fuses with its logsumexp
+    # reduction and is recomputed in backward (see _next_token_ce_chunked).
+    # A memory knob, not a speed knob (measured ~5-9% slower on v5e).
+    # Ignored (with a one-time warning) on tensor-parallel meshes, where
+    # the head's vocab dim is sharded and the full-logits path applies.
+    ce_chunks: int = 0
     # MoE (expert parallelism over the "expert" mesh axis): n_experts=0 means
     # dense FFN; >0 replaces every FFN with a top-k-routed expert layer
     n_experts: int = 0
@@ -180,6 +192,14 @@ def param_count(params) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _remat_policy(name: Optional[str]):
+    if name is None:
+        return None  # save nothing: full recompute
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {name!r} (None | 'dots')")
+
+
 def rms_norm(x, weight, eps):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
@@ -239,23 +259,26 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
     return x + ((gate * up) @ layer["w2"]).astype(x.dtype), jnp.zeros((), jnp.float32)
 
 
-def forward_and_aux(
-    params: Dict,
-    tokens: jax.Array,  # [batch, seq] int32
-    config: LlamaConfig,
-    mesh: Optional[Mesh] = None,
-    rules: Optional[ShardingRules] = None,
-) -> Tuple[jax.Array, jax.Array]:
-    """(logits [batch, seq, vocab] f32, summed MoE aux loss — 0 when dense)."""
-    rules = rules or ShardingRules()
-    context_size = 1
-    if mesh is not None:
-        context_size = mesh.shape.get("context", 1)
-
+def _constrainer(mesh, rules):
     def constrain(x, *dims):
         if mesh is None:
             return x
         return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
+    return constrain
+
+
+def _backbone(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+) -> Tuple[jax.Array, jax.Array]:
+    """(pre-final-norm activations [batch, seq, d], summed MoE aux loss)."""
+    context_size = 1
+    if mesh is not None:
+        context_size = mesh.shape.get("context", 1)
+    constrain = _constrainer(mesh, rules)
 
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
@@ -275,13 +298,25 @@ def forward_and_aux(
         return constrain(x, "batch", "seq", None), aux + a
 
     if config.remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
     aux = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         x, aux = layer_fn((x, aux), layer)
+    return x, aux
 
+
+def forward_and_aux(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits [batch, seq, vocab] f32, summed MoE aux loss — 0 when dense)."""
+    rules = rules or ShardingRules()
+    x, aux = _backbone(params, tokens, config, mesh, rules)
     logits = _lm_head(x, params, config)
-    return constrain(logits, "batch", "seq", "vocab"), aux
+    return _constrainer(mesh, rules)(logits, "batch", "seq", "vocab"), aux
 
 
 def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.Array:
@@ -289,13 +324,18 @@ def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.A
     return forward_and_aux(params, tokens, config, mesh=mesh, rules=rules)[0]
 
 
-def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
-    """Final norm + (tied or separate) LM head -> f32 logits."""
-    x = rms_norm(x, params["final_norm"], config.rms_eps)
+def _head_matrix(params, config: LlamaConfig) -> jax.Array:
+    """[d, vocab] LM head — separate weights or the tied embedding table."""
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T.astype(config.dtype)
-    return (x @ head).astype(jnp.float32)
+    return head
+
+
+def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
+    """Final norm + (tied or separate) LM head -> f32 logits."""
+    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    return (x @ _head_matrix(params, config)).astype(jnp.float32)
 
 
 def _next_token_ce(logits, targets):
@@ -304,10 +344,86 @@ def _next_token_ce(logits, targets):
     return -jnp.mean(ll)
 
 
+def _next_token_ce_chunked(x, params, config: LlamaConfig, targets, n_chunks: int):
+    """CE without materializing [b, t, V] f32 logits.
+
+    lax.scan over vocab chunks: each chunk's lm_head matmul fuses with its
+    max/sumexp reduction (only [b, t] statistics leave the chunk), and
+    jax.checkpoint recomputes the chunk logits in backward instead of
+    saving them. Online-logsumexp merge across chunks is exact.
+    """
+    xn = rms_norm(x, params["final_norm"], config.rms_eps)
+    head = _head_matrix(params, config)
+    d, V = head.shape
+    if V % n_chunks:
+        raise ValueError(f"vocab {V} not divisible by ce_chunks {n_chunks}")
+    cs = V // n_chunks
+    hc = jnp.moveaxis(head.reshape(d, n_chunks, cs), 1, 0)  # [n, d, cs]
+    offs = jnp.arange(n_chunks, dtype=targets.dtype) * cs
+
+    @jax.checkpoint
+    def chunk_stats(h_c, off):
+        logits = (xn @ h_c).astype(jnp.float32)  # [b, t, cs]
+        m = jnp.max(logits, axis=-1)
+        l = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+        in_chunk = (targets >= off) & (targets < off + cs)
+        idx = jnp.clip(targets - off, 0, cs - 1)
+        tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        tl = jnp.where(in_chunk, tl, -jnp.inf)
+        return m, l, tl
+
+    def body(carry, inp):
+        big_m, big_l, tgt = carry
+        m, l, tl = chunk_stats(*inp)
+        new_m = jnp.maximum(big_m, m)
+        big_l = big_l * jnp.exp(big_m - new_m) + l * jnp.exp(m - new_m)
+        # exactly one chunk holds each target, the rest contribute -inf
+        return (new_m, big_l, jnp.maximum(tgt, tl)), None
+
+    b, t = targets.shape
+    init = (
+        jnp.full((b, t), -jnp.inf, jnp.float32),
+        jnp.zeros((b, t), jnp.float32),
+        jnp.full((b, t), -jnp.inf, jnp.float32),
+    )
+    (big_m, big_l, tgt), _ = jax.lax.scan(body, init, (hc, offs))
+    lse = big_m + jnp.log(big_l)
+    return jnp.mean(lse - tgt)
+
+
 def loss_fn(params, tokens, config: LlamaConfig, mesh=None, rules=None):
-    """Next-token cross entropy (+ MoE aux); tokens [b, t], loss over [:, 1:]."""
-    logits, aux = forward_and_aux(params, tokens[:, :-1], config, mesh=mesh, rules=rules)
-    return _next_token_ce(logits, tokens[:, 1:]) + config.moe_aux_coef * aux
+    """Next-token cross entropy (+ MoE aux); tokens [b, t], loss over [:, 1:].
+
+    With config.ce_chunks > 1 (and no vocab/tensor sharding to respect)
+    the loss runs chunked — the full logits tensor never exists.
+    """
+    rules = rules or ShardingRules()
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if config.ce_chunks > 1:
+        if mesh is None or mesh.shape.get("tensor", 1) == 1:
+            x, aux = _backbone(params, inputs, config, mesh, rules)
+            ce = _next_token_ce_chunked(x, params, config, targets, config.ce_chunks)
+            return ce + config.moe_aux_coef * aux
+        _warn_ce_chunks_ignored(mesh.shape.get("tensor", 1))
+    logits, aux = forward_and_aux(params, inputs, config, mesh=mesh, rules=rules)
+    return _next_token_ce(logits, targets) + config.moe_aux_coef * aux
+
+
+_warned_ce_chunks = False
+
+
+def _warn_ce_chunks_ignored(tensor_size: int) -> None:
+    global _warned_ce_chunks
+    if _warned_ce_chunks:
+        return
+    _warned_ce_chunks = True
+    import warnings
+
+    warnings.warn(
+        f"ce_chunks ignored: the mesh's tensor axis ({tensor_size}) shards the "
+        f"head's vocab dim, so the full-logits loss path applies",
+        stacklevel=3,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -187,3 +187,64 @@ def test_grad_accumulation_matches_big_batch():
     b = jax.tree_util.tree_leaves(state_b.params)
     for x, y in zip(a, b):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_ce_matches_full_loss_and_grads():
+    """ce_chunks must be a pure optimization: same loss, same gradients."""
+    import dataclasses
+
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, config.vocab_size)
+
+    full = jax.value_and_grad(lambda p: llama.loss_fn(p, tokens, config))
+    cfg_c = dataclasses.replace(config, ce_chunks=4)
+    chunked = jax.value_and_grad(lambda p: llama.loss_fn(p, tokens, cfg_c))
+
+    l0, g0 = full(params)
+    l1, g1 = chunked(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_ce_rejects_indivisible_vocab():
+    import dataclasses
+
+    import pytest
+
+    from kubedl_tpu.models import llama
+
+    config = dataclasses.replace(
+        llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False), ce_chunks=7
+    )
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0, config.vocab_size)
+    with pytest.raises(ValueError, match="not divisible"):
+        llama.loss_fn(params, tokens, config)
+
+
+def test_remat_policy_dots_matches_full_remat():
+    import dataclasses
+
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, config.vocab_size)
+
+    base = jax.value_and_grad(lambda p: llama.loss_fn(p, tokens, config))
+    cfg_d = dataclasses.replace(config, remat_policy="dots")
+    dots = jax.value_and_grad(lambda p: llama.loss_fn(p, tokens, cfg_d))
+
+    l0, g0 = base(params)
+    l1, g1 = dots(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
