@@ -179,6 +179,29 @@ class TPUSliceAdmitter(GangScheduler):
         # Gang reservations outlive individual pods (restarts keep the
         # slice); they free on delete_gang.
 
+    def utilization(self) -> Dict:
+        """Pool occupancy snapshot (BASELINE.md "slice utilization" gauge)."""
+        with self._lock:
+            slices = list(self._slices.values())
+            total_chips = sum(s.type.chips for s in slices)
+            reserved = [s for s in slices if s.reserved_by is not None]
+            reserved_chips = sum(s.type.chips for s in reserved)
+            return {
+                "slices_total": len(slices),
+                "slices_reserved": len(reserved),
+                "chips_total": total_chips,
+                "chips_reserved": reserved_chips,
+                "utilization": (reserved_chips / total_chips) if total_chips else 0.0,
+                "slices": [
+                    {
+                        "name": s.name,
+                        "type": s.type.name,
+                        "reserved_by": s.reserved_by or "",
+                    }
+                    for s in slices
+                ],
+            }
+
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------

@@ -44,6 +44,8 @@ class RuntimeMetrics:
         self._requeues: Dict[str, int] = {}
         # controller name -> queue-depth callable, registered by the manager
         self._queue_depth: Dict[str, Callable[[], int]] = {}
+        # slice-pool snapshot callable (TPUSliceAdmitter.utilization)
+        self._slice_pool: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -61,6 +63,11 @@ class RuntimeMetrics:
     def register_queue(self, controller: str, depth_fn: Callable[[], int]) -> None:
         with self._lock:
             self._queue_depth[controller] = depth_fn
+
+    def register_slice_pool(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns TPUSliceAdmitter.utilization()-shaped dicts."""
+        with self._lock:
+            self._slice_pool = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -104,6 +111,39 @@ class RuntimeMetrics:
                 except Exception:
                     depth = -1
                 lines.append(f'kubedl_workqueue_depth{{controller="{name}"}} {depth}')
+            slice_fn = self._slice_pool
+        # Call the pool snapshot OUTSIDE the metrics lock: it takes the
+        # admitter's lock, and holding both would pin a lock order that a
+        # callback into RuntimeMetrics could deadlock against.
+        if slice_fn is not None:
+            lines.append(
+                "# HELP kubedl_slice_utilization Fraction of pool TPU chips reserved"
+            )
+            lines.append("# TYPE kubedl_slice_utilization gauge")
+            try:
+                snap = slice_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                # explicit sentinel (like kubedl_workqueue_depth) so the
+                # series degrades visibly instead of flapping absent
+                snap = None
+            if snap is None:
+                lines.append("kubedl_slice_utilization -1")
+            else:
+                lines.append(f"kubedl_slice_utilization {snap['utilization']:.4f}")
+                for metric, key in (
+                    ("kubedl_slices_total", "slices_total"),
+                    ("kubedl_slices_reserved", "slices_reserved"),
+                    ("kubedl_slice_chips_total", "chips_total"),
+                    ("kubedl_slice_chips_reserved", "chips_reserved"),
+                ):
+                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(f"{metric} {snap[key]}")
+                lines.append("# TYPE kubedl_slice_reserved gauge")
+                for s in snap["slices"]:
+                    lines.append(
+                        f'kubedl_slice_reserved{{slice="{s["name"]}",type="{s["type"]}"}} '
+                        f'{1 if s["reserved_by"] else 0}'
+                    )
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -124,5 +164,11 @@ class RuntimeMetrics:
                 except Exception:  # noqa: BLE001 — callback raced shutdown
                     depth = -1
                 out["controllers"].setdefault(name, {})["queue_depth"] = depth
+            slice_fn = self._slice_pool
+        if slice_fn is not None:
+            try:
+                out["slice_pool"] = slice_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["slice_pool"] = None
         out["threads"] = [t.name for t in threading.enumerate()]
         return out

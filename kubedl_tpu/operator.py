@@ -92,6 +92,9 @@ class Operator:
         self.gang_registry = GangRegistry()
         self.gang_registry.register(TPUSliceAdmitter.with_pool(self.store, self.config.tpu_slices))
         self._gang = self.gang_registry.get(self.config.gang_scheduler_name)
+        if self.config.tpu_slices and isinstance(self._gang, TPUSliceAdmitter):
+            # BASELINE.md "slice utilization" gauge: /metrics + /debug/vars
+            self.runtime_metrics.register_slice_pool(self._gang.utilization)
         self.executor: Optional[LocalPodExecutor] = None
         if self.config.run_executor:
             scheduler = self._gang if self.config.tpu_slices else None

@@ -98,3 +98,67 @@ def test_http_server_requires_token_for_nonlocal_bind():
         assert urllib.request.urlopen(req).status == 200
     finally:
         srv.stop()
+
+
+def test_slice_pool_gauges_rendered():
+    from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+    rm = RuntimeMetrics()
+    rm.register_slice_pool(lambda: {
+        "slices_total": 2, "slices_reserved": 1,
+        "chips_total": 12, "chips_reserved": 8, "utilization": 8 / 12,
+        "slices": [
+            {"name": "slice-0-v5p-8", "type": "v5p-8", "reserved_by": "default/llama"},
+            {"name": "slice-1-v5e-4", "type": "v5e-4", "reserved_by": ""},
+        ],
+    })
+    text = rm.render()
+    assert "kubedl_slice_utilization 0.6667" in text
+    assert "kubedl_slice_chips_reserved 8" in text
+    assert 'kubedl_slice_reserved{slice="slice-0-v5p-8",type="v5p-8"} 1' in text
+    assert 'kubedl_slice_reserved{slice="slice-1-v5e-4",type="v5e-4"} 0' in text
+    assert rm.debug_vars()["slice_pool"]["slices_reserved"] == 1
+
+
+def test_slice_pool_gauges_from_admitter():
+    """End to end: admitter pool -> utilization() -> rendered gauges."""
+    from kubedl_tpu.core.store import ObjectStore
+    from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+    from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+    store = ObjectStore()
+    adm = TPUSliceAdmitter.with_pool(store, ["v5e-4", "v5e-8"])
+    rm = RuntimeMetrics()
+    rm.register_slice_pool(adm.utilization)
+
+    assert "kubedl_slice_utilization 0.0000" in rm.render()
+
+    snap = adm.utilization()
+    assert snap["chips_total"] == 12
+    assert snap["slices_total"] == 2
+    # reserve one slice by hand (as _try_reserve would)
+    next(iter(adm._slices.values())).reserved_by = "default/job"
+    assert adm.utilization()["slices_reserved"] == 1
+    assert "kubedl_slices_reserved 1" in rm.render()
+
+
+def test_operator_wires_slice_pool_gauge():
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    op = Operator(OperatorConfig(tpu_slices=["v5e-8"]))
+    text = op.runtime_metrics.render()
+    assert "kubedl_slice_utilization 0.0000" in text
+    assert "kubedl_slice_chips_total 8" in text
+
+
+def test_slice_pool_sentinel_on_callback_failure():
+    from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+    rm = RuntimeMetrics()
+
+    def boom():
+        raise RuntimeError("pool gone")
+
+    rm.register_slice_pool(boom)
+    assert "kubedl_slice_utilization -1" in rm.render()
+    assert rm.debug_vars()["slice_pool"] is None
