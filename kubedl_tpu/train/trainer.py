@@ -49,11 +49,25 @@ def parse_args(argv=None):
                    type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_KEEP", 3)))
     # JAX profiler / XProf hook (SURVEY.md §5: "TPU side gets JAX
     # profiler/XProf hooks" — net-new, the reference has no profiling)
+    p.add_argument("--remat", choices=["full", "dots", "none"],
+                   default=os.environ.get("KUBEDL_REMAT", ""),
+                   help="override the model's remat: full recompute, "
+                        "matmul-saving 'dots' policy, or none")
+    p.add_argument("--ce-chunks", type=int,
+                   default=int(os.environ.get("KUBEDL_CE_CHUNKS", 0)),
+                   help=">1: chunked cross-entropy (no [b,t,V] logits)")
     p.add_argument("--profile-dir", default=os.environ.get("KUBEDL_PROFILE_DIR", ""))
     p.add_argument("--profile-steps", type=int,
                    default=int(os.environ.get("KUBEDL_PROFILE_STEPS", 5)),
                    help="trace this many steps after warmup into --profile-dir")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    # argparse validates `choices` only for command-line values; an env
+    # default (KUBEDL_REMAT=off) would otherwise slip through and silently
+    # mean "full remat" instead of erroring.
+    if args.remat not in ("", "full", "dots", "none"):
+        p.error(f"invalid KUBEDL_REMAT/--remat {args.remat!r} "
+                f"(choose from full, dots, none)")
+    return args
 
 
 def main(argv=None) -> int:
@@ -78,6 +92,16 @@ def main(argv=None) -> int:
         "bench-1b": llama.LlamaConfig.bench_1b(),
         "llama-7b": llama.LlamaConfig.llama_7b(),
     }[args.model]
+    import dataclasses
+
+    if args.remat:
+        config = dataclasses.replace(
+            config,
+            remat=args.remat != "none",
+            remat_policy="dots" if args.remat == "dots" else None,
+        )
+    if args.ce_chunks > 1:
+        config = dataclasses.replace(config, ce_chunks=args.ce_chunks)
 
     mesh = build_mesh(parse_mesh_env())
     rules = ShardingRules()
