@@ -88,6 +88,21 @@ class LlamaConfig:
         return LlamaConfig(**defaults)
 
     @staticmethod
+    def config_for(name: str) -> "LlamaConfig":
+        """Named configs shared by the trainer/generate CLIs."""
+        factories = {
+            "tiny": LlamaConfig.tiny,
+            "bench-150m": LlamaConfig.bench_150m,
+            "bench-1b": LlamaConfig.bench_1b,
+            "llama-7b": LlamaConfig.llama_7b,
+        }
+        if name not in factories:
+            raise ValueError(
+                f"unknown model {name!r} (choose from {sorted(factories)})"
+            )
+        return factories[name]()
+
+    @staticmethod
     def bench_150m(**kw) -> "LlamaConfig":
         """~170M params — the single-chip quick-proof bench size."""
         defaults = dict(

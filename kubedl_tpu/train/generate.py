@@ -1,0 +1,116 @@
+"""Batch generation — the inference companion to train/trainer.py.
+
+Runs as a JAXJob pod program (or standalone): restores params from the
+trainer's Orbax checkpoint when given one (otherwise fresh init), then
+generates with the KV-cache decode path (models/decode.py — one-pass
+flash prefill + lax.scan token loop, so the whole generation is a single
+compiled dispatch) and prints throughput.
+
+The reference has no serving path at all (it orchestrates training
+frameworks); this makes the train -> checkpoint -> serve loop a
+first-class job program on the same operator.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-generate")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""),
+                   help="trainer Orbax dir; newest step's params are used")
+    p.add_argument("--allow-fresh-init", action="store_true",
+                   help="serve from random weights when --checkpoint-path "
+                        "holds no checkpoint (otherwise that's an error)")
+    p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
+    p.add_argument("--prompt-len", type=int, default=32)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import decode, llama
+
+    config = llama.LlamaConfig.config_for(args.model)
+
+    params = None
+    if args.checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        mngr = ocp.CheckpointManager(args.checkpoint_path)
+        latest = mngr.latest_step()
+        if latest is None:
+            if not args.allow_fresh_init:
+                # An explicit checkpoint path with nothing under it means a
+                # missing volume mount or a wrong dir — serving random
+                # weights with exit 0 would hide that.
+                print(f"error: no checkpoint under {args.checkpoint_path} "
+                      f"(pass --allow-fresh-init to serve random weights)",
+                      file=sys.stderr)
+                return 1
+            print(f"no checkpoint under {args.checkpoint_path}; using fresh init",
+                  flush=True)
+        else:
+            # The trainer saves the full TrainState, whose pytree flattens
+            # to (params, opt_state, step) — an untargeted restore returns
+            # that as a list; keep the params and drop the optimizer.
+            restored = mngr.restore(latest)
+            if isinstance(restored, (list, tuple)):
+                tree = restored[0]
+            elif hasattr(restored, "params"):
+                tree = restored.params
+            else:
+                tree = restored["params"]
+            params = jax.tree.map(jnp.asarray, tree)
+            print(f"restored params from checkpoint step {latest}", flush=True)
+    if params is None:
+        # init only when actually serving fresh weights — a 7B init would
+        # double peak memory next to a restored checkpoint
+        params = llama.init(config, jax.random.PRNGKey(args.seed))
+
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(args.seed + 1),
+        (args.batch, args.prompt_len), 0, config.vocab_size,
+    )
+    gen = jax.jit(lambda p, pr, key: decode.generate(
+        p, pr, config,
+        max_new_tokens=args.max_new_tokens,
+        max_len=args.prompt_len + args.max_new_tokens,
+        temperature=args.temperature, key=key,
+    ))
+    key = jax.random.PRNGKey(args.seed + 2)
+
+    t0 = time.perf_counter()
+    toks = jax.device_get(gen(params, prompt, key))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    toks = jax.device_get(gen(params, prompt, key))
+    dt = max(time.perf_counter() - t0, 1e-9)
+
+    total = args.batch * args.max_new_tokens
+    print(f"sample[0,:8]={list(map(int, toks[0][:8]))}", flush=True)
+    print(f"done: generated {args.batch}x{args.max_new_tokens} tokens in "
+          f"{dt:.2f}s ({total / dt:.0f} tok/s, compile {compile_s:.1f}s)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

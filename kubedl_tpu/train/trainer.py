@@ -87,11 +87,7 @@ def main(argv=None) -> int:
     from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
     from kubedl_tpu.parallel.train_step import make_train_step
 
-    config = {
-        "tiny": llama.LlamaConfig.tiny(),
-        "bench-1b": llama.LlamaConfig.bench_1b(),
-        "llama-7b": llama.LlamaConfig.llama_7b(),
-    }[args.model]
+    config = llama.LlamaConfig.config_for(args.model)
     import dataclasses
 
     if args.remat:

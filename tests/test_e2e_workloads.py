@@ -34,15 +34,17 @@ CPU_ENV = {
 }
 
 
-def force_cpu(manifest, replica_field):
+def force_cpu(manifest, replica_field, command=None):
     """Pods inherit our env; pin the training subprocess to JAX CPU so tests
     don't touch the real TPU (and keep steps small)."""
+    if command is None:
+        command = [sys.executable, "-m", "kubedl_tpu.train.mnist", "--steps", "10"]
     for spec in manifest["spec"][replica_field].values():
         for c in spec["template"]["spec"]["containers"]:
             c.setdefault("env", {})
             if isinstance(c["env"], dict):
                 c["env"].update(CPU_ENV)
-            c["command"] = [sys.executable, "-m", "kubedl_tpu.train.mnist", "--steps", "10"]
+            c["command"] = command
     return manifest
 
 
@@ -62,3 +64,31 @@ def test_jaxjob_mnist_example_succeeds(op):
     assert op.wait_for_condition(job, "Succeeded", timeout=90)
     jm = op.metrics_registry.get("JAXJob")
     assert jm.successful == 1
+
+
+def test_train_then_generate_from_checkpoint(op, tmp_path):
+    """The full train -> Orbax checkpoint -> serve loop through the
+    operator: a trainer JAXJob saves params, then the generate JAXJob
+    (examples/jax_job_generate.yaml) restores them and emits tokens."""
+    ckpt = str(tmp_path / "ckpt")
+    train = load_example("jax_job_mnist.yaml")
+    train["metadata"]["name"] = "gen-train"
+    force_cpu(train, "jaxReplicaSpecs", command=[
+        sys.executable, "-m", "kubedl_tpu.train.trainer",
+        "--model", "tiny", "--steps", "4", "--batch", "4",
+        "--seq-len", "33", "--checkpoint-path", ckpt,
+        "--checkpoint-interval", "2", "--log-every", "100",
+    ])
+    job = op.apply(train)
+    assert op.wait_for_condition(job, "Succeeded", timeout=90)
+
+    gen = load_example("jax_job_generate.yaml")
+    force_cpu(gen, "jaxReplicaSpecs", command=[
+        sys.executable, "-m", "kubedl_tpu.train.generate",
+        "--model", "tiny", "--checkpoint-path", ckpt,
+        "--batch", "2", "--prompt-len", "8", "--max-new-tokens", "8",
+    ])
+    job = op.apply(gen)
+    assert op.wait_for_condition(job, "Succeeded", timeout=90)
+    jm = op.metrics_registry.get("JAXJob")
+    assert jm.successful == 2
