@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -47,6 +48,131 @@ def _mk_operator(args) -> Operator:
             kube_namespace=getattr(args, "kube_namespace", "default"),
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# client commands (kubectl-style, against a running `operator` server)
+# ---------------------------------------------------------------------------
+
+
+def _client_request(args, method: str, path: str, body=None):
+    import urllib.error
+    import urllib.request
+
+    url = args.server.rstrip("/") + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    token = args.api_token or os.environ.get("KUBEDL_API_TOKEN", "")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            ctype = r.headers.get("Content-Type", "")
+            raw = r.read().decode()
+    except urllib.error.HTTPError as e:
+        print(f"error: HTTP {e.code}: {e.read().decode()}", file=sys.stderr)
+        return None
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach {url}: {e.reason}", file=sys.stderr)
+        return None
+    return json.loads(raw) if ctype.startswith("application/json") else raw
+
+
+def _job_phase(status) -> str:
+    """Latest True condition type — the kubectl STATUS column."""
+    for c in reversed((status or {}).get("conditions") or []):
+        if str(c.get("status", "")).lower() in ("true", "1"):
+            return str(c.get("type", "Unknown"))
+    return "Pending"
+
+
+def _print_table(rows) -> None:
+    if not rows:
+        return
+    widths = [max(len(str(r[i])) for r in rows) + 2 for i in range(len(rows[0]))]
+    for r in rows:
+        print("".join(str(c).ljust(widths[i]) for i, c in enumerate(r)).rstrip())
+
+
+def cmd_get(args) -> int:
+    if args.name:
+        obj = _client_request(
+            args, "GET", f"/apis/{args.kind}/{args.namespace}/{args.name}"
+        )
+        if obj is None:
+            return 1
+        print(json.dumps(obj, indent=2, default=str))
+        return 0
+    listing = _client_request(args, "GET", f"/apis/{args.kind}")
+    if listing is None:
+        return 1
+    rows = [("NAMESPACE", "NAME", "STATUS")]
+    for item in listing.get("items", []):
+        meta = item.get("metadata") or {}
+        if not args.all_namespaces and meta.get("namespace") != args.namespace:
+            continue
+        rows.append((meta.get("namespace", ""), meta.get("name", ""),
+                     _job_phase(item.get("status"))))
+    _print_table(rows)
+    return 0
+
+
+def cmd_apply(args) -> int:
+    rc = 0
+    for path in args.files:
+        for manifest in _load_manifests(path):
+            kind = manifest.get("kind", "")
+            out = _client_request(args, "POST", f"/apis/{kind}", body=manifest)
+            if out is None:
+                rc = 1
+                continue
+            meta = out.get("metadata") or {}
+            print(f"applied {kind} {meta.get('namespace')}/{meta.get('name')}")
+    return rc
+
+
+def cmd_delete(args) -> int:
+    out = _client_request(
+        args, "DELETE", f"/apis/{args.kind}/{args.namespace}/{args.name}"
+    )
+    if out is None:
+        return 1
+    print(f"deleted {args.kind} {args.namespace}/{args.name}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    path = f"/logs/{args.namespace}/{args.pod}"
+    params = []
+    if args.container:
+        params.append(f"container={args.container}")
+    if args.tail is not None:
+        params.append(f"tail={args.tail}")
+    if params:
+        path += "?" + "&".join(params)
+    out = _client_request(args, "GET", path)
+    if out is None:
+        return 1
+    sys.stdout.write(out if isinstance(out, str) else str(out))
+    return 0
+
+
+def cmd_events(args) -> int:
+    listing = _client_request(args, "GET", f"/events/{args.namespace}")
+    if listing is None:
+        return 1
+    rows = [("TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
+    for e in listing.get("items", []):
+        inv = e.get("involvedObject") or e.get("involved_object") or {}
+        rows.append((
+            e.get("type", ""), e.get("reason", ""),
+            f"{inv.get('kind', '')}/{inv.get('name', '')}",
+            e.get("count", 1), e.get("message", ""),
+        ))
+    _print_table(rows)
+    return 0
 
 
 def cmd_run(args) -> int:
@@ -203,6 +329,40 @@ def main(argv=None) -> int:
     p_val = sub.add_parser("validate", help="parse and default manifests")
     p_val.add_argument("-f", "--files", nargs="+", required=True)
     p_val.set_defaults(fn=cmd_validate)
+
+    # kubectl-style client commands against a running `operator` server
+    def client_parser(name, help_):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--server", default=os.environ.get(
+            "KUBEDL_SERVER", "http://127.0.0.1:8443"))
+        p.add_argument("--api-token", default=None,
+                       help="bearer token (env KUBEDL_API_TOKEN)")
+        p.add_argument("-n", "--namespace", default="default")
+        return p
+
+    p_get = client_parser("get", "list jobs of a kind, or show one as JSON")
+    p_get.add_argument("kind")
+    p_get.add_argument("name", nargs="?", default="")
+    p_get.add_argument("-A", "--all-namespaces", action="store_true")
+    p_get.set_defaults(fn=cmd_get)
+
+    p_apply = client_parser("apply", "submit manifests to the operator")
+    p_apply.add_argument("-f", "--files", nargs="+", required=True)
+    p_apply.set_defaults(fn=cmd_apply)
+
+    p_del = client_parser("delete", "delete a job")
+    p_del.add_argument("kind")
+    p_del.add_argument("name")
+    p_del.set_defaults(fn=cmd_delete)
+
+    p_logs = client_parser("logs", "print a pod's container logs")
+    p_logs.add_argument("pod")
+    p_logs.add_argument("-c", "--container", default="")
+    p_logs.add_argument("--tail", type=int, default=None)
+    p_logs.set_defaults(fn=cmd_logs)
+
+    p_ev = client_parser("events", "list events in a namespace")
+    p_ev.set_defaults(fn=cmd_events)
 
     args = parser.parse_args(argv)
     return args.fn(args)

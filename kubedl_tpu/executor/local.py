@@ -102,7 +102,8 @@ class LocalPodExecutor:
                 continue
         text = "".join(chunks)
         if tail is not None:
-            text = "\n".join(text.splitlines()[-tail:])
+            # tail=0 means "no lines" (kubectl semantics); [-0:] would be all
+            text = "\n".join(text.splitlines()[-tail:]) if tail > 0 else ""
         return text
 
     # -- lifecycle -------------------------------------------------------

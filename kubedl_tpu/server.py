@@ -86,18 +86,54 @@ class OperatorHTTPServer:
             def do_GET(self):
                 if not self._authorized():
                     return
-                parts = [p for p in self.path.split("/") if p]
-                if self.path == "/metrics":
+                from urllib.parse import parse_qs, urlsplit
+
+                split = urlsplit(self.path)
+                query = parse_qs(split.query)
+                parts = [p for p in split.path.split("/") if p]
+                if split.path == "/metrics":
                     body = op.metrics_registry.render()
                     rm = getattr(op, "runtime_metrics", None)
                     if rm is not None:
                         body += rm.render()
                     self._send(200, body, "text/plain; version=0.0.4")
-                elif self.path == "/debug/vars":
+                elif split.path == "/debug/vars":
                     rm = getattr(op, "runtime_metrics", None)
                     self._json(200, rm.debug_vars() if rm is not None else {})
-                elif self.path == "/healthz":
+                elif split.path == "/healthz":
                     self._send(200, "ok", "text/plain")
+                elif len(parts) == 3 and parts[0] == "logs":
+                    # kubectl-logs equivalent: /logs/<ns>/<pod>[?container=&tail=]
+                    ex = getattr(op, "executor", None)
+                    if ex is None:
+                        self._json(404, {"error": "no local executor (kube mode: "
+                                                  "use kubectl logs)"})
+                    else:
+                        container = query.get("container", [None])[0]
+                        tail_q = query.get("tail", [None])[0]
+                        try:
+                            tail = int(tail_q) if tail_q is not None else None
+                        except ValueError:
+                            self._json(400, {"error": f"bad tail {tail_q!r}"})
+                            return
+                        text = ex.read_logs(parts[1], parts[2],
+                                            container=container, tail=tail)
+                        if not text:
+                            # distinguish "empty log" from a typo'd name:
+                            # 404 unless the pod exists (live, or left its
+                            # log dir behind after deletion)
+                            try:
+                                op.store.get("Pod", parts[1], parts[2])
+                            except NotFound:
+                                if not os.path.isdir(
+                                    ex._pod_log_dir(parts[1], parts[2])
+                                ):
+                                    self._json(404, {
+                                        "error": f"pod {parts[1]}/{parts[2]} "
+                                                 f"not found"
+                                    })
+                                    return
+                        self._send(200, text, "text/plain")
                 elif len(parts) >= 2 and parts[0] == "apis":
                     kind = op._kind_by_lower.get(parts[1].lower(), parts[1])
                     if len(parts) == 2:

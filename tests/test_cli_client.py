@@ -1,0 +1,129 @@
+"""kubectl-style CLI client commands (get/apply/delete/logs/events)
+against a live operator HTTP server — the user-facing workflow parity
+surface (the reference delegates all of this to kubectl)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubedl_tpu.cli import main as cli_main
+from kubedl_tpu.operator import Operator, OperatorConfig
+from kubedl_tpu.server import OperatorHTTPServer
+
+
+@pytest.fixture
+def server():
+    op = Operator(OperatorConfig())
+    op.register_all()
+    op.start()
+    srv = OperatorHTTPServer(op, port=0)
+    port = srv.start()
+    yield op, f"http://127.0.0.1:{port}"
+    srv.stop()
+    op.stop()
+
+
+def _manifest_file(tmp_path, name="cli-job"):
+    path = tmp_path / "job.yaml"
+    path.write_text(f"""
+apiVersion: kubedl-tpu.io/v1alpha1
+kind: JAXJob
+metadata:
+  name: {name}
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: ExitCode
+      template:
+        spec:
+          containers:
+            - name: jax
+              command: [{sys.executable}, -c, "print('hello from pod')"]
+              env:
+                JAX_PLATFORMS: cpu
+""")
+    return str(path)
+
+
+def test_apply_get_logs_events_delete_roundtrip(server, tmp_path, capsys):
+    op, url = server
+    path = _manifest_file(tmp_path)
+
+    assert cli_main(["apply", "--server", url, "-f", path]) == 0
+    assert "applied JAXJob default/cli-job" in capsys.readouterr().out
+
+    job = op.get_job("JAXJob", "default", "cli-job")
+    assert op.wait_for_condition(job, "Succeeded", timeout=60)
+
+    # table listing
+    assert cli_main(["get", "jaxjob", "--server", url]) == 0
+    out = capsys.readouterr().out
+    assert "NAMESPACE" in out and "cli-job" in out and "Succeeded" in out
+
+    # single-object JSON
+    assert cli_main(["get", "jaxjob", "cli-job", "--server", url]) == 0
+    assert '"name": "cli-job"' in capsys.readouterr().out
+
+    # pod logs through the server (kubectl-logs equivalent)
+    assert cli_main(["logs", "cli-job-worker-0", "--server", url]) == 0
+    assert "hello from pod" in capsys.readouterr().out
+
+    # events table
+    assert cli_main(["events", "--server", url]) == 0
+    out = capsys.readouterr().out
+    assert "SuccessfulCreatePod" in out
+
+    # delete
+    assert cli_main(["delete", "jaxjob", "cli-job", "--server", url]) == 0
+    assert cli_main(["get", "jaxjob", "cli-job", "--server", url]) == 1
+
+
+def test_logs_missing_pod_is_an_error(server, capsys):
+    """A typo'd pod name must NOT look like an empty log (kubectl errors)."""
+    op, url = server
+    assert cli_main(["logs", "nonexistent-pod", "--server", url]) == 1
+    assert "not found" in capsys.readouterr().err
+
+
+def test_logs_tail_zero_prints_nothing(server, tmp_path, capsys):
+    op, url = server
+    path = _manifest_file(tmp_path, name="tail-job")
+    assert cli_main(["apply", "--server", url, "-f", path]) == 0
+    job = op.get_job("JAXJob", "default", "tail-job")
+    assert op.wait_for_condition(job, "Succeeded", timeout=60)
+    capsys.readouterr()
+    assert cli_main(["logs", "tail-job-worker-0", "--tail", "0",
+                     "--server", url]) == 0
+    assert capsys.readouterr().out == ""
+
+
+def test_get_filters_by_namespace(server, tmp_path, capsys):
+    op, url = server
+    path = _manifest_file(tmp_path, name="ns-job")
+    assert cli_main(["apply", "--server", url, "-f", path]) == 0
+    capsys.readouterr()
+    # jobs live in "default"; asking for another namespace shows none
+    assert cli_main(["get", "jaxjob", "--server", url, "-n", "prod"]) == 0
+    assert "ns-job" not in capsys.readouterr().out
+    assert cli_main(["get", "jaxjob", "--server", url, "-A"]) == 0
+    assert "ns-job" in capsys.readouterr().out
+
+
+def test_client_commands_honor_bearer_token(tmp_path, capsys):
+    op = Operator(OperatorConfig())
+    op.register_all()
+    op.start()
+    srv = OperatorHTTPServer(op, port=0, token="s3cret")
+    port = srv.start()
+    url = f"http://127.0.0.1:{port}"
+    try:
+        assert cli_main(["get", "jaxjob", "--server", url]) == 1  # no token
+        capsys.readouterr()
+        assert cli_main(["get", "jaxjob", "--server", url,
+                         "--api-token", "s3cret"]) == 0
+    finally:
+        srv.stop()
+        op.stop()
