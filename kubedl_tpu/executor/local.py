@@ -277,6 +277,38 @@ class LocalPodExecutor:
                 paths[vol.name] = p
         return paths
 
+    def _localize_service_dns(self, env: Dict[str, str]) -> None:
+        """The local-executor equivalent of cluster DNS: every pod runs on
+        this host, so a simple `host` / `host:port` env value whose host is
+        a headless-service DNS name (`name.ns.svc[...]`, ref
+        tensorflow.go:122-136) — e.g. torch's MASTER_ADDR — rewrites to
+        127.0.0.1. Consumers like torch c10d cannot resolve the cluster
+        name themselves (the JAX coordinator does its own fallback,
+        train/coordinator.py). JSON blobs (TF_CONFIG) are left alone."""
+        import re
+
+        services = {s.metadata.name for s in self.store.list("Service")}
+
+        def local(host: str) -> str:
+            # only a BARE hostname is eligible — host lists, URLs, or
+            # suffixed addresses ("a.svc,b.svc", "zk.svc:2181/chroot")
+            # pass through untouched rather than collapsing to an IP
+            if not re.fullmatch(r"[A-Za-z0-9.-]+", host):
+                return host
+            first, _, rest = host.partition(".")
+            if first in services and ".svc" in rest:
+                return "127.0.0.1"
+            return host
+
+        for key, val in list(env.items()):
+            if not isinstance(val, str) or "." not in val:
+                continue
+            host, sep, port = val.partition(":")
+            if sep and port.isdigit():
+                env[key] = f"{local(host)}{sep}{port}"
+            else:
+                env[key] = local(val)
+
     def _run_container(self, entry: _RunningPod, container, volumes, placement, wait: bool):
         pod = entry.pod
         env = dict(os.environ)
@@ -293,6 +325,7 @@ class LocalPodExecutor:
         for vm in container.volume_mounts:
             if vm.name in volumes:
                 env[f"KUBEDL_VOLUME_{vm.name.upper().replace('-', '_')}"] = volumes[vm.name]
+        self._localize_service_dns(env)
         # Local mode has no container images: make the framework's own
         # runtime modules (kubedl_tpu.train.*) importable from any cwd,
         # merging with (not clobbering) any user-set PYTHONPATH.

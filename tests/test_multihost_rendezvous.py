@@ -104,3 +104,53 @@ def test_two_process_trainer_builds_global_batch(tmp_path):
             pytest.fail(f"trainer job did not succeed: {fresh.status.conditions}{logs}")
     finally:
         op.stop()
+
+
+def test_two_process_torch_ddp_rendezvous():
+    """Real torch.distributed (gloo) rendezvous through a PyTorchJob:
+    master + worker processes bootstrap from the injected MASTER_ADDR /
+    MASTER_PORT / RANK / WORLD_SIZE and all_reduce across processes. The
+    local executor's service-DNS localization makes the master-0 service
+    name resolvable (every pod shares this host)."""
+    from kubedl_tpu.workloads.pytorch import PyTorchJobController
+
+    op = Operator(OperatorConfig())
+    op.register(PyTorchJobController())
+    op.start()
+    try:
+        container = {
+            "name": "pytorch",
+            "command": [sys.executable, "-m", "kubedl_tpu.train.smoke_torch_ddp"],
+            # a non-default port so parallel test runs can't collide
+            "ports": [{"name": "pytorchjob-port", "containerPort": 29517}],
+        }
+        job = op.apply({
+            "apiVersion": "kubedl-tpu.io/v1",
+            "kind": "PyTorchJob",
+            "metadata": {"name": "ddp-smoke"},
+            "spec": {
+                "pytorchReplicaSpecs": {
+                    "Master": {
+                        "replicas": 1,
+                        "restartPolicy": "Never",
+                        "template": {"spec": {"containers": [dict(container)]}},
+                    },
+                    "Worker": {
+                        "replicas": 1,
+                        "restartPolicy": "Never",
+                        "template": {"spec": {"containers": [dict(container)]}},
+                    },
+                },
+            },
+        })
+        ok = op.wait_for_condition(job, "Succeeded", timeout=120)
+        if not ok:
+            fresh = op.get_job("PyTorchJob", "default", "ddp-smoke")
+            logs = ""
+            if op.executor is not None:
+                for pod in ("ddp-smoke-master-0", "ddp-smoke-worker-0"):
+                    logs += f"--- {pod} ---\n"
+                    logs += op.executor.read_logs("default", pod)
+            pytest.fail(f"DDP job did not succeed: {fresh.status.conditions}\n{logs}")
+    finally:
+        op.stop()
