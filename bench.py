@@ -281,7 +281,7 @@ def _tpu_child(results_path: str) -> int:
 
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
-            mnist.main(["--steps", "20" if small else "200", "--batch", "512"])
+            mnist.main(["--steps", "20" if small else "1000", "--batch", "512"])
         line = buf.getvalue().strip().splitlines()[-1]
         sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
         _emit(out, "mnist", {"mnist_steps_per_sec": sps})
