@@ -101,8 +101,8 @@ def main(argv=None) -> int:
         batch_sharded,
     )
 
-    n_calls = -(-args.steps // k)
-    total_steps = n_calls * k
+    n_calls = args.steps // k  # k divides steps exactly (clamp loop above)
+    total_steps = args.steps
 
     # compile, then time; device_get forces a real device sync (on the
     # remote-TPU platform block_until_ready can return early)

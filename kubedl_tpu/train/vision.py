@@ -38,15 +38,13 @@ def main(argv=None) -> int:
     from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
     from kubedl_tpu.parallel.train_step import make_train_step
 
-    import dataclasses
-
     config = {
         "tiny": vit.ViTConfig.tiny(),
         "vit-b16": vit.ViTConfig.base(),
     }[args.model]
-    # flash needs 128-aligned head_dim; tiny (head_dim 16) uses plain XLA
-    if config.head_dim % 128:
-        config = dataclasses.replace(config, use_flash=False)
+    # flash lane-aligns any head_dim by zero-padding and dispatches to the
+    # unfused path below its measured min-seq crossover on its own — no
+    # per-model override needed (ops/flash_attention.py)
 
     mesh = build_mesh(parse_mesh_env())
     rules = ShardingRules()
