@@ -284,18 +284,38 @@ def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+def _pad_d(x, dk):
+    pad = dk - x.shape[-1]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
     out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
     out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
-    return out, (q, k, v, out, lse)
+    # Residuals store only the true head dim: padded columns are zeros by
+    # construction, so slicing here and re-padding in backward is exact —
+    # and halves attention residual HBM for d=64 models.
+    res = (
+        q[..., :true_d], k[..., :true_d], v[..., :true_d],
+        out[..., :true_d], lse,
+    )
+    return out, res
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
+def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
+    dk_width = dout.shape[-1]
+    q, k, v, out, lse = res
+    res = (
+        _pad_d(q, dk_width), _pad_d(k, dk_width), _pad_d(v, dk_width),
+        _pad_d(out, dk_width), lse,
+    )
     return _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout)
 
 
@@ -319,11 +339,17 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    min_seq: Optional[int] = None,
 ) -> jax.Array:
     """Blocked attention over [batch, q_heads, seq, head_dim] tensors.
 
     GQA: k/v may have fewer heads (q_heads % kv_heads == 0); KV heads are
     broadcast to the query groups.
+
+    min_seq overrides the measured fused-vs-unfused crossover (default
+    FLASH_MIN_SEQ, swept on v5e): pass 0 to force the fused kernel at any
+    length — e.g. on a different TPU generation, or when the kernel's
+    O(T)-per-block memory (not its speed) is the point.
     """
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
@@ -339,7 +365,9 @@ def flash_attention(
     # Below the measured crossover the unfused path is simply faster —
     # this is dispatch policy, not degradation (no warning). Interpret
     # mode (CPU tests) keeps exercising the kernel at small shapes.
-    if not _interpret() and sq < FLASH_MIN_SEQ:
+    if min_seq is None:
+        min_seq = FLASH_MIN_SEQ
+    if not _interpret() and sq < min_seq:
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
     # Lane-align the head dim by zero-padding to the next multiple of 128
@@ -379,7 +407,7 @@ def flash_attention(
     kf = _pad_seq(k.reshape(b * hq, sq, dk), block_k)
     vf = _pad_seq(v.reshape(b * hq, sq, dk), block_k)
     # The padded tail is masked inside the kernels via seq_len.
-    out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, sq)
+    out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, sq, d)
     return out[:, :sq, :d].reshape(b, hq, sq, d)
 
 

@@ -241,7 +241,13 @@ def main(argv=None) -> int:
             stop_trace()
             save(step + 1, final=True)
             print("preempted: checkpoint saved, exiting retryable", flush=True)
-            return EXIT_TPU_PREEMPTED
+            # A clean interpreter exit would block in jax.distributed's
+            # shutdown barrier (atexit) while peers are still mid-collective
+            # — the exact deadlock slice restart exists to break. The
+            # checkpoint is durable; exit immediately.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(EXIT_TPU_PREEMPTED)
         if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
             jax.block_until_ready(metrics["loss"])
             save(step + 1)
