@@ -347,9 +347,11 @@ def flash_attention(
     broadcast to the query groups.
 
     min_seq overrides the measured fused-vs-unfused crossover (default
-    FLASH_MIN_SEQ, swept on v5e): pass 0 to force the fused kernel at any
-    length — e.g. on a different TPU generation, or when the kernel's
-    O(T)-per-block memory (not its speed) is the point.
+    FLASH_MIN_SEQ, swept on v5e): pass 0 to prefer the fused kernel at
+    any length — e.g. on a different TPU generation, or when the kernel's
+    O(T)-per-block memory (not its speed) is the point. Sequences shorter
+    than one 128 lane tile cannot tile onto the MXU and always take the
+    unfused path.
     """
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
@@ -367,7 +369,9 @@ def flash_attention(
     # mode (CPU tests) keeps exercising the kernel at small shapes.
     if min_seq is None:
         min_seq = FLASH_MIN_SEQ
-    if not _interpret() and sq < min_seq:
+    # < 128 can never tile onto the MXU regardless of min_seq (silent: it's
+    # a hardware constraint, not a degradation a caller could fix)
+    if not _interpret() and (sq < min_seq or sq < 128):
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
     # Lane-align the head dim by zero-padding to the next multiple of 128

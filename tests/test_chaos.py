@@ -68,10 +68,11 @@ def test_repeated_preemption_still_succeeds(tmp_path):
             # preempt only after fresh progress since the last kill, so each
             # restart provably resumed before being shot again
             if s is not None and s < STEPS and s > killed_at:
-                entry = next(
-                    (e for k, e in op.executor._running.items() if "chaos" in k),
-                    None,
-                )
+                with op.executor._lock:  # the executor thread mutates _running
+                    entry = next(
+                        (e for k, e in op.executor._running.items() if "chaos" in k),
+                        None,
+                    )
                 if entry and entry.procs:
                     for proc in entry.procs.values():
                         try:

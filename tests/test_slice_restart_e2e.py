@@ -61,11 +61,12 @@ def test_gang_preemption_restarts_both_workers_and_resumes(tmp_path):
         while not killed and time.monotonic() < deadline:
             logs = op.executor.read_logs("default", "slice-chaos-worker-1")
             if "step " in logs:
-                entry = next(
-                    (e for k, e in op.executor._running.items()
-                     if "slice-chaos-worker-1" in k),
-                    None,
-                )
+                with op.executor._lock:  # the executor thread mutates _running
+                    entry = next(
+                        (e for k, e in op.executor._running.items()
+                         if "slice-chaos-worker-1" in k),
+                        None,
+                    )
                 if entry and entry.procs:
                     for proc in entry.procs.values():
                         try:

@@ -277,6 +277,7 @@ def test_jaxjob_coordinator_and_mesh_env():
                 "spec": {"containers": [container_manifest("jax")]}}}},
             "mesh": {"data": 2, "fsdp": 2, "context": 1},
             "checkpoint": {"path": "/ckpt/job1", "saveIntervalSteps": 100},
+            "compilationCacheDir": "/cache/xla",
         },
     })
     store, _ = reconcile_once(ctrl, job)
@@ -287,6 +288,8 @@ def test_jaxjob_coordinator_and_mesh_env():
     assert env["KUBEDL_MESH"] == "data=2,fsdp=2,tensor=1,context=1,expert=1"
     assert env["KUBEDL_CHECKPOINT_PATH"] == "/ckpt/job1"
     assert env["KUBEDL_CHECKPOINT_INTERVAL"] == "100"
+    # preemption-recovery cost: restarted slices replay XLA compiles
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/cache/xla"
 
 
 def test_jaxjob_defaults():
