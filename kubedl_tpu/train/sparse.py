@@ -125,20 +125,45 @@ def main(argv=None) -> int:
         updates, opt_state = tx.update(grads, opt_state)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    # synthetic criteo-shaped multi-hot batch, batch-sharded over "data"
-    rng = np.random.default_rng(info.process_id)
+    # synthetic criteo-shaped multi-hot batch, batch-sharded over "data".
+    # Multi-process rule (same as trainer.py's data path): when the batch
+    # dim actually spans processes, each generates ONLY its local rows and
+    # contributes them via make_array_from_process_local_data; when the
+    # batch dim is replicated (the default all-devices-on-"tensor"
+    # SparseCore layout), every process must supply IDENTICAL rows — a
+    # device_put of per-process-different values onto a global sharding
+    # fails jax's cross-process equality check.
     data_shard = NamedSharding(mesh, P(("data", "fsdp")))
-    batch = max(args.batch, n)
+    data_span = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    split = info.num_processes > 1 and data_span % info.num_processes == 0
+    if split:
+        rng = np.random.default_rng(info.process_id)
+        # each process's local rows must themselves divide over its share
+        # of the data axis, so round local rows to data_span/num_processes
+        per_proc_span = data_span // info.num_processes
+        local_batch = max(
+            max(args.batch, n) // info.num_processes // per_proc_span, 1
+        ) * per_proc_span
+        batch = local_batch * info.num_processes
+    else:
+        rng = np.random.default_rng(0)  # common seed: identical everywhere
+        batch = local_batch = max(args.batch, n)
+
+    def globalize(local, shape):
+        if info.num_processes == 1:
+            return jax.device_put(jnp.asarray(local), data_shard)
+        return jax.make_array_from_process_local_data(data_shard, local, shape)
+
     batch_ids = {}
     for f in features:
-        ids = rng.integers(0, f.vocab_size, (batch, f.multi_hot), dtype=np.int32)
+        ids = rng.integers(0, f.vocab_size, (local_batch, f.multi_hot), dtype=np.int32)
         if f.multi_hot > 1:  # ragged bags: pad ~30% of the tail with -1
-            pad = rng.random((batch, f.multi_hot)) < 0.3
+            pad = rng.random((local_batch, f.multi_hot)) < 0.3
             pad[:, 0] = False
             ids[pad] = -1
-        batch_ids[f.name] = jax.device_put(jnp.asarray(ids), data_shard)
-    labels = jax.device_put(
-        jnp.asarray(rng.integers(0, 2, (batch,)).astype(np.float32)), data_shard)
+        batch_ids[f.name] = globalize(ids, (batch, f.multi_hot))
+    labels = globalize(
+        rng.integers(0, 2, (local_batch,)).astype(np.float32), (batch,))
 
     params, opt_state, loss = train_step(params, opt_state, batch_ids, labels)
     jax.block_until_ready(loss)
