@@ -32,6 +32,12 @@ DEFAULT_BLOCK_K = 512
 # short sequences (0.26-0.46x at 256-512, where the [T,T] scores are tiny
 # and per-program overheads dominate) and wins from ~1024 up (2.6-2.8x).
 FLASH_MIN_SEQ = 1024
+# Above this sequence length the default kernel's full-K/V-in-VMEM
+# BlockSpecs crowd the 16 MB scoped VMEM; the forward streams K/V blocks
+# through a 3D grid instead. The backward kernels keep whole-tensor loads,
+# so TRAINING beyond this length belongs to ring attention / context
+# parallelism — the streamed path serves long-context inference prefill.
+STREAM_MIN_SEQ = 8192
 NEG_INF = -1e30
 
 _warned_shapes: set = set()
@@ -66,6 +72,24 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask):
+    """One K-block update of the online-softmax state (m, l, acc) — the
+    shared numerics of the default and streamed forward kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k, seq_len):
     qb = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16): the MXU runs bf16 x bf16 ->
@@ -89,23 +113,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         m, l, acc = carry
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [block_q, block_k] f32
         k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask = mask & (k_pos <= q_pos)
-        s = jnp.where(mask, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l, acc
+        return _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask)
 
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
@@ -117,6 +129,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
     bh, seq, d = q.shape
+    if seq > STREAM_MIN_SEQ:
+        return _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len)
     grid = (bh, pl.cdiv(seq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(
@@ -141,6 +155,93 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
             flops=int(4 * bh * seq * seq * d * (0.5 if causal else 1.0)),
             bytes_accessed=q.size * 2 + k.size * 2 + v.size * 2,
             transcendentals=bh * seq * seq,
+        ),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+                         *, sm_scale, causal, block_q, block_k, seq_len, n_kb):
+    """K-streaming variant: grid (bh, q_blocks, k_blocks); K/V arrive one
+    block per grid step via BlockSpecs (double-buffered by Mosaic), and the
+    online-softmax state lives in VMEM scratch across the kb dimension.
+    VMEM use is O(block) regardless of sequence length."""
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # A 3D grid cannot skip iterations (the K/V DMA always runs), but the
+    # compute CAN skip grid steps that contribute nothing: fully past the
+    # diagonal (causal) or fully beyond the true sequence. On a causal
+    # prefill that's ~half the MXU work.
+    live = kb * block_k < seq_len
+    if causal:
+        live &= kb * block_k < (qb + 1) * block_q
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # [block_q, d] bf16
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        m_new, l, acc = _online_softmax_step(
+            q, k, v, m_s[...], l_s[...], acc_s[...], sm_scale, mask
+        )
+        m_s[...] = m_new
+        l_s[...] = l
+        acc_s[...] = acc
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_s[...] + jnp.log(l))[:, 0]
+
+
+def _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+    bh, seq, d = q.shape
+    n_kb = pl.cdiv(seq, block_k)
+    grid = (bh, pl.cdiv(seq, block_q), n_kb)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_streamed_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, seq_len=true_len, n_kb=n_kb,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
     )(q, k, v)
@@ -309,9 +410,23 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
     return out, res
 
 
+# Bound at import (NOT an alias of the monkeypatchable dispatch knob): the
+# backward kernels load whole-sequence tensors into VMEM and cannot fit
+# beyond this — training longer sequences is context parallelism's job.
+BWD_MAX_SEQ = 8192
+
+
 def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
     dk_width = dout.shape[-1]
     q, k, v, out, lse = res
+    if q.shape[1] > BWD_MAX_SEQ:
+        raise ValueError(
+            f"flash_attention backward at seq {q.shape[1]} exceeds the "
+            f"kernel's whole-sequence VMEM budget (max {BWD_MAX_SEQ}); "
+            f"train long sequences with ring attention over a 'context' "
+            f"mesh axis (ops/ring_attention.py) — the streamed forward "
+            f"serves inference prefill only"
+        )
     res = (
         _pad_d(q, dk_width), _pad_d(k, dk_width), _pad_d(v, dk_width),
         _pad_d(out, dk_width), lse,
@@ -322,9 +437,8 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def _pad_seq(x, block):
-    seq = x.shape[1]
-    pad = (-seq) % block
+def _pad_seq_to(x, target):
+    pad = target - x.shape[1]
     if pad:
         x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
     return x
@@ -407,10 +521,16 @@ def flash_attention(
             q[..., :d], k[..., :d], v[..., :d], causal=causal, sm_scale=sm_scale
         )
 
-    qf = _pad_seq(q.reshape(b * hq, sq, dk), block_q)
-    kf = _pad_seq(k.reshape(b * hq, sq, dk), block_k)
-    vf = _pad_seq(v.reshape(b * hq, sq, dk), block_k)
-    # The padded tail is masked inside the kernels via seq_len.
+    # One COMMON padded length divisible by both blocks: padding q and k/v
+    # to different lengths would send the K-block grid out of bounds when
+    # block_q != block_k. The padded tail is masked via seq_len.
+    import math
+
+    lcm = math.lcm(block_q, block_k)
+    target = -(-sq // lcm) * lcm
+    qf = _pad_seq_to(q.reshape(b * hq, sq, dk), target)
+    kf = _pad_seq_to(k.reshape(b * hq, sq, dk), target)
+    vf = _pad_seq_to(v.reshape(b * hq, sq, dk), target)
     out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, sq, d)
     return out[:, :sq, :d].reshape(b, hq, sq, d)
 
