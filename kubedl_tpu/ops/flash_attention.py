@@ -14,6 +14,7 @@ installs a custom VJP wiring the two kernels together.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -129,7 +130,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
     bh, seq, d = q.shape
-    if seq > STREAM_MIN_SEQ:
+    # dispatch on the TRUE length: lcm padding of mixed block sizes must
+    # not shift the documented threshold
+    if true_len > STREAM_MIN_SEQ:
         return _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len)
     grid = (bh, pl.cdiv(seq, block_q))
     out, lse = pl.pallas_call(
@@ -419,9 +422,9 @@ BWD_MAX_SEQ = 8192
 def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
     dk_width = dout.shape[-1]
     q, k, v, out, lse = res
-    if q.shape[1] > BWD_MAX_SEQ:
+    if true_len > BWD_MAX_SEQ:
         raise ValueError(
-            f"flash_attention backward at seq {q.shape[1]} exceeds the "
+            f"flash_attention backward at seq {true_len} exceeds the "
             f"kernel's whole-sequence VMEM budget (max {BWD_MAX_SEQ}); "
             f"train long sequences with ring attention over a 'context' "
             f"mesh axis (ops/ring_attention.py) — the streamed forward "
@@ -524,10 +527,8 @@ def flash_attention(
     # One COMMON padded length divisible by both blocks: padding q and k/v
     # to different lengths would send the K-block grid out of bounds when
     # block_q != block_k. The padded tail is masked via seq_len.
-    import math
-
     lcm = math.lcm(block_q, block_k)
-    target = -(-sq // lcm) * lcm
+    target = pl.cdiv(sq, lcm) * lcm
     qf = _pad_seq_to(q.reshape(b * hq, sq, dk), target)
     kf = _pad_seq_to(k.reshape(b * hq, sq, dk), target)
     vf = _pad_seq_to(v.reshape(b * hq, sq, dk), target)

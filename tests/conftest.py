@@ -28,3 +28,14 @@ if "jax" in sys.modules:
         clear_backends()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Hermetic CPU env for training SUBPROCESSES spawned by e2e tests: empty
+# PALLAS_AXON_POOL_IPS disables the environment's TPU sitecustomize hook so
+# the child gets a plain CPU JAX. (This process's own backend is pinned to
+# CPU above; subprocesses need the env route.)
+CPU_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    "PALLAS_AXON_POOL_IPS": "",
+}

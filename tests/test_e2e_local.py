@@ -5,6 +5,7 @@ SURVEY.md §4): the full watch-driven loop with pods running as actual host
 processes through the local executor, including gang slice admission.
 """
 import sys
+import os
 import time
 
 import pytest
@@ -185,3 +186,46 @@ def test_ttl_cleanup_end_to_end():
             pytest.fail("job was not TTL-deleted")
     finally:
         op.stop()
+
+
+def test_trainer_memory_knobs_run_end_to_end():
+    """--remat dots and --ce-chunks through the real trainer process:
+    the memory knobs must not change convergence-path behavior (job
+    completes; losses logged are finite)."""
+    import subprocess
+
+    from conftest import CPU_ENV
+
+    env = dict(os.environ)
+    env.update(CPU_ENV)
+    p = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.train.trainer",
+         "--model", "tiny", "--steps", "4", "--batch", "4",
+         "--seq-len", "33", "--remat", "dots", "--ce-chunks", "4",
+         "--log-every", "2"],
+        env=env, capture_output=True, text=True, timeout=180,
+    )
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "done: 4 steps" in p.stdout, p.stdout
+
+
+def test_generate_allow_fresh_init_round_trip(tmp_path):
+    """--allow-fresh-init serves random weights with an explicit opt-in;
+    without it an empty checkpoint dir is a hard error."""
+    import subprocess
+
+    from conftest import CPU_ENV
+
+    env = dict(os.environ)
+    env.update(CPU_ENV)
+    empty = str(tmp_path / "nockpt")
+    os.makedirs(empty)
+    base = [sys.executable, "-m", "kubedl_tpu.train.generate",
+            "--model", "tiny", "--checkpoint-path", empty,
+            "--batch", "1", "--prompt-len", "4", "--max-new-tokens", "2"]
+    p = subprocess.run(base, env=env, capture_output=True, text=True, timeout=180)
+    assert p.returncode == 1 and "no checkpoint" in p.stderr
+    p = subprocess.run(base + ["--allow-fresh-init"], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "done: generated" in p.stdout

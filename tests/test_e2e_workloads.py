@@ -25,13 +25,7 @@ def load_example(name):
         return yaml.safe_load(f)
 
 
-CPU_ENV = {
-    "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": "",
-    # empty value disables the environment's TPU sitecustomize hook so the
-    # training subprocess gets a hermetic CPU JAX
-    "PALLAS_AXON_POOL_IPS": "",
-}
+from conftest import CPU_ENV
 
 
 def force_cpu(manifest, replica_field, command=None):
