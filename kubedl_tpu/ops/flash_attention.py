@@ -440,6 +440,17 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _snap_block(block: int) -> int:
+    """Largest divisor of STREAM_MIN_SEQ that is <= block; sub-128 blocks
+    (interpret mode only) pass through untouched."""
+    if block < 128 or STREAM_MIN_SEQ % block == 0:
+        return block
+    p = 128
+    while p * 2 <= min(block, STREAM_MIN_SEQ):
+        p *= 2
+    return p
+
+
 def _pad_seq_to(x, target):
     pad = target - x.shape[1]
     if pad:
@@ -523,6 +534,19 @@ def flash_attention(
         return attention_reference(
             q[..., :d], k[..., :d], v[..., :d], causal=causal, sm_scale=sm_scale
         )
+
+    # The whole-sequence kernels (fwd at <= STREAM_MIN_SEQ, bwd always)
+    # budget VMEM for a padded length of at most STREAM_MIN_SEQ. Exotic
+    # block sizes (640, 384, ...) have lcms that can pad PAST that budget
+    # even when the true length is under it; only then snap them down to
+    # divisors of STREAM_MIN_SEQ (all its divisors are pow2 multiples of
+    # 128), which bounds the padded length by the budget again. In-budget
+    # caller choices are preserved exactly.
+    if sq <= STREAM_MIN_SEQ:
+        lcm0 = math.lcm(block_q, block_k)
+        if pl.cdiv(sq, lcm0) * lcm0 > STREAM_MIN_SEQ:
+            block_q = _snap_block(block_q)
+            block_k = _snap_block(block_k)
 
     # One COMMON padded length divisible by both blocks: padding q and k/v
     # to different lengths would send the K-block grid out of bounds when

@@ -37,6 +37,16 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=int(os.environ.get("SPARSE_BATCH", 4096)))
     parser.add_argument("--hidden", type=int, default=512)
     parser.add_argument("--lr", type=float, default=1e-2)
+    def positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return iv
+
+    parser.add_argument(
+        "--vocab-scale", type=positive_int, default=1,
+        help="divide every feature vocab by this (CI shrinks the synthetic "
+        "criteo tables so CPU compile+adagrad stays inside test budgets)")
     args = parser.parse_args(argv)
 
     from kubedl_tpu.train import coordinator
@@ -66,7 +76,7 @@ def main(argv=None) -> int:
     n_shards = mesh.shape["tensor"]
 
     features = tuple(
-        FeatureSpec(name, vocab, dim, mh, comb)
+        FeatureSpec(name, max(vocab // args.vocab_scale, n_shards), dim, mh, comb)
         for name, vocab, dim, mh, comb in FEATURE_DEFS
     )
     emb_dim = sum(f.dim for f in features)

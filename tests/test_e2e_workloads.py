@@ -97,9 +97,10 @@ def test_xdljob_sparse_example_succeeds(op):
     force_cpu(manifest, "xdlReplicaSpecs", command=[
         sys.executable, "-m", "kubedl_tpu.train.sparse",
         "--steps", "3", "--batch", "64", "--hidden", "32",
+        "--vocab-scale", "100",
     ])
     job = op.apply(manifest)
-    assert op.wait_for_condition(job, "Succeeded", timeout=120)
+    assert op.wait_for_condition(job, "Succeeded", timeout=240)
     jm = op.metrics_registry.get("XDLJob")
     assert jm.successful == 1
 

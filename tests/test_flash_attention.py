@@ -144,3 +144,65 @@ def test_streamed_fwd_matches_default_kernel(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(mixed), np.asarray(ref), rtol=2e-3, atol=2e-3
     )
+
+
+def test_snap_block_bounds_padded_length():
+    """Exotic block sizes must not let lcm padding exceed the
+    whole-sequence kernels' VMEM budget (STREAM_MIN_SEQ)."""
+    import math
+
+    from kubedl_tpu.ops.flash_attention import STREAM_MIN_SEQ, _snap_block
+
+    for bq, bk in [(640, 384), (128, 128), (512, 256), (896, 768)]:
+        sq, sk = _snap_block(bq), _snap_block(bk)
+        assert sq <= bq and sk <= bk
+        assert sq >= 128 and sk >= 128
+        assert STREAM_MIN_SEQ % math.lcm(sq, sk) == 0
+
+
+def test_exotic_blocks_numerics_match_reference(monkeypatch):
+    """End-to-end through flash_attention with a shrunken VMEM budget so
+    the snap path actually fires: sq=769 keeps blocks 640/384 past the
+    cap clamp (cap=768), their lcm pads to 1920 > budget 1024, snap
+    rewrites them to 512/256 and the padded length lands exactly at the
+    budget. Numerics must still match the reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "STREAM_MIN_SEQ", 1024)
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    shape = (1, 1, 769, 64)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    o = fa.flash_attention(q, k, v, causal=True, block_q=640, block_k=384, min_seq=0)
+    r = fa.attention_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(o - r))) < 2e-5
+
+
+def test_in_budget_exotic_blocks_preserved(monkeypatch):
+    """Caller block choices whose lcm padding fits the budget are NOT
+    rewritten (a silent substitution would invalidate block sweeps)."""
+    from kubedl_tpu.ops import flash_attention as fa
+
+    seen = []
+    real_fwd = fa._fwd
+
+    def spy(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+        seen.append((block_q, block_k))
+        return real_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
+
+    monkeypatch.setattr(fa, "_fwd", spy)
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    shape = (1, 1, 2048, 64)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    fa.flash_attention(q, k, v, causal=True, block_q=640, block_k=384, min_seq=0)
+    # lcm(640,384)=1920, target 3840 <= 8192: requested blocks survive
+    assert seen == [(640, 384)]
