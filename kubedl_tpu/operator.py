@@ -15,6 +15,7 @@ registry, and optional storage persistence. Usage:
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from dataclasses import dataclass, field
@@ -33,6 +34,8 @@ from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
 from kubedl_tpu.api.validation import validate
 from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector
 from kubedl_tpu.utils.serde import from_dict
+
+log = logging.getLogger("kubedl_tpu.operator")
 
 
 @dataclass
@@ -141,6 +144,8 @@ class Operator:
         engine.setup(runner)
         self.reconcilers[controller.kind] = engine
         self._kind_by_lower[controller.kind.lower()] = controller.kind
+        log.info("controller started kind=%s workers=%d",
+                 controller.kind, self.config.max_reconciles)
         return engine
 
     @property
@@ -159,9 +164,7 @@ class Operator:
         discover = self.store.has_kind if self.kube_mode else None
         controllers = enabled_controllers(self.config.workloads, discover=discover)
         if discover is not None and not controllers:
-            import logging
-
-            logging.getLogger("kubedl_tpu.operator").warning(
+            log.warning(
                 "workload gate %r enabled no controllers (no matching CRDs "
                 "served by the API server)", self.config.workloads,
             )

@@ -33,6 +33,10 @@ def parse_args(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 serving (models/quant.py): halves "
+                        "the per-token HBM weight read on the bandwidth-"
+                        "bound decode loop; per-output-channel scales")
     return p.parse_args(argv)
 
 
@@ -84,6 +88,15 @@ def main(argv=None) -> int:
         # init only when actually serving fresh weights — a 7B init would
         # double peak memory next to a restored checkpoint
         params = llama.init(config, jax.random.PRNGKey(args.seed))
+
+    if args.int8:
+        from kubedl_tpu.models import quant
+
+        before = quant.tree_bytes(params)
+        params = jax.jit(quant.quantize_params)(params)
+        after = quant.tree_bytes(params)
+        print(f"int8: params {before / 1e6:.0f} MB -> {after / 1e6:.0f} MB "
+              f"(whole tree incl. unquantized embedding)", flush=True)
 
     prompt = jax.random.randint(
         jax.random.PRNGKey(args.seed + 1),

@@ -108,3 +108,42 @@ def test_sampled_generate_shape_and_range():
     )
     assert out.shape == (2, 4)
     assert int(out.min()) >= 0 and int(out.max()) < config.vocab_size
+
+
+def test_uniform_cache_matches_ragged_equal_lengths():
+    """The scalar-length fast path must be bit-compatible with the ragged
+    path when all rows share a length (it is an optimization, not a
+    different decode)."""
+    config, params, tokens = _setup(t=6)
+    b, t = tokens.shape
+    uni = decode.generate(params, tokens, config, max_new_tokens=4, max_len=16)
+    rag = decode.generate(
+        params, tokens, config, max_new_tokens=4, max_len=16,
+        lengths=jnp.full((b,), t, jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(uni), np.asarray(rag))
+
+
+def test_uniform_prefill_rejects_per_row_lengths():
+    config, params, tokens = _setup()
+    cache = decode.init_kv_cache(config, tokens.shape[0], 16, uniform=True)
+    try:
+        decode.prefill(params, tokens, cache, config,
+                       lengths=jnp.full((tokens.shape[0],), 3, jnp.int32))
+    except ValueError as e:
+        assert "ragged cache" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_uniform_decode_step_positions():
+    config, params, tokens = _setup(t=5)
+    full = llama.forward(params, tokens, config)
+    cache = decode.init_kv_cache(config, tokens.shape[0], 8, uniform=True)
+    for i in range(tokens.shape[1]):
+        logits, cache = decode.decode_step(params, tokens[:, i], cache, config)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]), rtol=1e-4, atol=1e-4,
+            err_msg=f"position {i}",
+        )
+    assert int(cache["lengths"]) == tokens.shape[1]

@@ -1,0 +1,85 @@
+"""Weight-only int8 quantization (models/quant.py): per-channel error
+bounds, matmul dispatch, and the quantized decode path end to end."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import decode, llama, quant
+
+
+def test_quantize_dequantize_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 64), jnp.float32)
+    q = quant.quantize(w)
+    assert q["q"].dtype == jnp.int8
+    assert q["s"].shape == (64,)
+    back = quant.dequantize(q, dtype=jnp.float32)
+    # symmetric per-column: |err| <= s/2 + bf16 scale rounding
+    bound = np.asarray(q["s"].astype(jnp.float32)) * 0.51 + 1e-6
+    err = np.max(np.abs(np.asarray(back - w)), axis=0)
+    assert (err <= bound).all(), (err / bound).max()
+
+
+def test_quantize_zero_column_safe():
+    w = jnp.zeros((16, 4), jnp.float32)
+    q = quant.quantize(w)
+    assert np.asarray(quant.dequantize(q)).max() == 0
+    assert not np.isnan(np.asarray(q["s"].astype(np.float32))).any()
+
+
+def test_matmul_dispatch_close_to_exact():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (8, 256), jnp.float32)
+    w = jax.random.normal(k2, (256, 128), jnp.float32)
+    exact = x @ w
+    approx = quant.matmul(x, quant.quantize(w))
+    rel = float(jnp.linalg.norm(exact - approx) / jnp.linalg.norm(exact))
+    assert rel < 0.02, rel
+    # plain arrays pass through untouched
+    np.testing.assert_array_equal(np.asarray(quant.matmul(x, w)), np.asarray(exact))
+
+
+def test_quantized_tree_shape_and_bytes():
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params)
+    # same layer structure, matrices became {q, s} leaves
+    assert quant.is_quantized(qparams["layers"][0]["wq"])
+    assert quant.is_quantized(qparams["lm_head"])
+    assert qparams["embed"].dtype == params["embed"].dtype
+    # f32 matrices shrink ~4x; whole tree must shrink substantially
+    assert quant.tree_bytes(qparams) < 0.5 * quant.tree_bytes(params)
+
+
+def test_quantized_generate_matches_fp_closely():
+    """Quantized decode must track the fp model: same shapes, and the
+    prefill logits stay within small relative error (weight-only int8 is
+    a bandwidth optimization, not a different model)."""
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params)
+    b, t = 2, 7
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, config.vocab_size)
+
+    cache_f = decode.init_kv_cache(config, b, 16)
+    cache_q = decode.init_kv_cache(config, b, 16)
+    last_f, _ = decode.prefill(params, tokens, cache_f, config)
+    last_q, _ = decode.prefill(qparams, tokens, cache_q, config)
+    rel = float(jnp.linalg.norm(last_f - last_q) / jnp.linalg.norm(last_f))
+    assert rel < 0.05, rel
+
+    toks = decode.generate(qparams, tokens, config, max_new_tokens=5, max_len=16)
+    assert toks.shape == (b, 5)
+    assert (np.asarray(toks) >= 0).all() and (np.asarray(toks) < config.vocab_size).all()
+
+
+def test_quantized_decode_step_runs_gqa():
+    """decode_step with quantized weights on a GQA config (tiny has
+    n_heads=4, n_kv_heads=2) — exercises the grouped-einsum cache path."""
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = quant.quantize_params(llama.init(config, jax.random.PRNGKey(0)))
+    cache = decode.init_kv_cache(config, 2, 8)
+    logits, cache = decode.decode_step(
+        params, jnp.array([1, 2], jnp.int32), cache, config
+    )
+    assert logits.shape == (2, config.vocab_size)
+    assert [int(x) for x in cache["lengths"]] == [1, 1]
