@@ -290,18 +290,19 @@ def _tpu_child(results_path: str) -> int:
     # bf16 and weight-only int8 (models/quant.py): decode re-reads the full
     # weight set per token, so halving weight bytes pays off directly on
     # the bandwidth-bound loop ---------------------------------------------
-    def _decode_common(key, int8):
+    def _decode_common(key, int8, shapes=None, kv_dtype=None, tag=None):
         from kubedl_tpu.models import decode as dec, llama, quant
 
         config = (llama.LlamaConfig.tiny(use_flash=False) if small
-                  else llama.LlamaConfig.bench_150m(max_seq_len=512, remat=False))
-        b, t, new = (2, 8, 8) if small else (8, 128, 128)
+                  else llama.LlamaConfig.bench_150m(max_seq_len=2048, remat=False))
+        b, t, new = shapes or ((2, 8, 8) if small else (8, 128, 128))
         params = llama.init(config, jax.random.PRNGKey(0))
         if int8:
             params = jax.jit(quant.quantize_params)(params)
         prompt = jax.random.randint(jax.random.PRNGKey(1), (b, t), 0, config.vocab_size)
         gen = jax.jit(lambda p, pr: dec.generate(
-            p, pr, config, max_new_tokens=new, max_len=t + new))
+            p, pr, config, max_new_tokens=new, max_len=t + new,
+            kv_dtype=kv_dtype))
         jax.device_get(gen(params, prompt))  # compile
         iters = 3
         t0 = time.perf_counter()
@@ -309,12 +310,13 @@ def _tpu_child(results_path: str) -> int:
             toks = gen(params, prompt)
         jax.device_get(toks)
         dt = (time.perf_counter() - t0) / iters
-        tag = "decode_int8" if int8 else "decode"
+        tag = tag or ("decode_int8" if int8 else "decode")
         _emit(out, key, {
             f"{tag}_tokens_per_sec": round(b * new / dt, 0),
             f"{tag}_ms_per_token": round(dt / new * 1e3, 3),
             "params_mb": round(quant.tree_bytes(params) / 1e6, 1),
             "batch": b, "prompt_len": t, "new_tokens": new,
+            "kv_dtype": kv_dtype or "model",
         })
 
     def decode_milestone():
@@ -322,6 +324,16 @@ def _tpu_child(results_path: str) -> int:
 
     def decode_int8_milestone():
         _decode_common("decode_int8", int8=True)
+
+    # -- 4d. long-context decode: at 1k+ prompts the per-token cache read
+    # rivals the weight read, so the int8 KV cache (per-position scales
+    # folded into the attention einsums) shows up here -------------------
+    def decode_long_milestone():
+        shapes = (2, 32, 8) if small else (8, 1024, 64)
+        _decode_common("decode_long", int8=True, shapes=shapes,
+                       tag="decode_long_fpkv")
+        _decode_common("decode_long_int8kv", int8=True, shapes=shapes,
+                       kv_dtype="int8", tag="decode_long_int8kv")
 
     # -- 5. llama throughput/MFU (small proof first, then the 1B target) ----
     def llama_milestone(config_name, batch, seq, steps, key):
@@ -384,6 +396,7 @@ def _tpu_child(results_path: str) -> int:
         ("mnist", mnist_milestone, 250),
         ("decode", decode_milestone, 150),
         ("decode_int8", decode_int8_milestone, 120),
+        ("decode_long", decode_long_milestone, 150),
     ]
     for name, fn, min_budget in milestones:
         if left() < min_budget:

@@ -35,7 +35,11 @@ NEG_INF = -1e30
 
 
 def init_kv_cache(
-    config: LlamaConfig, batch: int, max_len: int, uniform: bool = False
+    config: LlamaConfig,
+    batch: int,
+    max_len: int,
+    uniform: bool = False,
+    kv_dtype: Optional[str] = None,
 ) -> Dict:
     """Per-layer K/V buffers (model dtype) + write positions.
 
@@ -51,40 +55,73 @@ def init_kv_cache(
     automatically when no per-row lengths are passed. The mode is a
     trace-time (shape) property, so both variants compile once each.
 
+    kv_dtype="int8" stores K/V as int8 with a per-position-per-head
+    scale (amax/127 over head_dim) in extra "ks"/"vs" buffers: half the
+    cache HBM and half the per-token cache read at long contexts. The
+    scales fold EXACTLY into the attention einsums (scores scale per key
+    position; value scales fold into the softmax weights), so a
+    dequantized cache never materializes.
+
     K/V are LISTS of per-layer arrays, not a stacked [n_layers, ...]
     tensor: in the scan token loop each leaf is its own donated carry
     buffer, so the per-step write is in place — a stacked cache forced
     an unstack/update/restack that recopied cache memory every token."""
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
     shape = (batch, config.n_kv_heads, max_len, config.head_dim)
-    return {
-        "k": [jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)],
-        "v": [jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)],
+    store_dt = jnp.int8 if kv_dtype == "int8" else config.dtype
+    cache = {
+        "k": [jnp.zeros(shape, store_dt) for _ in range(config.n_layers)],
+        "v": [jnp.zeros(shape, store_dt) for _ in range(config.n_layers)],
         "lengths": (jnp.zeros((), jnp.int32) if uniform
                     else jnp.zeros((batch,), jnp.int32)),
     }
+    if kv_dtype == "int8":
+        sshape = (batch, config.n_kv_heads, max_len)
+        cache["ks"] = [jnp.ones(sshape, jnp.float32) for _ in range(config.n_layers)]
+        cache["vs"] = [jnp.ones(sshape, jnp.float32) for _ in range(config.n_layers)]
+    return cache
 
 
-def _attend_cached(q, ck, cv, lengths, n_rep):
+def _quantize_kv(x):
+    """[b, h, t, d] -> (int8 codes, [b, h, t] scales); amax/127 over d."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127)
+    return q.astype(jnp.int8), s
+
+
+def _attend_cached(q, ck, cv, lengths, n_rep, k_scale=None, v_scale=None):
     """q [b,hq,1,d] vs cache [b,hkv,L,d]; row i masks positions >= lengths[i]
     (scalar lengths = one shared limit for the whole batch).
 
     GQA runs as a grouped einsum (q reshaped to [b,hkv,g,1,d]) instead of
     jnp.repeat-ing the cache — the cache read is the bandwidth bill here
     and must stay at hkv heads. Scores accumulate in f32 on bf16 operands
-    (preferred_element_type), so the cache is never upcast in HBM."""
+    (preferred_element_type), so the cache is never upcast in HBM.
+
+    int8 caches pass per-position scales ([b,hkv,L]); the K scale
+    multiplies the scores (q . (s*k) == s * (q . k)) and the V scale
+    folds into the softmax weights (sum_k p_k*(s_k*v_k) ==
+    sum_k (p_k*s_k)*v_k) — exact, no dequantized cache tensor."""
     b, hq, _, d = q.shape
     hkv, L = ck.shape[1], ck.shape[2]
+    cd = q.dtype  # compute dtype; int8 codes convert on the operand read
     qg = q.reshape(b, hkv, n_rep, d)  # group queries under their kv head
     s = jnp.einsum(
-        "bhgd,bhkd->bhgk", qg, ck, preferred_element_type=jnp.float32
+        "bhgd,bhkd->bhgk", qg, ck.astype(cd), preferred_element_type=jnp.float32
     )
+    if k_scale is not None:
+        s = s * k_scale[:, :, None, :]
     s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
     k_pos = jnp.arange(L)
     limit = lengths if lengths.ndim == 0 else lengths[:, None, None, None]
     s = jnp.where(k_pos[None, None, None, :] < limit, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if v_scale is not None:
+        p = p * v_scale[:, :, None, :]
     out = jnp.einsum(
-        "bhgk,bhkd->bhgd", p.astype(cv.dtype), cv,
+        "bhgk,bhkd->bhgd", p.astype(cd), cv.astype(cd),
         preferred_element_type=jnp.float32,
     )
     return out.reshape(b, hq, 1, d)
@@ -106,11 +143,15 @@ def decode_step(
     c = config
     b = token.shape[0]
     pos = cache["lengths"]  # [b], or scalar in uniform mode
+    int8_kv = "ks" in cache
     if pos.ndim == 0:
         positions = jnp.full((b, 1), pos, jnp.int32)  # shared RoPE position
 
         def write_row(cache_buf, new_row, p):
             return jax.lax.dynamic_update_slice(cache_buf, new_row, (0, 0, p, 0))
+
+        def write_scale(scale_buf, new_scale, p):
+            return jax.lax.dynamic_update_slice(scale_buf, new_scale, (0, 0, p))
     else:
         positions = pos[:, None]  # [b, 1] — per-row RoPE positions
         write_row = jax.vmap(
@@ -118,9 +159,14 @@ def decode_step(
                 cache_row, new_row, p, axis=1
             )
         )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
+        write_scale = jax.vmap(
+            lambda scale_row, new_scale, p: jax.lax.dynamic_update_slice_in_dim(
+                scale_row, new_scale, p, axis=1
+            )
+        )  # [b,hkv,L], [b,hkv,1], [b]
 
     x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -128,11 +174,23 @@ def decode_step(
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        ck = write_row(cache["k"][i], k.astype(c.dtype), pos)
-        cv = write_row(cache["v"][i], v.astype(c.dtype), pos)
+        cks = cvs = None
+        if int8_kv:
+            qk, sk = _quantize_kv(k)
+            qv, sv = _quantize_kv(v)
+            ck = write_row(cache["k"][i], qk, pos)
+            cv = write_row(cache["v"][i], qv, pos)
+            cks = write_scale(cache["ks"][i], sk, pos)
+            cvs = write_scale(cache["vs"][i], sv, pos)
+            new_ks.append(cks)
+            new_vs.append(cvs)
+        else:
+            ck = write_row(cache["k"][i], k.astype(c.dtype), pos)
+            cv = write_row(cache["v"][i], v.astype(c.dtype), pos)
         new_k.append(ck)
         new_v.append(cv)
-        attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads)
+        attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads,
+                              k_scale=cks, v_scale=cvs)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
@@ -142,6 +200,9 @@ def decode_step(
         "v": new_v,
         "lengths": pos + 1,
     }
+    if int8_kv:
+        cache["ks"] = new_ks
+        cache["vs"] = new_vs
     logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
     return logits, cache
 
@@ -195,7 +256,12 @@ def prefill(
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
 
-    cache = {
+    int8_kv = "ks" in cache
+    if int8_kv:
+        qks, kscales = zip(*(_quantize_kv(kl) for kl in ks))
+        qvs, vscales = zip(*(_quantize_kv(vl) for vl in vs))
+        ks, vs = list(qks), list(qvs)
+    out_cache = {
         "k": [
             jax.lax.dynamic_update_slice_in_dim(buf, kl, 0, axis=2)
             for buf, kl in zip(cache["k"], ks)
@@ -206,6 +272,16 @@ def prefill(
         ],
         "lengths": jnp.asarray(t, jnp.int32) if uniform else lengths,
     }
+    if int8_kv:
+        out_cache["ks"] = [
+            jax.lax.dynamic_update_slice_in_dim(buf, sl, 0, axis=2)
+            for buf, sl in zip(cache["ks"], kscales)
+        ]
+        out_cache["vs"] = [
+            jax.lax.dynamic_update_slice_in_dim(buf, sl, 0, axis=2)
+            for buf, sl in zip(cache["vs"], vscales)
+        ]
+    cache = out_cache
     logits_all = _lm_head(x, params, c)  # [b, t, vocab]
     if uniform:
         last = logits_all[:, t - 1]
@@ -225,16 +301,21 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     lengths: Optional[jax.Array] = None,  # [b] unpadded prompt lengths
+    kv_dtype: Optional[str] = None,  # None (model dtype) | "int8"
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens].
 
     Ragged batches: pass right-padded `prompt` plus per-row `lengths`;
     row i's continuation starts after its own last real token. Without
     `lengths` the batch is uniform and the cache takes the scalar-length
-    fast path (single-slice writes instead of per-row scatters)."""
+    fast path (single-slice writes instead of per-row scatters).
+    kv_dtype="int8" halves KV-cache memory and read traffic (per-position
+    scales fold exactly into the attention einsums)."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
-    cache = init_kv_cache(config, b, max_len, uniform=lengths is None)
+    cache = init_kv_cache(
+        config, b, max_len, uniform=lengths is None, kv_dtype=kv_dtype
+    )
     logits, cache = prefill(params, prompt, cache, config, lengths=lengths)
     if key is None:
         key = jax.random.PRNGKey(0)

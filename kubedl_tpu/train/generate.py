@@ -37,6 +37,10 @@ def parse_args(argv=None):
                    help="weight-only int8 serving (models/quant.py): halves "
                         "the per-token HBM weight read on the bandwidth-"
                         "bound decode loop; per-output-channel scales")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache: half the cache memory and read "
+                        "traffic at long contexts; per-position scales fold "
+                        "exactly into the attention einsums")
     return p.parse_args(argv)
 
 
@@ -107,6 +111,7 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         max_len=args.prompt_len + args.max_new_tokens,
         temperature=args.temperature, key=key,
+        kv_dtype="int8" if args.kv_int8 else None,
     ))
     key = jax.random.PRNGKey(args.seed + 2)
 
