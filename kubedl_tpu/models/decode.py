@@ -78,16 +78,24 @@ def init_kv_cache(
     }
     if kv_dtype == "int8":
         sshape = (batch, config.n_kv_heads, max_len)
-        cache["ks"] = [jnp.ones(sshape, jnp.float32) for _ in range(config.n_layers)]
-        cache["vs"] = [jnp.ones(sshape, jnp.float32) for _ in range(config.n_layers)]
+        cache["ks"] = [jnp.ones(sshape, jnp.bfloat16) for _ in range(config.n_layers)]
+        cache["vs"] = [jnp.ones(sshape, jnp.bfloat16) for _ in range(config.n_layers)]
     return cache
 
 
 def _quantize_kv(x):
-    """[b, h, t, d] -> (int8 codes, [b, h, t] scales); amax/127 over d."""
+    """[b, h, t, d] -> (int8 codes, [b, h, t] bf16 scales); amax/127 over d.
+
+    Like quant.quantize, the scale is rounded to its stored bf16 value
+    BEFORE the codes are computed, so the codes compensate the scale's
+    own rounding; bf16 scales keep the int8 cache read at ~half the bf16
+    cache read (f32 scales would cost 53% at head_dim=64)."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    s = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]), -127, 127)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.bfloat16)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / s.astype(jnp.float32)[..., None]),
+        -127, 127,
+    )
     return q.astype(jnp.int8), s
 
 

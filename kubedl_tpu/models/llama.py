@@ -240,9 +240,9 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
     b, t, d = x.shape
     hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
     h = rms_norm(x, layer["attn_norm"], config.rms_eps)
-    q = (h @ layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
-    k = (h @ layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
-    v = (h @ layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    q = _mm(h, layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
+    k = _mm(h, layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    v = _mm(h, layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
     q = _rope(q, positions, config.rope_theta)
     k = _rope(k, positions, config.rope_theta)
     if nq != nkv:
@@ -258,7 +258,7 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
 
         attn = attention_reference(q, k, v, causal=True)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
-    return x + (attn @ layer["wo"]).astype(x.dtype)
+    return x + _mm(attn, layer["wo"]).astype(x.dtype)
 
 
 def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):

@@ -129,7 +129,8 @@ def moe_mlp(
     rules = rules or ShardingRules()
     b, t, d = h.shape
     s = b * t
-    e = params["w1"].shape[0]
+    w1 = params["w1"]
+    e = (w1["q"] if isinstance(w1, dict) else w1).shape[0]
     c = expert_capacity(s, e, top_k, capacity_factor)
 
     def constrain(x, *dims):
@@ -141,14 +142,22 @@ def moe_mlp(
     gate_logits = hf.astype(jnp.float32) @ params["router"]
     dispatch, combine, aux = _top_k_gating(gate_logits, top_k, c)
 
+    def emm(x, w, eq):
+        """Batched expert matmul; int8 stacks ({q, s}, models/quant.py)
+        apply the [E, out] scale after the contraction — exact."""
+        if isinstance(w, dict):
+            return jnp.einsum(eq, x, w["q"].astype(x.dtype)) * w["s"].astype(
+                x.dtype)[:, None, :]
+        return jnp.einsum(eq, x, w)
+
     # tokens -> expert slots: the all-to-all (from the sharding constraint)
     expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(h.dtype), hf)
     expert_in = constrain(expert_in, "expert", None, "embed")
     gate = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]).astype(jnp.float32)
+        emm(expert_in, params["w1"], "ecd,edf->ecf").astype(jnp.float32)
     ).astype(h.dtype)
-    up = jnp.einsum("ecd,edf->ecf", expert_in, params["w3"])
-    out = jnp.einsum("ecf,efd->ecd", gate * up, params["w2"])
+    up = emm(expert_in, params["w3"], "ecd,edf->ecf")
+    out = emm(gate * up, params["w2"], "ecf,efd->ecd")
     out = constrain(out, "expert", None, "embed")
     # expert slots -> tokens: the reverse all-to-all
     y = jnp.einsum("sec,ecd->sd", combine.astype(h.dtype), out)

@@ -68,13 +68,22 @@ def matmul(x: jax.Array, w: Any) -> jax.Array:
 _LAYER_MATS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
 
 
+def quantize_stack(w: jax.Array) -> Dict[str, jax.Array]:
+    """Per-expert per-output-channel int8 for [E, in, out] stacks:
+    q int8 [E, in, out], s [E, out]."""
+    if w.ndim != 3:
+        raise ValueError(f"quantize_stack expects [E, in, out], got {w.shape}")
+    qs = jax.vmap(quantize)(w)
+    return {"q": qs["q"], "s": qs["s"]}
+
+
 def quantize_params(params: Dict) -> Dict:
     """Llama param pytree -> same-shape tree with int8 matrix leaves.
 
     The embedding stays bf16 (row-gather); with tied embeddings the head
     path reads embed.T, so tie_embeddings models only benefit in the
-    layers. MoE expert stacks are left unquantized (the routed einsum
-    path doesn't dispatch on quantized leaves yet)."""
+    layers. MoE expert stacks quantize per expert (the router stays f32 —
+    tiny, and gating is precision-sensitive)."""
     out = {"embed": params["embed"], "final_norm": params["final_norm"]}
     layers = []
     for layer in params["layers"]:
@@ -82,6 +91,11 @@ def quantize_params(params: Dict) -> Dict:
         for name, leaf in layer.items():
             if name in _LAYER_MATS:
                 ql[name] = quantize(leaf)
+            elif name == "moe":
+                ql[name] = {
+                    k: (quantize_stack(v) if k in ("w1", "w3", "w2") else v)
+                    for k, v in leaf.items()
+                }
             else:
                 ql[name] = leaf
         layers.append(ql)

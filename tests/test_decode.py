@@ -200,3 +200,33 @@ def test_init_kv_cache_rejects_unknown_dtype():
         assert "int8" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_tensor_parallel_decode_matches_single_device():
+    """Serving scales over a tensor mesh with no decode-specific sharding
+    code: params placed per param_specs, jit propagates the shardings
+    through prefill + decode steps and inserts the collectives (one psum
+    after wo/w2 per block, like training). Teacher-forced logits compare
+    with tolerance — the 2-way psum reorders f32 sums, so greedy-token
+    chains are NOT bit-stable and comparing them would flake."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+
+    config, params, tokens = _setup(t=7)
+    full = llama.forward(params, tokens, config)
+
+    mesh = build_mesh({"tensor": 2}, devices=jax.devices()[:2])
+    specs = llama.param_specs(config, ShardingRules())
+    sharded = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    step = jax.jit(lambda p, tok, cache: decode.decode_step(p, tok, cache, config))
+    cache = decode.init_kv_cache(config, tokens.shape[0], 16, uniform=True)
+    for i in range(tokens.shape[1]):
+        logits, cache = step(sharded, tokens[:, i], cache)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, i]), rtol=1e-3, atol=1e-3,
+            err_msg=f"position {i}",
+        )

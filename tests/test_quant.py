@@ -83,3 +83,29 @@ def test_quantized_decode_step_runs_gqa():
     )
     assert logits.shape == (2, config.vocab_size)
     assert [int(x) for x in cache["lengths"]] == [1, 1]
+
+
+def test_quantized_moe_tracks_fp():
+    """MoE expert stacks quantize per expert; the routed FFN must stay
+    within quantization tolerance of fp, and the router must be
+    untouched (same expert assignments)."""
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False, n_experts=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params)
+    moe = qparams["layers"][0]["moe"]
+    assert quant.is_quantized(moe["w1"]) and moe["w1"]["q"].ndim == 3
+    assert moe["router"].dtype == jnp.float32  # untouched
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, config.vocab_size)
+    ref = llama.forward(params, tokens, config)
+    got = llama.forward(qparams, tokens, config)
+    rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+    assert rel < 0.05, rel
+
+
+def test_quantized_moe_decode_runs():
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False, n_experts=4)
+    params = quant.quantize_params(llama.init(config, jax.random.PRNGKey(0)))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, config.vocab_size)
+    toks = decode.generate(params, tokens, config, max_new_tokens=3, max_len=16)
+    assert toks.shape == (2, 3)
