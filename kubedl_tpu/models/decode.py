@@ -99,40 +99,51 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), s
 
 
-def _attend_cached(q, ck, cv, lengths, n_rep, k_scale=None, v_scale=None):
-    """q [b,hq,1,d] vs cache [b,hkv,L,d]; row i masks positions >= lengths[i]
-    (scalar lengths = one shared limit for the whole batch).
+def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
+    """q [b,hq,tq,d] vs cache [b,hkv,L,d]; query t in row i attends cache
+    positions < its limit. `limits` is a scalar (one shared limit), [b]
+    (per-row limit, tq == 1), or [b, tq] (per-row per-query — the block
+    verify path, where query t may see t more positions than query 0).
 
-    GQA runs as a grouped einsum (q reshaped to [b,hkv,g,1,d]) instead of
-    jnp.repeat-ing the cache — the cache read is the bandwidth bill here
-    and must stay at hkv heads. Scores accumulate in f32 on bf16 operands
-    (preferred_element_type), so the cache is never upcast in HBM.
+    GQA runs as a grouped einsum (q reshaped to [b,hkv,g,tq,d]) instead
+    of jnp.repeat-ing the cache — the cache read is the bandwidth bill
+    here and must stay at hkv heads. Scores accumulate in f32 on bf16
+    operands (preferred_element_type), so the cache is never upcast in
+    HBM.
 
     int8 caches pass per-position scales ([b,hkv,L]); the K scale
     multiplies the scores (q . (s*k) == s * (q . k)) and the V scale
     folds into the softmax weights (sum_k p_k*(s_k*v_k) ==
     sum_k (p_k*s_k)*v_k) — exact, no dequantized cache tensor."""
-    b, hq, _, d = q.shape
+    b, hq, tq, d = q.shape
     hkv, L = ck.shape[1], ck.shape[2]
     cd = q.dtype  # compute dtype; int8 codes convert on the operand read
-    qg = q.reshape(b, hkv, n_rep, d)  # group queries under their kv head
+    qg = q.reshape(b, hkv, n_rep, tq, d)  # group queries under their kv head
     s = jnp.einsum(
-        "bhgd,bhkd->bhgk", qg, ck.astype(cd), preferred_element_type=jnp.float32
+        "bhgtd,bhkd->bhgtk", qg, ck.astype(cd), preferred_element_type=jnp.float32
     )
     if k_scale is not None:
-        s = s * k_scale[:, :, None, :]
+        s = s * k_scale[:, :, None, None, :]
     s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
     k_pos = jnp.arange(L)
-    limit = lengths if lengths.ndim == 0 else lengths[:, None, None, None]
-    s = jnp.where(k_pos[None, None, None, :] < limit, s, NEG_INF)
+    limits = jnp.asarray(limits)
+    if limits.ndim == 0:
+        lim = limits[None, None]  # -> [1, 1], shared by batch and queries
+    elif limits.ndim == 1:
+        lim = limits[:, None]  # [b] -> per-row, tq must be 1
+    else:
+        lim = limits  # [b, tq]
+    s = jnp.where(
+        k_pos[None, None, None, None, :] < lim[:, None, None, :, None], s, NEG_INF
+    )
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
-        p = p * v_scale[:, :, None, :]
+        p = p * v_scale[:, :, None, None, :]
     out = jnp.einsum(
-        "bhgk,bhkd->bhgd", p.astype(cd), cv.astype(cd),
+        "bhgtk,bhkd->bhgtd", p.astype(cd), cv.astype(cd),
         preferred_element_type=jnp.float32,
     )
-    return out.reshape(b, hq, 1, d)
+    return out.reshape(b, hq, tq, d)
 
 
 def decode_step(
@@ -143,35 +154,31 @@ def decode_step(
 ) -> Tuple[jax.Array, Dict]:
     """One decode step: returns (logits [b, vocab], updated cache).
 
-    Uniform cache (scalar lengths): all rows write one position — a
-    single dynamic_update_slice, the fast path. Ragged cache: each row
-    writes at its own position via a vmapped dynamic_update_slice that
-    lowers to a scatter (measurably slower on TPU; a one-hot select
-    over the whole cache would be even worse at O(max_len) traffic)."""
+    Uniform cache (scalar lengths): the T=1 case of decode_block_step —
+    all rows write one position with a single dynamic_update_slice, the
+    fast path. Ragged cache: each row writes at its own position via a
+    vmapped dynamic_update_slice that lowers to a scatter (measurably
+    slower on TPU; a one-hot select over the whole cache would be even
+    worse at O(max_len) traffic)."""
     c = config
     b = token.shape[0]
     pos = cache["lengths"]  # [b], or scalar in uniform mode
     int8_kv = "ks" in cache
     if pos.ndim == 0:
-        positions = jnp.full((b, 1), pos, jnp.int32)  # shared RoPE position
+        logits, cache = decode_block_step(params, token[:, None], cache, config)
+        return logits[:, 0], cache
 
-        def write_row(cache_buf, new_row, p):
-            return jax.lax.dynamic_update_slice(cache_buf, new_row, (0, 0, p, 0))
-
-        def write_scale(scale_buf, new_scale, p):
-            return jax.lax.dynamic_update_slice(scale_buf, new_scale, (0, 0, p))
-    else:
-        positions = pos[:, None]  # [b, 1] — per-row RoPE positions
-        write_row = jax.vmap(
-            lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
-                cache_row, new_row, p, axis=1
-            )
-        )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
-        write_scale = jax.vmap(
-            lambda scale_row, new_scale, p: jax.lax.dynamic_update_slice_in_dim(
-                scale_row, new_scale, p, axis=1
-            )
-        )  # [b,hkv,L], [b,hkv,1], [b]
+    positions = pos[:, None]  # [b, 1] — per-row RoPE positions
+    write_row = jax.vmap(
+        lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
+            cache_row, new_row, p, axis=1
+        )
+    )  # [b,hkv,L,d], [b,hkv,1,d], [b] -> per-row update at its own offset
+    write_scale = jax.vmap(
+        lambda scale_row, new_scale, p: jax.lax.dynamic_update_slice_in_dim(
+            scale_row, new_scale, p, axis=1
+        )
+    )  # [b,hkv,L], [b,hkv,1], [b]
 
     x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
     new_k, new_v, new_ks, new_vs = [], [], [], []
@@ -213,6 +220,72 @@ def decode_step(
         cache["vs"] = new_vs
     logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
     return logits, cache
+
+
+def decode_block_step(
+    params: Dict,
+    tokens: jax.Array,  # [b, T] int32 — T new tokens per row
+    cache: Dict,
+    config: LlamaConfig,
+) -> Tuple[jax.Array, Dict]:
+    """Chunked decode: T tokens forward through the cache in ONE dispatch.
+
+    Returns (logits [b, T, vocab], cache advanced by T). logits[:, i]
+    predicts the token AFTER tokens[:, i]. Query i attends the full
+    cache plus the block prefix up to itself (causal within the block).
+    Uniform (scalar-length) caches only — the speculative-verify and
+    chunked-prefill consumer paths are uniform by construction.
+
+    A caller that accepts fewer than T positions (speculative decoding)
+    rolls back by shrinking cache["lengths"]: entries past the length
+    are masked out of attention and overwritten by later writes."""
+    c = config
+    b, T = tokens.shape
+    pos = cache["lengths"]
+    if pos.ndim != 0:
+        raise ValueError("decode_block_step requires a uniform cache "
+                         "(init_kv_cache(..., uniform=True))")
+    int8_kv = "ks" in cache
+    positions = jnp.broadcast_to((pos + jnp.arange(T, dtype=jnp.int32))[None], (b, T))
+    limits = positions + 1  # query i sees cache < pos + i + 1
+
+    x = params["embed"][tokens].astype(c.dtype)  # [b, T, d]
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = _mm(h, layer["wq"]).reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _mm(h, layer["wk"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _mm(h, layer["wv"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        cks = cvs = None
+        if int8_kv:
+            qk, sk = _quantize_kv(k)
+            qv, sv = _quantize_kv(v)
+            ck = jax.lax.dynamic_update_slice(cache["k"][i], qk, (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(cache["v"][i], qv, (0, 0, pos, 0))
+            cks = jax.lax.dynamic_update_slice(cache["ks"][i], sk, (0, 0, pos))
+            cvs = jax.lax.dynamic_update_slice(cache["vs"][i], sv, (0, 0, pos))
+            new_ks.append(cks)
+            new_vs.append(cvs)
+        else:
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"][i], k.astype(c.dtype), (0, 0, pos, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"][i], v.astype(c.dtype), (0, 0, pos, 0))
+        new_k.append(ck)
+        new_v.append(cv)
+        attn = _attend_cached(q, ck, cv, limits, c.n_heads // c.n_kv_heads,
+                              k_scale=cks, v_scale=cvs)
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
+        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        x, _ = _mlp_block(x, layer, c)
+
+    out_cache = {"k": new_k, "v": new_v, "lengths": pos + T}
+    if int8_kv:
+        out_cache["ks"] = new_ks
+        out_cache["vs"] = new_vs
+    return _lm_head(x, params, c), out_cache
 
 
 def prefill(
@@ -342,3 +415,90 @@ def generate(
     keys = jax.random.split(key, max_new_tokens)
     (_, _), toks = jax.lax.scan(body, (logits, cache), keys)
     return toks.T  # [b, max_new_tokens]
+
+
+def generate_speculative(
+    params: Dict,
+    draft_params: Dict,
+    prompt: jax.Array,  # [1, t] int32 — single sequence
+    config: LlamaConfig,
+    draft_config: LlamaConfig,
+    max_new_tokens: int,
+    k: int = 4,
+    kv_dtype: Optional[str] = None,
+) -> jax.Array:
+    """Greedy speculative decoding: [1, max_new_tokens], EXACTLY the
+    target model's greedy continuation, produced in fewer target passes.
+
+    Each round a small draft model proposes k tokens one at a time; the
+    target verifies all of them in ONE decode_block_step and keeps the
+    longest matching prefix plus its own next token (the bonus).
+    Acceptance is capped at k-1 so the draft cache — which only ever saw
+    k inputs — stays position-aligned with the target cache; both roll
+    back by shrinking their scalar cache lengths. Latency-bound serving
+    is batch=1 by nature, and b=1 keeps every length scalar (the
+    uniform fast path); larger batches diverge per row and are not
+    supported.
+
+    Exactness: every emitted token is the target's argmax given the
+    previously emitted prefix — a mismatched draft only costs speed.
+    (Logits come from the block verify, whose reductions may order
+    differently than single-token steps; near-exact ties in the target
+    distribution can therefore resolve differently than vanilla
+    generate(), as between any two compiled schedules.)"""
+    b, t = prompt.shape
+    if b != 1:
+        raise ValueError(f"speculative decoding is batch=1 (got batch {b})")
+    if k < 2:
+        raise ValueError(f"k must be >= 2 (got {k}); k=1 degenerates to "
+                         "vanilla greedy with an extra draft pass")
+    max_len = t + max_new_tokens + k  # slack: final block may overshoot
+
+    t_cache = init_kv_cache(config, 1, max_len, uniform=True, kv_dtype=kv_dtype)
+    logits, t_cache = prefill(params, prompt, t_cache, config)
+    d_cache = init_kv_cache(draft_config, 1, max_len, uniform=True,
+                            kv_dtype=kv_dtype)
+    _, d_cache = prefill(draft_params, prompt, d_cache, draft_config)
+
+    cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1] — first token
+    out = jnp.zeros((1, max_new_tokens + k), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, cur[None], (0, 0))
+
+    def draft_round(d_cache, cur):
+        def body(carry, _):
+            tok, cache = carry
+            lg, cache = decode_step(draft_params, tok, cache, draft_config)
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            return (nxt, cache), nxt
+        (_, d_cache), drafted = jax.lax.scan(body, (cur, d_cache), None, length=k)
+        return d_cache, drafted[:, 0]  # [k]
+
+    def cond(state):
+        _, n, _, _, _ = state
+        return n < max_new_tokens
+
+    def round_body(state):
+        cur, n, out, t_cache, d_cache = state
+        pos = t_cache["lengths"]  # == d_cache["lengths"]
+        d_cache, drafted = draft_round(d_cache, cur)  # [k]
+        blk = jnp.concatenate([cur, drafted])[None]  # [1, k+1]
+        blk_logits, t_cache = decode_block_step(params, blk, t_cache, config)
+        ta = jnp.argmax(blk_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+        # longest matching prefix of the drafts, capped at k-1 (see doc)
+        matches = (drafted[: k - 1] == ta[: k - 1]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches))
+        bonus = jax.lax.dynamic_index_in_dim(ta, a, keepdims=False)
+        # emit drafted[:a] then bonus at slot a; tail junk is overwritten
+        # by later rounds and trimmed at the end
+        slots = jnp.arange(k)
+        emit = jnp.where(slots < a, drafted, 0)
+        emit = jnp.where(slots == a, bonus, emit)
+        out = jax.lax.dynamic_update_slice(out, emit[None], (0, n))
+        # roll both caches back to the accepted prefix (cur + a drafts)
+        t_cache = dict(t_cache, lengths=pos + a + 1)
+        d_cache = dict(d_cache, lengths=pos + a + 1)
+        return bonus[None], n + a + 1, out, t_cache, d_cache
+
+    state = (cur, jnp.asarray(1, jnp.int32), out, t_cache, d_cache)
+    _, _, out, _, _ = jax.lax.while_loop(cond, round_body, state)
+    return out[:, :max_new_tokens]

@@ -41,6 +41,15 @@ def parse_args(argv=None):
                    help="int8 KV cache: half the cache memory and read "
                         "traffic at long contexts; per-position scales fold "
                         "exactly into the attention einsums")
+    p.add_argument("--speculative-k", type=int, default=0,
+                   help="greedy speculative decoding: a draft model proposes "
+                        "K tokens per target verify pass (batch must be 1; "
+                        "output is exactly the target's greedy continuation)")
+    p.add_argument("--draft-model", default="tiny",
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"],
+                   help="draft model config for --speculative-k")
+    p.add_argument("--draft-checkpoint-path", default="",
+                   help="Orbax dir for draft params (fresh init if empty)")
     return p.parse_args(argv)
 
 
@@ -58,13 +67,32 @@ def main(argv=None) -> int:
 
     config = llama.LlamaConfig.config_for(args.model)
 
-    params = None
-    if args.checkpoint_path:
+    def restore_params(path, label):
+        """Newest checkpoint's params under `path`, or None if empty.
+
+        The trainer saves the full TrainState, whose pytree flattens to
+        (params, opt_state, step) — an untargeted restore returns that
+        as a list; keep the params and drop the optimizer."""
         import orbax.checkpoint as ocp
 
-        mngr = ocp.CheckpointManager(args.checkpoint_path)
+        mngr = ocp.CheckpointManager(path)
         latest = mngr.latest_step()
         if latest is None:
+            return None
+        restored = mngr.restore(latest)
+        if isinstance(restored, (list, tuple)):
+            tree = restored[0]
+        elif hasattr(restored, "params"):
+            tree = restored.params
+        else:
+            tree = restored["params"]
+        print(f"restored {label} params from checkpoint step {latest}", flush=True)
+        return jax.tree.map(jnp.asarray, tree)
+
+    params = None
+    if args.checkpoint_path:
+        params = restore_params(args.checkpoint_path, "target")
+        if params is None:
             if not args.allow_fresh_init:
                 # An explicit checkpoint path with nothing under it means a
                 # missing volume mount or a wrong dir — serving random
@@ -75,19 +103,6 @@ def main(argv=None) -> int:
                 return 1
             print(f"no checkpoint under {args.checkpoint_path}; using fresh init",
                   flush=True)
-        else:
-            # The trainer saves the full TrainState, whose pytree flattens
-            # to (params, opt_state, step) — an untargeted restore returns
-            # that as a list; keep the params and drop the optimizer.
-            restored = mngr.restore(latest)
-            if isinstance(restored, (list, tuple)):
-                tree = restored[0]
-            elif hasattr(restored, "params"):
-                tree = restored.params
-            else:
-                tree = restored["params"]
-            params = jax.tree.map(jnp.asarray, tree)
-            print(f"restored params from checkpoint step {latest}", flush=True)
     if params is None:
         # init only when actually serving fresh weights — a 7B init would
         # double peak memory next to a restored checkpoint
@@ -106,13 +121,53 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(args.seed + 1),
         (args.batch, args.prompt_len), 0, config.vocab_size,
     )
-    gen = jax.jit(lambda p, pr, key: decode.generate(
-        p, pr, config,
-        max_new_tokens=args.max_new_tokens,
-        max_len=args.prompt_len + args.max_new_tokens,
-        temperature=args.temperature, key=key,
-        kv_dtype="int8" if args.kv_int8 else None,
-    ))
+    kv_dtype = "int8" if args.kv_int8 else None
+    if args.speculative_k:
+        if args.speculative_k < 2:
+            print("error: --speculative-k must be >= 2 (k=1 degenerates to "
+                  "vanilla greedy with an extra draft pass)", file=sys.stderr)
+            return 2
+        if args.batch != 1:
+            print("error: --speculative-k requires --batch 1", file=sys.stderr)
+            return 2
+        if args.temperature > 0:
+            print("error: --speculative-k is greedy (temperature 0)",
+                  file=sys.stderr)
+            return 2
+        draft_config = llama.LlamaConfig.config_for(args.draft_model)
+        draft = None
+        if args.draft_checkpoint_path:
+            draft = restore_params(args.draft_checkpoint_path, "draft")
+            if draft is None and not args.allow_fresh_init:
+                # same policy as the target path: an empty draft dir means
+                # a missing mount — a silent random draft would just make
+                # speculation slower than vanilla with exit 0
+                print(f"error: no checkpoint under {args.draft_checkpoint_path} "
+                      f"(pass --allow-fresh-init for a random draft)",
+                      file=sys.stderr)
+                return 1
+        if draft is None:
+            draft = llama.init(draft_config, jax.random.PRNGKey(args.seed + 3))
+        if args.int8:
+            from kubedl_tpu.models import quant
+
+            draft = jax.jit(quant.quantize_params)(draft)
+        spec_gen = jax.jit(lambda p, dp, pr: decode.generate_speculative(
+            p, dp, pr, config, draft_config,
+            max_new_tokens=args.max_new_tokens, k=args.speculative_k,
+            kv_dtype=kv_dtype,
+        ))
+
+        def gen(p, pr, key):
+            return spec_gen(p, draft, pr)
+    else:
+        gen = jax.jit(lambda p, pr, key: decode.generate(
+            p, pr, config,
+            max_new_tokens=args.max_new_tokens,
+            max_len=args.prompt_len + args.max_new_tokens,
+            temperature=args.temperature, key=key,
+            kv_dtype=kv_dtype,
+        ))
     key = jax.random.PRNGKey(args.seed + 2)
 
     t0 = time.perf_counter()

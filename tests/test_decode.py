@@ -230,3 +230,78 @@ def test_tensor_parallel_decode_matches_single_device():
             np.asarray(logits), np.asarray(full[:, i]), rtol=1e-3, atol=1e-3,
             err_msg=f"position {i}",
         )
+
+
+def test_speculative_matches_vanilla_greedy():
+    """Greedy speculative decoding must emit EXACTLY the target model's
+    greedy continuation — with a bad draft (different init) and a
+    perfect draft (the target itself). A mismatched draft only costs
+    speed, never output."""
+    config, params, tokens = _setup(t=7)
+    tokens = tokens[:1]  # speculative is batch=1
+    want = decode.generate(params, tokens, config, max_new_tokens=9, max_len=32)
+
+    bad_draft = llama.init(config, jax.random.PRNGKey(42))
+    for draft in (bad_draft, params):
+        got = decode.generate_speculative(
+            params, draft, tokens, config, config, max_new_tokens=9, k=3,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_with_small_draft_and_int8_kv():
+    """Typical deployment shape: a shallower draft config plus int8 KV
+    caches on both models."""
+    config, params, tokens = _setup(t=6)
+    tokens = tokens[:1]
+    draft_config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False,
+                                          n_layers=1)
+    draft = llama.init(draft_config, jax.random.PRNGKey(7))
+    got = decode.generate_speculative(
+        params, draft, tokens, config, draft_config, max_new_tokens=6, k=4,
+        kv_dtype="int8",
+    )
+    # int8 caches quantize both paths; vanilla fp greedy may legitimately
+    # differ, so compare against int8 vanilla instead
+    want_int8 = decode.generate(params, tokens, config, max_new_tokens=6,
+                                max_len=32, kv_dtype="int8")
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want_int8))
+
+
+def test_decode_block_step_matches_stepwise():
+    """One block dispatch == T sequential decode_steps (same cache math)."""
+    config, params, tokens = _setup(t=8)
+    b = tokens.shape[0]
+    prompt, block = tokens[:, :5], tokens[:, 5:]
+
+    cache1 = decode.init_kv_cache(config, b, 16, uniform=True)
+    _, cache1 = decode.prefill(params, prompt, cache1, config)
+    step_logits = []
+    for i in range(block.shape[1]):
+        lg, cache1 = decode.decode_step(params, block[:, i], cache1, config)
+        step_logits.append(lg)
+
+    cache2 = decode.init_kv_cache(config, b, 16, uniform=True)
+    _, cache2 = decode.prefill(params, prompt, cache2, config)
+    blk_logits, cache2 = decode.decode_block_step(params, block, cache2, config)
+    np.testing.assert_allclose(
+        np.asarray(blk_logits), np.stack([np.asarray(x) for x in step_logits], 1),
+        rtol=1e-4, atol=1e-4,
+    )
+    assert int(cache2["lengths"]) == int(cache1["lengths"]) == 8
+
+
+def test_speculative_rejects_batches_and_bad_k():
+    config, params, tokens = _setup(t=5)
+    try:
+        decode.generate_speculative(params, params, tokens, config, config, 4)
+    except ValueError as e:
+        assert "batch=1" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+    try:
+        decode.generate_speculative(params, params, tokens[:1], config, config, 4, k=1)
+    except ValueError as e:
+        assert "k must be" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
