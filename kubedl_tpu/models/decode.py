@@ -101,9 +101,9 @@ def _quantize_kv(x):
 
 def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
     """q [b,hq,tq,d] vs cache [b,hkv,L,d]; query t in row i attends cache
-    positions < its limit. `limits` is a scalar (one shared limit), [b]
-    (per-row limit, tq == 1), or [b, tq] (per-row per-query — the block
-    verify path, where query t may see t more positions than query 0).
+    positions < its limit. `limits` is [b] (per-row limit, tq == 1) or
+    [b, tq] (per-row per-query — the block verify path, where query t
+    may see t more positions than query 0).
 
     GQA runs as a grouped einsum (q reshaped to [b,hkv,g,tq,d]) instead
     of jnp.repeat-ing the cache — the cache read is the bandwidth bill
@@ -127,9 +127,7 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
     s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
     k_pos = jnp.arange(L)
     limits = jnp.asarray(limits)
-    if limits.ndim == 0:
-        lim = limits[None, None]  # -> [1, 1], shared by batch and queries
-    elif limits.ndim == 1:
+    if limits.ndim == 1:
         lim = limits[:, None]  # [b] -> per-row, tq must be 1
     else:
         lim = limits  # [b, tq]
@@ -452,6 +450,13 @@ def generate_speculative(
     if k < 2:
         raise ValueError(f"k must be >= 2 (got {k}); k=1 degenerates to "
                          "vanilla greedy with an extra draft pass")
+    if draft_config.vocab_size != config.vocab_size:
+        # JAX clamps out-of-range gathers, so a smaller draft vocab would
+        # not crash — it would silently floor acceptance to ~0
+        raise ValueError(
+            f"draft vocab {draft_config.vocab_size} != target vocab "
+            f"{config.vocab_size}; the models must share a tokenizer"
+        )
     max_len = t + max_new_tokens + k  # slack: final block may overshoot
 
     t_cache = init_kv_cache(config, 1, max_len, uniform=True, kv_dtype=kv_dtype)

@@ -135,17 +135,27 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         draft_config = llama.LlamaConfig.config_for(args.draft_model)
+        if draft_config.vocab_size != config.vocab_size:
+            print(f"error: --draft-model {args.draft_model} vocab "
+                  f"{draft_config.vocab_size} != target vocab "
+                  f"{config.vocab_size}; the models must share a tokenizer",
+                  file=sys.stderr)
+            return 2
         draft = None
         if args.draft_checkpoint_path:
             draft = restore_params(args.draft_checkpoint_path, "draft")
-            if draft is None and not args.allow_fresh_init:
-                # same policy as the target path: an empty draft dir means
-                # a missing mount — a silent random draft would just make
-                # speculation slower than vanilla with exit 0
-                print(f"error: no checkpoint under {args.draft_checkpoint_path} "
-                      f"(pass --allow-fresh-init for a random draft)",
-                      file=sys.stderr)
-                return 1
+            if draft is None:
+                if not args.allow_fresh_init:
+                    # same policy as the target path: an empty draft dir
+                    # means a missing mount — a silent random draft would
+                    # just make speculation slower than vanilla with exit 0
+                    print(f"error: no checkpoint under "
+                          f"{args.draft_checkpoint_path} "
+                          f"(pass --allow-fresh-init for a random draft)",
+                          file=sys.stderr)
+                    return 1
+                print(f"no checkpoint under {args.draft_checkpoint_path}; "
+                      f"using fresh draft init", flush=True)
         if draft is None:
             draft = llama.init(draft_config, jax.random.PRNGKey(args.seed + 3))
         if args.int8:

@@ -31,6 +31,16 @@ def parse_args(argv=None):
     p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
     p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default=os.environ.get("KUBEDL_LR_SCHEDULE", "constant"),
+                   help="cosine: warmup then cosine decay to 10%% of --lr "
+                        "over --steps")
+    p.add_argument("--warmup-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_WARMUP_STEPS", 0)),
+                   help="linear LR warmup steps (used by both schedules)")
+    p.add_argument("--grad-clip", type=float,
+                   default=float(os.environ.get("KUBEDL_GRAD_CLIP", 0.0)),
+                   help="clip gradients by global norm (0 = off)")
     p.add_argument("--accum-steps", type=int,
                    default=int(os.environ.get("KUBEDL_ACCUM_STEPS", 1)),
                    help="gradient accumulation micro-steps per update")
@@ -67,6 +77,9 @@ def parse_args(argv=None):
     if args.remat not in ("", "full", "dots", "none"):
         p.error(f"invalid KUBEDL_REMAT/--remat {args.remat!r} "
                 f"(choose from full, dots, none)")
+    if args.lr_schedule not in ("constant", "cosine"):
+        p.error(f"invalid KUBEDL_LR_SCHEDULE/--lr-schedule "
+                f"{args.lr_schedule!r} (choose from constant, cosine)")
     return args
 
 
@@ -118,7 +131,21 @@ def main(argv=None) -> int:
     def loss(params, batch):
         return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
 
-    tx = optax.adamw(args.lr, weight_decay=0.01)
+    if args.lr_schedule == "cosine":
+        # warmup -> cosine decay to 10% of peak over the run
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=max(args.warmup_steps, 1),
+            decay_steps=max(args.steps, args.warmup_steps + 1),
+            end_value=args.lr * 0.1,
+        )
+    elif args.warmup_steps > 0:
+        lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    else:
+        lr = args.lr
+    tx = optax.adamw(lr, weight_decay=0.01)
+    if args.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
     try:
         init_state, train_step = make_train_step(
             loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,

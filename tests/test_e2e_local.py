@@ -229,3 +229,30 @@ def test_generate_allow_fresh_init_round_trip(tmp_path):
                        capture_output=True, text=True, timeout=180)
     assert p.returncode == 0, p.stderr[-800:]
     assert "done: generated" in p.stdout
+
+
+def test_trainer_lr_schedule_resumes_from_checkpoint(tmp_path):
+    """Cosine schedule + warmup + grad clipping through the real trainer,
+    including an Orbax save -> resume cycle (the chained optimizer's
+    state tree must round-trip)."""
+    import subprocess
+
+    from conftest import CPU_ENV
+
+    env = dict(os.environ)
+    env.update(CPU_ENV)
+    ckpt = str(tmp_path / "ckpt")
+    base = [sys.executable, "-m", "kubedl_tpu.train.trainer",
+            "--model", "tiny", "--steps", "6", "--batch", "4",
+            "--seq-len", "33", "--lr-schedule", "cosine",
+            "--warmup-steps", "2", "--grad-clip", "1.0",
+            "--checkpoint-path", ckpt, "--checkpoint-interval", "2",
+            "--log-every", "2"]
+    p = subprocess.run(base, env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "done: 6 steps" in p.stdout, p.stdout
+    # resume: same flags, more steps — restores the chained opt state
+    base[base.index("--steps") + 1] = "8"
+    p = subprocess.run(base, env=env, capture_output=True, text=True, timeout=240)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "resumed" in p.stdout or "restored" in p.stdout, p.stdout
