@@ -424,9 +424,13 @@ def generate_speculative(
     max_new_tokens: int,
     k: int = 4,
     kv_dtype: Optional[str] = None,
+    return_stats: bool = False,
 ) -> jax.Array:
     """Greedy speculative decoding: [1, max_new_tokens], EXACTLY the
     target model's greedy continuation, produced in fewer target passes.
+    With return_stats=True, returns (tokens, {"rounds", "acceptance"})
+    — acceptance = mean accepted drafts per round / (k-1), the number to
+    watch when tuning k or judging a draft model.
 
     Each round a small draft model proposes k tokens one at a time; the
     target verifies all of them in ONE decode_block_step and keeps the
@@ -479,11 +483,11 @@ def generate_speculative(
         return d_cache, drafted[:, 0]  # [k]
 
     def cond(state):
-        _, n, _, _, _ = state
+        _, n, _, _, _, _ = state
         return n < max_new_tokens
 
     def round_body(state):
-        cur, n, out, t_cache, d_cache = state
+        cur, n, out, t_cache, d_cache, rounds = state
         pos = t_cache["lengths"]  # == d_cache["lengths"]
         d_cache, drafted = draft_round(d_cache, cur)  # [k]
         blk = jnp.concatenate([cur, drafted])[None]  # [1, k+1]
@@ -502,8 +506,21 @@ def generate_speculative(
         # roll both caches back to the accepted prefix (cur + a drafts)
         t_cache = dict(t_cache, lengths=pos + a + 1)
         d_cache = dict(d_cache, lengths=pos + a + 1)
-        return bonus[None], n + a + 1, out, t_cache, d_cache
+        return bonus[None], n + a + 1, out, t_cache, d_cache, rounds + 1
 
-    state = (cur, jnp.asarray(1, jnp.int32), out, t_cache, d_cache)
-    _, _, out, _, _ = jax.lax.while_loop(cond, round_body, state)
-    return out[:, :max_new_tokens]
+    state = (cur, jnp.asarray(1, jnp.int32), out, t_cache, d_cache,
+             jnp.asarray(0, jnp.int32))
+    _, n, out, _, _, rounds = jax.lax.while_loop(cond, round_body, state)
+    toks = out[:, :max_new_tokens]
+    if not return_stats:
+        return toks
+    # n-1 tokens were emitted by rounds (the first came from prefill);
+    # each round emits accepted+1, so mean accepted = (n-1)/rounds - 1.
+    # Zero rounds (max_new_tokens == 1: prefill alone suffices) reports
+    # acceptance 0 — there was nothing to accept.
+    r = jnp.maximum(rounds, 1).astype(jnp.float32)
+    mean_accepted = jnp.where(
+        rounds > 0, (n - 1).astype(jnp.float32) / r - 1.0, 0.0
+    )
+    stats = {"rounds": rounds, "acceptance": mean_accepted / (k - 1)}
+    return toks, stats

@@ -165,11 +165,14 @@ def main(argv=None) -> int:
         spec_gen = jax.jit(lambda p, dp, pr: decode.generate_speculative(
             p, dp, pr, config, draft_config,
             max_new_tokens=args.max_new_tokens, k=args.speculative_k,
-            kv_dtype=kv_dtype,
+            kv_dtype=kv_dtype, return_stats=True,
         ))
+        spec_stats = {}
 
         def gen(p, pr, key):
-            return spec_gen(p, draft, pr)
+            toks, stats = spec_gen(p, draft, pr)
+            spec_stats.update(stats)
+            return toks
     else:
         gen = jax.jit(lambda p, pr, key: decode.generate(
             p, pr, config,
@@ -189,6 +192,9 @@ def main(argv=None) -> int:
 
     total = args.batch * args.max_new_tokens
     print(f"sample[0,:8]={list(map(int, toks[0][:8]))}", flush=True)
+    if args.speculative_k:
+        print(f"speculative: rounds={int(spec_stats['rounds'])} "
+              f"acceptance={float(spec_stats['acceptance']):.2f}", flush=True)
     print(f"done: generated {args.batch}x{args.max_new_tokens} tokens in "
           f"{dt:.2f}s ({total / dt:.0f} tok/s, compile {compile_s:.1f}s)",
           flush=True)

@@ -305,3 +305,20 @@ def test_speculative_rejects_batches_and_bad_k():
         assert "k must be" in str(e)
     else:
         raise AssertionError("expected ValueError")
+
+
+def test_speculative_stats_acceptance_extremes():
+    """Perfect draft (= target) reaches acceptance 1.0; stats report the
+    rounds taken and the same tokens as stats-free calls."""
+    config, params, tokens = _setup(t=6)
+    tokens = tokens[:1]
+    plain = decode.generate_speculative(
+        params, params, tokens, config, config, max_new_tokens=8, k=3)
+    toks, stats = decode.generate_speculative(
+        params, params, tokens, config, config, max_new_tokens=8, k=3,
+        return_stats=True)
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(plain))
+    # perfect draft: every round accepts the k-1 cap -> acceptance 1.0,
+    # emitting k per round: 1 prefill token + ceil(7/3) rounds
+    assert float(stats["acceptance"]) == 1.0
+    assert int(stats["rounds"]) == 3
