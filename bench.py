@@ -353,6 +353,11 @@ def _tpu_child(results_path: str) -> int:
             # 16 GB, and recompute was costing ~35% (chip sweep: 0.68 MFU
             # at b8/s1024 remat=F vs 0.51 at b8/s2048 remat=T)
             "1b": llama.LlamaConfig.bench_1b(remat=False, max_seq_len=1024),
+            # top-2-of-4 experts on the 150m backbone: single-chip MoE
+            # compute proof (the expert axis itself is multichip-only,
+            # covered by the dryrun)
+            "moe": llama.LlamaConfig.bench_150m(
+                max_seq_len=seq, remat=False, n_experts=4, expert_top_k=2),
         }
         config = configs[config_name]
         rules = ShardingRules()
@@ -379,13 +384,24 @@ def _tpu_child(results_path: str) -> int:
         dt = time.perf_counter() - t0
         tok_s = steps * batch * seq / dt
         nparams = llama.param_count(state.params)
-        mfu = tok_s * 6 * nparams / peak_flops
+        if config.n_experts > 0:
+            # MFU over ACTIVE params: each token runs top_k of n_experts
+            # expert FFNs, so counting every expert would inflate FLOPs
+            expert = sum(
+                int(np.prod(l["moe"][w].shape))
+                for l in state.params["layers"] for w in ("w1", "w3", "w2")
+            )
+            active = nparams - expert * (1 - config.expert_top_k / config.n_experts)
+        else:
+            active = nparams
+        mfu = tok_s * 6 * active / peak_flops
         _emit(out, key, {
             f"llama_{config_name}_tokens_per_sec": round(tok_s, 0),
             f"llama_{config_name}_step_s": round(dt / steps, 3),
             f"llama_{config_name}_mfu": round(mfu, 4),
             f"llama_{config_name}_compile_s": round(compile_s, 1),
-            "params": nparams, "loss": round(float(metrics["loss"]), 3),
+            "params": nparams, "active_params": int(active),
+            "loss": round(float(metrics["loss"]), 3),
         })
         del state, params
         return mfu
@@ -428,6 +444,15 @@ def _tpu_child(results_path: str) -> int:
                                     "fallback": "llama_150m"})
     except Exception as e:  # noqa: BLE001
         _emit(out, "llama_1b", {"error": f"{type(e).__name__}: {e}"[:300]})
+    try:
+        if small:
+            _emit(out, "llama_moe", {"skipped": "KUBEDL_BENCH_SMALL set"})
+        elif left() > 180:
+            llama_milestone("moe", batch=8, seq=1024, steps=10, key="llama_moe")
+        else:
+            _emit(out, "llama_moe", {"skipped": f"budget exhausted ({left():.0f}s left)"})
+    except Exception as e:  # noqa: BLE001
+        _emit(out, "llama_moe", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     _emit(out, "done", {"budget_left_s": round(left(), 1)})
     out.close()

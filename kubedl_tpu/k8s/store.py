@@ -39,21 +39,7 @@ log = logging.getLogger("kubedl_tpu.k8s.store")
 # (k8s: strings like "500m"/"1Gi"), and resourceVersion is an int (k8s:
 # string). Translate at this edge so a REAL apiserver accepts our pods.
 
-_QUANTITY_SUFFIX = {
-    "n": 1e-9, "u": 1e-6, "m": 1e-3,
-    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
-    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
-}
-
-
-def _quantity_to_float(q) -> float:
-    if isinstance(q, (int, float)):
-        return float(q)
-    s = str(q).strip()
-    for suf in sorted(_QUANTITY_SUFFIX, key=len, reverse=True):
-        if s.endswith(suf):
-            return float(s[: -len(suf)]) * _QUANTITY_SUFFIX[suf]
-    return float(s)
+from kubedl_tpu.utils.serde import parse_quantity as _quantity_to_float
 
 
 def _float_to_quantity(v: float) -> str:

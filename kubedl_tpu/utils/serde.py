@@ -93,12 +93,38 @@ def _from(tp, data):
             kwargs[f.name] = _from(hints[f.name], v)
         return tp(**kwargs)
     if tp is float and isinstance(data, str):
-        # Timestamps arrive as RFC3339 in k8s-style manifests; internal
-        # representation is float epoch seconds (see api/meta.py).
-        import calendar
-        import time as _time
+        # Two kinds of strings land in float fields: RFC3339 timestamps
+        # (k8s metadata times -> float epoch seconds, see api/meta.py) and
+        # k8s resource quantities ("1", "500m", "1Gi" — YAML authors quote
+        # them routinely, and kubectl emits them quoted).
+        if "T" in data and data.endswith("Z"):
+            import calendar
+            import time as _time
 
-        return float(calendar.timegm(_time.strptime(data, "%Y-%m-%dT%H:%M:%SZ")))
+            return float(calendar.timegm(_time.strptime(data, "%Y-%m-%dT%H:%M:%SZ")))
+        return parse_quantity(data)
     if tp in (int, float, str, bool):
         return tp(data) if data is not None else None
     return data
+
+
+# Full k8s resource.Quantity suffix set (shared with k8s/store.py's
+# wire translation — one table, one parser).
+QUANTITY_SUFFIX = {
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+_SUFFIXES_BY_LEN = sorted(QUANTITY_SUFFIX, key=len, reverse=True)
+
+
+def parse_quantity(q) -> float:
+    """k8s resource quantity -> float ("500m" -> 0.5, "1Gi" -> 2**30,
+    "100n" -> 1e-7, "2" -> 2.0); ref resource.Quantity semantics."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    for suf in _SUFFIXES_BY_LEN:
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * QUANTITY_SUFFIX[suf]
+    return float(s)

@@ -64,3 +64,46 @@ def test_rfc3339_timestamp_accepted():
 
     m = from_dict(ObjectMeta, {"name": "x", "creationTimestamp": "2026-07-29T10:00:00Z"})
     assert isinstance(m.creation_timestamp, float) and m.creation_timestamp > 1.7e9
+
+
+def test_quoted_resource_quantities_parse():
+    """k8s authors quote quantities routinely ("1", "500m", "1Gi");
+    float fields must parse them instead of choking on a timestamp
+    format (regression: quoted google.com/tpu crashed from_dict)."""
+    from kubedl_tpu.api.pod import PodSpec
+    from kubedl_tpu.utils.serde import from_dict, parse_quantity
+
+    spec = from_dict(PodSpec, {
+        "containers": [{
+            "name": "c",
+            "resources": {"limits": {"google.com/tpu": "4",
+                                     "memory": "2Gi", "cpu": "500m"}},
+        }],
+    })
+    limits = spec.containers[0].resources.limits
+    assert limits["google.com/tpu"] == 4.0
+    assert limits["memory"] == 2 * 2**30
+    assert limits["cpu"] == 0.5
+    assert spec.tpu_chips() == 4
+    assert parse_quantity("1Ki") == 1024.0
+    assert parse_quantity(" 3 ") == 3.0
+
+
+def test_timestamps_still_parse_in_float_fields():
+    from kubedl_tpu.api.meta import ObjectMeta
+    from kubedl_tpu.utils.serde import from_dict
+
+    meta = from_dict(ObjectMeta, {
+        "name": "x", "creationTimestamp": "2026-01-02T03:04:05Z",
+    })
+    assert meta.creation_timestamp == 1767323045.0
+
+
+def test_full_quantity_suffix_set():
+    from kubedl_tpu.utils.serde import parse_quantity
+
+    assert abs(parse_quantity("100n") - 1e-7) < 1e-15
+    assert abs(parse_quantity("250u") - 2.5e-4) < 1e-12
+    assert parse_quantity("1E") == 1e18
+    assert parse_quantity("1Ei") == 2**60
+    assert parse_quantity(3) == 3.0
