@@ -129,11 +129,37 @@ def _tpu_child(results_path: str) -> int:
     def left():
         return deadline - time.monotonic()
 
-    # -- 1. probe: dial the tunnel with a tiny matmul -----------------------
+    # -- 1. probe: dial the tunnel with a tiny matmul. The dial can hang
+    # INDEFINITELY if the pool still holds a dead client's claim (a killed
+    # mid-compile client wedges the tunnel for hours, not minutes); a
+    # watchdog thread turns that into a fast, visible failure instead of
+    # silently eating the whole budget ------------------------------------
+    import threading
+
+    dial_budget = float(os.environ.get("KUBEDL_BENCH_DIAL_BUDGET", "300"))
+    probe_done = threading.Event()
+
+    def _dial_watchdog():
+        if probe_done.wait(dial_budget):
+            return
+        _emit(out, "probe", {
+            "error": f"tunnel dial exceeded {dial_budget:.0f}s — likely a "
+                     f"wedged pool claim; TPU milestones skipped"})
+        # Interrupt the blocked dial FIRST: KeyboardInterrupt lets the
+        # axon client unwind its claim; an abrupt kill here is the very
+        # thing that wedges the pool for hours (the failure this
+        # watchdog reports). Hard-exit only if the dial ignores it.
+        signal.raise_signal(signal.SIGINT)
+        if not probe_done.wait(30):
+            out.close()
+            os._exit(3)
+
+    threading.Thread(target=_dial_watchdog, daemon=True).start()
     t0 = time.perf_counter()
     dev = jax.devices()[0]
     x = jnp.ones((1024, 1024), jnp.bfloat16)
     float(jax.device_get(jnp.sum((x @ x).astype(jnp.float32))))
+    probe_done.set()
     _emit(out, "probe", {"device": str(dev), "dial_s": round(time.perf_counter() - t0, 2)})
 
     is_tpu = dev.platform != "cpu"

@@ -103,6 +103,15 @@ def _from(tp, data):
 
             return float(calendar.timegm(_time.strptime(data, "%Y-%m-%dT%H:%M:%SZ")))
         return parse_quantity(data)
+    if tp is bool and isinstance(data, str):
+        # bool("false") is True in Python — a quoted flag in a manifest
+        # must not silently invert
+        low = data.strip().lower()
+        if low in ("true", "1", "yes"):
+            return True
+        if low in ("false", "0", "no"):
+            return False
+        raise ValueError(f"invalid boolean string {data!r}")
     if tp in (int, float, str, bool):
         return tp(data) if data is not None else None
     return data

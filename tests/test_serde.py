@@ -107,3 +107,21 @@ def test_full_quantity_suffix_set():
     assert parse_quantity("1E") == 1e18
     assert parse_quantity("1Ei") == 2**60
     assert parse_quantity(3) == 3.0
+
+
+def test_quoted_bool_strings_do_not_invert():
+    import dataclasses
+
+    import pytest
+
+    from kubedl_tpu.utils.serde import from_dict
+
+    @dataclasses.dataclass
+    class X:
+        flag: bool = False
+
+    assert from_dict(X, {"flag": "false"}).flag is False
+    assert from_dict(X, {"flag": "True"}).flag is True
+    assert from_dict(X, {"flag": True}).flag is True
+    with pytest.raises(ValueError):
+        from_dict(X, {"flag": "maybe"})
