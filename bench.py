@@ -142,13 +142,19 @@ def _tpu_child(results_path: str) -> int:
     def _dial_watchdog():
         if probe_done.wait(dial_budget):
             return
+        if probe_done.is_set():  # completed exactly at the budget boundary
+            return
         _emit(out, "probe", {
             "error": f"tunnel dial exceeded {dial_budget:.0f}s — likely a "
                      f"wedged pool claim; TPU milestones skipped"})
-        # Interrupt the blocked dial FIRST: KeyboardInterrupt lets the
-        # axon client unwind its claim; an abrupt kill here is the very
-        # thing that wedges the pool for hours (the failure this
-        # watchdog reports). Hard-exit only if the dial ignores it.
+        # Try SIGINT first: it unwinds dials that periodically return to
+        # Python. A dial blocked inside a native wait never runs the
+        # handler, so the hard exit below is unavoidable then — which is
+        # acceptable: a client that never ATTACHED holds no pool claim
+        # (the hours-long wedge comes from killing an attached client
+        # mid-compile, not from abandoning a dial).
+        if probe_done.is_set():
+            return
         signal.raise_signal(signal.SIGINT)
         if not probe_done.wait(30):
             out.close()

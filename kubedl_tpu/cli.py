@@ -175,6 +175,32 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_top(args) -> int:
+    """kubectl-top-style view of the operator: TPU slice pool utilization
+    plus per-controller reconcile health (from /debug/vars)."""
+    vars_ = _client_request(args, "GET", "/debug/vars")
+    if vars_ is None:
+        return 1
+    pool = vars_.get("slice_pool")
+    if pool:
+        print(f"slice pool: {pool['chips_reserved']}/{pool['chips_total']} chips "
+              f"reserved ({pool['utilization']:.0%}), "
+              f"{pool['slices_reserved']}/{pool['slices_total']} slices")
+        rows = [("SLICE", "TYPE", "CHIPS", "RESERVED BY")]
+        for s in pool.get("slices", []):
+            rows.append((s["name"], s["type"], s.get("chips", ""),
+                         s.get("reserved_by") or "-"))
+        _print_table(rows)
+        print()
+    rows = [("CONTROLLER", "RECONCILES", "ERRORS", "REQUEUES", "QUEUE", "MEAN_MS")]
+    for name, c in sorted((vars_.get("controllers") or {}).items()):
+        rows.append((name, c.get("reconciles", 0), c.get("errors", 0),
+                     c.get("requeues", 0), c.get("queue_depth", ""),
+                     round(c.get("mean_seconds", 0.0) * 1e3, 2)))
+    _print_table(rows)
+    return 0
+
+
 def cmd_run(args) -> int:
     op = _mk_operator(args)
     op.register_all()
@@ -363,6 +389,9 @@ def main(argv=None) -> int:
 
     p_ev = client_parser("events", "list events in a namespace")
     p_ev.set_defaults(fn=cmd_events)
+
+    p_top = client_parser("top", "slice-pool utilization + controller health")
+    p_top.set_defaults(fn=cmd_top)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -196,6 +196,7 @@ class TPUSliceAdmitter(GangScheduler):
                     {
                         "name": s.name,
                         "type": s.type.name,
+                        "chips": s.type.chips,
                         "reserved_by": s.reserved_by or "",
                     }
                     for s in slices

@@ -179,16 +179,24 @@ def main(argv=None) -> int:
             start_step = int(state.step)
             print(f"restored checkpoint at step {start_step}", flush=True)
 
+    # interval saves are ASYNC: orbax's save() blocks only for the
+    # device->host copy (so the next step may donate the state buffers
+    # safely) and streams to disk in background — training overlaps the
+    # write. Only final saves (preemption, end of run) wait for
+    # durability. last-saved is tracked here, not via latest_step(),
+    # which lags while a save is in flight.
+    saved_step = {"v": mngr.latest_step() if mngr else None}
+
     def save(step, final=False):
         if mngr is None:
             return
-        if mngr.latest_step() == step:
-            return  # already saved by the interval hook
-        import orbax.checkpoint as ocp
+        if saved_step["v"] != step:  # else: interval hook already saved it
+            import orbax.checkpoint as ocp
 
-        mngr.save(step, args=ocp.args.StandardSave(state))
-        mngr.wait_until_finished()
+            mngr.save(step, args=ocp.args.StandardSave(state))
+            saved_step["v"] = step
         if final:
+            mngr.wait_until_finished()
             print(f"saved final checkpoint at step {step}", flush=True)
 
     # input pipeline: native mmap+prefetch loader over token shards, or

@@ -127,3 +127,22 @@ def test_client_commands_honor_bearer_token(tmp_path, capsys):
     finally:
         srv.stop()
         op.stop()
+
+
+def test_top_shows_pool_and_controllers(tmp_path, capsys):
+    op = Operator(OperatorConfig(tpu_slices=["v5e-8", "v5e-8"],
+                                 enable_gang_scheduling=True))
+    op.register_all()
+    op.start()
+    srv = OperatorHTTPServer(op, port=0)
+    port = srv.start()
+    try:
+        rc = cli_main(["top", "--server", f"http://127.0.0.1:{port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slice pool: 0/16 chips reserved (0%)" in out
+        assert "CONTROLLER" in out and "jaxjob-controller" in out
+        assert out.count("v5e-8") >= 2
+    finally:
+        srv.stop()
+        op.stop()
