@@ -134,38 +134,44 @@ def _tpu_child(results_path: str) -> int:
     # mid-compile client wedges the tunnel for hours, not minutes); a
     # watchdog thread turns that into a fast, visible failure instead of
     # silently eating the whole budget ------------------------------------
+    import queue
     import threading
 
     dial_budget = float(os.environ.get("KUBEDL_BENCH_DIAL_BUDGET", "300"))
-    probe_done = threading.Event()
 
-    def _dial_watchdog():
-        if probe_done.wait(dial_budget):
-            return
-        if probe_done.is_set():  # completed exactly at the budget boundary
-            return
+    # The dial runs in a daemon thread and the MAIN thread owns the
+    # timeout (queue.get is the single atomic hand-off — no signals, no
+    # set()/raise races). On timeout the child hard-exits, which is safe
+    # here: a client that never ATTACHED holds no pool claim (the
+    # hours-long wedge comes from killing an attached client mid-compile,
+    # not from abandoning a dial). The jax backend the dial thread
+    # initializes is process-global, so main-thread use afterwards is
+    # fine.
+    dial_q: "queue.Queue" = queue.Queue()
+
+    def _dial():
+        try:
+            d = jax.devices()[0]
+            x = jnp.ones((1024, 1024), jnp.bfloat16)
+            float(jax.device_get(jnp.sum((x @ x).astype(jnp.float32))))
+            dial_q.put(("ok", d))
+        except Exception as e:  # noqa: BLE001 — report, don't hang the parent
+            dial_q.put(("error", f"{type(e).__name__}: {e}"[:300]))
+
+    t0 = time.perf_counter()
+    threading.Thread(target=_dial, daemon=True).start()
+    try:
+        status, dev = dial_q.get(timeout=dial_budget)
+    except queue.Empty:
         _emit(out, "probe", {
             "error": f"tunnel dial exceeded {dial_budget:.0f}s — likely a "
                      f"wedged pool claim; TPU milestones skipped"})
-        # Try SIGINT first: it unwinds dials that periodically return to
-        # Python. A dial blocked inside a native wait never runs the
-        # handler, so the hard exit below is unavoidable then — which is
-        # acceptable: a client that never ATTACHED holds no pool claim
-        # (the hours-long wedge comes from killing an attached client
-        # mid-compile, not from abandoning a dial).
-        if probe_done.is_set():
-            return
-        signal.raise_signal(signal.SIGINT)
-        if not probe_done.wait(30):
-            out.close()
-            os._exit(3)
-
-    threading.Thread(target=_dial_watchdog, daemon=True).start()
-    t0 = time.perf_counter()
-    dev = jax.devices()[0]
-    x = jnp.ones((1024, 1024), jnp.bfloat16)
-    float(jax.device_get(jnp.sum((x @ x).astype(jnp.float32))))
-    probe_done.set()
+        out.close()
+        os._exit(3)
+    if status == "error":
+        _emit(out, "probe", {"error": dev})
+        out.close()
+        return 4
     _emit(out, "probe", {"device": str(dev), "dial_s": round(time.perf_counter() - t0, 2)})
 
     is_tpu = dev.platform != "cpu"

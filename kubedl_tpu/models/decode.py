@@ -286,6 +286,54 @@ def decode_block_step(
     return _lm_head(x, params, c), out_cache
 
 
+def prefill_chunked(
+    params: Dict,
+    tokens: jax.Array,  # [b, t] int32, uniform batches only
+    cache: Dict,
+    config: LlamaConfig,
+    chunk_size: int = 2048,
+) -> Tuple[jax.Array, Dict]:
+    """Incremental prefill: run the prompt through the cache in fixed
+    chunks of decode_block_step. The point is APPENDING to a non-empty
+    cache — multi-turn serving ingests each new user turn into the
+    session's cache without re-running earlier turns; projection/MLP
+    activations stay O(b * chunk * d).
+
+    Memory note: the block attention materializes O(chunk * cache_len)
+    f32 scores per layer, so for SINGLE-SHOT long prompts the one-pass
+    `prefill` (flash kernel, O(t) streaming scores) is the better tool;
+    this path trades that for cache-append ability and bounded
+    projection activations. Returns (last-token logits [b, vocab],
+    cache). Uniform caches only; the prompt length must be a multiple of
+    chunk_size or shorter than it."""
+    b, t = tokens.shape
+    if cache["lengths"].ndim != 0:
+        raise ValueError("prefill_chunked requires a uniform cache "
+                         "(init_kv_cache(..., uniform=True))")
+    if t <= chunk_size:
+        logits, cache = decode_block_step(params, tokens, cache, config)
+        return logits[:, -1], cache
+    if t % chunk_size:
+        raise ValueError(
+            f"prompt length {t} is not a multiple of chunk_size {chunk_size}; "
+            f"pad the prompt or pick a divisor"
+        )
+    # lax.scan over equal chunks: one compiled block step reused t/chunk
+    # times, not t/chunk separately-traced programs. Last-chunk logits
+    # ride in the carry — stacking per-chunk ys would allocate
+    # [n_chunks, b, vocab] only to keep one slice.
+    chunks = tokens.reshape(b, t // chunk_size, chunk_size).transpose(1, 0, 2)
+
+    def body(carry, chunk):
+        cache, _ = carry
+        logits, cache = decode_block_step(params, chunk, cache, config)
+        return (cache, logits[:, -1]), None
+
+    init = (cache, jnp.zeros((b, config.vocab_size), jnp.float32))
+    (cache, last), _ = jax.lax.scan(body, init, chunks)
+    return last, cache
+
+
 def prefill(
     params: Dict,
     tokens: jax.Array,  # [b, t] int32, right-padded when ragged

@@ -322,3 +322,55 @@ def test_speculative_stats_acceptance_extremes():
     # emitting k per round: 1 prefill token + ceil(7/3) rounds
     assert float(stats["acceptance"]) == 1.0
     assert int(stats["rounds"]) == 3
+
+
+def test_chunked_prefill_matches_one_pass():
+    """Chunked prefill (decode_block_step per chunk) must agree with the
+    one-pass prefill: same final logits, same cache contents."""
+    config, params, _ = _setup()
+    b, t, chunk = 2, 12, 4
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (b, t), 0, config.vocab_size)
+
+    c1 = decode.init_kv_cache(config, b, 16, uniform=True)
+    last1, c1 = decode.prefill(params, tokens, c1, config)
+    c2 = decode.init_kv_cache(config, b, 16, uniform=True)
+    last2, c2 = decode.prefill_chunked(params, tokens, c2, config, chunk_size=chunk)
+
+    np.testing.assert_allclose(np.asarray(last2), np.asarray(last1),
+                               rtol=1e-4, atol=1e-4)
+    assert int(c2["lengths"]) == t
+    for l1, l2 in zip(c1["k"], c2["k"]):
+        np.testing.assert_allclose(
+            np.asarray(l2[:, :, :t]), np.asarray(l1[:, :, :t]),
+            rtol=1e-4, atol=1e-4,
+        )
+    # decode continues identically from either cache
+    nxt = jnp.argmax(last1, axis=-1).astype(jnp.int32)
+    lg1, _ = decode.decode_step(params, nxt, c1, config)
+    lg2, _ = decode.decode_step(params, nxt, c2, config)
+    np.testing.assert_allclose(np.asarray(lg2), np.asarray(lg1),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_prefill_short_prompt_and_errors():
+    config, params, _ = _setup()
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 5), 0, config.vocab_size)
+    cache = decode.init_kv_cache(config, 2, 16, uniform=True)
+    last, cache = decode.prefill_chunked(params, tokens, cache, config,
+                                         chunk_size=8)
+    ref_cache = decode.init_kv_cache(config, 2, 16, uniform=True)
+    ref, _ = decode.prefill(params, tokens, ref_cache, config)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    import pytest
+
+    with pytest.raises(ValueError, match="multiple of chunk_size"):
+        decode.prefill_chunked(
+            params,
+            jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, 256),
+            decode.init_kv_cache(config, 2, 16, uniform=True),
+            config, chunk_size=4,
+        )
+    with pytest.raises(ValueError, match="uniform cache"):
+        decode.prefill_chunked(
+            params, tokens, decode.init_kv_cache(config, 2, 16), config)
