@@ -225,14 +225,16 @@ def decode_block_step(
     tokens: jax.Array,  # [b, T] int32 — T new tokens per row
     cache: Dict,
     config: LlamaConfig,
+    return_hidden: bool = False,
 ) -> Tuple[jax.Array, Dict]:
     """Chunked decode: T tokens forward through the cache in ONE dispatch.
 
-    Returns (logits [b, T, vocab], cache advanced by T). logits[:, i]
-    predicts the token AFTER tokens[:, i]. Query i attends the full
-    cache plus the block prefix up to itself (causal within the block).
-    Uniform (scalar-length) caches only — the speculative-verify and
-    chunked-prefill consumer paths are uniform by construction.
+    Returns (logits [b, T, vocab], cache advanced by T) — or, with
+    return_hidden=True, (pre-head activations [b, T, d], cache).
+    logits[:, i] predicts the token AFTER tokens[:, i]. Query i attends
+    the full cache plus the block prefix up to itself (causal within the
+    block). Uniform (scalar-length) caches only — the speculative-verify
+    and chunked-prefill consumer paths are uniform by construction.
 
     A caller that accepts fewer than T positions (speculative decoding)
     rolls back by shrinking cache["lengths"]: entries past the length
@@ -243,6 +245,16 @@ def decode_block_step(
     if pos.ndim != 0:
         raise ValueError("decode_block_step requires a uniform cache "
                          "(init_kv_cache(..., uniform=True))")
+    max_cap = cache["k"][0].shape[2]
+    if T > max_cap:
+        raise ValueError(f"block of {T} tokens exceeds cache max_len {max_cap}")
+    if not isinstance(pos, jax.core.Tracer) and int(pos) + T > max_cap:
+        # appending past capacity would CLAMP the write offset and
+        # silently corrupt earlier positions — the multi-turn footgun
+        raise ValueError(
+            f"cache holds {int(pos)} of {max_cap} positions; appending "
+            f"{T} more overflows it — init a larger max_len"
+        )
     int8_kv = "ks" in cache
     positions = jnp.broadcast_to((pos + jnp.arange(T, dtype=jnp.int32))[None], (b, T))
     limits = positions + 1  # query i sees cache < pos + i + 1
@@ -283,6 +295,11 @@ def decode_block_step(
     if int8_kv:
         out_cache["ks"] = new_ks
         out_cache["vs"] = new_vs
+    if return_hidden:
+        # pre-head activations for callers that only head a subset (the
+        # chunked prefill heads ONE row after its scan; the full
+        # [b, T, vocab] head matmul would dominate every chunk)
+        return x, out_cache
     return _lm_head(x, params, c), out_cache
 
 
@@ -303,35 +320,46 @@ def prefill_chunked(
     f32 scores per layer, so for SINGLE-SHOT long prompts the one-pass
     `prefill` (flash kernel, O(t) streaming scores) is the better tool;
     this path trades that for cache-append ability and bounded
-    projection activations. Returns (last-token logits [b, vocab],
-    cache). Uniform caches only; the prompt length must be a multiple of
-    chunk_size or shorter than it."""
+    projection activations. The LM head runs ONCE on the final hidden
+    row — chunks carry pre-head activations, never [chunk, vocab]
+    logits. Returns (last-token logits [b, vocab], cache). Uniform
+    caches only; a trailing partial chunk runs as one extra block step
+    (padding instead would bake pad tokens into attended cache state)."""
     b, t = tokens.shape
     if cache["lengths"].ndim != 0:
         raise ValueError("prefill_chunked requires a uniform cache "
                          "(init_kv_cache(..., uniform=True))")
-    if t <= chunk_size:
-        logits, cache = decode_block_step(params, tokens, cache, config)
-        return logits[:, -1], cache
-    if t % chunk_size:
+    # whole-append capacity check up front: inside the scan the length is
+    # a tracer and the per-block check cannot fire
+    max_cap = cache["k"][0].shape[2]
+    pos0 = cache["lengths"]
+    if not isinstance(pos0, jax.core.Tracer) and int(pos0) + t > max_cap:
         raise ValueError(
-            f"prompt length {t} is not a multiple of chunk_size {chunk_size}; "
-            f"pad the prompt or pick a divisor"
+            f"cache holds {int(pos0)} of {max_cap} positions; appending "
+            f"{t} more overflows it — init a larger max_len"
         )
-    # lax.scan over equal chunks: one compiled block step reused t/chunk
-    # times, not t/chunk separately-traced programs. Last-chunk logits
-    # ride in the carry — stacking per-chunk ys would allocate
-    # [n_chunks, b, vocab] only to keep one slice.
-    chunks = tokens.reshape(b, t // chunk_size, chunk_size).transpose(1, 0, 2)
+    n_full = t // chunk_size
+    rem = t - n_full * chunk_size
+    x_last = None
+    if n_full:
+        # lax.scan over equal chunks: one compiled block step reused
+        # n_full times, not n_full separately-traced programs
+        chunks = tokens[:, : n_full * chunk_size].reshape(
+            b, n_full, chunk_size).transpose(1, 0, 2)
 
-    def body(carry, chunk):
-        cache, _ = carry
-        logits, cache = decode_block_step(params, chunk, cache, config)
-        return (cache, logits[:, -1]), None
+        def body(carry, chunk):
+            cache, _ = carry
+            x, cache = decode_block_step(params, chunk, cache, config,
+                                         return_hidden=True)
+            return (cache, x[:, -1]), None
 
-    init = (cache, jnp.zeros((b, config.vocab_size), jnp.float32))
-    (cache, last), _ = jax.lax.scan(body, init, chunks)
-    return last, cache
+        init = (cache, jnp.zeros((b, config.d_model), config.dtype))
+        (cache, x_last), _ = jax.lax.scan(body, init, chunks)
+    if rem:
+        x, cache = decode_block_step(params, tokens[:, n_full * chunk_size:],
+                                     cache, config, return_hidden=True)
+        x_last = x[:, -1]
+    return _lm_head(x_last[:, None], params, config)[:, 0], cache
 
 
 def prefill(

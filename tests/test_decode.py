@@ -364,13 +364,49 @@ def test_chunked_prefill_short_prompt_and_errors():
                                rtol=1e-4, atol=1e-4)
     import pytest
 
-    with pytest.raises(ValueError, match="multiple of chunk_size"):
-        decode.prefill_chunked(
-            params,
-            jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, 256),
-            decode.init_kv_cache(config, 2, 16, uniform=True),
-            config, chunk_size=4,
-        )
+    # non-multiple lengths run the trailing partial chunk as one extra
+    # block step (padding would bake pad tokens into the cache)
+    odd = jax.random.randint(jax.random.PRNGKey(7), (2, 10), 0, config.vocab_size)
+    c_odd = decode.init_kv_cache(config, 2, 16, uniform=True)
+    last_odd, c_odd = decode.prefill_chunked(params, odd, c_odd, config,
+                                             chunk_size=4)
+    ref_odd, _ = decode.prefill(
+        params, odd, decode.init_kv_cache(config, 2, 16, uniform=True), config)
+    np.testing.assert_allclose(np.asarray(last_odd), np.asarray(ref_odd),
+                               rtol=1e-4, atol=1e-4)
+    assert int(c_odd["lengths"]) == 10
+
     with pytest.raises(ValueError, match="uniform cache"):
         decode.prefill_chunked(
             params, tokens, decode.init_kv_cache(config, 2, 16), config)
+    # appending past cache capacity is a loud error, not silent corruption
+    with pytest.raises(ValueError, match="overflows"):
+        decode.prefill_chunked(params, odd, c_odd, config, chunk_size=4)
+
+
+def test_chunked_prefill_appends_to_existing_cache():
+    """The multi-turn use: ingest turn 2 into a cache already holding
+    turn 1; logits and cache must match one-pass prefill over the
+    concatenated turns."""
+    config, params, _ = _setup()
+    b = 2
+    turn1 = jax.random.randint(jax.random.PRNGKey(8), (b, 6), 0, config.vocab_size)
+    turn2 = jax.random.randint(jax.random.PRNGKey(9), (b, 4), 0, config.vocab_size)
+
+    ref_cache = decode.init_kv_cache(config, b, 16, uniform=True)
+    ref_last, ref_cache = decode.prefill(
+        params, jnp.concatenate([turn1, turn2], axis=1), ref_cache, config)
+
+    cache = decode.init_kv_cache(config, b, 16, uniform=True)
+    _, cache = decode.prefill(params, turn1, cache, config)
+    last, cache = decode.prefill_chunked(params, turn2, cache, config,
+                                         chunk_size=2)
+
+    np.testing.assert_allclose(np.asarray(last), np.asarray(ref_last),
+                               rtol=1e-4, atol=1e-4)
+    assert int(cache["lengths"]) == 10
+    nxt = jnp.argmax(ref_last, axis=-1).astype(jnp.int32)
+    lg_ref, _ = decode.decode_step(params, nxt, ref_cache, config)
+    lg, _ = decode.decode_step(params, nxt, cache, config)
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(lg_ref),
+                               rtol=1e-4, atol=1e-4)
