@@ -501,9 +501,16 @@ def generate_speculative(
     k: int = 4,
     kv_dtype: Optional[str] = None,
     return_stats: bool = False,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy speculative decoding: [1, max_new_tokens], EXACTLY the
-    target model's greedy continuation, produced in fewer target passes.
+    """Speculative decoding: [1, max_new_tokens] from the target model's
+    distribution, produced in fewer target passes. temperature=0 (the
+    default) is greedy and emits EXACTLY the target's greedy
+    continuation; temperature>0 samples with the standard rejection
+    scheme — accept draft token x with prob min(1, p(x)/q(x)), else
+    resample from the residual normalize(max(p-q, 0)) — which preserves
+    the target distribution exactly (Leviathan et al.'s identity).
     With return_stats=True, returns (tokens, {"rounds", "acceptance"})
     — acceptance = mean accepted drafts per round / (k-1), the number to
     watch when tuning k or judging a draft model.
@@ -518,12 +525,16 @@ def generate_speculative(
     uniform fast path); larger batches diverge per row and are not
     supported.
 
-    Exactness: every emitted token is the target's argmax given the
-    previously emitted prefix — a mismatched draft only costs speed.
-    (Logits come from the block verify, whose reductions may order
-    differently than single-token steps; near-exact ties in the target
-    distribution can therefore resolve differently than vanilla
-    generate(), as between any two compiled schedules.)"""
+    Exactness (temperature=0): every emitted token is the target's
+    argmax given the previously emitted prefix — a mismatched draft only
+    costs speed. At temperature>0 the guarantee is distributional: the
+    emitted sequence is a sample from the target's own sampling
+    distribution (pinned by a statistical test against exact
+    enumeration). Either way, logits come from the block verify, whose
+    reductions may order differently than single-token steps; greedy
+    near-ties can resolve differently than vanilla generate(), and
+    sampled probabilities can differ in the last ulps, as between any
+    two compiled schedules."""
     b, t = prompt.shape
     if b != 1:
         raise ValueError(f"speculative decoding is batch=1 (got batch {b})")
@@ -539,41 +550,82 @@ def generate_speculative(
         )
     max_len = t + max_new_tokens + k  # slack: final block may overshoot
 
+    sampled = temperature > 0
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
     t_cache = init_kv_cache(config, 1, max_len, uniform=True, kv_dtype=kv_dtype)
     logits, t_cache = prefill(params, prompt, t_cache, config)
     d_cache = init_kv_cache(draft_config, 1, max_len, uniform=True,
                             kv_dtype=kv_dtype)
     _, d_cache = prefill(draft_params, prompt, d_cache, draft_config)
 
-    cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [1] — first token
+    key, k0 = jax.random.split(key)
+    if sampled:
+        cur = jax.random.categorical(k0, logits / temperature, axis=-1)
+        cur = cur.astype(jnp.int32)  # [1] — first token
+    else:
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     out = jnp.zeros((1, max_new_tokens + k), jnp.int32)
     out = jax.lax.dynamic_update_slice(out, cur[None], (0, 0))
 
-    def draft_round(d_cache, cur):
-        def body(carry, _):
+    def draft_round(d_cache, cur, rkey):
+        """Greedy: (cache, drafted [k]). Sampled: also each step's full
+        draft distribution q [k, V] (the rejection test needs q(x) and
+        the residual needs the whole q)."""
+        def body(carry, kk):
             tok, cache = carry
             lg, cache = decode_step(draft_params, tok, cache, draft_config)
+            if sampled:
+                nxt = jax.random.categorical(kk, lg / temperature, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                q = jax.nn.softmax(lg[0] / temperature)
+                return (nxt, cache), (nxt[0], q)
             nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
-            return (nxt, cache), nxt
-        (_, d_cache), drafted = jax.lax.scan(body, (cur, d_cache), None, length=k)
-        return d_cache, drafted[:, 0]  # [k]
+            return (nxt, cache), (nxt[0], jnp.zeros((), jnp.float32))
+        keys = jax.random.split(rkey, k)
+        (_, d_cache), (drafted, q) = jax.lax.scan(body, (cur, d_cache), keys)
+        return d_cache, drafted, q
 
     def cond(state):
-        _, n, _, _, _, _ = state
+        _, n, _, _, _, _, _ = state
         return n < max_new_tokens
 
     def round_body(state):
-        cur, n, out, t_cache, d_cache, rounds = state
+        cur, n, out, t_cache, d_cache, rounds, key = state
+        key, kd, ka, kf = jax.random.split(key, 4)
         pos = t_cache["lengths"]  # == d_cache["lengths"]
-        d_cache, drafted = draft_round(d_cache, cur)  # [k]
+        d_cache, drafted, q = draft_round(d_cache, cur, kd)  # [k], [k, V]
         blk = jnp.concatenate([cur, drafted])[None]  # [1, k+1]
         blk_logits, t_cache = decode_block_step(params, blk, t_cache, config)
-        ta = jnp.argmax(blk_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
-        # longest matching prefix of the drafts, capped at k-1 (see doc)
-        matches = (drafted[: k - 1] == ta[: k - 1]).astype(jnp.int32)
-        a = jnp.sum(jnp.cumprod(matches))
-        bonus = jax.lax.dynamic_index_in_dim(ta, a, keepdims=False)
-        # emit drafted[:a] then bonus at slot a; tail junk is overwritten
+        if sampled:
+            p = jax.nn.softmax(blk_logits[0] / temperature)  # [k+1, V]
+            # accept draft i (i < k-1 cap) with prob min(1, p_i(x)/q_i(x))
+            px = jnp.take_along_axis(
+                p[: k - 1], drafted[: k - 1, None], axis=1)[:, 0]
+            qx = jnp.take_along_axis(
+                q[: k - 1], drafted[: k - 1, None], axis=1)[:, 0]
+            u = jax.random.uniform(ka, (k - 1,))
+            accept = (u * qx < px).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(accept))
+            # the token at slot a: residual max(p_a - q_a, 0) after a
+            # rejection; plain p_a after full acceptance (a == k-1, the
+            # capped slot whose draft was never tested)
+            p_a = p[a]
+            residual = jnp.maximum(p_a - q[a], 0.0)
+            rs = jnp.sum(residual)
+            final_dist = jnp.where(
+                (a == k - 1) | (rs <= 0), p_a, residual / jnp.maximum(rs, 1e-30)
+            )
+            bonus = jax.random.categorical(kf, jnp.log(final_dist))
+            bonus = bonus.astype(jnp.int32)
+        else:
+            ta = jnp.argmax(blk_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+            # longest matching prefix of the drafts, capped at k-1 (see doc)
+            matches = (drafted[: k - 1] == ta[: k - 1]).astype(jnp.int32)
+            a = jnp.sum(jnp.cumprod(matches))
+            bonus = jax.lax.dynamic_index_in_dim(ta, a, keepdims=False)
+        # emit drafted[:a] then the slot-a token; tail junk is overwritten
         # by later rounds and trimmed at the end
         slots = jnp.arange(k)
         emit = jnp.where(slots < a, drafted, 0)
@@ -582,11 +634,11 @@ def generate_speculative(
         # roll both caches back to the accepted prefix (cur + a drafts)
         t_cache = dict(t_cache, lengths=pos + a + 1)
         d_cache = dict(d_cache, lengths=pos + a + 1)
-        return bonus[None], n + a + 1, out, t_cache, d_cache, rounds + 1
+        return bonus[None], n + a + 1, out, t_cache, d_cache, rounds + 1, key
 
     state = (cur, jnp.asarray(1, jnp.int32), out, t_cache, d_cache,
-             jnp.asarray(0, jnp.int32))
-    _, n, out, _, _, rounds = jax.lax.while_loop(cond, round_body, state)
+             jnp.asarray(0, jnp.int32), key)
+    _, n, out, _, _, rounds, _ = jax.lax.while_loop(cond, round_body, state)
     toks = out[:, :max_new_tokens]
     if not return_stats:
         return toks

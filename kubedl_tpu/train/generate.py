@@ -42,9 +42,12 @@ def parse_args(argv=None):
                         "traffic at long contexts; per-position scales fold "
                         "exactly into the attention einsums")
     p.add_argument("--speculative-k", type=int, default=0,
-                   help="greedy speculative decoding: a draft model proposes "
-                        "K tokens per target verify pass (batch must be 1; "
-                        "output is exactly the target's greedy continuation)")
+                   help="speculative decoding: a draft model proposes K "
+                        "tokens per target verify pass (batch must be 1). "
+                        "At --temperature 0 the output is exactly the "
+                        "target's greedy continuation; with temperature>0 "
+                        "rejection sampling preserves the target's sampling "
+                        "distribution")
     p.add_argument("--draft-model", default="tiny",
                    choices=["tiny", "bench-150m", "bench-1b", "llama-7b"],
                    help="draft model config for --speculative-k")
@@ -130,10 +133,6 @@ def main(argv=None) -> int:
         if args.batch != 1:
             print("error: --speculative-k requires --batch 1", file=sys.stderr)
             return 2
-        if args.temperature > 0:
-            print("error: --speculative-k is greedy (temperature 0)",
-                  file=sys.stderr)
-            return 2
         draft_config = llama.LlamaConfig.config_for(args.draft_model)
         if draft_config.vocab_size != config.vocab_size:
             print(f"error: --draft-model {args.draft_model} vocab "
@@ -162,15 +161,16 @@ def main(argv=None) -> int:
             from kubedl_tpu.models import quant
 
             draft = jax.jit(quant.quantize_params)(draft)
-        spec_gen = jax.jit(lambda p, dp, pr: decode.generate_speculative(
+        spec_gen = jax.jit(lambda p, dp, pr, kk: decode.generate_speculative(
             p, dp, pr, config, draft_config,
             max_new_tokens=args.max_new_tokens, k=args.speculative_k,
             kv_dtype=kv_dtype, return_stats=True,
+            temperature=args.temperature, key=kk,
         ))
         spec_stats = {}
 
         def gen(p, pr, key):
-            toks, stats = spec_gen(p, draft, pr)
+            toks, stats = spec_gen(p, draft, pr, key)
             spec_stats.update(stats)
             return toks
     else:
