@@ -175,7 +175,29 @@ def _tpu_child(results_path: str) -> int:
     _emit(out, "probe", {"device": str(dev), "dial_s": round(time.perf_counter() - t0, 2)})
 
     is_tpu = dev.platform != "cpu"
-    peak_flops = 197e12 if is_tpu else 1e12  # v5e bf16 peak per chip
+    # bf16 peak per chip by device kind — MFU must not assume v5e if the
+    # pool hands out a different generation; unknown kinds are flagged in
+    # the record so an off-generation MFU is visibly suspect
+    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
+    known = True
+    if not is_tpu:
+        peak_flops = 1e12
+    elif "v6" in kind or "trillium" in kind:
+        peak_flops = 918e12
+    elif "v5p" in kind:
+        peak_flops = 459e12
+    elif "v4" in kind:
+        peak_flops = 275e12
+    elif "v3" in kind:
+        peak_flops = 123e12
+    elif "v5lite" in kind or "v5e" in kind:
+        peak_flops = 197e12
+    else:
+        peak_flops = 197e12  # fallback; MFU numbers are suspect
+        known = False
+    _emit(out, "peak", {"device_kind": kind or "cpu",
+                        "peak_tflops": peak_flops / 1e12,
+                        "kind_known": known})
     small = bool(os.environ.get("KUBEDL_BENCH_SMALL"))  # CPU smoke shapes
 
     # -- 2. flash attention: numeric check + timing on the chip -------------
