@@ -98,6 +98,10 @@ def _print_table(rows) -> None:
 
 def cmd_get(args) -> int:
     if args.name:
+        if getattr(args, "watch", False):
+            print("error: -w/--watch applies to the list form "
+                  f"(kubedl-tpu get {args.kind} -w)", file=sys.stderr)
+            return 2
         obj = _client_request(
             args, "GET", f"/apis/{args.kind}/{args.namespace}/{args.name}"
         )
@@ -105,17 +109,60 @@ def cmd_get(args) -> int:
             return 1
         print(json.dumps(obj, indent=2, default=str))
         return 0
-    listing = _client_request(args, "GET", f"/apis/{args.kind}")
-    if listing is None:
+
+    def snapshot():
+        listing = _client_request(args, "GET", f"/apis/{args.kind}")
+        if listing is None:
+            return None
+        rows = []
+        for item in listing.get("items", []):
+            meta = item.get("metadata") or {}
+            if not args.all_namespaces and meta.get("namespace") != args.namespace:
+                continue
+            rows.append((meta.get("namespace", ""), meta.get("name", ""),
+                         _job_phase(item.get("status"))))
+        return rows
+
+    rows = snapshot()
+    if rows is None:
         return 1
-    rows = [("NAMESPACE", "NAME", "STATUS")]
-    for item in listing.get("items", []):
-        meta = item.get("metadata") or {}
-        if not args.all_namespaces and meta.get("namespace") != args.namespace:
-            continue
-        rows.append((meta.get("namespace", ""), meta.get("name", ""),
-                     _job_phase(item.get("status"))))
-    _print_table(rows)
+    header = ("NAMESPACE", "NAME", "STATUS")
+    table = [header] + rows
+    _print_table(table)
+    if not getattr(args, "watch", False):
+        return 0
+    # kubectl -w: poll and print only rows whose status changed (or that
+    # appeared), keeping the initial table's column alignment. Transient
+    # request failures are retried a few times before giving up.
+    # KUBEDL_WATCH_MAX bounds the loop for tests; default runs until
+    # interrupted.
+    widths = [max(len(str(r[i])) for r in table) + 2 for i in range(3)]
+
+    def print_row(r):
+        print("".join(str(c).ljust(widths[i]) for i, c in enumerate(r)).rstrip())
+
+    seen = dict(((ns, name), st) for ns, name, st in rows)
+    max_polls = int(os.environ.get("KUBEDL_WATCH_MAX", "0"))
+    polls = failures = 0
+    try:
+        while not max_polls or polls < max_polls:
+            time.sleep(float(os.environ.get("KUBEDL_WATCH_INTERVAL", "2")))
+            polls += 1
+            rows = snapshot()
+            if rows is None:
+                failures += 1
+                if failures >= 3:
+                    print("error: watch lost the server (3 consecutive "
+                          "failures)", file=sys.stderr)
+                    return 1
+                continue
+            failures = 0
+            for ns, name, st in rows:
+                if seen.get((ns, name)) != st:
+                    seen[(ns, name)] = st
+                    print_row((ns, name, st))
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -370,6 +417,8 @@ def main(argv=None) -> int:
     p_get.add_argument("kind")
     p_get.add_argument("name", nargs="?", default="")
     p_get.add_argument("-A", "--all-namespaces", action="store_true")
+    p_get.add_argument("-w", "--watch", action="store_true",
+                       help="poll and print status changes until interrupted")
     p_get.set_defaults(fn=cmd_get)
 
     p_apply = client_parser("apply", "submit manifests to the operator")

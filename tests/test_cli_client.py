@@ -146,3 +146,49 @@ def test_top_shows_pool_and_controllers(tmp_path, capsys):
     finally:
         srv.stop()
         op.stop()
+
+
+def test_get_watch_prints_status_changes(server, tmp_path, capsys, monkeypatch):
+    """get -w polls and prints rows whose status changed. Deterministic:
+    the pod blocks on a gate file, so the initial snapshot sees the job
+    un-Succeeded; releasing the gate mid-watch produces the transition."""
+    import threading
+
+    op, url = server
+    gate = tmp_path / "gate"
+    path = tmp_path / "job.yaml"
+    path.write_text(f"""
+apiVersion: kubedl-tpu.io/v1alpha1
+kind: JAXJob
+metadata:
+  name: watch-job
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: ExitCode
+      template:
+        spec:
+          containers:
+            - name: jax
+              command: [{sys.executable}, -c, "import os,sys,time\\nfor _ in range(600):\\n  time.sleep(0.1)\\n  if os.path.exists({str(gate)!r}): sys.exit(0)\\nsys.exit(1)"]
+              env:
+                JAX_PLATFORMS: cpu
+""")
+    assert cli_main(["apply", "--server", url, "-f", str(path)]) == 0
+    job = op.get_job("JAXJob", "default", "watch-job")
+    assert op.wait_for_condition(job, "Running", timeout=60)
+    threading.Timer(1.5, lambda: gate.write_text("go")).start()
+    monkeypatch.setenv("KUBEDL_WATCH_MAX", "16")
+    monkeypatch.setenv("KUBEDL_WATCH_INTERVAL", "0.5")
+    capsys.readouterr()
+    rc = cli_main(["get", "jaxjob", "--server", url, "-w"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # initial table row (Running) + the Succeeded transition row
+    assert out.count("watch-job") >= 2, out
+    assert "Succeeded" in out
+
+    # named-object watch is a clear error, not a silent one-shot
+    assert cli_main(["get", "jaxjob", "watch-job", "--server", url, "-w"]) == 2
+    assert "list form" in capsys.readouterr().err
