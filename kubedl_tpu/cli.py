@@ -88,12 +88,19 @@ def _job_phase(status) -> str:
     return "Pending"
 
 
-def _print_table(rows) -> None:
+def _format_row(row, widths) -> str:
+    return "".join(str(c).ljust(widths[i]) for i, c in enumerate(row)).rstrip()
+
+
+def _print_table(rows):
+    """Print aligned rows; returns the column widths so continuation rows
+    (watch mode) can keep the alignment."""
     if not rows:
-        return
+        return []
     widths = [max(len(str(r[i])) for r in rows) + 2 for i in range(len(rows[0]))]
     for r in rows:
-        print("".join(str(c).ljust(widths[i]) for i, c in enumerate(r)).rstrip())
+        print(_format_row(r, widths), flush=True)
+    return widths
 
 
 def cmd_get(args) -> int:
@@ -127,20 +134,14 @@ def cmd_get(args) -> int:
     if rows is None:
         return 1
     header = ("NAMESPACE", "NAME", "STATUS")
-    table = [header] + rows
-    _print_table(table)
+    widths = _print_table([header] + rows)
     if not getattr(args, "watch", False):
         return 0
-    # kubectl -w: poll and print only rows whose status changed (or that
-    # appeared), keeping the initial table's column alignment. Transient
-    # request failures are retried a few times before giving up.
-    # KUBEDL_WATCH_MAX bounds the loop for tests; default runs until
-    # interrupted.
-    widths = [max(len(str(r[i])) for r in table) + 2 for i in range(3)]
-
-    def print_row(r):
-        print("".join(str(c).ljust(widths[i]) for i, c in enumerate(r)).rstrip())
-
+    # kubectl -w: poll and print rows whose status changed, appeared, or
+    # were deleted, keeping the initial table's column alignment; each
+    # row flushes so piped output streams. Transient request failures
+    # are retried a few times before giving up. KUBEDL_WATCH_MAX bounds
+    # the loop for tests; default runs until interrupted.
     seen = dict(((ns, name), st) for ns, name, st in rows)
     max_polls = int(os.environ.get("KUBEDL_WATCH_MAX", "0"))
     polls = failures = 0
@@ -157,10 +158,16 @@ def cmd_get(args) -> int:
                     return 1
                 continue
             failures = 0
+            current = set()
             for ns, name, st in rows:
+                current.add((ns, name))
                 if seen.get((ns, name)) != st:
                     seen[(ns, name)] = st
-                    print_row((ns, name, st))
+                    print(_format_row((ns, name, st), widths), flush=True)
+            for key in sorted(set(seen) - current):
+                del seen[key]
+                print(_format_row((key[0], key[1], "Deleted"), widths),
+                      flush=True)
     except KeyboardInterrupt:
         pass
     return 0

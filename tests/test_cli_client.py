@@ -192,3 +192,22 @@ spec:
     # named-object watch is a clear error, not a silent one-shot
     assert cli_main(["get", "jaxjob", "watch-job", "--server", url, "-w"]) == 2
     assert "list form" in capsys.readouterr().err
+
+
+def test_get_watch_reports_deletion(server, tmp_path, capsys, monkeypatch):
+    import threading
+
+    op, url = server
+    assert cli_main(["apply", "--server", url,
+                     "-f", _manifest_file(tmp_path, "del-job")]) == 0
+    job = op.get_job("JAXJob", "default", "del-job")
+    assert op.wait_for_condition(job, "Succeeded", timeout=60)
+    threading.Timer(
+        1.0, lambda: cli_main(["delete", "jaxjob", "del-job", "--server", url])
+    ).start()
+    monkeypatch.setenv("KUBEDL_WATCH_MAX", "10")
+    monkeypatch.setenv("KUBEDL_WATCH_INTERVAL", "0.5")
+    capsys.readouterr()
+    assert cli_main(["get", "jaxjob", "--server", url, "-w"]) == 0
+    out = capsys.readouterr().out
+    assert "Deleted" in out, out
