@@ -52,8 +52,8 @@ def test_job_runs_to_succeeded():
     op = make_operator()
     try:
         job = op.apply(job_manifest())
-        assert op.wait_for_condition(job, "Running", timeout=10)
-        assert op.wait_for_condition(job, "Succeeded", timeout=15)
+        assert op.wait_for_condition(job, "Running", timeout=30)
+        assert op.wait_for_condition(job, "Succeeded", timeout=45)
         status = op.get_job(TEST_KIND, "default", "e2e-job").status
         assert status.replica_statuses["Worker"].succeeded == 2
         # launch-delay metrics were observed
