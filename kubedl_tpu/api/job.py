@@ -9,7 +9,7 @@ declared via dataclass field metadata.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict
 
 from kubedl_tpu.api.common import JobStatus, ReplicaSpec, RunPolicy
 from kubedl_tpu.api.meta import ObjectMeta

@@ -18,7 +18,7 @@ import time
 
 import yaml
 
-from kubedl_tpu.api.common import JobConditionType, has_condition, is_failed, is_succeeded
+from kubedl_tpu.api.common import is_failed, is_succeeded
 from kubedl_tpu.api.validation import ValidationError, validate as api_validate
 from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH
 from kubedl_tpu.core.store import NotFound

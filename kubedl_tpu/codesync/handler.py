@@ -14,7 +14,7 @@ import copy
 import json
 import posixpath
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Tuple
 
 from kubedl_tpu.api.common import ANNOTATION_GIT_SYNC_CONFIG
 from kubedl_tpu.api.pod import Container, Volume, VolumeMount

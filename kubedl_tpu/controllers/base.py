@@ -16,7 +16,7 @@ logic is one machine with four knobs, so here it is written once:
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kubedl_tpu.api.common import (
     CleanPodPolicy,

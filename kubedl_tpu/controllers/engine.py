@@ -60,7 +60,7 @@ from kubedl_tpu.controllers.interface import WorkloadController
 from kubedl_tpu.core import events as ev
 from kubedl_tpu.core.expectations import ControllerExpectations
 from kubedl_tpu.core.manager import ControllerRunner, Result
-from kubedl_tpu.core.store import ADDED, DELETED, MODIFIED, AlreadyExists, Conflict, NotFound, ObjectStore
+from kubedl_tpu.core.store import ADDED, DELETED, AlreadyExists, Conflict, NotFound, ObjectStore
 from kubedl_tpu.utils.exit_codes import is_retryable_exit_code
 from kubedl_tpu.utils.joblog import job_logger
 

@@ -10,11 +10,9 @@ PyTorch Master" special case (ref pkg/job_controller/job.go:223-227) becomes
 from __future__ import annotations
 
 import abc
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kubedl_tpu.api.common import JobStatus, ReplicaSpec, ReplicaType
-from kubedl_tpu.api.job import BaseJob
-from kubedl_tpu.api.pod import Pod
 
 
 class WorkloadController(abc.ABC):

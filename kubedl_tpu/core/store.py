@@ -13,8 +13,8 @@ from __future__ import annotations
 import copy
 import queue
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 from kubedl_tpu.api.meta import new_uid, now
 

@@ -35,7 +35,7 @@ from kubedl_tpu.api.pod import (
     PodPhase,
     PodRestartPolicy,
 )
-from kubedl_tpu.core.store import ADDED, DELETED, MODIFIED, Conflict, NotFound, ObjectStore
+from kubedl_tpu.core.store import ADDED, DELETED, Conflict, NotFound, ObjectStore
 
 log = logging.getLogger("kubedl_tpu.executor")
 

@@ -12,9 +12,8 @@ placement the JAXJob controller uses for the context-parallel mesh axis.
 """
 from __future__ import annotations
 
-import itertools
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 # generation -> chips per host

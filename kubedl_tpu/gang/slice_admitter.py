@@ -19,7 +19,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from kubedl_tpu.api.common import LABEL_REPLICA_INDEX, LABEL_REPLICA_TYPE, ReplicaSpec
+from kubedl_tpu.api.common import LABEL_REPLICA_INDEX, ReplicaSpec
 from kubedl_tpu.api.meta import ObjectMeta
 from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
 from kubedl_tpu.executor.tpu_topology import (

@@ -13,7 +13,6 @@ scrape (ref pkg/metrics/status_counter.go:35-47).
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
 from kubedl_tpu.api.common import JobStatus, is_created, is_failed, is_running, is_succeeded

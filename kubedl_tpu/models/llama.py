@@ -19,8 +19,7 @@ TPU-first choices:
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from functools import partial
+from dataclasses import dataclass
 from typing import Any, Dict, Optional, Tuple
 
 import jax

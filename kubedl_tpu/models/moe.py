@@ -21,7 +21,7 @@ their residual path passes through — standard Switch behavior.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -29,7 +29,7 @@ from kubedl_tpu.core.store import NotFound, ObjectStore
 from kubedl_tpu.executor.local import LocalPodExecutor
 from kubedl_tpu.gang.interface import GangRegistry
 from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
-from kubedl_tpu.metrics.job_metrics import JobMetrics, MetricsRegistry
+from kubedl_tpu.metrics.job_metrics import MetricsRegistry
 from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
 from kubedl_tpu.api.validation import validate
 from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector

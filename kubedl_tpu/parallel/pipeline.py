@@ -26,8 +26,7 @@ tp/cp/ep on the non-pipelined forward instead.
 """
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from kubedl_tpu.api.common import LABEL_REPLICA_TYPE
 from kubedl_tpu.api.pod import Pod, PodPhase

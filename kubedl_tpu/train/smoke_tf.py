@@ -77,7 +77,7 @@ def main(argv=None) -> int:
 
     for _ in range(10):
         step()
-    w0 = float(np.asarray(w)[0] if np.asarray(w).ndim == 1 else np.asarray(w)[0][0])
+    w0 = float(np.asarray(w)[0])
     task = json.loads(os.environ.get("TF_CONFIG", "{}")).get("task", {})
     print(f"smoke_tf done: task={task.get('type')}/{task.get('index')} "
           f"replicas={n} w0={w0:.3f}", flush=True)

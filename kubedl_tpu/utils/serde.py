@@ -8,9 +8,8 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import sys
 import typing
-from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin, get_type_hints
+from typing import Any, Type, TypeVar, Union, get_args, get_origin, get_type_hints
 
 T = TypeVar("T")
 

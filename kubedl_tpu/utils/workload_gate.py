@@ -11,7 +11,6 @@ the reference's behavior.
 from __future__ import annotations
 
 import os
-from typing import List, Set
 
 ENV_WORKLOADS_ENABLE = "WORKLOADS_ENABLE"
 

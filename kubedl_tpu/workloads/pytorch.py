@@ -26,7 +26,7 @@ from kubedl_tpu.api.common import ReplicaSpec, ReplicaType, RestartPolicy, RunPo
 from kubedl_tpu.api.job import BaseJob
 from kubedl_tpu.controllers.base import BaseWorkloadController
 from kubedl_tpu.controllers.registry import register_workload
-from kubedl_tpu.controllers.utils import gen_general_name, get_total_replicas
+from kubedl_tpu.controllers.utils import get_total_replicas
 from kubedl_tpu.workloads import common
 
 KIND = "PyTorchJob"

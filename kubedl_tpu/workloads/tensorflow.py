@@ -24,7 +24,6 @@ from typing import Dict, List
 
 from kubedl_tpu.api.common import ReplicaSpec, ReplicaType, RestartPolicy, RunPolicy
 from kubedl_tpu.api.job import BaseJob
-from kubedl_tpu.api.meta import ObjectMeta
 from kubedl_tpu.controllers.base import BaseWorkloadController
 from kubedl_tpu.controllers.registry import register_workload
 from kubedl_tpu.controllers.utils import get_total_replicas
