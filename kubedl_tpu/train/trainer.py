@@ -41,6 +41,18 @@ def parse_args(argv=None):
     p.add_argument("--grad-clip", type=float,
                    default=float(os.environ.get("KUBEDL_GRAD_CLIP", 0.0)),
                    help="clip gradients by global norm (0 = off)")
+    p.add_argument("--eval-every", type=int,
+                   default=int(os.environ.get("KUBEDL_EVAL_EVERY", 0)),
+                   help="evaluate eval-set loss every N steps (0 = off)")
+    p.add_argument("--eval-batches", type=int,
+                   default=int(os.environ.get("KUBEDL_EVAL_BATCHES", 4)),
+                   help="batches per eval pass (a fixed set each time)")
+    p.add_argument("--eval-data-path",
+                   default=os.environ.get("KUBEDL_EVAL_DATA_PATH", ""),
+                   help="separate shards for a TRUE held-out set; without "
+                        "it the eval set is a fixed probe drawn from the "
+                        "training distribution (overlaps training data "
+                        "after ~1 epoch)")
     p.add_argument("--accum-steps", type=int,
                    default=int(os.environ.get("KUBEDL_ACCUM_STEPS", 1)),
                    help="gradient accumulation micro-steps per update")
@@ -227,26 +239,73 @@ def main(argv=None) -> int:
     batch_sharding = rules.sharding(mesh, "batch", None)
     global_batch = args.batch * info.num_processes
 
-    def next_batch(step: int):
+    def to_global(local):
         """Global [world*batch, seq] array from per-process local rows.
 
         Each process loads ONLY its own rows (rank-strided window ids) and
         contributes them via make_array_from_process_local_data — jnp.asarray
         would device-commit locally and cannot reshard onto the other
         processes' non-addressable devices on a multi-host mesh."""
-        if loader is not None:
-            local = loader.batch_at(step * info.num_processes + info.process_id)
-        else:
-            local = rng.integers(
-                0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32
-            )
         if info.num_processes == 1:
             return jnp.asarray(local)
         return jax.make_array_from_process_local_data(
             batch_sharding, np.asarray(local), (global_batch, args.seq_len)
         )
 
+    def next_batch(step: int):
+        if loader is not None:
+            local = loader.batch_at(step * info.num_processes + info.process_id)
+        else:
+            local = rng.integers(
+                0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32
+            )
+        return to_global(local)
+
     tokens_per_step = global_batch * (args.seq_len - 1)
+
+    # eval: every pass scores the SAME fixed batch set (fresh rng / fixed
+    # ids), so losses are comparable across the run. With
+    # --eval-data-path the set comes from SEPARATE shards — a true
+    # held-out set; otherwise it is a probe drawn from the training
+    # distribution (batch_at wraps modulo the shard windows, so probe
+    # batches overlap training data once a run covers an epoch).
+    eval_fn = jax.jit(loss) if args.eval_every else None
+    eval_loader = None
+    if args.eval_every and args.eval_data_path:
+        import glob as globlib
+
+        from kubedl_tpu.native.loader import TokenLoader
+
+        eval_shards = sorted(globlib.glob(args.eval_data_path))
+        if not eval_shards:
+            print(f"no shards match {args.eval_data_path!r}", file=sys.stderr)
+            return 1
+        eval_loader = TokenLoader(
+            eval_shards, batch=args.batch, seq_len=args.seq_len,
+            seed=args.data_seed, n_threads=0,
+        )
+
+    def eval_pass(step: int) -> None:
+        erng = np.random.default_rng(10**9 + info.process_id)
+        src = eval_loader if eval_loader is not None else loader
+        losses = []
+        for i in range(args.eval_batches):
+            if src is not None:
+                # held-out loader: its own shards, ids from 0. Probe mode
+                # reads a fixed far region of the TRAINING loader — stable
+                # across passes, but not disjoint from training in general
+                base = 0 if eval_loader is not None else 2**20
+                local = src.batch_at(
+                    base + i * info.num_processes + info.process_id)
+            else:
+                local = erng.integers(
+                    0, config.vocab_size, (args.batch, args.seq_len),
+                    dtype=np.int32)
+            losses.append(eval_fn(state.params, to_global(local)))
+        ev = float(np.mean([float(jax.device_get(l)) for l in losses]))
+        tag = "held-out" if eval_loader is not None else "probe"
+        print(f"eval step {step}: loss={ev:.4f} "
+              f"({args.eval_batches} {tag} batches)", flush=True)
 
     # profiler window: [start+1, start+1+profile_steps) — skips the compile step
     prof_start = start_step + 1 if args.profile_dir else -1
@@ -286,6 +345,8 @@ def main(argv=None) -> int:
         if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
             jax.block_until_ready(metrics["loss"])
             save(step + 1)
+        if args.eval_every and (step + 1) % args.eval_every == 0:
+            eval_pass(step + 1)
         if (step + 1) % args.log_every == 0:
             loss_v = float(metrics["loss"])
             now = time.perf_counter()
