@@ -109,3 +109,17 @@ def test_quantized_moe_decode_runs():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, config.vocab_size)
     toks = decode.generate(params, tokens, config, max_new_tokens=3, max_len=16)
     assert toks.shape == (2, 3)
+
+
+def test_quantized_speculative_matches_quantized_vanilla():
+    """Speculative decoding composes with int8 weights: a quantized
+    target (and draft) must emit exactly quantized vanilla's greedy
+    continuation."""
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = quant.quantize_params(llama.init(config, jax.random.PRNGKey(0)))
+    draft = quant.quantize_params(llama.init(config, jax.random.PRNGKey(42)))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, config.vocab_size)
+    want = decode.generate(params, prompt, config, max_new_tokens=7, max_len=32)
+    got = decode.generate_speculative(
+        params, draft, prompt, config, config, max_new_tokens=7, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
