@@ -23,6 +23,11 @@ class BaseJobSpec:
 
 @dataclass
 class BaseJob:
+    # Every workload CRD declares `subresources: status: {}`
+    # (config/crd/bases/*.yaml, matching ref kubeflow.org_tfjobs.yaml:31):
+    # status writes must go through the store's update_status().
+    STATUS_SUBRESOURCE = True
+
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: BaseJobSpec = field(default_factory=BaseJobSpec)
     status: JobStatus = field(default_factory=JobStatus)

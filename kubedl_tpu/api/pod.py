@@ -151,6 +151,10 @@ class PodStatus:
 
 @dataclass
 class Pod:
+    # Pods serve /status on a real apiserver (kubelet owns it): status
+    # writes must go through the store's update_status().
+    STATUS_SUBRESOURCE = True
+
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     status: PodStatus = field(default_factory=PodStatus)

@@ -60,7 +60,15 @@ from kubedl_tpu.controllers.interface import WorkloadController
 from kubedl_tpu.core import events as ev
 from kubedl_tpu.core.expectations import ControllerExpectations
 from kubedl_tpu.core.manager import ControllerRunner, Result
-from kubedl_tpu.core.store import ADDED, DELETED, AlreadyExists, Conflict, NotFound, ObjectStore
+from kubedl_tpu.core.store import (
+    ADDED,
+    DELETED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    write_status,
+)
 from kubedl_tpu.utils.exit_codes import is_retryable_exit_code
 from kubedl_tpu.utils.joblog import job_logger
 
@@ -783,7 +791,10 @@ class JobReconciler:
                 return
             fresh.status = copy.deepcopy(status)
             try:
-                self.store.update(fresh)
+                # /status subresource write — a main-path update would be
+                # silently dropped by a real apiserver (CRDs declare
+                # subresources.status; ref tensorflow/job.go:95-104)
+                write_status(self.store, fresh)
                 return
             except Conflict:
                 continue

@@ -53,6 +53,24 @@ def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> 
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def _has_status_subresource(obj) -> bool:
+    """The flag lives on the API type itself (Pod.STATUS_SUBRESOURCE,
+    BaseJob.STATUS_SUBRESOURCE, ...) so the store's semantics don't depend
+    on which resource registries happen to be populated in this process."""
+    return bool(getattr(type(obj), "STATUS_SUBRESOURCE", False))
+
+
+def write_status(store, obj):
+    """Route a status write through the store's /status surface.
+
+    `update_status` is part of the store contract (both ObjectStore and
+    KubeObjectStore implement it); stores predating the contract fall back
+    to a main-path update, which is exactly right for them — a store
+    without the subresource split doesn't drop main-path status."""
+    fn = getattr(store, "update_status", None)
+    return fn(obj) if fn is not None else store.update(obj)
+
+
 class ObjectStore:
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -82,6 +100,10 @@ class ObjectStore:
         kind = obj.kind
         with self._lock:
             obj = copy.deepcopy(obj)
+            if _has_status_subresource(obj) and hasattr(obj, "status"):
+                # status is reset on create for subresource kinds, exactly
+                # like an apiserver with `subresources: status: {}`
+                obj.status = type(obj.status)()
             bucket = self._objects.setdefault(kind, {})
             key = self._key(obj)
             if key in bucket:
@@ -102,27 +124,61 @@ class ObjectStore:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             return copy.deepcopy(obj)
 
+    def _current_for_write(self, obj):
+        """Shared optimistic-concurrency preamble (caller holds the lock)."""
+        kind = obj.kind
+        key = self._key(obj)
+        cur = self._objects.get(kind, {}).get(key)
+        if cur is None:
+            raise NotFound(f"{kind} {key} not found")
+        if obj.metadata.resource_version != cur.metadata.resource_version:
+            raise Conflict(
+                f"{kind} {key}: resourceVersion {obj.metadata.resource_version} "
+                f"!= {cur.metadata.resource_version}"
+            )
+        return cur
+
     def update(self, obj):
-        """Full-object update with optimistic concurrency."""
+        """Full-object update with optimistic concurrency.
+
+        For kinds with a `/status` subresource, status changes on this
+        path are silently dropped — exactly what a real apiserver does
+        with `subresources: status: {}` declared; use update_status().
+        """
         kind = obj.kind
         with self._lock:
-            bucket = self._objects.get(kind, {})
+            bucket = self._objects.setdefault(kind, {})
             key = self._key(obj)
-            cur = bucket.get(key)
-            if cur is None:
-                raise NotFound(f"{kind} {key} not found")
-            if obj.metadata.resource_version != cur.metadata.resource_version:
-                raise Conflict(
-                    f"{kind} {key}: resourceVersion {obj.metadata.resource_version} "
-                    f"!= {cur.metadata.resource_version}"
-                )
+            cur = self._current_for_write(obj)
             obj = copy.deepcopy(obj)
             obj.metadata.uid = cur.metadata.uid
             obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
             obj.metadata.resource_version = self._next_rv()
+            if _has_status_subresource(cur) and hasattr(cur, "status"):
+                obj.status = copy.deepcopy(cur.status)
             bucket[key] = obj
             out = copy.deepcopy(obj)
             self._emit(MODIFIED, kind, copy.deepcopy(obj))
+            return out
+
+    def update_status(self, obj):
+        """Write ONLY the object's status (the `/status` subresource PUT —
+        ref controllers/tensorflow/job.go:95-104 r.Status().Update). Spec,
+        labels, and the rest of the stored object are left untouched. For
+        kinds without the subresource this degrades to a full update."""
+        kind = obj.kind
+        if not _has_status_subresource(obj):
+            return self.update(obj)
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            cur = self._current_for_write(obj)
+            new = copy.deepcopy(cur)
+            new.status = copy.deepcopy(obj.status)
+            new.metadata.resource_version = self._next_rv()
+            bucket[key] = new
+            out = copy.deepcopy(new)
+            self._emit(MODIFIED, kind, copy.deepcopy(new))
             return out
 
     def delete(self, kind: str, namespace: str, name: str):

@@ -35,7 +35,7 @@ from kubedl_tpu.api.pod import (
     PodPhase,
     PodRestartPolicy,
 )
-from kubedl_tpu.core.store import ADDED, DELETED, Conflict, NotFound, ObjectStore
+from kubedl_tpu.core.store import ADDED, DELETED, Conflict, NotFound, ObjectStore, write_status
 
 log = logging.getLogger("kubedl_tpu.executor")
 
@@ -405,7 +405,7 @@ class LocalPodExecutor:
                 pod.status.tpu_slice = placement.slice_name
                 pod.status.tpu_worker_id = placement.worker_id
             try:
-                self.store.update(pod)
+                write_status(self.store, pod)
                 return
             except Conflict:
                 continue

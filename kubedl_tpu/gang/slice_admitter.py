@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import LABEL_REPLICA_INDEX, ReplicaSpec
 from kubedl_tpu.api.meta import ObjectMeta
-from kubedl_tpu.core.store import AlreadyExists, NotFound, ObjectStore
+from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore, write_status
 from kubedl_tpu.executor.tpu_topology import (
     Placement,
     SliceInfo,
@@ -47,6 +47,10 @@ class PodGroupStatus:
 
 @dataclass
 class PodGroup:
+    # podgroups CRD declares `subresources: status: {}` — phase/slice
+    # writes must go through the store's update_status().
+    STATUS_SUBRESOURCE = True
+
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodGroupSpec = field(default_factory=PodGroupSpec)
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
@@ -293,12 +297,25 @@ class TPUSliceAdmitter(GangScheduler):
         try:
             existing = self.store.get("PodGroup", pg.metadata.namespace, pg.metadata.name)
             pg.metadata = existing.metadata
-            if (existing.status.phase, existing.status.slice_name) != (
-                pg.status.phase, pg.status.slice_name
-            ):
-                self.store.update(pg)
+            try:
+                if existing.spec != pg.spec:
+                    # spec changes (min_member, chips, slice request) ride
+                    # the main path; status is preserved by the store
+                    pg.metadata = self.store.update(pg).metadata
+                if (existing.status.phase, existing.status.slice_name) != (
+                    pg.status.phase, pg.status.slice_name
+                ):
+                    # phase/slice live in status -> /status subresource PUT
+                    write_status(self.store, pg)
+            except (Conflict, NotFound):
+                pass  # concurrent writer/deletion: next pass re-mirrors
         except NotFound:
             try:
-                self.store.create(pg)
-            except AlreadyExists:
+                # create strips status on subresource kinds; follow up with
+                # a /status write when the desired status isn't the default
+                created = self.store.create(pg)
+                if pg.status != created.status:
+                    pg.metadata = created.metadata
+                    write_status(self.store, pg)
+            except (AlreadyExists, Conflict, NotFound):
                 pass

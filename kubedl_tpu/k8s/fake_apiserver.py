@@ -21,10 +21,14 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-# /api/v1/namespaces/{ns}/{plural}[/{name}]
-_CORE_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/([^/]+)(?:/([^/]+))?$")
-# /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}]
-_GROUP_RE = re.compile(r"^/apis/([^/]+)/([^/]+)/namespaces/([^/]+)/([^/]+)(?:/([^/]+))?$")
+# /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+_CORE_RE = re.compile(
+    r"^/api/v1/namespaces/([^/]+)/([^/]+)(?:/([^/]+)(?:/(status))?)?$"
+)
+# /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+_GROUP_RE = re.compile(
+    r"^/apis/([^/]+)/([^/]+)/namespaces/([^/]+)/([^/]+)(?:/([^/]+)(?:/(status))?)?$"
+)
 _DISCOVERY_RE = re.compile(r"^/apis/([^/]+)/([^/]+)$")
 
 
@@ -36,6 +40,10 @@ class _State:
         self.objects: Dict[Tuple[str, str], Dict[Tuple[str, str], Dict]] = {}
         # registered resources: (gv, plural) -> kind
         self.resources: Dict[Tuple[str, str], str] = {}
+        # resources serving a /status subresource: main-path writes have
+        # their status silently dropped, like a real apiserver with
+        # `subresources: status: {}` in the CRD
+        self.status_subresources: set = set()
         self.watchers: List["_Watcher"] = []
         self.uid = 0
 
@@ -120,17 +128,17 @@ class _Handler(BaseHTTPRequestHandler):
         self._error(401, "Unauthorized", "Unauthorized")
         return False
 
-    def _route(self) -> Optional[Tuple[str, str, str, Optional[str]]]:
-        """-> (gv, plural, namespace, name) or None."""
+    def _route(self) -> Optional[Tuple[str, str, str, Optional[str], Optional[str]]]:
+        """-> (gv, plural, namespace, name, subresource) or None."""
         path = urllib.parse.urlparse(self.path).path
         m = _CORE_RE.match(path)
         if m:
-            ns, plural, name = m.groups()
-            return "v1", plural, ns, name
+            ns, plural, name, sub = m.groups()
+            return "v1", plural, ns, name, sub
         m = _GROUP_RE.match(path)
         if m:
-            group, version, ns, plural, name = m.groups()
-            return f"{group}/{version}", plural, ns, name
+            group, version, ns, plural, name, sub = m.groups()
+            return f"{group}/{version}", plural, ns, name, sub
         return None
 
     def _params(self) -> Dict[str, str]:
@@ -179,15 +187,18 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._error(404, f"unknown path {path}", "NotFound")
-        gv, plural, ns, name = route
+        gv, plural, ns, name, sub = route
         st = self.state
         if (gv, plural) not in st.resources:
             return self._error(404, f"resource {gv}/{plural} not registered", "NotFound")
+        if sub and (gv, plural) not in st.status_subresources:
+            return self._error(404, f"{plural} has no status subresource", "NotFound")
         if name:
             with st.lock:
                 obj = st.objects.get((gv, plural), {}).get((ns, name))
             if obj is None:
                 return self._error(404, f"{plural} {ns}/{name} not found", "NotFound")
+            # GET of /status returns the whole object, like the real thing
             return self._send_json(200, obj)
         params = self._params()
         if params.get("watch") == "true":
@@ -254,11 +265,17 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None:
             return self._error(404, "unknown path", "NotFound")
-        gv, plural, ns, _ = route
+        gv, plural, ns, _, sub = route
         st = self.state
+        if sub:
+            return self._error(405, "create not allowed on subresource", "MethodNotAllowed")
         if (gv, plural) not in st.resources:
             return self._error(404, f"resource {gv}/{plural} not registered", "NotFound")
         obj = self._read_body() or {}
+        # status is reset on create for subresource-enabled kinds — the
+        # apiserver owns the main path, status owners write /status later
+        if (gv, plural) in st.status_subresources:
+            obj.pop("status", None)
         meta = obj.setdefault("metadata", {})
         meta["namespace"] = ns
         name = meta.get("name", "")
@@ -284,8 +301,11 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None or route[3] is None:
             return self._error(404, "unknown path", "NotFound")
-        gv, plural, ns, name = route
+        gv, plural, ns, name, sub = route
         st = self.state
+        has_status = (gv, plural) in st.status_subresources
+        if sub and not has_status:
+            return self._error(404, f"{plural} has no status subresource", "NotFound")
         obj = self._read_body() or {}
         meta = obj.setdefault("metadata", {})
         meta["namespace"] = ns
@@ -303,9 +323,26 @@ class _Handler(BaseHTTPRequestHandler):
                     f"the object has been modified",
                     "Conflict",
                 )
-            meta["uid"] = cur["metadata"].get("uid")
-            meta["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
-            meta["resourceVersion"] = st.next_rv()
+            if sub:
+                # /status PUT: only the status (and nothing else) changes
+                new = json.loads(json.dumps(cur))
+                if "status" in obj:
+                    new["status"] = obj["status"]
+                else:
+                    new.pop("status", None)
+                obj = new
+            else:
+                meta["uid"] = cur["metadata"].get("uid")
+                meta["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
+                if has_status:
+                    # main-path PUT: incoming status is SILENTLY dropped —
+                    # the exact real-apiserver behavior that makes missing
+                    # update_status() calls a production bug
+                    if "status" in cur:
+                        obj["status"] = cur["status"]
+                    else:
+                        obj.pop("status", None)
+            obj["metadata"]["resourceVersion"] = st.next_rv()
             bucket[(ns, name)] = obj
             st.emit("MODIFIED", gv, plural, obj)
         self._send_json(200, obj)
@@ -316,7 +353,9 @@ class _Handler(BaseHTTPRequestHandler):
         route = self._route()
         if route is None or route[3] is None:
             return self._error(404, "unknown path", "NotFound")
-        gv, plural, ns, name = route
+        gv, plural, ns, name, sub = route
+        if sub:
+            return self._error(405, "delete not allowed on subresource", "MethodNotAllowed")
         st = self.state
         with st.lock:
             bucket = st.objects.get((gv, plural), {})
@@ -337,27 +376,37 @@ class FakeApiServer:
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
-        self.register_resource("v1", "pods", "Pod")
+        self.register_resource("v1", "pods", "Pod", status_subresource=True)
         self.register_resource("v1", "services", "Service")
         self.register_resource("v1", "events", "Event")
-        self.register_resource("scheduling.kubedl-tpu.io/v1alpha1", "podgroups", "PodGroup")
+        self.register_resource(
+            "scheduling.kubedl-tpu.io/v1alpha1", "podgroups", "PodGroup",
+            status_subresource=True,
+        )
 
     @property
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
 
-    def register_resource(self, gv: str, plural: str, kind: str) -> None:
+    def register_resource(
+        self, gv: str, plural: str, kind: str, status_subresource: bool = False
+    ) -> None:
         state: _State = self._httpd.state  # type: ignore[attr-defined]
         with state.lock:
             state.resources[(gv, plural)] = kind
+            if status_subresource:
+                state.status_subresources.add((gv, plural))
 
     def register_workload_crds(self) -> None:
         from kubedl_tpu.k8s.resources import register_workload_kinds, registered_kinds
 
         register_workload_kinds()
         for kind, info in registered_kinds().items():
-            self.register_resource(info.api_version, info.plural, kind)
+            self.register_resource(
+                info.api_version, info.plural, kind,
+                status_subresource=info.status_subresource,
+            )
 
     def start(self) -> "FakeApiServer":
         self._thread = threading.Thread(

@@ -17,6 +17,13 @@ class ResourceInfo:
     api_version: str  # "v1" or "group/version"
     plural: str
     cls: Optional[Type] = None  # dataclass for typed decode; None = raw dict
+    # True when the kind serves a `/status` subresource: status changes on
+    # the main resource path are silently dropped by the apiserver and
+    # must go through status_path() instead (ref: the CRDs declare
+    # `subresources: status: {}` — config/crd/bases/*.yaml — matching the
+    # reference's kubeflow.org_tfjobs.yaml:31; writes go through
+    # r.Status().Update, ref controllers/tensorflow/job.go:95-104).
+    status_subresource: bool = False
 
     @property
     def group(self) -> str:
@@ -35,14 +42,35 @@ class ResourceInfo:
         p = f"{self.base_path()}/namespaces/{namespace}/{self.plural}"
         return f"{p}/{name}" if name else p
 
+    def status_path(self, namespace: str, name: str) -> str:
+        return f"{self.path(namespace, name)}/status"
+
 
 _REGISTRY: Dict[str, ResourceInfo] = {}
 
 
 def register_kind(
-    kind: str, api_version: str, plural: str, cls: Optional[Type] = None
+    kind: str,
+    api_version: str,
+    plural: str,
+    cls: Optional[Type] = None,
+    status_subresource: Optional[bool] = None,
 ) -> ResourceInfo:
-    info = ResourceInfo(kind=kind, api_version=api_version, plural=plural, cls=cls)
+    if status_subresource is None:
+        # single source of truth: the API type carries the marker. For
+        # raw-dict kinds (cls=None) there is no type to consult — callers
+        # registering a dict-typed CRD whose manifest declares
+        # `subresources: status: {}` MUST pass status_subresource=True or
+        # update_status() degrades to a main-path PUT whose status a real
+        # apiserver silently drops.
+        status_subresource = bool(cls and getattr(cls, "STATUS_SUBRESOURCE", False))
+    info = ResourceInfo(
+        kind=kind,
+        api_version=api_version,
+        plural=plural,
+        cls=cls,
+        status_subresource=status_subresource,
+    )
     _REGISTRY[kind] = info
     return info
 
@@ -63,6 +91,8 @@ def _register_builtins() -> None:
     from kubedl_tpu.core.events import Event
     from kubedl_tpu.gang.slice_admitter import PodGroup
 
+    # status_subresource derives from each type's STATUS_SUBRESOURCE marker
+    # (Pod and PodGroup carry it; Services/Events have no status writers).
     register_kind("Pod", "v1", "pods", Pod)
     register_kind("Service", "v1", "services", Service)
     register_kind("Event", "v1", "events", Event)
@@ -77,6 +107,9 @@ def register_workload_kinds() -> None:
 
     for ctrl in enabled_controllers("*"):
         if ctrl.kind not in _REGISTRY:
+            # every workload job type derives BaseJob, whose
+            # STATUS_SUBRESOURCE marker matches the shipped CRDs'
+            # `subresources: status: {}` declaration
             register_kind(
                 ctrl.kind,
                 ctrl.api_version,

@@ -192,6 +192,25 @@ class KubeObjectStore:
             raise _map_error(e, obj.kind, self._key(obj)) from e
         return _decode(obj.kind, body)
 
+    def update_status(self, obj):
+        """PUT to the `/status` subresource. Required for every kind whose
+        CRD declares `subresources: status: {}` (all five workload CRDs +
+        podgroups, config/crd/bases/) — a real apiserver silently drops
+        status changes sent to the main resource path.
+        Ref: controllers/tensorflow/job.go:95-104 r.Status().Update."""
+        info = resource_for(obj.kind)
+        if not info.status_subresource:
+            return self.update(obj)
+        try:
+            body = self.client.request(
+                "PUT",
+                info.status_path(obj.metadata.namespace, obj.metadata.name),
+                body=_encode(obj),
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
     def delete(self, kind: str, namespace: str, name: str):
         info = resource_for(kind)
         try:

@@ -44,7 +44,7 @@ def set_pod_phase(store, pod, phase, exit_code=None, container="test-container")
                 terminated=ContainerStateTerminated(exit_code=exit_code),
             )
         ]
-    store.update(fresh)
+    store.update_status(fresh)
 
 
 def reconcile_until_settled(engine, key, n=5):
@@ -378,22 +378,22 @@ def test_status_conflict_churn_does_not_burn_backoff_limit():
     from kubedl_tpu.core.store import Conflict
 
     set_pod_phase(store, store.get("Pod", "default", "test-job-worker-1"), PodPhase.RUNNING)
-    real_update = store.update
+    real_update_status = store.update_status
     conflicts = {"n": 0}
 
-    def flaky_update(obj):
+    def flaky_update_status(obj):
         if getattr(obj, "kind", "") == TEST_KIND and conflicts["n"] < 5:
             conflicts["n"] += 1
             raise Conflict("injected")
-        return real_update(obj)
+        return real_update_status(obj)
 
-    store.update = flaky_update
+    store.update_status = flaky_update_status
     try:
         for _ in range(8):
             res = engine.reconcile(job.key)
             observe_all(engine, job)
     finally:
-        store.update = real_update
+        store.update_status = real_update_status
     assert conflicts["n"] == 5
     assert engine._failure_backoff[job.key] == 1
     status = store.get(TEST_KIND, "default", "test-job").status

@@ -124,7 +124,7 @@ def test_tf_worker0_completed_heuristic():
                 ContainerStatus(name="tensorflow",
                                 terminated=ContainerStateTerminated(exit_code=code))
             ]
-        store.update(pod)
+        store.update_status(pod)
     for rt in ("worker",):
         engine.expectations.delete_expectations(f"default/job1/{rt}/pods")
         engine.expectations.delete_expectations(f"default/job1/{rt}/services")
@@ -139,7 +139,7 @@ def test_tf_chief_drives_when_present():
     chief = store.get("Pod", "default", "job1-chief-0")
     assert chief.metadata.labels["job-role"] == "master"
     chief.status.phase = PodPhase.RUNNING
-    store.update(chief)
+    store.update_status(chief)
     for rt in ("chief", "worker"):
         engine.expectations.delete_expectations(f"default/job1/{rt}/pods")
         engine.expectations.delete_expectations(f"default/job1/{rt}/services")
@@ -248,7 +248,7 @@ def test_xdl_min_finish_success():
     assert len(pods) == 10
     for i, pod in enumerate(pods):
         pod.status.phase = PodPhase.SUCCEEDED if i < 5 else PodPhase.RUNNING
-        store.update(pod)
+        store.update_status(pod)
     engine.expectations.delete_expectations("default/job1/worker/pods")
     engine.expectations.delete_expectations("default/job1/worker/services")
     engine.reconcile("default/job1")
