@@ -530,10 +530,13 @@ def _run_tpu_child(results_path: str):
     return proc
 
 
-def _collect_results(results_path: str):
-    extras = {}
+SNAPSHOT_PATH = os.path.join(REPO, "bench_results_snapshot.jsonl")
+
+
+def _parse_results(path: str):
+    out = {}
     try:
-        with open(results_path) as f:
+        with open(path) as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -543,9 +546,32 @@ def _collect_results(results_path: str):
                 except json.JSONDecodeError:
                     continue
                 key = rec.pop("k", "unknown")
-                extras[key] = rec
+                out[key] = rec
     except FileNotFoundError:
         pass
+    return out
+
+
+def _collect_results(results_path: str):
+    """Live child results, backfilled from the committed snapshot.
+
+    The snapshot is written mid-round whenever a TPU child completes
+    successfully (same code, same chip pool). If the driver-time child
+    hits a wedged tunnel (two rounds running: BENCH_r01 timeout,
+    BENCH_r02 wedged claim), milestones measured earlier in the round
+    still reach the artifact — each backfilled record carries
+    "from_snapshot": true so nothing masquerades as a live number."""
+    extras = _parse_results(results_path)
+
+    def live_ok(key):
+        rec = extras.get(key)
+        return rec is not None and "error" not in rec and "skipped" not in rec
+
+    snapshot = _parse_results(SNAPSHOT_PATH)
+    for key, rec in snapshot.items():
+        if key == "done" or live_ok(key):
+            continue
+        extras[key] = {**rec, "from_snapshot": True}
     return extras
 
 

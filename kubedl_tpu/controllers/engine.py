@@ -67,6 +67,7 @@ from kubedl_tpu.core.store import (
     Conflict,
     NotFound,
     ObjectStore,
+    read_fresh,
     write_status,
 )
 from kubedl_tpu.utils.exit_codes import is_retryable_exit_code
@@ -692,8 +693,9 @@ class JobReconciler:
         (ref pkg/job_controller/util.go:33-49 RecheckDeletionTimestamp):
         adopting while the job is being deleted would resurrect orphans."""
         try:
-            fresh = self.store.get(
-                self.controller.kind, job.metadata.namespace, job.metadata.name
+            fresh = read_fresh(
+                self.store, self.controller.kind,
+                job.metadata.namespace, job.metadata.name,
             )
         except NotFound:
             return False
@@ -784,8 +786,11 @@ class JobReconciler:
         status.last_reconcile_time = now()
         for _ in range(3):
             try:
-                fresh = self.store.get(
-                    self.controller.kind, job.metadata.namespace, job.metadata.name
+                # uncached read: a cache-stale resourceVersion would make
+                # every attempt Conflict and burn the retry budget
+                fresh = read_fresh(
+                    self.store, self.controller.kind,
+                    job.metadata.namespace, job.metadata.name,
                 )
             except NotFound:
                 return

@@ -60,6 +60,14 @@ def _has_status_subresource(obj) -> bool:
     return bool(getattr(type(obj), "STATUS_SUBRESOURCE", False))
 
 
+def read_fresh(store, kind: str, namespace: str, name: str):
+    """Uncached read — bypasses a store's informer cache when it has one
+    (KubeObjectStore.get_fresh); falls back to plain get, which is already
+    authoritative for the in-memory store."""
+    fn = getattr(store, "get_fresh", None)
+    return fn(kind, namespace, name) if fn is not None else store.get(kind, namespace, name)
+
+
 def write_status(store, obj):
     """Route a status write through the store's /status surface.
 

@@ -146,16 +146,32 @@ class KubeClient:
         return json.loads(data) if data else None
 
     def watch(
-        self, path: str, params: Optional[Dict[str, str]] = None
+        self,
+        path: str,
+        params: Optional[Dict[str, str]] = None,
+        conn_holder: Optional[list] = None,
+        abort=None,
     ) -> Iterator[Tuple[str, Dict]]:
         """Stream watch events until the server closes the connection.
 
         Uses a dedicated connection with no read timeout; the caller owns
-        reconnect-with-last-resourceVersion (store.py does)."""
+        reconnect-with-last-resourceVersion (store.py does). If given,
+        `conn_holder` receives the live connection so a stopper can close
+        it from another thread and unblock the chunked read. `abort` is
+        re-checked AFTER the connection is registered: a stopper either
+        ran before registration (abort() is True -> return) or after (the
+        registered conn gets shut down) — no unstoppable window."""
         params = dict(params or {})
         params["watch"] = "true"
         qs = urllib.parse.urlencode(params)
         conn = self._new_conn(None)
+        if conn_holder is not None:
+            conn_holder.append(conn)
+        if abort is not None and abort():
+            if conn_holder is not None:
+                conn_holder.remove(conn)
+            conn.close()
+            return
         try:
             conn.request("GET", f"{path}?{qs}", headers=self._headers())
             resp = conn.getresponse()
@@ -174,4 +190,6 @@ class KubeClient:
                     ev = json.loads(line)
                     yield ev.get("type", ""), ev.get("object", {})
         finally:
+            if conn_holder is not None and conn in conn_holder:
+                conn_holder.remove(conn)
             conn.close()

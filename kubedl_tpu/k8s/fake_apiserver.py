@@ -46,6 +46,9 @@ class _State:
         self.status_subresources: set = set()
         self.watchers: List["_Watcher"] = []
         self.uid = 0
+        # (method, path-sans-query, is_watch) per request — lets tests
+        # assert the informer cache eliminated hot-path HTTP traffic
+        self.requests: List[Tuple[str, str, bool]] = []
 
     def next_rv(self) -> str:
         self.rv += 1
@@ -119,6 +122,13 @@ class _Handler(BaseHTTPRequestHandler):
             "message": message, "reason": reason, "code": status,
         })
 
+    def _record(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        is_watch = "watch=true" in (parsed.query or "")
+        st = self.state
+        with st.lock:
+            st.requests.append((method, parsed.path, is_watch))
+
     def _auth_ok(self) -> bool:
         token = self.server.token  # type: ignore[attr-defined]
         if not token:
@@ -179,6 +189,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- verbs ------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        self._record("GET")
         if not self._auth_ok():
             return
         path = urllib.parse.urlparse(self.path).path
@@ -260,6 +271,7 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(length))
 
     def do_POST(self) -> None:  # noqa: N802
+        self._record("POST")
         if not self._auth_ok():
             return
         route = self._route()
@@ -296,6 +308,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(201, obj)
 
     def do_PUT(self) -> None:  # noqa: N802
+        self._record("PUT")
         if not self._auth_ok():
             return
         route = self._route()
@@ -348,6 +361,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, obj)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        self._record("DELETE")
         if not self._auth_ok():
             return
         route = self._route()

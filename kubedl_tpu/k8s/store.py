@@ -13,13 +13,17 @@ the reference (L0, SURVEY.md §1).
 """
 from __future__ import annotations
 
+import copy
 import logging
 import queue
+import socket
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from kubedl_tpu.core.store import (
     ADDED,
+    DELETED,
     AlreadyExists,
     Conflict,
     NotFound,
@@ -153,12 +157,91 @@ def _selector_param(label_selector: Optional[Dict[str, str]]) -> Dict[str, str]:
     return {"labelSelector": ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))}
 
 
+class _InformerCache:
+    """Watch-synced read cache — the informer half of controller-runtime.
+
+    Fed by the KubeWatch pump that owns each kind (cache applied BEFORE the
+    event is delivered, so a reconcile triggered by an event always sees a
+    cache at least as new as the event). `get`/`list` serve from here once
+    a kind is synced, making the reconcile hot path HTTP-free — the
+    reference reads from the informer cache the same way (SURVEY §3.2,
+    ref pkg/job_controller/job.go:106-116)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._synced: Dict[str, bool] = {}
+        # kind -> (ns, name) -> decoded object
+        self._objects: Dict[str, Dict[tuple, Any]] = {}
+
+    _NOT_SYNCED = object()  # sentinel: caller must fall back to HTTP
+
+    def synced(self, kind: str) -> bool:
+        with self._lock:
+            return self._synced.get(kind, False)
+
+    def begin_sync(self, kind: str) -> None:
+        with self._lock:
+            self._synced[kind] = False
+            self._objects[kind] = {}
+
+    def mark_synced(self, kind: str) -> None:
+        with self._lock:
+            self._synced[kind] = True
+
+    def apply(self, etype: str, kind: str, obj) -> None:
+        key = (obj.metadata.namespace, obj.metadata.name)
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            if etype == DELETED:
+                bucket.pop(key, None)
+                return
+            cur = bucket.get(key)
+            # guard against replay of an older snapshot overwriting a
+            # newer event (two pumps or a relist race)
+            if cur is not None and cur.metadata.resource_version > obj.metadata.resource_version:
+                return
+            bucket[key] = obj
+
+    def get(self, kind: str, namespace: str, name: str):
+        """-> object copy, None (synced and absent), or _NOT_SYNCED.
+        The synced check and the read share one lock acquisition, so a
+        concurrent relist (begin_sync clears the bucket) can never serve
+        an empty bucket as truth."""
+        with self._lock:
+            if not self._synced.get(kind, False):
+                return self._NOT_SYNCED
+            obj = self._objects.get(kind, {}).get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str, namespace: str, label_selector):
+        """-> sorted list of copies, or _NOT_SYNCED (same atomicity note)."""
+        with self._lock:
+            if not self._synced.get(kind, False):
+                return self._NOT_SYNCED
+            items = [
+                copy.deepcopy(o)
+                for (ns, _), o in self._objects.get(kind, {}).items()
+                if ns == namespace
+                and all(
+                    o.metadata.labels.get(k) == v
+                    for k, v in (label_selector or {}).items()
+                )
+            ]
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return items
+
+
 class KubeObjectStore:
     def __init__(self, client: KubeClient, namespace: str = "default") -> None:
         register_workload_kinds()
         self.client = client
         self.default_namespace = namespace
         self._watchers: List["KubeWatch"] = []
+        self.cache = _InformerCache()
+        # kind -> the KubeWatch pump feeding the cache for that kind (one
+        # informer per kind; extra watches don't double-feed)
+        self._cache_feeders: Dict[str, "KubeWatch"] = {}
+        self._feeder_lock = threading.Lock()
 
     # -- CRUD (same contract as core.store.ObjectStore) -------------------
 
@@ -173,6 +256,17 @@ class KubeObjectStore:
         return _decode(obj.kind, body)
 
     def get(self, kind: str, namespace: str, name: str):
+        obj = self.cache.get(kind, namespace, name)
+        if obj is _InformerCache._NOT_SYNCED:
+            return self.get_fresh(kind, namespace, name)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        return obj
+
+    def get_fresh(self, kind: str, namespace: str, name: str):
+        """Uncached apiserver GET — for reads that must not be stale
+        (adoption's deletion-timestamp recheck, status-write rv refresh;
+        ref pkg/job_controller/util.go:33-49 uses the uncached reader)."""
         info = resource_for(kind)
         try:
             body = self.client.request("GET", info.path(namespace, name))
@@ -227,6 +321,9 @@ class KubeObjectStore:
     ) -> List[Any]:
         info = resource_for(kind)
         ns = namespace if namespace is not None else self.default_namespace
+        cached = self.cache.list(kind, ns, label_selector)
+        if cached is not _InformerCache._NOT_SYNCED:
+            return cached
         try:
             body = self.client.request(
                 "GET", info.path(ns), params=_selector_param(label_selector)
@@ -265,6 +362,18 @@ class KubeObjectStore:
         w.start()
         return w
 
+    def wait_for_cache_sync(self, kinds: List[str], timeout: float = 30.0) -> bool:
+        """Block until the informer cache has replayed the initial list for
+        every kind (controller-runtime's WaitForCacheSync). Returns False
+        on timeout — callers keep running; reads just stay HTTP until the
+        pumps catch up."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            if all(self.cache.synced(k) for k in kinds):
+                return True
+            time.sleep(0.02)
+        return all(self.cache.synced(k) for k in kinds)
+
     @staticmethod
     def _key(obj) -> str:
         return f"{obj.metadata.namespace}/{obj.metadata.name}"
@@ -291,6 +400,7 @@ class KubeWatch:
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._conns: list = []  # live watch connections, closed on stop()
 
     def start(self) -> None:
         for kind in self._kinds:
@@ -302,41 +412,62 @@ class KubeWatch:
 
     def _pump(self, kind: str) -> None:
         info = resource_for(kind)
-        ns = self._store.default_namespace
+        store = self._store
+        ns = store.default_namespace
+        # Claim the informer role for this kind: exactly one pump feeds
+        # the read cache so two watches can't fight over relist resets.
+        with store._feeder_lock:
+            feeds_cache = store._cache_feeders.setdefault(kind, self) is self
         rv: Optional[str] = None
-        while not self._stopped.is_set():
-            try:
-                if rv is None:
-                    body = self._store.client.request("GET", info.path(ns))
-                    rv = str((body.get("metadata") or {}).get("resourceVersion", "0"))
-                    for item in body.get("items", []):
-                        self._offer(ADDED, kind, item)
-                for etype, obj in self._store.client.watch(
-                    info.path(ns), params={"resourceVersion": rv}
-                ):
-                    if self._stopped.is_set():
-                        return
-                    if etype == "ERROR":
-                        rv = None  # 410 Gone mid-stream: relist
-                        break
-                    item_rv = (obj.get("metadata") or {}).get("resourceVersion")
-                    if item_rv is not None:
-                        rv = str(item_rv)
-                    self._offer(etype, kind, obj)
-            except KubeApiError as e:
-                if e.status == 410:
-                    rv = None
-                self._stopped.wait(0.2)
-            except Exception:  # noqa: BLE001 — transport blips: back off, retry
-                if not self._stopped.is_set():
-                    self._stopped.wait(0.5)
+        try:
+            while not self._stopped.is_set():
+                try:
+                    if rv is None:
+                        if feeds_cache:
+                            store.cache.begin_sync(kind)
+                        body = store.client.request("GET", info.path(ns))
+                        rv = str((body.get("metadata") or {}).get("resourceVersion", "0"))
+                        for item in body.get("items", []):
+                            self._offer(ADDED, kind, item, feeds_cache)
+                        if feeds_cache:
+                            store.cache.mark_synced(kind)
+                    for etype, obj in store.client.watch(
+                        info.path(ns), params={"resourceVersion": rv},
+                        conn_holder=self._conns, abort=self._stopped.is_set,
+                    ):
+                        if self._stopped.is_set():
+                            return
+                        if etype == "ERROR":
+                            rv = None  # 410 Gone mid-stream: relist
+                            break
+                        item_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if item_rv is not None:
+                            rv = str(item_rv)
+                        self._offer(etype, kind, obj, feeds_cache)
+                except KubeApiError as e:
+                    if e.status == 410:
+                        rv = None
+                    self._stopped.wait(0.2)
+                except Exception:  # noqa: BLE001 — transport blips: back off, retry
+                    if not self._stopped.is_set():
+                        self._stopped.wait(0.5)
+        finally:
+            if feeds_cache:
+                with store._feeder_lock:
+                    if store._cache_feeders.get(kind) is self:
+                        del store._cache_feeders[kind]
+                store.cache.begin_sync(kind)  # stale cache must not serve reads
 
-    def _offer(self, etype: str, kind: str, body: Dict) -> None:
+    def _offer(self, etype: str, kind: str, body: Dict, feeds_cache: bool = False) -> None:
         try:
             obj = _decode(kind, body)
         except Exception:  # noqa: BLE001 — skip undecodable objects
             log.warning("undecodable %s watch event dropped", kind)
             return
+        if feeds_cache:
+            # cache BEFORE delivery: a reconcile woken by this event sees
+            # a cache at least as fresh as the event itself
+            self._store.cache.apply(etype, kind, obj)
         self._q.put(WatchEvent(type=etype, kind=kind, obj=obj))
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
@@ -347,4 +478,15 @@ class KubeWatch:
 
     def stop(self) -> None:
         self._stopped.set()
+        # Unblock pumps parked in the chunked read so their feeder/cache
+        # cleanup runs promptly. socket.shutdown (not conn.close) — close
+        # would need the buffered reader's lock, which the blocked reader
+        # thread holds, deadlocking the stopper.
+        for conn in list(self._conns):
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
         self._q.put(None)

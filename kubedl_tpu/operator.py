@@ -188,6 +188,15 @@ class Operator:
         if self.executor is not None:
             self.executor.start()
         self.manager.start()
+        if self.kube_mode and self.reconcilers:
+            # informer cache: after sync, reconcile get/list never hits
+            # the apiserver (ref reads from the informer cache, SURVEY
+            # §3.2). Pod/Service pumps only exist when a controller
+            # registered, so with zero controllers there is nothing to
+            # wait for.
+            kinds = sorted({*self.reconcilers, "Pod", "Service"})
+            if not self.store.wait_for_cache_sync(kinds, timeout=30.0):
+                log.warning("informer cache not synced within 30s; reads stay uncached")
         return True
 
     def _setup_persistence(self) -> None:
