@@ -89,6 +89,63 @@ class TPUSliceAdmitter(GangScheduler):
             infos.append(SliceInfo(name=f"slice-{i}-{st.name}", type=st))
         return cls(store, infos)
 
+    def set_pool(self, infos: List[SliceInfo]) -> None:
+        """Replace the slice pool (node-inventory updates, k8s/nodes.py).
+        Reservations carry over by slice name; gangs whose slice vanished
+        OR changed shape go back to waiting and re-reserve. Affected
+        PodGroup mirrors are re-written so dashboards never show a
+        reservation on hardware that no longer exists."""
+        with self._lock:
+            old = self._slices
+            new: Dict[str, SliceInfo] = {}
+            # slice names whose reservation did NOT carry over (gone, or
+            # the node pool was re-provisioned with a different shape)
+            invalidated = set(old)
+            for info in infos:
+                prev = old.get(info.name)
+                if prev is not None and prev.type == info.type:
+                    info.reserved_by = prev.reserved_by
+                    invalidated.discard(info.name)
+                new[info.name] = info
+            self._slices = new
+            changed_keys = []
+            for key, state in self._gangs.items():
+                if state.slice_name is not None and (
+                    state.slice_name not in new or state.slice_name in invalidated
+                ):
+                    state.slice_name = None
+                    changed_keys.append(key)
+            self._solo = {
+                pod_key: sname for pod_key, sname in self._solo.items()
+                if sname in new and sname not in invalidated
+            }
+            changed_keys.extend(self._reserve_waiting())
+        for key in changed_keys:
+            self._remirror_podgroup_status(key)
+
+    def _remirror_podgroup_status(self, gang_key: str) -> None:
+        """Refresh the PodGroup mirror's status after a pool-driven
+        reservation change (no job reconcile fires for those)."""
+        namespace, _, name = gang_key.partition("/")
+        with self._lock:
+            state = self._gangs.get(gang_key)
+            if state is None:
+                return
+            phase = "Reserved" if state.slice_name else "Pending"
+            slice_name = state.slice_name or ""
+        try:
+            pg = self.store.get("PodGroup", namespace, name)
+        except NotFound:
+            return
+        if (pg.status.phase, pg.status.slice_name) == (phase, slice_name):
+            return
+        pg.status.phase = phase
+        pg.status.slice_name = slice_name
+        try:
+            write_status(self.store, pg)
+        except (Conflict, NotFound):
+            pass  # next mirror pass converges
+
     # ------------------------------------------------------------------
     # GangScheduler contract
     # ------------------------------------------------------------------
@@ -214,10 +271,11 @@ class TPUSliceAdmitter(GangScheduler):
     def _free_slices(self) -> List[SliceInfo]:
         return [s for s in self._slices.values() if s.reserved_by is None]
 
-    def _reserve_waiting(self) -> None:
+    def _reserve_waiting(self) -> List[str]:
         """Grant free slices to waiting gangs in (priority desc, FIFO) order
         so a freed slice goes to the front of the queue, not to whichever
-        gang's executor poll happens to run next."""
+        gang's executor poll happens to run next. Returns the keys of
+        gangs that obtained a reservation in this pass."""
         waiting = sorted(
             (
                 (k, s) for k, s in self._gangs.items()
@@ -225,8 +283,12 @@ class TPUSliceAdmitter(GangScheduler):
             ),
             key=lambda kv: (-kv[1].priority, kv[1].seq),
         )
+        granted = []
         for key, state in waiting:
             self._try_reserve(key, state)
+            if state.slice_name is not None:
+                granted.append(key)
+        return granted
 
     def _try_reserve(self, key: str, state: _GangState) -> None:
         if state.slice_name is not None or state.tpu_chips <= 0:

@@ -29,7 +29,12 @@ _CORE_RE = re.compile(
 _GROUP_RE = re.compile(
     r"^/apis/([^/]+)/([^/]+)/namespaces/([^/]+)/([^/]+)(?:/([^/]+)(?:/(status))?)?$"
 )
+# cluster-scoped core resources, e.g. /api/v1/nodes[/{name}[/status]]
+_CLUSTER_RE = re.compile(r"^/api/v1/([^/]+)(?:/([^/]+)(?:/(status))?)?$")
 _DISCOVERY_RE = re.compile(r"^/apis/([^/]+)/([^/]+)$")
+
+# namespace key used for cluster-scoped objects in the state buckets
+CLUSTER_NS = ""
 
 
 class _State:
@@ -44,6 +49,8 @@ class _State:
         # their status silently dropped, like a real apiserver with
         # `subresources: status: {}` in the CRD
         self.status_subresources: set = set()
+        # cluster-scoped resources (no namespace segment), e.g. ("v1","nodes")
+        self.cluster_resources: set = set()
         self.watchers: List["_Watcher"] = []
         self.uid = 0
         # (method, path-sans-query, is_watch) per request — lets tests
@@ -149,6 +156,11 @@ class _Handler(BaseHTTPRequestHandler):
         if m:
             group, version, ns, plural, name, sub = m.groups()
             return f"{group}/{version}", plural, ns, name, sub
+        m = _CLUSTER_RE.match(path)
+        if m:
+            plural, name, sub = m.groups()
+            if ("v1", plural) in self.state.cluster_resources:
+                return "v1", plural, CLUSTER_NS, name, sub
         return None
 
     def _params(self) -> Dict[str, str]:
@@ -393,6 +405,8 @@ class FakeApiServer:
         self.register_resource("v1", "pods", "Pod", status_subresource=True)
         self.register_resource("v1", "services", "Service")
         self.register_resource("v1", "events", "Event")
+        self.register_resource("coordination.k8s.io/v1", "leases", "Lease")
+        self.register_resource("v1", "nodes", "Node", namespaced=False)
         self.register_resource(
             "scheduling.kubedl-tpu.io/v1alpha1", "podgroups", "PodGroup",
             status_subresource=True,
@@ -404,13 +418,20 @@ class FakeApiServer:
         return f"http://{host}:{port}"
 
     def register_resource(
-        self, gv: str, plural: str, kind: str, status_subresource: bool = False
+        self,
+        gv: str,
+        plural: str,
+        kind: str,
+        status_subresource: bool = False,
+        namespaced: bool = True,
     ) -> None:
         state: _State = self._httpd.state  # type: ignore[attr-defined]
         with state.lock:
             state.resources[(gv, plural)] = kind
             if status_subresource:
                 state.status_subresources.add((gv, plural))
+            if not namespaced:
+                state.cluster_resources.add((gv, plural))
 
     def register_workload_crds(self) -> None:
         from kubedl_tpu.k8s.resources import register_workload_kinds, registered_kinds

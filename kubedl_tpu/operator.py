@@ -62,6 +62,10 @@ class OperatorConfig:
     # operators are single-instance — the CLI `operator` command enables it)
     enable_leader_election: bool = False
     leader_lease_path: str = DEFAULT_LEASE_PATH
+    # kube mode: coordination.k8s.io Lease timing (client-go-ish defaults)
+    leader_lease_duration: float = 15.0
+    leader_renew_period: float = 5.0
+    leader_retry_period: float = 2.0
     # Kubernetes mode: reconcile real Pod/Service objects on a cluster
     # through the kube-apiserver instead of the in-process store + local
     # executor (ref main.go:70-75 manager-over-client-go). "in-cluster"
@@ -106,7 +110,8 @@ class Operator:
         self._kind_by_lower: Dict[str, str] = {}
         self._started = False
         self._stopping = threading.Event()
-        self.elector: Optional[FileLeaseElector] = None
+        self.elector = None  # FileLeaseElector | KubeLeaseElector
+        self.node_inventory = None  # kube mode: slice pool from node labels
         # storage persistence (ref main.go:97-100): backends resolved at
         # start() so every registered workload gets a persist controller
         self.object_backend = None
@@ -180,7 +185,23 @@ class Operator:
         if self._started:
             return True
         if self.config.enable_leader_election:
-            self.elector = FileLeaseElector(self.config.leader_lease_path)
+            if self.kube_mode:
+                # apiserver-backed Lease: replicas on different nodes
+                # contend through coordination.k8s.io like the reference
+                # (ref main.go:56,70-75); losing the lease stops the
+                # manager — the reference's process would exit
+                from kubedl_tpu.k8s.leader import KubeLeaseElector
+
+                self.elector = KubeLeaseElector(
+                    self.store.client,
+                    namespace=self.config.kube_namespace,
+                    lease_duration=self.config.leader_lease_duration,
+                    renew_period=self.config.leader_renew_period,
+                    retry_period=self.config.leader_retry_period,
+                    on_lost=self._on_leadership_lost,
+                )
+            else:
+                self.elector = FileLeaseElector(self.config.leader_lease_path)
             if not self.elector.acquire(timeout=timeout, stop=self._stopping.is_set):
                 return False
         self._started = True
@@ -197,6 +218,20 @@ class Operator:
             kinds = sorted({*self.reconcilers, "Pod", "Service"})
             if not self.store.wait_for_cache_sync(kinds, timeout=30.0):
                 log.warning("informer cache not synced within 30s; reads stay uncached")
+        if (
+            self.kube_mode
+            and not self.config.tpu_slices
+            and isinstance(self._gang, TPUSliceAdmitter)
+        ):
+            # derive the slice pool from what GKE actually provisioned
+            # (node labels), keeping --tpu-slices as an explicit override
+            from kubedl_tpu.k8s.nodes import NodeInventory
+
+            self.node_inventory = NodeInventory(
+                self.store.client, on_change=self._gang.set_pool
+            )
+            self.node_inventory.start()
+            self.runtime_metrics.register_slice_pool(self._gang.utilization)
         return True
 
     def _setup_persistence(self) -> None:
@@ -235,8 +270,14 @@ class Operator:
             region=self.config.region,
         )
 
+    def _on_leadership_lost(self) -> None:
+        log.error("leadership lost — stopping reconcilers (standby takes over)")
+        self.stop()
+
     def stop(self) -> None:
         self._stopping.set()
+        if self.node_inventory is not None:
+            self.node_inventory.stop()
         self.manager.stop()
         if self.elector is not None:
             self.elector.release()

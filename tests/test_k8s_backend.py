@@ -248,7 +248,7 @@ TFJOB = {
 }
 
 
-def _play_kubelet(store, job_name, phase, stop, n=2):
+def _play_kubelet(store, job_name, phase, stop, n=2, container="tensorflow"):
     """Background kubelet: move this job's pods to `phase`."""
     deadline = time.monotonic() + 30
     moved = set()
@@ -260,7 +260,7 @@ def _play_kubelet(store, job_name, phase, stop, n=2):
             if phase == PodPhase.SUCCEEDED:
                 pod.status.container_statuses = [
                     ContainerStatus(
-                        name="tensorflow",
+                        name=container,
                         terminated=ContainerStateTerminated(exit_code=0),
                     )
                 ]
@@ -500,3 +500,106 @@ def test_cache_resyncs_after_watch_stop(srv):
         time.sleep(0.02)
     # stale cache must not serve reads once its feeder is gone
     assert not kstore.cache.synced("Pod")
+
+
+# ---------------------------------------------------------------------------
+# Gang admission over the wire (VERDICT r2 missing #3): a gang-enabled
+# JAXJob mirrors a PodGroup through the apiserver — spec on the main path,
+# phase through /status — binds pods to the gang, and cleans up the
+# PodGroup when the job terminates.
+# ---------------------------------------------------------------------------
+
+
+JAXJOB_GANG = {
+    "apiVersion": "kubedl-tpu.io/v1alpha1",
+    "kind": "JAXJob",
+    "metadata": {"name": "gang-jax", "namespace": "default"},
+    "spec": {
+        "runPolicy": {
+            "cleanPodPolicy": "None",
+            "schedulingPolicy": {"tpuSlice": "v5e-8"},
+        },
+        "jaxReplicaSpecs": {
+            "Worker": {
+                "replicas": 2,
+                "restartPolicy": "Never",
+                "template": {"spec": {"containers": [{
+                    "name": "jax",
+                    "image": "img",
+                    "resources": {"limits": {"google.com/tpu": 4}},
+                }]}},
+            }
+        },
+    },
+}
+
+
+def test_gang_podgroup_lifecycle_over_kube_store(srv):
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    kstore = KubeObjectStore(KubeClient(srv.url))
+    op = Operator(
+        OperatorConfig(
+            workloads="jax", enable_gang_scheduling=True, tpu_slices=["v5e-8"],
+        ),
+        store=kstore,
+    )
+    op.register_all()
+    op.start()
+    stop = threading.Event()
+    raw = KubeClient(srv.url)
+    pg_path = (
+        "/apis/scheduling.kubedl-tpu.io/v1alpha1/namespaces/default/podgroups/gang-jax"
+    )
+    try:
+        job = op.apply(dict(JAXJOB_GANG))
+
+        # PodGroup appears on the wire with spec AND status (phase written
+        # through /status — a main-path write would be dropped)
+        deadline = time.monotonic() + 15
+        pg = None
+        while time.monotonic() < deadline:
+            try:
+                pg = raw.request("GET", pg_path)
+                if (pg.get("status") or {}).get("phase"):
+                    break
+            except KubeApiError:
+                pass
+            time.sleep(0.05)
+        assert pg is not None, "PodGroup never created"
+        assert pg["spec"]["minMember"] == 2
+        assert pg["spec"]["tpuChips"] == 8
+        assert pg["status"]["phase"] == "Reserved"
+        assert pg["status"]["sliceName"]
+
+        # both pods bound to the gang
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            pods = kstore.list("Pod", "default", {"job-name": "gang-jax"})
+            if len(pods) == 2:
+                break
+            time.sleep(0.05)
+        from kubedl_tpu.gang.interface import ANNOTATION_GANG_NAME
+
+        for p in pods:
+            assert p.metadata.annotations[ANNOTATION_GANG_NAME] == "default/gang-jax"
+            assert p.spec.scheduler_name == "tpu-slice"
+
+        # kubelet: run + succeed -> job terminates -> PodGroup deleted
+        _play_kubelet(kstore, "gang-jax", PodPhase.RUNNING, stop, container="jax")
+        assert op.wait_for_condition(job, "Running", timeout=15)
+        _play_kubelet(kstore, "gang-jax", PodPhase.SUCCEEDED, stop, container="jax")
+        assert op.wait_for_condition(job, "Succeeded", timeout=15)
+
+        deadline = time.monotonic() + 10
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                raw.request("GET", pg_path)
+                time.sleep(0.05)
+            except KubeApiError as e:
+                gone = e.status == 404
+        assert gone, "PodGroup not cleaned up on job termination"
+    finally:
+        stop.set()
+        op.stop()
