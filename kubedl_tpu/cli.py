@@ -92,6 +92,14 @@ def _format_row(row, widths) -> str:
     return "".join(str(c).ljust(widths[i]) for i, c in enumerate(row)).rstrip()
 
 
+def _grow_widths(widths, row) -> None:
+    """Widen columns for a continuation row longer than anything in the
+    initial snapshot, so later rows stay aligned with each other."""
+    for i, cell in enumerate(row):
+        if i < len(widths):
+            widths[i] = max(widths[i], len(str(cell)) + 2)
+
+
 def _print_table(rows):
     """Print aligned rows; returns the column widths so continuation rows
     (watch mode) can keep the alignment."""
@@ -163,9 +171,11 @@ def cmd_get(args) -> int:
                 current.add((ns, name))
                 if seen.get((ns, name)) != st:
                     seen[(ns, name)] = st
+                    _grow_widths(widths, (ns, name, st))
                     print(_format_row((ns, name, st), widths), flush=True)
             for key in sorted(set(seen) - current):
                 del seen[key]
+                _grow_widths(widths, (key[0], key[1], "Deleted"))
                 print(_format_row((key[0], key[1], "Deleted"), widths),
                       flush=True)
     except KeyboardInterrupt:

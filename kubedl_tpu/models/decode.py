@@ -165,6 +165,14 @@ def decode_step(
     if pos.ndim == 0:
         logits, cache = decode_block_step(params, token[:, None], cache, config)
         return logits[:, 0], cache
+    max_cap = cache["k"][0].shape[2]
+    if not isinstance(pos, jax.core.Tracer) and int(jnp.max(pos)) + 1 > max_cap:
+        # same guard as decode_block_step: a clamped write offset would
+        # silently overwrite the last cache position for the full rows
+        raise ValueError(
+            f"ragged cache row at {int(jnp.max(pos))} of {max_cap} positions; "
+            f"appending 1 more overflows it — init a larger max_len"
+        )
 
     positions = pos[:, None]  # [b, 1] — per-row RoPE positions
     write_row = jax.vmap(
@@ -588,11 +596,11 @@ def generate_speculative(
         return d_cache, drafted, q
 
     def cond(state):
-        _, n, _, _, _, _, _ = state
+        _, n, _, _, _, _, _, _ = state
         return n < max_new_tokens
 
     def round_body(state):
-        cur, n, out, t_cache, d_cache, rounds, key = state
+        cur, n, out, t_cache, d_cache, rounds, acc, key = state
         key, kd, ka, kf = jax.random.split(key, 4)
         pos = t_cache["lengths"]  # == d_cache["lengths"]
         d_cache, drafted, q = draft_round(d_cache, cur, kd)  # [k], [k, V]
@@ -634,21 +642,22 @@ def generate_speculative(
         # roll both caches back to the accepted prefix (cur + a drafts)
         t_cache = dict(t_cache, lengths=pos + a + 1)
         d_cache = dict(d_cache, lengths=pos + a + 1)
-        return bonus[None], n + a + 1, out, t_cache, d_cache, rounds + 1, key
+        return (bonus[None], n + a + 1, out, t_cache, d_cache, rounds + 1,
+                acc + a, key)
 
     state = (cur, jnp.asarray(1, jnp.int32), out, t_cache, d_cache,
-             jnp.asarray(0, jnp.int32), key)
-    _, n, out, _, _, rounds, _ = jax.lax.while_loop(cond, round_body, state)
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32), key)
+    _, n, out, _, _, rounds, acc, _ = jax.lax.while_loop(cond, round_body, state)
     toks = out[:, :max_new_tokens]
     if not return_stats:
         return toks
-    # n-1 tokens were emitted by rounds (the first came from prefill);
-    # each round emits accepted+1, so mean accepted = (n-1)/rounds - 1.
-    # Zero rounds (max_new_tokens == 1: prefill alone suffices) reports
-    # acceptance 0 — there was nothing to accept.
+    # Acceptance comes from a DIRECT count of verifier-accepted drafts
+    # (`acc`), not from n-arithmetic: the final round can overshoot
+    # max_new_tokens and deriving from the trimmed n would misreport the
+    # draft-quality stat either way (inflated if untrimmed, deflated if
+    # clamped). Zero rounds (max_new_tokens == 1: prefill alone
+    # suffices) reports acceptance 0 — there was nothing to accept.
     r = jnp.maximum(rounds, 1).astype(jnp.float32)
-    mean_accepted = jnp.where(
-        rounds > 0, (n - 1).astype(jnp.float32) / r - 1.0, 0.0
-    )
+    mean_accepted = jnp.where(rounds > 0, acc.astype(jnp.float32) / r, 0.0)
     stats = {"rounds": rounds, "acceptance": mean_accepted / (k - 1)}
     return toks, stats

@@ -39,12 +39,17 @@ def new_event_backend(name: str, **kwargs) -> EventStorageBackend:
 
 def register_default_backends() -> None:
     """Ref registry.go RegisterStorageBackends called from main.go:97."""
+    from kubedl_tpu.storage.gcs_backend import GCSBackend
     from kubedl_tpu.storage.jsonl_backend import JSONLBackend
 
     register_object_backend("sqlite", SQLiteBackend)
     register_event_backend("sqlite", SQLiteBackend)
     register_object_backend("jsonl", JSONLBackend)
     register_event_backend("jsonl", JSONLBackend)
+    # remote backend: GCS JSON API (the reference's registry equally hosts
+    # networked MySQL/SLS backends — mysql.go:57-443, sls_logstore.go:45-279)
+    register_object_backend("gcs", GCSBackend)
+    register_event_backend("gcs", GCSBackend)
 
 
 register_default_backends()

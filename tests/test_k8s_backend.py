@@ -603,3 +603,107 @@ def test_gang_podgroup_lifecycle_over_kube_store(srv):
     finally:
         stop.set()
         op.stop()
+
+
+# ---------------------------------------------------------------------------
+# All five workloads converge over the wire path (VERDICT r2 next #7) —
+# the reference's per-workload suites (SURVEY §4 item 4) lifted to HTTP,
+# with the GKE TPU mutator asserted on the flagship JAXJob.
+# ---------------------------------------------------------------------------
+
+
+WORKLOADS = {
+    "TFJob": dict(
+        api="kubeflow.org/v1", key="tfReplicaSpecs", workloads="tensorflow",
+        container="tensorflow",
+        replicas={"Worker": 2},
+    ),
+    "PyTorchJob": dict(
+        api="kubeflow.org/v1", key="pytorchReplicaSpecs", workloads="pytorch",
+        container="pytorch",
+        replicas={"Master": 1, "Worker": 1},
+    ),
+    "XDLJob": dict(
+        api="xdl.kubedl.io/v1alpha1", key="xdlReplicaSpecs", workloads="xdl",
+        container="xdl",
+        replicas={"Worker": 2},
+    ),
+    "XGBoostJob": dict(
+        api="xgboostjob.kubeflow.org/v1alpha1", key="xgbReplicaSpecs",
+        workloads="xgboost", container="xgboostjob",
+        replicas={"Master": 1, "Worker": 1},
+    ),
+    "JAXJob": dict(
+        api="kubedl-tpu.io/v1alpha1", key="jaxReplicaSpecs", workloads="jax",
+        container="jax",
+        replicas={"Worker": 2}, tpu=4,
+    ),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(WORKLOADS))
+def test_workload_converges_over_kube_store(srv, kind):
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    cfg = WORKLOADS[kind]
+    name = f"conv-{kind.lower()}"
+    container = {"name": cfg["container"], "image": "img"}
+    if cfg.get("tpu"):
+        container["resources"] = {"limits": {"google.com/tpu": cfg["tpu"]}}
+    manifest = {
+        "apiVersion": cfg["api"], "kind": kind,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "runPolicy": {"cleanPodPolicy": "None"},
+            cfg["key"]: {
+                rt: {
+                    "replicas": n, "restartPolicy": "Never",
+                    "template": {"spec": {"containers": [dict(container)]}},
+                }
+                for rt, n in cfg["replicas"].items()
+            },
+        },
+    }
+    if cfg.get("tpu"):
+        manifest["spec"]["runPolicy"]["schedulingPolicy"] = {"tpuSlice": "v5e-8"}
+
+    n_pods = sum(cfg["replicas"].values())
+    kstore = KubeObjectStore(KubeClient(srv.url))
+    op = Operator(OperatorConfig(workloads=cfg["workloads"]), store=kstore)
+    op.register_all()
+    op.start()
+    stop = threading.Event()
+    try:
+        job = op.apply(manifest)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            pods = kstore.list("Pod", "default", {"job-name": name})
+            if len(pods) == n_pods:
+                break
+            time.sleep(0.05)
+        assert len(pods) == n_pods, f"{kind}: {len(pods)} pods"
+
+        if kind == "JAXJob":
+            # GKE TPU mutator on the wire (ref tensorflow.go:122-136 DNS
+            # scheme applied to the TPU bootstrap contract)
+            p0 = next(p for p in sorted(pods, key=lambda p: p.metadata.name))
+            assert p0.spec.containers[0].resources.tpu_chips() == 4
+            assert p0.spec.node_selector["cloud.google.com/gke-tpu-topology"] == "2x4"
+            assert p0.spec.node_selector["cloud.google.com/gke-tpu-accelerator"] == (
+                "tpu-v5litepod-slice"
+            )
+            env = p0.spec.containers[0].env
+            assert env["TPU_WORKER_ID"] == "0"
+            assert env["TPU_WORKER_HOSTNAMES"] == (
+                f"{name}-worker-0.default,{name}-worker-1.default"
+            )
+
+        _play_kubelet(kstore, name, PodPhase.RUNNING, stop, n=n_pods,
+                      container=cfg["container"])
+        assert op.wait_for_condition(job, "Running", timeout=15), kind
+        _play_kubelet(kstore, name, PodPhase.SUCCEEDED, stop, n=n_pods,
+                      container=cfg["container"])
+        assert op.wait_for_condition(job, "Succeeded", timeout=15), kind
+    finally:
+        stop.set()
+        op.stop()

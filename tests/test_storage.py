@@ -168,14 +168,24 @@ def test_tenancy_parse_roundtrip():
         get_tenancy(job)
 
 
-# -- object/event backends (parameterized: registry hosts two impls,
-# like the reference's MySQL + SLS pair) ---------------------------------
+# -- object/event backends (parameterized: registry hosts three impls —
+# two local, one REMOTE over the GCS wire protocol, like the reference's
+# MySQL + SLS pair) ------------------------------------------------------
 
 
-@pytest.fixture(params=["sqlite", "jsonl"])
+@pytest.fixture(params=["sqlite", "jsonl", "gcs"])
 def backend(request):
     from kubedl_tpu.storage.registry import new_object_backend
 
+    if request.param == "gcs":
+        from kubedl_tpu.storage.fake_gcs import FakeGCSServer
+
+        with FakeGCSServer() as srv:
+            b = new_object_backend("gcs", endpoint=srv.url, bucket="history")
+            b.initialize()
+            yield b
+            b.close()
+        return
     b = new_object_backend(request.param)
     b.initialize()
     yield b
@@ -278,10 +288,18 @@ def test_event_save_and_list(backend):
 # -- persist controllers e2e ---------------------------------------------
 
 
-@pytest.mark.parametrize("backend_name", ["sqlite", "jsonl"])
-def test_persist_mirrors_job_lifecycle(tmp_path, backend_name):
+@pytest.mark.parametrize("backend_name", ["sqlite", "jsonl", "gcs"])
+def test_persist_mirrors_job_lifecycle(tmp_path, backend_name, monkeypatch):
     from kubedl_tpu.operator import Operator, OperatorConfig
     from fake_workload import TestJobController
+
+    gcs_srv = None
+    if backend_name == "gcs":
+        from kubedl_tpu.storage.fake_gcs import FakeGCSServer
+
+        gcs_srv = FakeGCSServer().start()
+        monkeypatch.setenv("GCS_ENDPOINT", gcs_srv.url)
+        monkeypatch.setenv("GCS_BUCKET", "history")
 
     db = str(tmp_path / "history.db")
     op = Operator(
@@ -324,6 +342,8 @@ def test_persist_mirrors_job_lifecycle(tmp_path, backend_name):
         assert row.deleted == 1 and row.is_in_etcd == 0
     finally:
         op.stop()
+        if gcs_srv is not None:
+            gcs_srv.stop()
 
 
 def test_jsonl_backend_replays_log_after_restart(tmp_path):
