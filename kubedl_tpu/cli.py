@@ -44,6 +44,9 @@ def _mk_operator(args) -> Operator:
             storage_db_path=args.storage_db_path,
             enable_leader_election=getattr(args, "enable_leader_election", False),
             leader_lease_path=getattr(args, "leader_lease_path", DEFAULT_LEASE_PATH),
+            leader_lease_duration=getattr(args, "leader_lease_duration", 15.0),
+            leader_renew_period=getattr(args, "leader_renew_period", 5.0),
+            leader_retry_period=getattr(args, "leader_retry_period", 2.0),
             kube_api_url=getattr(args, "kube_api_url", ""),
             kube_namespace=getattr(args, "kube_namespace", "default"),
         )
@@ -407,6 +410,10 @@ def main(argv=None) -> int:
     p_op.add_argument("--enable-leader-election", action=argparse.BooleanOptionalAction,
                       default=True)
     p_op.add_argument("--leader-lease-path", default=DEFAULT_LEASE_PATH)
+    # kube mode elects on a coordination.k8s.io Lease; client-go-ish timing
+    p_op.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p_op.add_argument("--leader-renew-period", type=float, default=5.0)
+    p_op.add_argument("--leader-retry-period", type=float, default=2.0)
     p_op.add_argument("--kube-api-url", default="",
                       help="reconcile real cluster objects through this "
                            "kube-apiserver ('in-cluster' = service account)")

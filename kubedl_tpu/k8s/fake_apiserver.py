@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -313,7 +314,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             st.uid += 1
             meta.setdefault("uid", f"fake-uid-{st.uid}")
-            meta.setdefault("creationTimestamp", 0)
+            meta.setdefault("creationTimestamp", time.time())
             meta["resourceVersion"] = st.next_rv()
             bucket[(ns, name)] = obj
             st.emit("ADDED", gv, plural, obj)

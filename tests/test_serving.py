@@ -1,0 +1,99 @@
+"""Continuous-batching serving engine (models/serving.py): greedy parity
+with single-request generate, mid-flight admission, slot reuse, EOS."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.models import decode, llama
+from kubedl_tpu.models.serving import ServingEngine, _bucket
+
+
+@pytest.fixture(scope="module")
+def model():
+    # fp32: the parity assertions compare greedy argmax across the ragged
+    # serving path and the uniform generate path — bf16 rounding produces
+    # spurious tie flips between two mathematically-identical attentions
+    config = llama.LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def ref_generate(params, config, prompt, n):
+    """Single-request greedy reference through the plain decode path."""
+    toks = decode.generate(
+        params, jnp.asarray(prompt, jnp.int32)[None, :], config,
+        max_new_tokens=n, max_len=len(prompt) + n)
+    return [int(t) for t in np.asarray(jax.device_get(toks))[0]]
+
+
+def test_bucket_selection():
+    assert _bucket(3, [16, 32]) == 16
+    assert _bucket(16, [16, 32]) == 16
+    assert _bucket(17, [16, 32]) == 32
+    with pytest.raises(ValueError):
+        _bucket(33, [16, 32])
+
+
+def test_greedy_parity_with_generate(model):
+    params, config = model
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+        for n in (3, 7, 12, 5)
+    ]
+    eng = ServingEngine(params, config, slots=3, max_len=64)
+    outs = eng.serve_all(prompts, max_new_tokens=6)
+    for prompt, out in zip(prompts, outs):
+        assert out == ref_generate(params, config, prompt, 6)
+    st = eng.stats()
+    assert st["admitted"] == 4 and st["tokens_out"] == 24
+    assert st["slots_busy"] == 0 and st["queue_depth"] == 0
+
+
+def test_midflight_admission_and_slot_reuse(model):
+    params, config = model
+    rng = np.random.default_rng(1)
+    eng = ServingEngine(params, config, slots=2, max_len=64)
+    p = lambda n: rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+
+    a = eng.submit(p(4), max_new_tokens=3)
+    b = eng.submit(p(6), max_new_tokens=8)
+    eng.step()  # both admitted (a got its prefill token + 1 tick token)
+    assert eng.stats()["slots_busy"] == 2
+    # c waits: no free slot
+    c = eng.submit(p(5), max_new_tokens=2)
+    eng.step()
+    assert eng.stats()["queue_depth"] == 1
+    while not a.done:
+        eng.step()
+    # a's slot freed -> c admitted on a later step while b still runs
+    while not c.done:
+        eng.step()
+    assert not b.done  # b (8 tokens) outlives c (2)
+    while not b.done:
+        eng.step()
+    # every request matches its single-stream reference
+    for req, n in ((a, 3), (b, 8), (c, 2)):
+        assert req.tokens == ref_generate(params, config, req.prompt, n)
+
+
+def test_eos_frees_slot_early(model):
+    params, config = model
+    prompt = np.arange(1, 6, dtype=np.int32)
+    full = ref_generate(params, config, prompt, 8)
+    eos = full[2]  # pretend the 3rd emitted token is EOS
+    eng = ServingEngine(params, config, slots=1, max_len=64)
+    out = eng.serve_all([prompt], max_new_tokens=8, eos_token=eos)[0]
+    assert out == full[:3]
+    assert eng.stats()["slots_busy"] == 0
+
+
+def test_submit_validation(model):
+    params, config = model
+    eng = ServingEngine(params, config, slots=1, max_len=32)
+    with pytest.raises(ValueError):
+        eng.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(30, np.int32), 8)  # 30 + 8 > 32
