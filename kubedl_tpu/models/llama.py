@@ -53,6 +53,12 @@ class LlamaConfig:
     # of remat's HBM win at a fraction of its ~15-35% step-time cost
     remat_policy: Optional[str] = None
     use_flash: bool = True
+    # context-parallel attention strategy when the mesh's "context" axis
+    # is >1: "ring" rotates K/V with ppermute (any P, score memory t/P);
+    # "ulysses" all-to-alls into head shards and runs plain full-sequence
+    # attention per rank (cheaper comms at small P, capped at the head
+    # count) — see ops/ulysses.py for the trade-off.
+    context_parallel: str = "ring"
     tie_embeddings: bool = False
     # >1: compute the training loss over this many vocab chunks instead of
     # materializing [b, t, vocab] f32 logits (a 1 GB HBM round-trip at
@@ -249,7 +255,13 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if context_size > 1:
-        attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+        if config.context_parallel == "ulysses":
+            from kubedl_tpu.ops.ulysses import ulysses_attention
+
+            attn = ulysses_attention(
+                q, k, v, mesh=mesh, causal=True, use_flash=config.use_flash)
+        else:
+            attn = ring_attention(q, k, v, mesh=mesh, causal=True)
     elif config.use_flash:
         attn = flash_attention(q, k, v, causal=True)
     else:

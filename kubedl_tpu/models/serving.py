@@ -84,6 +84,10 @@ class ServingEngine:
                 b *= 2
             prompt_buckets.append(max_len)
         self.prompt_buckets = sorted(prompt_buckets)
+        if self.prompt_buckets[-1] > max_len:
+            raise ValueError(
+                f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"max_len {max_len} — prefill could not fit the scratch cache")
         self.temperature = temperature
         self._key = jax.random.PRNGKey(seed)
 
@@ -216,7 +220,9 @@ class ServingEngine:
         """Admit waiting requests, advance every active slot one token.
         Returns the number of active slots this tick."""
         self._admit()
-        n_active = int(jax.device_get(jnp.sum(self.active)))
+        # host-side count: _slot_req mirrors `active` exactly, and a
+        # device_get here would sync the host against every tick
+        n_active = sum(1 for r in self._slot_req if r is not None)
         if n_active == 0:
             return 0
         self._key, sub = jax.random.split(self._key)

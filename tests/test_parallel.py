@@ -248,3 +248,76 @@ def test_remat_policy_dots_matches_full_remat():
     np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses all-to-all sequence parallelism (ops/ulysses.py) — the second
+# long-context strategy alongside the ring.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_reference(causal):
+    from kubedl_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh({"context": 4, "data": 2})
+    b, h, t, d = 2, 4, 256, 32
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, t, d))
+    v = jax.random.normal(ks[2], (b, h, t, d))
+    out = ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+
+
+def test_ulysses_attention_gradients():
+    from kubedl_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh({"context": 4, "data": 2})
+    b, h, t, d = 2, 4, 128, 32
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, t, d))
+    v = jax.random.normal(ks[2], (b, h, t, d))
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh=mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    gu = jax.grad(loss_uly, argnums=(0, 1, 2))(q, k, v)
+    gref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gu, gref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-3, rtol=5e-3, err_msg=f"d{name}"
+        )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from kubedl_tpu.ops.ulysses import ulysses_attention
+
+    mesh = build_mesh({"context": 8})
+    q = jnp.zeros((1, 4, 64, 16))  # 4 heads over 8 context shards
+    with pytest.raises(ValueError):
+        ulysses_attention(q, q, q, mesh=mesh)
+
+
+def test_llama_train_step_with_ulysses_context_parallelism():
+    mesh = build_mesh({"data": 2, "context": 4})
+    rules = ShardingRules()
+    cfg = tiny_cfg(context_parallel="ulysses")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    spec_tree = llama.param_specs(cfg, rules)
+
+    def loss(params, batch):
+        return llama.loss_fn(params, batch, cfg, mesh=mesh, rules=rules)
+
+    init_state, train_step = make_train_step(
+        loss, optax.adam(1e-3), mesh, spec_tree, rules.spec("batch", None), rules
+    )
+    state = init_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, cfg.vocab_size)
+    state, metrics = train_step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
