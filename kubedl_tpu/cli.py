@@ -352,6 +352,28 @@ def cmd_operator(args) -> int:
     return 0
 
 
+def cmd_webhook(args) -> int:
+    """Serve admission webhooks until interrupted (docs/kubernetes.md)."""
+    from kubedl_tpu.k8s.webhook import AdmissionWebhookServer
+
+    srv = AdmissionWebhookServer(
+        bind=args.bind, port=args.port,
+        certfile=args.tls_cert or None, keyfile=args.tls_key or None,
+    ).start()
+    scheme = "https" if args.tls_cert else "http"
+    print(f"admission webhook on {scheme}://{args.bind}:{srv.port} "
+          f"(/validate /mutate /healthz)", flush=True)
+    try:
+        import signal as _signal
+
+        _signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
 def cmd_validate(args) -> int:
     op = _mk_operator(args)
     op.register_all()
@@ -426,6 +448,17 @@ def main(argv=None) -> int:
     p_val = sub.add_parser("validate", help="parse and default manifests")
     p_val.add_argument("-f", "--files", nargs="+", required=True)
     p_val.set_defaults(fn=cmd_validate)
+
+    p_wh = sub.add_parser(
+        "webhook",
+        help="serve admission webhooks (/validate + /mutate AdmissionReview)",
+    )
+    p_wh.add_argument("--bind", default="0.0.0.0")
+    p_wh.add_argument("--port", type=int, default=9443)
+    p_wh.add_argument("--tls-cert", default="",
+                      help="TLS cert path (apiserver requires HTTPS)")
+    p_wh.add_argument("--tls-key", default="")
+    p_wh.set_defaults(fn=cmd_webhook)
 
     # kubectl-style client commands against a running `operator` server
     def client_parser(name, help_):

@@ -1,0 +1,192 @@
+"""Admission webhook (k8s/webhook.py) — AdmissionReview v1 over the wire:
+validating denial with field paths, mutating JSON patch that lands the
+defaulters, fail-open for unhandled kinds. The reference scaffolds
+webhooks without implementing them (SURVEY §2.3)."""
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from kubedl_tpu.k8s.webhook import (
+    AdmissionWebhookServer,
+    apply_patch,
+    json_patch,
+    review_response,
+)
+
+
+def review(obj, uid="u1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": obj,
+                    "kind": {"kind": obj.get("kind", "")}},
+    }
+
+
+TFJOB = {
+    "apiVersion": "kubeflow.org/v1",
+    "kind": "TFJob",
+    "metadata": {"name": "wh-job", "namespace": "default"},
+    "spec": {
+        "tfReplicaSpecs": {
+            "worker": {  # lowercase on purpose: the defaulter canonicalizes
+                "replicas": 2,
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "img"}]}},
+            }
+        }
+    },
+}
+
+
+# -- json patch primitives ---------------------------------------------------
+
+
+def test_json_patch_roundtrip():
+    old = {"a": 1, "b": {"c": [1, 2]}, "gone": True}
+    new = {"a": 2, "b": {"c": [1, 2, 3], "d": "x"}, "added": {"k": "v"}}
+    ops = json_patch(old, new)
+    assert apply_patch(old, ops) == new
+    # escaping: keys with / and ~
+    old, new = {"a/b": 1}, {"a/b": 2, "c~d": 3}
+    ops = json_patch(old, new)
+    assert {"op": "replace", "path": "/a~1b", "value": 2} in ops
+    assert apply_patch(old, ops) == new
+
+
+# -- admission logic ---------------------------------------------------------
+
+
+def test_validate_allows_good_job():
+    out = review_response(review(TFJOB), mutate=False)
+    assert out["response"]["allowed"] is True
+    assert out["response"]["uid"] == "u1"
+
+
+def test_validate_denies_bad_job_with_field_path():
+    bad = json.loads(json.dumps(TFJOB))
+    bad["spec"]["tfReplicaSpecs"]["worker"]["replicas"] = -3
+    out = review_response(review(bad), mutate=False)
+    assert out["response"]["allowed"] is False
+    assert "replicas" in out["response"]["status"]["message"]
+
+
+def test_validate_fails_open_for_unknown_kind():
+    out = review_response(review({"kind": "Deployment"}), mutate=False)
+    assert out["response"]["allowed"] is True
+    assert out["response"]["warnings"]
+
+
+def test_mutate_patch_applies_defaulters():
+    out = review_response(review(TFJOB), mutate=True)
+    resp = out["response"]
+    assert resp["allowed"] is True and resp["patchType"] == "JSONPatch"
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    patched = apply_patch(TFJOB, ops)
+    # the TF defaulter canonicalizes the replica key, injects the port,
+    # sets ExitCode restart + CleanPodPolicy Running (ref defaults.go:92-108)
+    specs = patched["spec"]["tfReplicaSpecs"]
+    assert "Worker" in specs and "worker" not in specs
+    assert specs["Worker"]["restartPolicy"] == "ExitCode"
+    ports = specs["Worker"]["template"]["spec"]["containers"][0]["ports"]
+    assert {"name": "tfjob-port", "containerPort": 2222} in [
+        {k: p[k] for k in ("name", "containerPort")} for p in ports
+    ]
+    assert patched["spec"]["runPolicy"]["cleanPodPolicy"] == "Running"
+    # status is never patched
+    assert not any(op["path"].startswith("/status") for op in ops)
+
+
+# -- wire protocol -----------------------------------------------------------
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_webhook_server_end_to_end():
+    with AdmissionWebhookServer(bind="127.0.0.1", port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        out = _post(f"{base}/validate", review(TFJOB))
+        assert out["response"]["allowed"] is True
+
+        bad = json.loads(json.dumps(TFJOB))
+        bad["spec"]["tfReplicaSpecs"]["worker"]["replicas"] = -1
+        out = _post(f"{base}/validate", review(bad))
+        assert out["response"]["allowed"] is False
+
+        out = _post(f"{base}/mutate", review(TFJOB))
+        ops = json.loads(base64.b64decode(out["response"]["patch"]))
+        assert apply_patch(TFJOB, ops)["spec"]["tfReplicaSpecs"]["Worker"]
+
+        health = urllib.request.urlopen(f"{base}/healthz", timeout=5)
+        assert json.loads(health.read()) == {"ok": True}
+
+
+def test_mutate_never_strips_unmodeled_fields():
+    """Fields the internal dataclasses don't carry (tolerations, affinity,
+    serviceAccountName...) must pass through /mutate untouched — the
+    patch diffs pre-default vs post-default encodes of the SAME decode,
+    so unknown fields appear on neither side."""
+    rich = json.loads(json.dumps(TFJOB))
+    tmpl = rich["spec"]["tfReplicaSpecs"]["worker"]["template"]["spec"]
+    tmpl["tolerations"] = [{"key": "google.com/tpu", "operator": "Exists"}]
+    tmpl["serviceAccountName"] = "train-sa"
+    rich["metadata"]["finalizers"] = ["example.com/guard"]
+    rich["metadata"]["creationTimestamp"] = "2026-01-01T00:00:00Z"
+
+    out = review_response(review(rich), mutate=True)
+    ops = json.loads(base64.b64decode(out["response"]["patch"]))
+    patched = apply_patch(rich, ops)
+
+    spec = patched["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]
+    assert spec["tolerations"] == [{"key": "google.com/tpu", "operator": "Exists"}]
+    assert spec["serviceAccountName"] == "train-sa"
+    assert patched["metadata"]["finalizers"] == ["example.com/guard"]
+    # apiserver-owned timestamp is untouched (no float corruption)
+    assert patched["metadata"]["creationTimestamp"] == "2026-01-01T00:00:00Z"
+    # and the defaulting still happened under the renamed key
+    assert spec["containers"][0]["ports"][0]["name"] == "tfjob-port"
+
+
+def test_webhook_serves_tls(tmp_path):
+    """The apiserver only talks HTTPS; handshake happens per-connection
+    in the worker thread (a silent TCP client must not wedge accept)."""
+    import socket
+    import ssl as ssl_mod
+    import subprocess
+
+    cert, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+    gen = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        capture_output=True,
+    )
+    if gen.returncode != 0:
+        pytest.skip(f"openssl unavailable: {gen.stderr.decode()[:100]}")
+    with AdmissionWebhookServer(bind="127.0.0.1", port=0,
+                                certfile=cert, keyfile=key) as srv:
+        # a do-nothing TCP client parked on the port...
+        lurker = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            # ...must not block a real TLS request behind it
+            ctx = ssl_mod.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl_mod.CERT_NONE
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{srv.port}/validate",
+                data=json.dumps(review(TFJOB)).encode(), method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            out = json.loads(urllib.request.urlopen(req, timeout=10, context=ctx).read())
+            assert out["response"]["allowed"] is True
+        finally:
+            lurker.close()
