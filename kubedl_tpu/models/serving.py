@@ -216,6 +216,28 @@ class ServingEngine:
             self._slot_req[slot] = None
             self.active = self.active.at[slot].set(False)
 
+    def has_pending(self) -> bool:
+        """True while any request is queued or occupying a slot."""
+        return bool(self._queue) or any(r is not None for r in self._slot_req)
+
+    def cancel(self, req: Request) -> None:
+        """Drop a request: dequeue it if still waiting, or free its slot.
+        Safe to call on finished requests (no-op)."""
+        if req.done:
+            return
+        try:
+            self._queue.remove(req)
+            req.done = True
+            return
+        except ValueError:
+            pass
+        for slot, r in enumerate(self._slot_req):
+            if r is req:
+                req.done = True
+                self._slot_req[slot] = None
+                self.active = self.active.at[slot].set(False)
+                return
+
     def step(self) -> int:
         """Admit waiting requests, advance every active slot one token.
         Returns the number of active slots this tick."""

@@ -56,6 +56,60 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def restore_params(path, label="params"):
+    """Newest checkpoint's params under `path`, or None if empty.
+
+    The trainer saves the full TrainState, whose pytree flattens to
+    (params, opt_state, step) — an untargeted restore returns that
+    as a list; keep the params and drop the optimizer."""
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(path)
+    latest = mngr.latest_step()
+    if latest is None:
+        return None
+    restored = mngr.restore(latest)
+    if isinstance(restored, (list, tuple)):
+        tree = restored[0]
+    elif hasattr(restored, "params"):
+        tree = restored.params
+    else:
+        tree = restored["params"]
+    print(f"restored {label} params from checkpoint step {latest}", flush=True)
+    return jax.tree.map(jnp.asarray, tree)
+
+
+def restore_or_init(config, checkpoint_path, allow_fresh_init, seed=0,
+                    label="target"):
+    """Checkpoint params, fresh init, or None (error already printed) —
+    shared by the generate and serve workload entrypoints."""
+    import jax
+
+    from kubedl_tpu.models import llama
+
+    params = None
+    if checkpoint_path:
+        params = restore_params(checkpoint_path, label)
+        if params is None:
+            if not allow_fresh_init:
+                # An explicit checkpoint path with nothing under it means a
+                # missing volume mount or a wrong dir — serving random
+                # weights with exit 0 would hide that.
+                print(f"error: no checkpoint under {checkpoint_path} "
+                      f"(pass --allow-fresh-init to serve random weights)",
+                      file=sys.stderr)
+                return None
+            print(f"no checkpoint under {checkpoint_path}; using fresh init",
+                  flush=True)
+    if params is None:
+        # init only when actually serving fresh weights — a 7B init would
+        # double peak memory next to a restored checkpoint
+        params = llama.init(config, jax.random.PRNGKey(seed))
+    return params
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
 
@@ -70,46 +124,10 @@ def main(argv=None) -> int:
 
     config = llama.LlamaConfig.config_for(args.model)
 
-    def restore_params(path, label):
-        """Newest checkpoint's params under `path`, or None if empty.
-
-        The trainer saves the full TrainState, whose pytree flattens to
-        (params, opt_state, step) — an untargeted restore returns that
-        as a list; keep the params and drop the optimizer."""
-        import orbax.checkpoint as ocp
-
-        mngr = ocp.CheckpointManager(path)
-        latest = mngr.latest_step()
-        if latest is None:
-            return None
-        restored = mngr.restore(latest)
-        if isinstance(restored, (list, tuple)):
-            tree = restored[0]
-        elif hasattr(restored, "params"):
-            tree = restored.params
-        else:
-            tree = restored["params"]
-        print(f"restored {label} params from checkpoint step {latest}", flush=True)
-        return jax.tree.map(jnp.asarray, tree)
-
-    params = None
-    if args.checkpoint_path:
-        params = restore_params(args.checkpoint_path, "target")
-        if params is None:
-            if not args.allow_fresh_init:
-                # An explicit checkpoint path with nothing under it means a
-                # missing volume mount or a wrong dir — serving random
-                # weights with exit 0 would hide that.
-                print(f"error: no checkpoint under {args.checkpoint_path} "
-                      f"(pass --allow-fresh-init to serve random weights)",
-                      file=sys.stderr)
-                return 1
-            print(f"no checkpoint under {args.checkpoint_path}; using fresh init",
-                  flush=True)
+    params = restore_or_init(
+        config, args.checkpoint_path, args.allow_fresh_init, seed=args.seed)
     if params is None:
-        # init only when actually serving fresh weights — a 7B init would
-        # double peak memory next to a restored checkpoint
-        params = llama.init(config, jax.random.PRNGKey(args.seed))
+        return 1
 
     if args.int8:
         from kubedl_tpu.models import quant

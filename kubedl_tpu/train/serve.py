@@ -1,0 +1,223 @@
+"""HTTP serving workload — the continuous-batching engine as a JAXJob.
+
+Completes the operator's train -> checkpoint -> serve loop: a JAXJob
+runs this module (examples/jax_job_serving.yaml), it restores params
+from the trainer's Orbax checkpoint, and serves generation over a small
+JSON API backed by `models/serving.ServingEngine`:
+
+    POST /generate   {"tokens": [..], "max_new_tokens": 64,
+                      "eos_token": 2?}        -> {"tokens": [...]}
+    POST /generate   {"requests": [{...}, ...]}  (batch form; each entry
+                      rides its own engine slot)  -> {"results": [...]}
+    GET  /stats      -> ServingEngine.stats()
+    GET  /healthz    -> {"ok": true}
+
+One background thread drives `engine.step()` whenever work is pending —
+request handlers only enqueue and wait, so concurrent HTTP clients
+batch onto the same decode ticks (that's the continuous-batching win).
+The reference has no serving stack at all (SURVEY §2.4).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger("kubedl_tpu.serve")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-serve")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--allow-fresh-init", action="store_true")
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 (models/quant.py)")
+    p.add_argument("--max-steps", type=int, default=0,
+                   help="stop after N engine ticks (smoke tests); 0 = forever")
+    return p.parse_args(argv)
+
+
+class _Service:
+    """Engine + queue pump shared by all HTTP handler threads."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self._lock = threading.Lock()  # engine calls are single-threaded
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self.ticks = 0
+        self._thread = threading.Thread(
+            target=self._pump, name="serve-pump", daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            if not self._work.wait(timeout=0.1):
+                continue
+            with self._lock:
+                if not self.engine.has_pending():
+                    self._work.clear()
+                    continue
+                self.engine.step()
+                self.ticks += 1
+
+    def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int]):
+        with self._lock:
+            req = self.engine.submit(prompt, max_new_tokens, eos_token)
+        self._work.set()
+        return req
+
+    def wait(self, reqs, timeout: float = 300.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.done for r in reqs):
+                return True
+            self._work.set()
+            time.sleep(0.005)
+        return False
+
+    def cancel(self, reqs) -> None:
+        with self._lock:
+            for r in reqs:
+                self.engine.cancel(r)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    @property
+    def svc(self) -> _Service:
+        return self.server.svc  # type: ignore[attr-defined]
+
+    def _send(self, status: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/healthz":
+            return self._send(200, {"ok": True})
+        if self.path == "/stats":
+            stats = self.svc.engine.stats()
+            stats["ticks"] = self.svc.ticks
+            return self._send(200, stats)
+        self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/generate":
+            return self._send(404, {"error": f"unknown path {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0") or "0")
+            body = json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._send(400, {"error": f"bad JSON: {e}"})
+        if not isinstance(body, dict):
+            return self._send(400, {"error": "body must be a JSON object"})
+        entries = body.get("requests")
+        single = entries is None
+        if single:
+            entries = [body]
+        reqs = []
+        try:
+            for e in entries:
+                if not isinstance(e, dict):
+                    raise ValueError("each request must be a JSON object")
+                reqs.append(self.svc.submit(
+                    e.get("tokens") or [],
+                    int(e.get("max_new_tokens") or 32),
+                    e.get("eos_token"),
+                ))
+        except (ValueError, TypeError) as e:
+            # partially-submitted batch: release what already went in
+            self.svc.cancel(reqs)
+            return self._send(422, {"error": str(e)})
+        if not self.svc.wait(reqs):
+            # client gets a 504 and is gone; orphaned work must not keep
+            # occupying slots generating tokens nobody reads
+            self.svc.cancel(reqs)
+            return self._send(504, {"error": "generation timed out"})
+        results = [{"tokens": r.tokens, "request_id": r.request_id} for r in reqs]
+        self._send(200, results[0] if single else {"results": results})
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.models.serving import ServingEngine
+    from kubedl_tpu.train.generate import restore_or_init
+
+    config = llama.LlamaConfig.config_for(args.model)
+    params = restore_or_init(
+        config, args.checkpoint_path, args.allow_fresh_init, seed=0)
+    if params is None:
+        return 1
+    if args.int8:
+        from kubedl_tpu.models import quant
+
+        params = jax.jit(quant.quantize_params)(params)
+    engine = ServingEngine(
+        params, config, slots=args.slots, max_len=args.max_len,
+        temperature=args.temperature,
+    )
+    svc = _Service(engine)
+    httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)
+    httpd.daemon_threads = True
+    httpd.svc = svc  # type: ignore[attr-defined]
+    host, port = httpd.server_address[:2]
+    print(f"serving {args.model} on http://{host}:{port} "
+          f"(slots={args.slots}, max_len={args.max_len})", flush=True)
+    if args.max_steps:
+        # smoke mode: serve in the background until N ticks happen
+        import time
+
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        while svc.ticks < args.max_steps:
+            time.sleep(0.05)
+        httpd.shutdown()
+        svc.stop()
+        return 0
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""HTTP serving workload (train/serve.py): concurrent clients batch onto
+shared engine ticks; stats/health endpoints; checkpoint-less smoke."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.models.serving import ServingEngine
+    from kubedl_tpu.train.serve import _Handler, _Service
+    from http.server import ThreadingHTTPServer
+
+    config = llama.LlamaConfig.tiny(use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, config, slots=3, max_len=64)
+    svc = _Service(engine)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    httpd.daemon_threads = True
+    httpd.svc = svc
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", config
+    httpd.shutdown()
+    svc.stop()
+
+
+def _post(url, body, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_single_generate(server):
+    base, config = server
+    out = _post(f"{base}/generate",
+                {"tokens": [1, 5, 9], "max_new_tokens": 4})
+    assert len(out["tokens"]) == 4
+    assert all(0 <= t < config.vocab_size for t in out["tokens"])
+
+
+def test_batch_form_and_concurrent_clients(server):
+    base, config = server
+    out = _post(f"{base}/generate", {"requests": [
+        {"tokens": [2, 3], "max_new_tokens": 3},
+        {"tokens": [4, 5, 6, 7], "max_new_tokens": 5},
+    ]})
+    assert [len(r["tokens"]) for r in out["results"]] == [3, 5]
+
+    # concurrent clients share engine ticks (continuous batching)
+    results = {}
+
+    def client(i):
+        results[i] = _post(f"{base}/generate",
+                           {"tokens": [i + 1, i + 2], "max_new_tokens": 4})
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert all(len(results[i]["tokens"]) == 4 for i in range(5))
+
+    stats = json.loads(urllib.request.urlopen(f"{base}/stats", timeout=10).read())
+    assert stats["admitted"] >= 7
+
+
+def test_validation_and_health(server):
+    base, _ = server
+    req = urllib.request.Request(
+        f"{base}/generate", data=json.dumps({"tokens": []}).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 422
+    assert json.loads(urllib.request.urlopen(f"{base}/healthz", timeout=5).read()) == {"ok": True}
+
+
+def test_main_smoke_max_steps(tmp_path, capsys):
+    from kubedl_tpu.train import serve
+
+    # no checkpoint path + fresh init + 0 requests: serve main() must come
+    # up, idle, and exit after ticks... ticks only advance with work, so
+    # drive one request through a thread.
+    import time
+
+    rc = {}
+
+    def run():
+        rc["v"] = serve.main([
+            "--model", "tiny", "--bind", "127.0.0.1", "--port", "18777",
+            "--slots", "2", "--max-len", "32", "--max-steps", "2",
+        ])
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline and not ok:
+        try:
+            out = _post("http://127.0.0.1:18777/generate",
+                        {"tokens": [1, 2], "max_new_tokens": 3}, timeout=5)
+            ok = len(out["tokens"]) == 3
+        except Exception:
+            time.sleep(0.2)
+    t.join(timeout=60)
+    assert ok and rc.get("v") == 0
+
+
+def test_malformed_bodies_get_http_errors(server):
+    base, _ = server
+
+    def post_raw(data):
+        req = urllib.request.Request(
+            f"{base}/generate", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            return 200
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert post_raw(b"[1, 2]") == 400                       # not an object
+    assert post_raw(b"not json") == 400
+    assert post_raw(json.dumps(
+        {"tokens": [1], "max_new_tokens": "many"}).encode()) == 422
+    assert post_raw(json.dumps({"requests": [1]}).encode()) == 422
+    # a half-valid batch must not leak its valid half into the engine
+    assert post_raw(json.dumps({"requests": [
+        {"tokens": [1, 2], "max_new_tokens": 3},
+        {"tokens": [], "max_new_tokens": 3},
+    ]}).encode()) == 422
+    stats = json.loads(urllib.request.urlopen(f"{base}/stats", timeout=10).read())
+    assert stats["queue_depth"] == 0 and stats["slots_busy"] == 0

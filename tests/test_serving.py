@@ -97,3 +97,22 @@ def test_submit_validation(model):
         eng.submit(np.zeros(0, np.int32), 4)
     with pytest.raises(ValueError):
         eng.submit(np.ones(30, np.int32), 8)  # 30 + 8 > 32
+
+
+def test_cancel_frees_queue_and_slot(model):
+    params, config = model
+    eng = ServingEngine(params, config, slots=1, max_len=64)
+    a = eng.submit(np.arange(1, 5, dtype=np.int32), max_new_tokens=50)
+    b = eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=4)
+    eng.step()  # a admitted; b queued behind the single slot
+    assert eng.stats()["queue_depth"] == 1
+    eng.cancel(b)  # dequeued without ever running
+    assert b.done and eng.stats()["queue_depth"] == 0
+    eng.cancel(a)  # slot freed mid-generation
+    assert a.done and eng.stats()["slots_busy"] == 0
+    assert not eng.has_pending()
+    # the freed slot admits new work
+    c = eng.submit(np.arange(1, 4, dtype=np.int32), max_new_tokens=3)
+    while not c.done:
+        eng.step()
+    assert len(c.tokens) == 3
