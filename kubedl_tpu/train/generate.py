@@ -25,6 +25,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-path",
                    default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""),
                    help="trainer Orbax dir; newest step's params are used")
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face Llama name/dir — overrides --model/"
+                        "--checkpoint-path (models/import_hf.py)")
     p.add_argument("--allow-fresh-init", action="store_true",
                    help="serve from random weights when --checkpoint-path "
                         "holds no checkpoint (otherwise that's an error)")
@@ -122,12 +125,17 @@ def main(argv=None) -> int:
 
     from kubedl_tpu.models import decode, llama
 
-    config = llama.LlamaConfig.config_for(args.model)
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
 
-    params = restore_or_init(
-        config, args.checkpoint_path, args.allow_fresh_init, seed=args.seed)
-    if params is None:
-        return 1
+        params, config = load_hf(args.hf_model)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+
+        params = restore_or_init(
+            config, args.checkpoint_path, args.allow_fresh_init, seed=args.seed)
+        if params is None:
+            return 1
 
     if args.int8:
         from kubedl_tpu.models import quant

@@ -36,6 +36,9 @@ def parse_args(argv=None):
                    choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
     p.add_argument("--checkpoint-path",
                    default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face Llama name/dir — overrides --model/"
+                        "--checkpoint-path (models/import_hf.py)")
     p.add_argument("--allow-fresh-init", action="store_true")
     p.add_argument("--bind", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
@@ -177,11 +180,16 @@ def main(argv=None) -> int:
     from kubedl_tpu.models.serving import ServingEngine
     from kubedl_tpu.train.generate import restore_or_init
 
-    config = llama.LlamaConfig.config_for(args.model)
-    params = restore_or_init(
-        config, args.checkpoint_path, args.allow_fresh_init, seed=0)
-    if params is None:
-        return 1
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        params, config = load_hf(args.hf_model)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+        params = restore_or_init(
+            config, args.checkpoint_path, args.allow_fresh_init, seed=0)
+        if params is None:
+            return 1
     if args.int8:
         from kubedl_tpu.models import quant
 
@@ -195,7 +203,8 @@ def main(argv=None) -> int:
     httpd.daemon_threads = True
     httpd.svc = svc  # type: ignore[attr-defined]
     host, port = httpd.server_address[:2]
-    print(f"serving {args.model} on http://{host}:{port} "
+    model_name = args.hf_model or args.model
+    print(f"serving {model_name} on http://{host}:{port} "
           f"(slots={args.slots}, max_len={args.max_len})", flush=True)
     if args.max_steps:
         # smoke mode: serve in the background until N ticks happen
