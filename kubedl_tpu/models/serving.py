@@ -26,6 +26,7 @@ the operator's /metrics endpoint.
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -49,9 +50,10 @@ def _bucket(n: int, buckets: List[int]) -> int:
 @dataclass
 class Request:
     request_id: int
-    prompt: np.ndarray  # [t] int32
+    prompt: np.ndarray  # [t] int32 (the SUFFIX when prefix_id is set)
     max_new_tokens: int
     eos_token: Optional[int] = None
+    prefix_id: Optional[int] = None
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
     done: bool = False
@@ -71,6 +73,7 @@ class ServingEngine:
         prompt_buckets: Optional[List[int]] = None,
         temperature: float = 0.0,
         seed: int = 0,
+        max_prefixes: int = 8,
     ) -> None:
         self.params = params
         self.config = config
@@ -116,6 +119,31 @@ class ServingEngine:
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
 
+        # prefix caching (shared system prompts): prefix K/V computed once
+        # into a uniform batch-1 cache; suffixes append via fixed-size
+        # block steps (compiles bounded by _SUFFIX_CHUNK distinct shapes,
+        # not by suffix length)
+        self._prefixes: Dict[int, tuple] = {}
+        self._next_prefix_id = 0
+        self.max_prefixes = max_prefixes
+        self._prefix_lock = threading.Lock()
+
+        def prefix_prefill_fn(params, prompt):
+            scratch = decode.init_kv_cache(
+                self.config, 1, self.max_len, uniform=True)
+            return decode.prefill(params, prompt, scratch, self.config)
+
+        self._prefix_prefill = jax.jit(prefix_prefill_fn)
+        def append(params, toks, cache):
+            return decode.decode_block_step(
+                params, toks, cache, self.config, return_hidden=True)
+
+        # first suffix chunk must PRESERVE the shared prefix cache; later
+        # chunks own their input (the previous chunk's output) and donate
+        # it, so appends after the first are in place
+        self._append_block = jax.jit(append)
+        self._append_block_donated = jax.jit(append, donate_argnums=(2,))
+
     # -- compiled pieces ---------------------------------------------------
 
     def _insert_impl(self, cache, row_cache, slot, length, first_token,
@@ -154,40 +182,111 @@ class ServingEngine:
 
     # -- public API --------------------------------------------------------
 
+    _SUFFIX_CHUNK = 16  # block size for prefix-append prefill
+
+    def register_prefix(self, tokens) -> int:
+        """Precompute K/V for a shared prompt prefix (system prompt).
+        Requests submitted with the returned id only prefill their
+        SUFFIX — the prefix costs one forward for the engine's lifetime.
+        Each registered prefix holds a full batch-1 [max_len] K/V buffer
+        on device; register a handful, not thousands."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError("empty prefix")
+        if tokens.size >= self.max_len:
+            raise ValueError(
+                f"prefix of {tokens.size} tokens leaves no room in "
+                f"max_len {self.max_len}")
+        with self._prefix_lock:
+            if len(self._prefixes) >= self.max_prefixes:
+                # each prefix pins a full [max_len] K/V buffer on device;
+                # an unbounded registry is an OOM, not a cache
+                raise ValueError(
+                    f"prefix registry full ({self.max_prefixes}); "
+                    f"unregister_prefix one first")
+        # the prefill (and its per-length compile) runs OUTSIDE any lock
+        _, cache = self._prefix_prefill(self.params, jnp.asarray(tokens[None, :]))
+        with self._prefix_lock:
+            if len(self._prefixes) >= self.max_prefixes:
+                raise ValueError(
+                    f"prefix registry full ({self.max_prefixes}); "
+                    f"unregister_prefix one first")
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = (cache, int(tokens.size))
+        return pid
+
+    def unregister_prefix(self, prefix_id: int) -> None:
+        """Release a prefix's device buffers. Queued requests still naming
+        it are failed at admission (empty token list, done=True)."""
+        with self._prefix_lock:
+            self._prefixes.pop(prefix_id, None)
+
     def submit(
         self,
         prompt,
         max_new_tokens: int,
         eos_token: Optional[int] = None,
+        prefix_id: Optional[int] = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
-            raise ValueError("empty prompt")
-        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError("empty prompt (with a prefix, pass at least "
+                             "the first suffix token)")
+        prefix_len = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(f"unknown prefix_id {prefix_id}")
+            prefix_len = self._prefixes[prefix_id][1]
+        if prefix_len + prompt.size + max_new_tokens > self.max_len:
             raise ValueError(
-                f"prompt {prompt.size} + {max_new_tokens} new tokens exceeds "
-                f"max_len {self.max_len}")
-        if prompt.size > self.prompt_buckets[-1]:
+                f"prefix {prefix_len} + prompt {prompt.size} + "
+                f"{max_new_tokens} new tokens exceeds max_len {self.max_len}")
+        if prefix_id is None and prompt.size > self.prompt_buckets[-1]:
             # reject at submission, not when _admit pops it mid-flight
             raise ValueError(
                 f"prompt of {prompt.size} tokens exceeds the largest "
                 f"prompt bucket {self.prompt_buckets[-1]}")
-        req = Request(self._next_id, prompt, max_new_tokens, eos_token)
+        req = Request(self._next_id, prompt, max_new_tokens, eos_token,
+                      prefix_id=prefix_id)
         self._next_id += 1
         self._queue.append(req)
         return req
+
+    def _suffix_prefill(self, prefix_id: int, suffix: np.ndarray):
+        """Append the suffix to a copy of the cached prefix K/V via
+        fixed-size block steps; returns (last-token logits, row cache)."""
+        from kubedl_tpu.models.llama import _lm_head
+
+        cache, _ = self._prefixes[prefix_id]
+        chunk = self._SUFFIX_CHUNK
+        hidden = None
+        for i in range(0, len(suffix), chunk):
+            toks = jnp.asarray(suffix[None, i:i + chunk])
+            fn = self._append_block if i == 0 else self._append_block_donated
+            hidden, cache = fn(self.params, toks, cache)
+        logits = _lm_head(hidden[:, -1:], self.params, self.config)[:, 0]
+        return logits, cache
 
     def _admit(self) -> None:
         while self._queue and None in self._slot_req:
             req = self._queue.popleft()
             slot = self._slot_req.index(None)
             t = len(req.prompt)
-            bucket = _bucket(t, self.prompt_buckets)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :t] = req.prompt
-            logits, row_cache = self._prefill(
-                self.params, jnp.asarray(padded),
-                jnp.asarray([t], jnp.int32))
+            if req.prefix_id is not None:
+                entry = self._prefixes.get(req.prefix_id)
+                if entry is None:  # unregistered while queued
+                    req.done = True
+                    continue
+                t += entry[1]
+                logits, row_cache = self._suffix_prefill(req.prefix_id, req.prompt)
+            else:
+                bucket = _bucket(t, self.prompt_buckets)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :t] = req.prompt
+                logits, row_cache = self._prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray([t], jnp.int32))
             if self.temperature > 0.0:
                 self._key, sub = jax.random.split(self._key)
                 first = jax.random.categorical(

@@ -6,9 +6,11 @@ from the trainer's Orbax checkpoint, and serves generation over a small
 JSON API backed by `models/serving.ServingEngine`:
 
     POST /generate   {"tokens": [..], "max_new_tokens": 64,
-                      "eos_token": 2?}        -> {"tokens": [...]}
+                      "eos_token": 2?, "prefix_id": 0?} -> {"tokens": [...]}
     POST /generate   {"requests": [{...}, ...]}  (batch form; each entry
                       rides its own engine slot)  -> {"results": [...]}
+    POST /prefix     {"tokens": [...]}  -> {"prefix_id": N}   (shared
+                      system prompts prefill once; see register_prefix)
     GET  /stats      -> ServingEngine.stats()
     GET  /healthz    -> {"ok": true}
 
@@ -76,11 +78,19 @@ class _Service:
                 self.engine.step()
                 self.ticks += 1
 
-    def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int]):
+    def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int],
+               prefix_id: Optional[int] = None):
         with self._lock:
-            req = self.engine.submit(prompt, max_new_tokens, eos_token)
+            req = self.engine.submit(prompt, max_new_tokens, eos_token,
+                                     prefix_id=prefix_id)
         self._work.set()
         return req
+
+    def register_prefix(self, tokens) -> int:
+        # NOT under the service lock: the prefill compile can take tens
+        # of seconds on a real chip and must not freeze the tick pump;
+        # the engine's own prefix lock guards its registry
+        return self.engine.register_prefix(tokens)
 
     def wait(self, reqs, timeout: float = 300.0) -> bool:
         import time
@@ -131,7 +141,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802
-        if self.path != "/generate":
+        if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"unknown path {self.path}"})
         try:
             length = int(self.headers.get("Content-Length", "0") or "0")
@@ -140,6 +150,12 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": f"bad JSON: {e}"})
         if not isinstance(body, dict):
             return self._send(400, {"error": "body must be a JSON object"})
+        if self.path == "/prefix":
+            try:
+                pid = self.svc.register_prefix(body.get("tokens") or [])
+            except (ValueError, TypeError) as e:
+                return self._send(422, {"error": str(e)})
+            return self._send(200, {"prefix_id": pid})
         entries = body.get("requests")
         single = entries is None
         if single:
@@ -153,6 +169,7 @@ class _Handler(BaseHTTPRequestHandler):
                     e.get("tokens") or [],
                     int(e.get("max_new_tokens") or 32),
                     e.get("eos_token"),
+                    prefix_id=e.get("prefix_id"),
                 ))
         except (ValueError, TypeError) as e:
             # partially-submitted batch: release what already went in

@@ -141,3 +141,21 @@ def test_malformed_bodies_get_http_errors(server):
     ]}).encode()) == 422
     stats = json.loads(urllib.request.urlopen(f"{base}/stats", timeout=10).read())
     assert stats["queue_depth"] == 0 and stats["slots_busy"] == 0
+
+
+def test_prefix_endpoint(server):
+    base, config = server
+    out = _post(f"{base}/prefix", {"tokens": [7, 8, 9, 10]})
+    pid = out["prefix_id"]
+    gen = _post(f"{base}/generate",
+                {"tokens": [11, 12], "max_new_tokens": 3, "prefix_id": pid})
+    assert len(gen["tokens"]) == 3
+    # bad prefix id -> 422, not a dropped connection
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"tokens": [1], "max_new_tokens": 2,
+                         "prefix_id": 999}).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 422

@@ -116,3 +116,59 @@ def test_cancel_frees_queue_and_slot(model):
     while not c.done:
         eng.step()
     assert len(c.tokens) == 3
+
+
+def test_prefix_cache_matches_full_prompt(model):
+    params, config = model
+    rng = np.random.default_rng(3)
+    system = rng.integers(1, config.vocab_size, size=21).astype(np.int32)
+    eng = ServingEngine(params, config, slots=2, max_len=96)
+    pid = eng.register_prefix(system)
+
+    suffixes = [rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+                for n in (4, 19, 33)]  # crosses the 16-token chunk boundary
+    reqs = [eng.submit(sfx, max_new_tokens=5, prefix_id=pid) for sfx in suffixes]
+    while not all(r.done for r in reqs):
+        eng.step()
+    for sfx, req in zip(suffixes, reqs):
+        full = np.concatenate([system, sfx])
+        assert req.tokens == ref_generate(params, config, full, 5), (
+            f"suffix len {len(sfx)}")
+
+
+def test_prefix_validation(model):
+    params, config = model
+    eng = ServingEngine(params, config, slots=1, max_len=32)
+    with pytest.raises(ValueError):
+        eng.register_prefix(np.zeros(0, np.int32))
+    with pytest.raises(ValueError):
+        eng.register_prefix(np.ones(32, np.int32))  # no room left
+    pid = eng.register_prefix(np.ones(20, np.int32))
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(8, np.int32), max_new_tokens=8, prefix_id=pid)
+    with pytest.raises(ValueError):
+        eng.submit(np.ones(2, np.int32), max_new_tokens=2, prefix_id=99)
+    # prefixed requests bypass the prompt-bucket cap (no padding path)
+    eng2 = ServingEngine(params, config, slots=1, max_len=64,
+                         prompt_buckets=[8])
+    pid2 = eng2.register_prefix(np.ones(4, np.int32))
+    req = eng2.submit(np.ones(20, np.int32), max_new_tokens=2, prefix_id=pid2)
+    while not req.done:
+        eng2.step()
+    assert len(req.tokens) == 2
+
+
+def test_prefix_registry_cap_and_unregister(model):
+    params, config = model
+    eng = ServingEngine(params, config, slots=1, max_len=64, max_prefixes=2)
+    a = eng.register_prefix(np.ones(3, np.int32))
+    eng.register_prefix(np.ones(4, np.int32))
+    with pytest.raises(ValueError, match="registry full"):
+        eng.register_prefix(np.ones(5, np.int32))
+    eng.unregister_prefix(a)
+    c = eng.register_prefix(np.ones(6, np.int32))
+    # a queued request whose prefix vanished fails at admission, not crash
+    req = eng.submit(np.ones(2, np.int32), max_new_tokens=3, prefix_id=c)
+    eng.unregister_prefix(c)
+    eng.step()
+    assert req.done and req.tokens == []
