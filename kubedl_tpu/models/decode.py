@@ -99,7 +99,8 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), s
 
 
-def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
+def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
+                   window=None):
     """q [b,hq,tq,d] vs cache [b,hkv,L,d]; query t in row i attends cache
     positions < its limit. `limits` is [b] (per-row limit, tq == 1) or
     [b, tq] (per-row per-query — the block verify path, where query t
@@ -114,10 +115,39 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
     int8 caches pass per-position scales ([b,hkv,L]); the K scale
     multiplies the scores (q . (s*k) == s * (q . k)) and the V scale
     folds into the softmax weights (sum_k p_k*(s_k*v_k) ==
-    sum_k (p_k*s_k)*v_k) — exact, no dequantized cache tensor."""
+    sum_k (p_k*s_k)*v_k) — exact, no dequantized cache tensor.
+
+    With a sliding window, the cache READ is first narrowed to the
+    window + tq - 1 rows any query can attend (per-row dynamic slice):
+    decode is bandwidth-bound, so at long contexts the per-token cache
+    traffic scales with the WINDOW, not max_len. (The buffers themselves
+    stay O(max_len); a ring-buffer cache is the next step.)"""
     b, hq, tq, d = q.shape
     hkv, L = ck.shape[1], ck.shape[2]
     cd = q.dtype  # compute dtype; int8 codes convert on the operand read
+    limits = jnp.asarray(limits)
+    if limits.ndim == 1:
+        lim = limits[:, None]  # [b] -> per-row, tq must be 1
+    else:
+        lim = limits  # [b, tq]
+    if window is not None and L > window + tq - 1:
+        ws = window + tq - 1  # static: covers every query's window
+        start = jnp.clip(lim[:, 0] - window, 0, L - ws)  # [b]
+
+        def rows(cache_leaf, axis):
+            return jax.vmap(
+                lambda leaf, s0: jax.lax.dynamic_slice_in_dim(leaf, s0, ws, axis=axis)
+            )(cache_leaf, start)
+
+        ck = rows(ck, axis=1)
+        cv = rows(cv, axis=1)
+        if k_scale is not None:
+            k_scale = rows(k_scale, axis=1)
+        if v_scale is not None:
+            v_scale = rows(v_scale, axis=1)
+        k_pos = start[:, None] + jnp.arange(ws)[None, :]  # [b, ws] absolute
+    else:
+        k_pos = jnp.broadcast_to(jnp.arange(L)[None, :], (b, L))
     qg = q.reshape(b, hkv, n_rep, tq, d)  # group queries under their kv head
     s = jnp.einsum(
         "bhgtd,bhkd->bhgtk", qg, ck.astype(cd), preferred_element_type=jnp.float32
@@ -125,15 +155,13 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None):
     if k_scale is not None:
         s = s * k_scale[:, :, None, None, :]
     s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    k_pos = jnp.arange(L)
-    limits = jnp.asarray(limits)
-    if limits.ndim == 1:
-        lim = limits[:, None]  # [b] -> per-row, tq must be 1
-    else:
-        lim = limits  # [b, tq]
-    s = jnp.where(
-        k_pos[None, None, None, None, :] < lim[:, None, None, :, None], s, NEG_INF
-    )
+    attend = k_pos[:, None, None, None, :] < lim[:, None, None, :, None]
+    if window is not None:
+        # sliding window: the query at position lim-1 sees keys in
+        # (lim-1-window, lim-1], i.e. k_pos >= lim - window
+        attend &= k_pos[:, None, None, None, :] >= (
+            lim[:, None, None, :, None] - window)
+    s = jnp.where(attend, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
         p = p * v_scale[:, :, None, None, :]
@@ -211,7 +239,8 @@ def decode_step(
         new_k.append(ck)
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads,
-                              k_scale=cks, v_scale=cvs)
+                              k_scale=cks, v_scale=cvs,
+                              window=c.sliding_window)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
@@ -294,7 +323,8 @@ def decode_block_step(
         new_k.append(ck)
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, limits, c.n_heads // c.n_kv_heads,
-                              k_scale=cks, v_scale=cvs)
+                              k_scale=cks, v_scale=cvs,
+                              window=c.sliding_window)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
@@ -414,7 +444,7 @@ def prefill(
         ks.append(k.astype(c.dtype))
         vs.append(v.astype(c.dtype))
         # GQA broadcast happens inside the attention entry points
-        attn = _attn(q, k, v, causal=True)
+        attn = _attn(q, k, v, causal=True, window=c.sliding_window)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)

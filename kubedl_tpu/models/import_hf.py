@@ -1,6 +1,8 @@
-"""Hugging Face Llama checkpoint importer.
+"""Hugging Face Llama/Mistral checkpoint importer.
 
-Maps a `transformers` Llama state dict onto this repo's param tree so
+Maps a `transformers` Llama or Mistral state dict (identical key
+layout; Mistral adds sliding-window attention, mapped onto
+LlamaConfig.sliding_window) onto this repo's param tree so
 real released weights run through the TPU-native stack (training,
 decode, serving) — and, just as importantly, gives the Llama
 implementation a gold-standard external parity check: logits must match
@@ -41,6 +43,8 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
         rms_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
         tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        # HF uses sliding_window in {None, 0} to mean "disabled"
+        sliding_window=(getattr(hf_config, "sliding_window", None) or None),
         dtype=jnp.bfloat16,
     )
     kw.update(overrides)

@@ -59,6 +59,10 @@ class LlamaConfig:
     # attention per rank (cheaper comms at small P, capped at the head
     # count) — see ops/ulysses.py for the trade-off.
     context_parallel: str = "ring"
+    # Mistral-style sliding-window attention: query i attends keys in
+    # (i - sliding_window, i]. None = full causal. Applies to prefill,
+    # decode, and training; not combined with context parallelism.
+    sliding_window: Optional[int] = None
     tie_embeddings: bool = False
     # >1: compute the training loss over this many vocab chunks instead of
     # materializing [b, t, vocab] f32 logits (a 1 GB HBM round-trip at
@@ -74,6 +78,13 @@ class LlamaConfig:
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            # a window of 0 masks EVERY key: softmax over all -inf rows
+            # returns uniform garbage with exit 0 — refuse loudly
+            raise ValueError(
+                f"sliding_window must be >= 1 or None, got {self.sliding_window}")
 
     @property
     def head_dim(self) -> int:
@@ -255,6 +266,11 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if context_size > 1:
+        if config.sliding_window is not None:
+            raise NotImplementedError(
+                "sliding_window + context parallelism is not implemented "
+                "(a windowed ring would skip most hops; use full attention "
+                "on the context mesh or a single-shard windowed model)")
         if config.context_parallel == "ulysses":
             from kubedl_tpu.ops.ulysses import ulysses_attention
 
@@ -263,11 +279,13 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
         else:
             attn = ring_attention(q, k, v, mesh=mesh, causal=True)
     elif config.use_flash:
-        attn = flash_attention(q, k, v, causal=True)
+        attn = flash_attention(q, k, v, causal=True,
+                               window=config.sliding_window)
     else:
         from kubedl_tpu.ops.flash_attention import attention_reference
 
-        attn = attention_reference(q, k, v, causal=True)
+        attn = attention_reference(q, k, v, causal=True,
+                                   window=config.sliding_window)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
     return x + _mm(attn, layer["wo"]).astype(x.dtype)
 

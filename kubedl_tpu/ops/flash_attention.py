@@ -91,7 +91,8 @@ def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask):
     return m_new, l, acc
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_q, block_k, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                window, block_q, block_k, seq_len):
     qb = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16): the MXU runs bf16 x bf16 ->
     # f32 at full rate, while f32 inputs drop it several-fold. All
@@ -109,6 +110,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     if causal:
         # K blocks strictly above the diagonal contribute nothing.
         num_kb = jnp.minimum(num_kb, (qb + 1) * block_q // block_k + 1)
+    start_kb = jnp.int32(0)
+    if window is not None:
+        # K blocks entirely below every query's window contribute nothing.
+        start_kb = jnp.maximum(0, (qb * block_q - window + 1) // block_k)
 
     def body(kb, carry):
         m, l, acc = carry
@@ -118,9 +123,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         return _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask)
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(start_kb, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # lse rides in a [bh, 1, seq] buffer: a (1, 1, block_q) block keeps the
@@ -128,16 +135,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
-def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+def _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
     bh, seq, d = q.shape
     # dispatch on the TRUE length: lcm padding of mixed block sizes must
     # not shift the documented threshold
     if true_len > STREAM_MIN_SEQ:
-        return _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len)
+        return _fwd_streamed(q, k, v, sm_scale, causal, window, block_q,
+                             block_k, true_len)
     grid = (bh, pl.cdiv(seq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal,
+            _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, seq_len=true_len,
         ),
         grid=grid,
@@ -165,7 +173,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len):
 
 
 def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
-                         *, sm_scale, causal, block_q, block_k, seq_len, n_kb):
+                         *, sm_scale, causal, window, block_q, block_k,
+                         seq_len, n_kb):
     """K-streaming variant: grid (bh, q_blocks, k_blocks); K/V arrive one
     block per grid step via BlockSpecs (double-buffered by Mosaic), and the
     online-softmax state lives in VMEM scratch across the kb dimension.
@@ -186,6 +195,9 @@ def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
     live = kb * block_k < seq_len
     if causal:
         live &= kb * block_k < (qb + 1) * block_q
+    if window is not None:
+        # the whole K block sits below every query's window
+        live &= (kb + 1) * block_k - 1 >= qb * block_q - window + 1
 
     @pl.when(live)
     def _step():
@@ -201,6 +213,8 @@ def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         m_new, l, acc = _online_softmax_step(
             q, k, v, m_s[...], l_s[...], acc_s[...], sm_scale, mask
         )
@@ -215,14 +229,15 @@ def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         lse_ref[0, 0] = (m_s[...] + jnp.log(l))[:, 0]
 
 
-def _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
     bh, seq, d = q.shape
     n_kb = pl.cdiv(seq, block_k)
     grid = (bh, pl.cdiv(seq, block_q), n_kb)
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_streamed_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, seq_len=true_len, n_kb=n_kb,
+            window=window, block_q=block_q, block_k=block_k,
+            seq_len=true_len, n_kb=n_kb,
         ),
         grid=grid,
         in_specs=[
@@ -257,7 +272,7 @@ def _fwd_streamed(q, k, v, sm_scale, causal, block_q, block_k, true_len):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, block_q, block_k, seq_len):
+                   *, sm_scale, causal, window, block_q, block_k, seq_len):
     qb = pl.program_id(1)
     q = q_ref[0]  # bf16 into the MXU; f32 accumulation
     do = do_ref[0]
@@ -268,6 +283,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     num_kb = pl.cdiv(seq_len, block_k)
     if causal:
         num_kb = jnp.minimum(num_kb, (qb + 1) * block_q // block_k + 1)
+    start_kb = jnp.int32(0)
+    if window is not None:
+        start_kb = jnp.maximum(0, (qb * block_q - window + 1) // block_k)
 
     def body(kb, dq):
         k = k_ref[0, pl.ds(kb * block_k, block_k), :]
@@ -278,6 +296,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -286,12 +306,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                         preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    dq = jax.lax.fori_loop(0, num_kb, body, dq0)
+    dq = jax.lax.fori_loop(start_kb, num_kb, body, dq0)
     dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    *, sm_scale, causal, block_q, block_k, seq_len):
+                    *, sm_scale, causal, window, block_q, block_k, seq_len):
     kb = pl.program_id(1)
     k = k_ref[0]  # bf16 into the MXU; f32 accumulation
     v = v_ref[0]
@@ -302,6 +322,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     if causal:
         # Q blocks strictly before this K block see none of it.
         start_qb = kb * block_k // block_q
+    if window is not None:
+        # Q blocks whose every query is past this K block's window.
+        num_qb = jnp.minimum(
+            num_qb, ((kb + 1) * block_k - 1 + window) // block_q + 1)
 
     def body(qb, carry):
         dk, dv = carry
@@ -315,6 +339,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
             mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         pb = p.astype(do.dtype)
         dv = dv + jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
@@ -333,7 +359,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
+def _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout):
     q, k, v, out, lse = res
     bh, seq, d = q.shape
     # [bh, 1, seq] to match the lse layout (TPU-tileable blocks)
@@ -341,8 +367,8 @@ def _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout):
         out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1
     )[:, None, :]
 
-    kern = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
-                block_k=block_k, seq_len=true_len)
+    kern = dict(sm_scale=sm_scale, causal=causal, window=window,
+                block_q=block_q, block_k=block_k, seq_len=true_len)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **kern),
         grid=(bh, pl.cdiv(seq, block_q)),
@@ -395,14 +421,14 @@ def _pad_d(x, dk):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
-    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
+    out, _ = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
-    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
+    out, lse = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
     # Residuals store only the true head dim: padded columns are zeros by
     # construction, so slicing here and re-padding in backward is exact —
     # and halves attention residual HBM for d=64 models.
@@ -419,7 +445,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len, true_d):
 BWD_MAX_SEQ = 8192
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, true_len, true_d, res, dout):
     dk_width = dout.shape[-1]
     q, k, v, out, lse = res
     if true_len > BWD_MAX_SEQ:
@@ -434,7 +460,7 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, true_len, true_d, res, dout):
         _pad_d(q, dk_width), _pad_d(k, dk_width), _pad_d(v, dk_width),
         _pad_d(out, dk_width), lse,
     )
-    return _bwd(sm_scale, causal, block_q, block_k, true_len, res, dout)
+    return _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -465,6 +491,7 @@ def flash_attention(
     *,
     causal: bool = True,
     sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     min_seq: Optional[int] = None,
@@ -473,6 +500,10 @@ def flash_attention(
 
     GQA: k/v may have fewer heads (q_heads % kv_heads == 0); KV heads are
     broadcast to the query groups.
+
+    window: sliding-window (Mistral-style) attention — query i attends
+    keys in (i - window, i]. Requires causal=True. Dead K blocks are
+    skipped in both directions, so compute scales with window, not seq.
 
     min_seq overrides the measured fused-vs-unfused crossover (default
     FLASH_MIN_SEQ, swept on v5e): pass 0 to prefer the fused kernel at
@@ -483,6 +514,12 @@ def flash_attention(
     """
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding window "
+                             "is a causal-attention concept)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if hq != hkv:
         if hq % hkv:
             raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
@@ -500,7 +537,8 @@ def flash_attention(
     # < 128 can never tile onto the MXU regardless of min_seq (silent: it's
     # a hardware constraint, not a degradation a caller could fix)
     if not _interpret() and (sq < min_seq or sq < 128):
-        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   window=window)
 
     # Lane-align the head dim by zero-padding to the next multiple of 128
     # (ViT-class 64, GQA oddballs): zero K columns add nothing to QK^T,
@@ -532,7 +570,8 @@ def flash_attention(
     if not _interpret() and (block_q % 128 or block_k % 128):
         _warn_unfused_fallback(d, block_q, block_k)
         return attention_reference(
-            q[..., :d], k[..., :d], v[..., :d], causal=causal, sm_scale=sm_scale
+            q[..., :d], k[..., :d], v[..., :d], causal=causal,
+            sm_scale=sm_scale, window=window,
         )
 
     # The whole-sequence kernels (fwd at <= STREAM_MIN_SEQ, bwd always)
@@ -556,12 +595,17 @@ def flash_attention(
     qf = _pad_seq_to(q.reshape(b * hq, sq, dk), target)
     kf = _pad_seq_to(k.reshape(b * hq, sq, dk), target)
     vf = _pad_seq_to(v.reshape(b * hq, sq, dk), target)
-    out = _flash(qf, kf, vf, sm_scale, causal, block_q, block_k, sq, d)
+    out = _flash(qf, kf, vf, sm_scale, causal, window, block_q, block_k, sq, d)
     return out[:, :sq, :d].reshape(b, hq, sq, d)
 
 
-def attention_reference(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = None):
-    """Plain-XLA attention for correctness tests (same GQA semantics)."""
+def attention_reference(q, k, v, *, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        window: Optional[int] = None):
+    """Plain-XLA attention for correctness tests (same GQA semantics,
+    incl. the sliding window)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     b, hq, sq, d = q.shape
     hkv = k.shape[1]
     if hq != hkv:
@@ -572,6 +616,8 @@ def attention_reference(q, k, v, *, causal: bool = True, sm_scale: Optional[floa
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
     if causal:
         mask = np.tril(np.ones((sq, sq), bool))
+        if window is not None:
+            mask &= ~np.tril(np.ones((sq, sq), bool), k=-window)
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

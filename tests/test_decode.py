@@ -3,6 +3,7 @@ forward — the cache is an optimization, not a different model."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kubedl_tpu.models import decode, llama
 
@@ -460,3 +461,48 @@ def test_sampled_speculative_preserves_target_distribution():
     # token-3 marginals agree between the two samplers
     tv_3 = 0.5 * np.abs(marginal(spec_toks, 2) - marginal(van_toks, 2)).sum()
     assert tv_3 < 0.12, tv_3
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window decode: the windowed cache read-slice must be exactly
+# the full-cache masked attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("int8_scales", [False, True])
+@pytest.mark.parametrize("tq", [1, 4])
+def test_windowed_attend_matches_full_cache_mask(int8_scales, tq):
+    from kubedl_tpu.models.decode import NEG_INF, _attend_cached
+
+    b, hkv, n_rep, L, d, window = 3, 2, 2, 64, 16, 7
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    q = jax.random.normal(ks[0], (b, hkv * n_rep, tq, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, hkv, L, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, hkv, L, d), jnp.float32)
+    ksc = vsc = None
+    if int8_scales:
+        ksc = jax.random.uniform(ks[3], (b, hkv, L), jnp.float32, 0.5, 1.5)
+        vsc = jax.random.uniform(ks[4], (b, hkv, L), jnp.float32, 0.5, 1.5)
+    if tq == 1:
+        limits = jnp.asarray([9, 30, 64])  # incl. lim < window edge + full
+    else:
+        limits = jnp.asarray([[6, 7, 8, 9], [30, 31, 32, 33], [61, 62, 63, 64]])
+
+    out = _attend_cached(q, ck, cv, limits, n_rep,
+                         k_scale=ksc, v_scale=vsc, window=window)
+
+    # reference: full-cache scores with the band mask, no slicing
+    lim = limits[:, None] if limits.ndim == 1 else limits
+    qg = q.reshape(b, hkv, n_rep, tq, d)
+    s = jnp.einsum("bhgtd,bhkd->bhgtk", qg, ck) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if ksc is not None:
+        s = s * ksc[:, :, None, None, :]
+    k_pos = jnp.arange(L)
+    attend = (k_pos[None, None, None, None, :] < lim[:, None, None, :, None]) & (
+        k_pos[None, None, None, None, :] >= lim[:, None, None, :, None] - window)
+    p = jax.nn.softmax(jnp.where(attend, s, NEG_INF), axis=-1)
+    if vsc is not None:
+        p = p * vsc[:, :, None, None, :]
+    ref = jnp.einsum("bhgtk,bhkd->bhgtd", p, cv).reshape(b, hkv * n_rep, tq, d)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)

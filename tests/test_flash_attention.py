@@ -190,9 +190,10 @@ def test_in_budget_exotic_blocks_preserved(monkeypatch):
     seen = []
     real_fwd = fa._fwd
 
-    def spy(q, k, v, sm_scale, causal, block_q, block_k, true_len):
+    def spy(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
         seen.append((block_q, block_k))
-        return real_fwd(q, k, v, sm_scale, causal, block_q, block_k, true_len)
+        return real_fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                        true_len)
 
     monkeypatch.setattr(fa, "_fwd", spy)
     import jax
@@ -206,3 +207,81 @@ def test_in_budget_exotic_blocks_preserved(monkeypatch):
     fa.flash_attention(q, k, v, causal=True, block_q=640, block_k=384, min_seq=0)
     # lcm(640,384)=1920, target 3840 <= 8192: requested blocks survive
     assert seen == [(640, 384)]
+
+
+# ---------------------------------------------------------------------------
+# Sliding window (Mistral-style): query i attends keys in (i-window, i]
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("window", [1, 7, 64, 300])
+def test_window_fwd_matches_masked_reference(window):
+    b, h, t, d = 2, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, t, d))
+    v = jax.random.normal(ks[2], (b, h, t, d))
+    out = flash_attention(q, k, v, causal=True, window=window)
+    ref = attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+    # and the window actually changed the result vs full causal
+    if window < t:
+        full = attention_reference(q, k, v, causal=True)
+        assert float(jnp.max(jnp.abs(ref - full))) > 1e-3
+
+
+def test_window_gradients_match_reference():
+    b, h, t, d = 1, 2, 192, 64
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, t, d))
+    v = jax.random.normal(ks[2], (b, h, t, d))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, window=50) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True, window=50) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-3, rtol=5e-3, err_msg=f"d{name}")
+
+
+def test_window_requires_causal():
+    x = jnp.zeros((1, 1, 8, 16))
+    with pytest.raises(ValueError):
+        flash_attention(x, x, x, causal=False, window=4)
+    with pytest.raises(ValueError):
+        attention_reference(x, x, x, causal=False, window=4)
+
+
+def test_window_streamed_kernel_matches_reference(monkeypatch):
+    """The K-streaming kernel's window block-skip only runs past
+    STREAM_MIN_SEQ; drop the threshold so its boundary math is exercised
+    at test sizes."""
+    from kubedl_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "STREAM_MIN_SEQ", 128)
+    b, h, t, d = 1, 2, 512, 64
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (b, h, t, d))
+    k = jax.random.normal(ks[1], (b, h, t, d))
+    v = jax.random.normal(ks[2], (b, h, t, d))
+    for window in (1, 100, 128, 129, 400):
+        out = fa.flash_attention(q, k, v, causal=True, window=window,
+                                 block_q=128, block_k=128)
+        ref = fa.attention_reference(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"window={window}")
+
+
+def test_config_rejects_zero_window():
+    from kubedl_tpu.models.llama import LlamaConfig
+
+    with pytest.raises(ValueError):
+        LlamaConfig.tiny(sliding_window=0)
