@@ -41,13 +41,14 @@ jax.tree_util.register_pytree_node(
 
 
 def make_train_step(
-    loss_fn: Callable,  # (params, batch) -> scalar loss
+    loss_fn: Callable,  # (params, batch) -> scalar loss  [or (loss, aux)]
     tx: optax.GradientTransformation,
     mesh: Mesh,
     param_spec_tree: Any,
     batch_spec: P,
     rules: Optional[ShardingRules] = None,
     accum_steps: int = 1,
+    has_aux: bool = False,
 ) -> Tuple[Callable, Callable]:
     """Returns (init_state, train_step), both jitted over the mesh.
 
@@ -80,13 +81,18 @@ def make_train_step(
     init_jit = jax.jit(_init, in_shardings=(param_sharding,))
 
     def _step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+            aux = {}
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         return (
             TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
-            {"loss": loss, "grad_norm": gnorm},
+            {"loss": loss, "grad_norm": gnorm, **aux},
         )
 
     step_jit = jax.jit(
