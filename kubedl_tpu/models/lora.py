@@ -93,6 +93,22 @@ def adapter_count(adapters: Dict) -> int:
     return llama.param_count(adapters)
 
 
+def restore_and_merge(
+    base_params: Dict,
+    checkpoint_path: str,
+    alpha: Optional[float] = None,
+) -> Dict:
+    """Merge the newest adapter checkpoint under `checkpoint_path` (a
+    trainer --lora-rank run's Orbax dir) into base weights — the consumer
+    side of adapter-only checkpoints for generate/serve."""
+    from kubedl_tpu.train.generate import restore_params
+
+    adapters = restore_params(checkpoint_path, label="lora adapters")
+    if adapters is None:
+        raise ValueError(f"no adapter checkpoint under {checkpoint_path!r}")
+    return merge(base_params, adapters, alpha=alpha)
+
+
 def make_lora_step(
     base_params: Dict,
     config: llama.LlamaConfig,

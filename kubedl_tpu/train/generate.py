@@ -31,6 +31,10 @@ def parse_args(argv=None):
     p.add_argument("--allow-fresh-init", action="store_true",
                    help="serve from random weights when --checkpoint-path "
                         "holds no checkpoint (otherwise that's an error)")
+    p.add_argument("--lora-checkpoint-path", default="",
+                   help="merge the newest adapter checkpoint from a trainer "
+                        "--lora-rank run into the base weights (models/lora.py)")
+    p.add_argument("--lora-alpha", type=float, default=None)
     p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
     p.add_argument("--prompt-len", type=int, default=32)
     p.add_argument("--max-new-tokens", type=int, default=32)
@@ -136,6 +140,11 @@ def main(argv=None) -> int:
             config, args.checkpoint_path, args.allow_fresh_init, seed=args.seed)
         if params is None:
             return 1
+    if args.lora_checkpoint_path:
+        from kubedl_tpu.models import lora as lora_mod
+
+        params = lora_mod.restore_and_merge(
+            params, args.lora_checkpoint_path, alpha=args.lora_alpha)
 
     if args.int8:
         from kubedl_tpu.models import quant

@@ -42,6 +42,10 @@ def parse_args(argv=None):
                    help="Hugging Face Llama name/dir — overrides --model/"
                         "--checkpoint-path (models/import_hf.py)")
     p.add_argument("--allow-fresh-init", action="store_true")
+    p.add_argument("--lora-checkpoint-path", default="",
+                   help="merge the newest adapter checkpoint from a trainer "
+                        "--lora-rank run into the base weights")
+    p.add_argument("--lora-alpha", type=float, default=None)
     p.add_argument("--bind", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
     p.add_argument("--slots", type=int, default=8)
@@ -207,6 +211,11 @@ def main(argv=None) -> int:
             config, args.checkpoint_path, args.allow_fresh_init, seed=0)
         if params is None:
             return 1
+    if args.lora_checkpoint_path:
+        from kubedl_tpu.models import lora as lora_mod
+
+        params = lora_mod.restore_and_merge(
+            params, args.lora_checkpoint_path, alpha=args.lora_alpha)
     if args.int8:
         from kubedl_tpu.models import quant
 

@@ -71,6 +71,16 @@ def parse_args(argv=None):
                    type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_KEEP", 3)))
     # JAX profiler / XProf hook (SURVEY.md §5: "TPU side gets JAX
     # profiler/XProf hooks" — net-new, the reference has no profiling)
+    p.add_argument("--lora-rank", type=int,
+                   default=int(os.environ.get("KUBEDL_LORA_RANK", 0)),
+                   help="train low-rank adapters instead of full weights "
+                        "(models/lora.py); 0 = full fine-tune/pretrain")
+    p.add_argument("--lora-alpha", type=float, default=None,
+                   help="LoRA scale numerator (default: rank, i.e. scale 1)")
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="start from Hugging Face Llama/Mistral weights "
+                        "(models/import_hf.py) — the base for --lora-rank "
+                        "or a full fine-tune")
     p.add_argument("--remat", choices=["full", "dots", "none"],
                    default=os.environ.get("KUBEDL_REMAT", ""),
                    help="override the model's remat: full recompute, "
@@ -112,8 +122,17 @@ def main(argv=None) -> int:
     from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
     from kubedl_tpu.parallel.train_step import make_train_step
 
-    config = llama.LlamaConfig.config_for(args.model)
     import dataclasses
+
+    hf_base = None
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        hf_base, config = load_hf(args.hf_model)
+        print(f"base weights: {args.hf_model} "
+              f"({config.n_layers}L/{config.d_model}d)", flush=True)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
 
     if args.remat:
         config = dataclasses.replace(
@@ -126,8 +145,9 @@ def main(argv=None) -> int:
 
     mesh = build_mesh(parse_mesh_env())
     rules = ShardingRules()
+    model_name = args.hf_model or args.model
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
-          f"model={args.model} params≈{config.n_layers}L/{config.d_model}d", flush=True)
+          f"model={model_name} params≈{config.n_layers}L/{config.d_model}d", flush=True)
 
     # preemption flag flipped by SIGTERM
     preempted = {"flag": False}
@@ -137,8 +157,8 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, on_sigterm)
 
-    params = llama.init(config, jax.random.PRNGKey(0))
-    spec_tree = llama.param_specs(config, rules)
+    params = (hf_base if hf_base is not None
+              else llama.init(config, jax.random.PRNGKey(0)))
 
     def loss(params, batch):
         return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
@@ -159,11 +179,37 @@ def main(argv=None) -> int:
     if args.grad_clip > 0:
         tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
     try:
-        init_state, train_step = make_train_step(
-            loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,
-            accum_steps=args.accum_steps,
-        )
-        state = init_state(params)
+        if args.lora_rank > 0:
+            # adapter-only training: gradients + optimizer state cover the
+            # low-rank deltas; the frozen base rides sharded through the
+            # step (models/lora.py)
+            from kubedl_tpu.models import lora as lora_mod
+
+            adapters0, init_state, train_step = lora_mod.make_lora_step(
+                params, config, tx, mesh, rules=rules, rank=args.lora_rank,
+                alpha=args.lora_alpha, accum_steps=args.accum_steps,
+            )
+            state = init_state(adapters0)
+            n_ad = lora_mod.adapter_count(adapters0)
+            print(f"lora: rank {args.lora_rank}, {n_ad} adapter params "
+                  f"({100.0 * n_ad / llama.param_count(params):.2f}% of base)",
+                  flush=True)
+            if args.eval_every:
+                print("note: --eval-every is skipped under --lora-rank "
+                      "(restore with generate/serve --lora-checkpoint-path "
+                      "to evaluate the merged model)", flush=True)
+                args.eval_every = 0
+        else:
+            spec_tree = llama.param_specs(config, rules)
+            init_state, train_step = make_train_step(
+                loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,
+                accum_steps=args.accum_steps,
+            )
+            state = init_state(params)
+        # the sharded copies live on the mesh now; a 7B HF import would
+        # otherwise pin ~14 GB of dead host arrays for the whole run
+        del params
+        hf_base = None
     except Exception as e:
         if "RESOURCE_EXHAUSTED" in str(e) or "XlaRuntimeError" in type(e).__name__:
             print(f"compile/alloc failure: {e}", file=sys.stderr)
