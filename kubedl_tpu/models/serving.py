@@ -74,6 +74,7 @@ class ServingEngine:
         temperature: float = 0.0,
         seed: int = 0,
         max_prefixes: int = 8,
+        kv_dtype=None,
     ) -> None:
         self.params = params
         self.config = config
@@ -93,8 +94,10 @@ class ServingEngine:
                 f"max_len {max_len} — prefill could not fit the scratch cache")
         self.temperature = temperature
         self._key = jax.random.PRNGKey(seed)
+        self.kv_dtype = kv_dtype  # None | "int8" (half the cache HBM/read)
 
-        self.cache = decode.init_kv_cache(config, slots, max_len)
+        self.cache = decode.init_kv_cache(config, slots, max_len,
+                                          kv_dtype=kv_dtype)
         self.cur_tokens = jnp.zeros((slots,), jnp.int32)
         self.active = jnp.zeros((slots,), jnp.bool_)
         self._slot_req: List[Optional[Request]] = [None] * slots
@@ -111,7 +114,8 @@ class ServingEngine:
         # One jitted prefill covers every bucket: jit retraces per padded
         # prompt shape, i.e. exactly once per bucket.
         def prefill_fn(params, prompt, length):
-            scratch = decode.init_kv_cache(self.config, 1, self.max_len)
+            scratch = decode.init_kv_cache(self.config, 1, self.max_len,
+                                           kv_dtype=kv_dtype)
             return decode.prefill(
                 params, prompt, scratch, self.config, lengths=length)
 
@@ -130,7 +134,7 @@ class ServingEngine:
 
         def prefix_prefill_fn(params, prompt):
             scratch = decode.init_kv_cache(
-                self.config, 1, self.max_len, uniform=True)
+                self.config, 1, self.max_len, uniform=True, kv_dtype=kv_dtype)
             return decode.prefill(params, prompt, scratch, self.config)
 
         self._prefix_prefill = jax.jit(prefix_prefill_fn)

@@ -53,6 +53,9 @@ def parse_args(argv=None):
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 (models/quant.py)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache with exact scale folding — half the "
+                        "per-token cache read at long contexts")
     p.add_argument("--max-steps", type=int, default=0,
                    help="stop after N engine ticks (smoke tests); 0 = forever")
     return p.parse_args(argv)
@@ -223,6 +226,7 @@ def main(argv=None) -> int:
     engine = ServingEngine(
         params, config, slots=args.slots, max_len=args.max_len,
         temperature=args.temperature,
+        kv_dtype="int8" if args.kv_int8 else None,
     )
     svc = _Service(engine)
     httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)

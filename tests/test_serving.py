@@ -172,3 +172,21 @@ def test_prefix_registry_cap_and_unregister(model):
     eng.unregister_prefix(c)
     eng.step()
     assert req.done and req.tokens == []
+
+
+def test_int8_kv_serving_close_to_fp(model):
+    """kv_dtype='int8' runs the whole engine (prefill scales, insert,
+    ragged decode with folded scales) and tracks the fp cache closely on
+    greedy outputs."""
+    params, config = model
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+               for n in (5, 11, 17)]
+    fp = ServingEngine(params, config, slots=2, max_len=64)
+    q8 = ServingEngine(params, config, slots=2, max_len=64, kv_dtype="int8")
+    out_fp = fp.serve_all(prompts, max_new_tokens=6)
+    out_q8 = q8.serve_all(prompts, max_new_tokens=6)
+    agree = sum(a == b for seq_fp, seq_q8 in zip(out_fp, out_q8)
+                for a, b in zip(seq_fp, seq_q8))
+    total = sum(len(o) for o in out_fp)
+    assert agree / total >= 0.8, (out_fp, out_q8)
