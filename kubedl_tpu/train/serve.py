@@ -7,6 +7,8 @@ JSON API backed by `models/serving.ServingEngine`:
 
     POST /generate   {"tokens": [..], "max_new_tokens": 64,
                       "eos_token": 2?, "prefix_id": 0?} -> {"tokens": [...]}
+                     (with an --hf-model tokenizer, {"text": "..."} works
+                      too and the response adds decoded "text")
     POST /generate   {"requests": [{...}, ...]}  (batch form; each entry
                       rides its own engine slot)  -> {"results": [...]}
     POST /prefix     {"tokens": [...]}  -> {"prefix_id": N}   (shared
@@ -64,8 +66,9 @@ def parse_args(argv=None):
 class _Service:
     """Engine + queue pump shared by all HTTP handler threads."""
 
-    def __init__(self, engine) -> None:
+    def __init__(self, engine, tokenizer=None) -> None:
         self.engine = engine
+        self.tokenizer = tokenizer
         self._lock = threading.Lock()  # engine calls are single-threaded
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -167,15 +170,33 @@ class _Handler(BaseHTTPRequestHandler):
         single = entries is None
         if single:
             entries = [body]
+        tok = self.svc.tokenizer
         reqs = []
         try:
             for e in entries:
                 if not isinstance(e, dict):
                     raise ValueError("each request must be a JSON object")
+                tokens = e.get("tokens")
+                is_text = tokens is None and e.get("text") is not None
+                if is_text:
+                    if tok is None:
+                        raise ValueError(
+                            "text requests need a tokenizer — start the "
+                            "server with --hf-model")
+                    tokens = tok.encode(str(e["text"]))
+                # eos default applies ONLY to text requests (natural stop);
+                # the token-id API keeps exact-length semantics, and an
+                # explicit "eos_token": null opts text requests out too
+                if "eos_token" in e:
+                    eos = e["eos_token"]
+                elif is_text and tok is not None:
+                    eos = tok.eos_token_id
+                else:
+                    eos = None
                 reqs.append(self.svc.submit(
-                    e.get("tokens") or [],
+                    tokens or [],
                     int(e.get("max_new_tokens") or 32),
-                    e.get("eos_token"),
+                    eos,
                     prefix_id=e.get("prefix_id"),
                 ))
         except (ValueError, TypeError) as e:
@@ -187,7 +208,12 @@ class _Handler(BaseHTTPRequestHandler):
             # occupying slots generating tokens nobody reads
             self.svc.cancel(reqs)
             return self._send(504, {"error": "generation timed out"})
-        results = [{"tokens": r.tokens, "request_id": r.request_id} for r in reqs]
+        results = []
+        for r in reqs:
+            entry = {"tokens": r.tokens, "request_id": r.request_id}
+            if tok is not None:
+                entry["text"] = tok.decode(r.tokens, skip_special_tokens=True)
+            results.append(entry)
         self._send(200, results[0] if single else {"results": results})
 
 
@@ -204,10 +230,17 @@ def main(argv=None) -> int:
     from kubedl_tpu.models.serving import ServingEngine
     from kubedl_tpu.train.generate import restore_or_init
 
+    tokenizer = None
     if args.hf_model:
         from kubedl_tpu.models.import_hf import load_hf
 
         params, config = load_hf(args.hf_model)
+        try:
+            import transformers
+
+            tokenizer = transformers.AutoTokenizer.from_pretrained(args.hf_model)
+        except Exception as e:  # noqa: BLE001 — token-id API still works
+            print(f"no tokenizer loaded ({e}); token-id API only", flush=True)
     else:
         config = llama.LlamaConfig.config_for(args.model)
         params = restore_or_init(
@@ -228,7 +261,7 @@ def main(argv=None) -> int:
         temperature=args.temperature,
         kv_dtype="int8" if args.kv_int8 else None,
     )
-    svc = _Service(engine)
+    svc = _Service(engine, tokenizer=tokenizer)
     httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)
     httpd.daemon_threads = True
     httpd.svc = svc  # type: ignore[attr-defined]

@@ -85,35 +85,46 @@ def test_validation_and_health(server):
     assert json.loads(urllib.request.urlopen(f"{base}/healthz", timeout=5).read()) == {"ok": True}
 
 
-def test_main_smoke_max_steps(tmp_path, capsys):
-    from kubedl_tpu.train import serve
+def _run_main_and_post(argv, port, body, timeout=120):
+    """serve.main on a thread (--max-steps mode) + one real request.
 
-    # no checkpoint path + fresh init + 0 requests: serve main() must come
-    # up, idle, and exit after ticks... ticks only advance with work, so
-    # drive one request through a thread.
+    After the target request completes, keep posting 1-token dummies so
+    engine ticks keep accruing past --max-steps no matter how few ticks
+    the target needed (eos can finish it on tick 1) — otherwise main()
+    would spin on `ticks < max_steps` with no pending work forever."""
     import time
 
+    from kubedl_tpu.train import serve
+
     rc = {}
-
-    def run():
-        rc["v"] = serve.main([
-            "--model", "tiny", "--bind", "127.0.0.1", "--port", "18777",
-            "--slots", "2", "--max-len", "32", "--max-steps", "2",
-        ])
-
-    t = threading.Thread(target=run)
+    t = threading.Thread(target=lambda: rc.update(
+        v=serve.main(argv + ["--bind", "127.0.0.1", "--port", str(port)])))
     t.start()
-    deadline = time.time() + 60
-    ok = False
-    while time.time() < deadline and not ok:
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.time() + timeout
+    out = None
+    while time.time() < deadline and out is None:
         try:
-            out = _post("http://127.0.0.1:18777/generate",
-                        {"tokens": [1, 2], "max_new_tokens": 3}, timeout=5)
-            ok = len(out["tokens"]) == 3
+            out = _post(f"{base}/generate", body, timeout=10)
+        except Exception:
+            time.sleep(0.3)
+    while t.is_alive() and time.time() < deadline:
+        try:
+            _post(f"{base}/generate",
+                  {"tokens": [1], "max_new_tokens": 1, "eos_token": None},
+                  timeout=5)
         except Exception:
             time.sleep(0.2)
     t.join(timeout=60)
-    assert ok and rc.get("v") == 0
+    return out, rc.get("v")
+
+
+def test_main_smoke_max_steps():
+    out, rc = _run_main_and_post(
+        ["--model", "tiny", "--slots", "2", "--max-len", "32",
+         "--max-steps", "2"],
+        18777, {"tokens": [1, 2], "max_new_tokens": 3})
+    assert rc == 0 and out is not None and len(out["tokens"]) == 3
 
 
 def test_malformed_bodies_get_http_errors(server):
@@ -159,3 +170,32 @@ def test_prefix_endpoint(server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 422
+
+
+def test_text_api_with_hf_tokenizer(tmp_path):
+    """--hf-model provides a tokenizer: /generate accepts {"text": ...}
+    and decodes the response; eos defaults to the tokenizer's."""
+    import torch
+    import transformers
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    d = str(tmp_path / "m")
+    hf_config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(hf_config).save_pretrained(d)
+    vocab = {"<eos>": 0, "hello": 1, "tpu": 2, "world": 3}
+    vocab.update({f"w{i}": i + 4 for i in range(60)})
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="w0"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, eos_token="<eos>").save_pretrained(d)
+
+    out, rc = _run_main_and_post(
+        ["--hf-model", d, "--slots", "2", "--max-len", "48",
+         "--max-steps", "2"],
+        18783, {"text": "hello tpu world", "max_new_tokens": 4})
+    assert out is not None and rc == 0
+    assert len(out["tokens"]) <= 4 and isinstance(out["text"], str)
