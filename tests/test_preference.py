@@ -135,3 +135,78 @@ def test_dpo_moe_keeps_router_aux(model):
         for layer in g["layers"]
     )
     assert gate_norm > 0.0
+
+
+def test_dpo_cli_with_jsonl_and_checkpoint(tmp_path, monkeypatch):
+    """The DPO workload CLI: JSONL pairs in, trained full-params
+    checkpoint out, restorable by the plain generate --checkpoint-path."""
+    import json
+
+    monkeypatch.setenv("KUBEDL_MESH", "data=4,tensor=2")
+    from kubedl_tpu.train import dpo, generate
+
+    data = tmp_path / "prefs.jsonl"
+    rng = np.random.default_rng(0)
+    with open(data, "w") as f:
+        for _ in range(8):
+            rec = {
+                "prompt": rng.integers(1, 250, size=4).tolist(),
+                "chosen": rng.integers(1, 250, size=6).tolist(),
+                "rejected": rng.integers(1, 250, size=5).tolist(),
+            }
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps({"prompt": [1], "chosen": list(range(1, 40)),
+                            "rejected": [2]}) + "\n")  # skipped: too long
+
+    ckpt = str(tmp_path / "policy")
+    rc = dpo.main([
+        "--model", "tiny", "--data-path", str(data), "--steps", "4",
+        "--batch", "4", "--seq-len", "16", "--lr", "1e-3", "--beta", "0.5",
+        "--checkpoint-path", ckpt, "--log-every", "2",
+    ])
+    assert rc == 0
+    rc = generate.main([
+        "--model", "tiny", "--checkpoint-path", ckpt,
+        "--batch", "2", "--prompt-len", "6", "--max-new-tokens", "3",
+    ])
+    assert rc == 0
+
+
+def test_load_pairs_validation(tmp_path):
+    from kubedl_tpu.train.dpo import load_pairs
+
+    bad = tmp_path / "empty.jsonl"
+    bad.write_text('{"prompt": [1], "chosen": ' + str(list(range(99))) +
+                   ', "rejected": [2]}\n')
+    with pytest.raises(ValueError, match="no usable pairs"):
+        load_pairs(str(bad), seq_len=16)
+
+
+def test_dpo_cli_resume_and_guards(tmp_path, monkeypatch):
+    monkeypatch.setenv("KUBEDL_MESH", "data=4,tensor=2")
+    from kubedl_tpu.train import dpo
+    from kubedl_tpu.train.dpo import load_pairs
+
+    # empty continuation pairs are skipped, not trained on
+    import json as _json
+
+    data = tmp_path / "p.jsonl"
+    data.write_text(
+        _json.dumps({"prompt": [1], "chosen": [], "rejected": [2]}) + "\n"
+        + _json.dumps({"prompt": [1], "chosen": [2], "rejected": [3]}) + "\n")
+    toks, _, _ = load_pairs(str(data), seq_len=8)
+    assert len(toks) == 1
+
+    # missing ref checkpoint dir fails loudly without --allow-fresh-init
+    rc = dpo.main([
+        "--model", "tiny", "--steps", "1", "--batch", "4", "--seq-len", "12",
+        "--ref-checkpoint-path", str(tmp_path / "nope"),
+    ])
+    assert rc == 1
+
+    # preemption resume: second run restores and only runs the remainder
+    ckpt = str(tmp_path / "policy")
+    common = ["--model", "tiny", "--batch", "4", "--seq-len", "12",
+              "--checkpoint-path", ckpt, "--checkpoint-interval", "2"]
+    assert dpo.main(common + ["--steps", "2"]) == 0
+    assert dpo.main(common + ["--steps", "4"]) == 0  # resumes at step 2
