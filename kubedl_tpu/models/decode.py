@@ -215,9 +215,11 @@ def decode_step(
     )  # [b,hkv,L], [b,hkv,1], [b]
 
     x = params["embed"][token][:, None, :].astype(c.dtype)  # [b, 1, d]
+    if c.embed_scale != 1.0:
+        x = x * jnp.asarray(c.embed_scale, c.dtype)
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
         q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -297,9 +299,11 @@ def decode_block_step(
     limits = positions + 1  # query i sees cache < pos + i + 1
 
     x = params["embed"][tokens].astype(c.dtype)  # [b, T, d]
+    if c.embed_scale != 1.0:
+        x = x * jnp.asarray(c.embed_scale, c.dtype)
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
         q = _mm(h, layer["wq"]).reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -433,9 +437,11 @@ def prefill(
         from kubedl_tpu.ops.flash_attention import attention_reference as _attn
 
     x = params["embed"][tokens].astype(c.dtype)
+    if c.embed_scale != 1.0:
+        x = x * jnp.asarray(c.embed_scale, c.dtype)
     ks, vs = [], []
     for layer in params["layers"]:
-        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
         q = _mm(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)

@@ -1,8 +1,9 @@
-"""Hugging Face Llama/Mistral checkpoint importer.
+"""Hugging Face Llama/Mistral/Gemma checkpoint importer.
 
-Maps a `transformers` Llama or Mistral state dict (identical key
-layout; Mistral adds sliding-window attention, mapped onto
-LlamaConfig.sliding_window) onto this repo's param tree so
+Maps a `transformers` Llama, Mistral, or Gemma state dict (identical
+key layout; Mistral adds sliding-window attention -> sliding_window;
+Gemma adds GeGLU, norm weights stored as w-1, and sqrt(d) embedding
+scaling -> act/norm_offset/embed_scale) onto this repo's param tree so
 real released weights run through the TPU-native stack (training,
 decode, serving) — and, just as importantly, gives the Llama
 implementation a gold-standard external parity check: logits must match
@@ -31,6 +32,10 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
     """LlamaConfig from a transformers LlamaConfig."""
     import jax.numpy as jnp
 
+    model_type = getattr(hf_config, "model_type", "llama")
+    if model_type not in ("llama", "mistral", "gemma"):
+        raise ValueError(
+            f"unsupported model_type {model_type!r} (llama, mistral, gemma)")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -47,6 +52,13 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         sliding_window=(getattr(hf_config, "sliding_window", None) or None),
         dtype=jnp.bfloat16,
     )
+    if model_type == "gemma":
+        kw.update(
+            act="gelu_tanh",
+            norm_offset=1.0,  # HF stores RMSNorm weights as w - 1
+            embed_scale=float(hf_config.hidden_size) ** 0.5,
+        )
+
     kw.update(overrides)
     # refuse configs whose math this stack doesn't implement — importing
     # them would produce degraded logits with exit 0

@@ -59,6 +59,11 @@ class LlamaConfig:
     # attention per rank (cheaper comms at small P, capped at the head
     # count) — see ops/ulysses.py for the trade-off.
     context_parallel: str = "ring"
+    # family knobs (Gemma: gelu_tanh FFN, norm weight stored as w-1,
+    # embeddings scaled by sqrt(d_model))
+    act: str = "silu"  # "silu" | "gelu_tanh"
+    norm_offset: float = 0.0  # rms_norm multiplies by (weight + offset)
+    embed_scale: float = 1.0
     # Mistral-style sliding-window attention: query i attends keys in
     # (i - sliding_window, i]. None = full causal. Applies to prefill,
     # decode, and training; not combined with context parallelism.
@@ -188,13 +193,14 @@ def init(config: LlamaConfig, key: jax.Array) -> Dict:
     layers = []
     for i in range(config.n_layers):
         ks = jax.random.split(keys[i], 7)
+        norm_init = jnp.full((d,), 1.0 - config.norm_offset, jnp.float32)
         layer = {
-            "attn_norm": jnp.ones((d,), jnp.float32),
+            "attn_norm": norm_init,
             "wq": dense(ks[0], (d, nq * hd), d),
             "wk": dense(ks[1], (d, nkv * hd), d),
             "wv": dense(ks[2], (d, nkv * hd), d),
             "wo": dense(ks[3], (nq * hd, d), nq * hd),
-            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "mlp_norm": norm_init,
         }
         if config.n_experts > 0:
             layer["moe"] = moe_init(ks[4], d, dff, config.n_experts, dtype=dt)
@@ -208,7 +214,7 @@ def init(config: LlamaConfig, key: jax.Array) -> Dict:
     params = {
         "embed": dense(keys[-3], (config.vocab_size, d), d),
         "layers": layers,
-        "final_norm": jnp.ones((d,), jnp.float32),
+        "final_norm": jnp.full((d,), 1.0 - config.norm_offset, jnp.float32),
     }
     if not config.tie_embeddings:
         params["lm_head"] = dense(keys[-2], (d, config.vocab_size), d)
@@ -232,10 +238,19 @@ def _remat_policy(name: Optional[str]):
     raise ValueError(f"unknown remat_policy {name!r} (None | 'dots')")
 
 
-def rms_norm(x, weight, eps):
+def rms_norm(x, weight, eps, offset: float = 0.0):
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
+    w = weight + offset if offset else weight
+    return (xf * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def _act(x, kind: str):
+    if kind == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if kind != "silu":
+        raise ValueError(f"unknown activation {kind!r} (silu, gelu_tanh)")
+    return jax.nn.silu(x)
 
 
 def _rope(x, positions, theta):
@@ -255,7 +270,7 @@ def _rope(x, positions, theta):
 def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, context_size):
     b, t, d = x.shape
     hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
-    h = rms_norm(x, layer["attn_norm"], config.rms_eps)
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps, config.norm_offset)
     q = _mm(h, layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
     k = _mm(h, layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
     v = _mm(h, layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
@@ -292,14 +307,14 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
 
 def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
     """Dense or MoE FFN; returns (out, aux_loss)."""
-    h = rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    h = rms_norm(x, layer["mlp_norm"], config.rms_eps, config.norm_offset)
     if "moe" in layer:
         y, aux = moe_mlp(
             h, layer["moe"], top_k=config.expert_top_k,
             capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
         )
         return x + y.astype(x.dtype), aux
-    gate = jax.nn.silu(_mm(h, layer["w1"]).astype(jnp.float32)).astype(h.dtype)
+    gate = _act(_mm(h, layer["w1"]).astype(jnp.float32), config.act).astype(h.dtype)
     up = _mm(h, layer["w3"])
     return x + (_mm(gate * up, layer["w2"])).astype(x.dtype), jnp.zeros((), jnp.float32)
 
@@ -333,6 +348,8 @@ def _backbone(
     # embed dim unsharded the output reshards by a cheap dynamic-slice.
     tbl = constrain(params["embed"], "vocab", None)
     x = tbl[tokens].astype(config.dtype)
+    if config.embed_scale != 1.0:
+        x = x * jnp.asarray(config.embed_scale, config.dtype)
     x = constrain(x, "batch", "seq", None)
 
     def layer_fn(carry, layer):
@@ -380,7 +397,7 @@ def _head_matrix(params, config: LlamaConfig):
 
 def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
     """Final norm + (tied or separate) LM head -> f32 logits."""
-    x = rms_norm(x, params["final_norm"], config.rms_eps)
+    x = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     return _mm(x, _head_matrix(params, config)).astype(jnp.float32)
 
 
@@ -398,7 +415,7 @@ def _next_token_ce_chunked(x, params, config: LlamaConfig, targets, n_chunks: in
     jax.checkpoint recomputes the chunk logits in backward instead of
     saving them. Online-logsumexp merge across chunks is exact.
     """
-    xn = rms_norm(x, params["final_norm"], config.rms_eps)
+    xn = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
     head = _head_matrix(params, config)
     d, V = head.shape
     if V % n_chunks:

@@ -48,7 +48,8 @@ def _target_logprobs_chunked(x, params, config, targets):
     head = llama._head_matrix(params, config)  # [d, V]
     # x arrives PRE-norm from the backbone; the head path applies the
     # final RMSNorm first (llama._lm_head does the same)
-    x = llama.rms_norm(x, params["final_norm"], config.rms_eps)
+    x = llama.rms_norm(x, params["final_norm"], config.rms_eps,
+                       config.norm_offset)
     v = head.shape[1]
     chunks = config.ce_chunks
     csize = -(-v // chunks)

@@ -152,3 +152,93 @@ def test_mistral_greedy_decode_matches_transformers(mistral_pair):
     ours = np.asarray(jax.device_get(decode.generate(
         params, jnp.asarray(prompt), config, max_new_tokens=8, max_len=21)))[0]
     np.testing.assert_array_equal(ours, ref)
+
+
+# ---------------------------------------------------------------------------
+# Gemma: GeGLU + (1+w) RMSNorm + sqrt(d) embedding scale, tied head
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gemma_pair():
+    hf_config = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rms_norm_eps=1e-5,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    model = transformers.GemmaForCausalLM(hf_config).eval()
+    config = config_from_hf(hf_config, dtype=jnp.float32, use_flash=False)
+    params = params_from_state_dict(model.state_dict(), config)
+    return model, params, config
+
+
+def test_gemma_config_mapping(gemma_pair):
+    _, _, config = gemma_pair
+    assert config.act == "gelu_tanh"
+    assert config.norm_offset == 1.0
+    assert config.embed_scale == pytest.approx(8.0)
+    assert config.tie_embeddings
+
+
+def test_gemma_logits_match_transformers(gemma_pair):
+    model, params, config = gemma_pair
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, config.vocab_size, size=(2, 14))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), config))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_gemma_greedy_decode_matches_transformers(gemma_pair):
+    model, params, config = gemma_pair
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, config.vocab_size, size=(1, 9))
+    with torch.no_grad():
+        ref = model.generate(
+            torch.tensor(prompt), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[0, 9:]
+    ours = np.asarray(jax.device_get(decode.generate(
+        params, jnp.asarray(prompt), config, max_new_tokens=6, max_len=15)))[0]
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_unknown_model_type_rejected():
+    cfg = transformers.GPT2Config()
+    with pytest.raises(ValueError, match="unsupported model_type"):
+        config_from_hf(cfg)
+
+
+def test_gemma_chunked_ce_matches_full(gemma_pair):
+    """ce_chunks and the DPO chunked logprobs must apply the (1+w) final
+    norm like the unchunked head — pinned on a real Gemma import."""
+    import dataclasses
+
+    _, params, config = gemma_pair
+    rng = np.random.default_rng(10)
+    tokens = jnp.asarray(rng.integers(1, config.vocab_size, size=(2, 12)))
+    full = llama.loss_fn(params, tokens, config)
+    chunked = llama.loss_fn(
+        params, tokens, dataclasses.replace(config, ce_chunks=4))
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-5)
+
+    from kubedl_tpu.train.preference import sequence_logprobs
+
+    pl = jnp.asarray([2, 3])
+    sl = jnp.asarray([10, 12])
+    lp_full = sequence_logprobs(params, tokens, pl, sl, config)
+    lp_chunk = sequence_logprobs(
+        params, tokens, pl, sl, dataclasses.replace(config, ce_chunks=4))
+    np.testing.assert_allclose(np.asarray(lp_chunk), np.asarray(lp_full),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gemma_fresh_init_effective_norm_gain_is_one():
+    config = llama.LlamaConfig.tiny(norm_offset=1.0)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    # stored weight 0 -> (w + offset) == 1 at step 0, like HF Gemma
+    assert float(jnp.max(jnp.abs(params["layers"][0]["attn_norm"]))) == 0.0
+    assert float(jnp.max(jnp.abs(params["final_norm"]))) == 0.0
