@@ -21,7 +21,14 @@ from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import LABEL_REPLICA_INDEX, ReplicaSpec
 from kubedl_tpu.api.meta import ObjectMeta
-from kubedl_tpu.core.store import AlreadyExists, Conflict, NotFound, ObjectStore, write_status
+from kubedl_tpu.core.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    read_fresh,
+    write_status,
+)
 from kubedl_tpu.executor.tpu_topology import (
     Placement,
     SliceInfo,
@@ -134,7 +141,14 @@ class TPUSliceAdmitter(GangScheduler):
             phase = "Reserved" if state.slice_name else "Pending"
             slice_name = state.slice_name or ""
         try:
+            # the no-change check may serve from the informer cache; a
+            # WRITE needs the fresh resourceVersion (a cached rv makes
+            # the swallowed Conflict below permanent — pool changes get
+            # no follow-up reconcile to retry)
             pg = self.store.get("PodGroup", namespace, name)
+            if (pg.status.phase, pg.status.slice_name) == (phase, slice_name):
+                return
+            pg = read_fresh(self.store, "PodGroup", namespace, name)
         except NotFound:
             return
         if (pg.status.phase, pg.status.slice_name) == (phase, slice_name):
@@ -357,7 +371,17 @@ class TPUSliceAdmitter(GangScheduler):
             ),
         )
         try:
-            existing = self.store.get("PodGroup", pg.metadata.namespace, pg.metadata.name)
+            existing = self.store.get(
+                "PodGroup", pg.metadata.namespace, pg.metadata.name)
+            if (
+                existing.spec == pg.spec
+                and (existing.status.phase, existing.status.slice_name)
+                == (pg.status.phase, pg.status.slice_name)
+            ):
+                return  # common case: cached read says nothing to write
+            # writing: re-read FRESH for a current resourceVersion
+            existing = read_fresh(
+                self.store, "PodGroup", pg.metadata.namespace, pg.metadata.name)
             pg.metadata = existing.metadata
             try:
                 if existing.spec != pg.spec:

@@ -356,8 +356,14 @@ class KubeObjectStore:
 
     # -- watch ------------------------------------------------------------
 
-    def watch(self, kinds: Optional[List[str]] = None) -> "KubeWatch":
-        w = KubeWatch(self, kinds or [])
+    def watch(
+        self, kinds: Optional[List[str]] = None, cache_only: bool = False
+    ) -> "KubeWatch":
+        """cache_only=True feeds the informer cache without queueing
+        events — for kinds nothing reconciles on (e.g. PodGroups, which
+        the gang admitter reads per pass) where an undrained queue would
+        grow unboundedly."""
+        w = KubeWatch(self, kinds or [], cache_only=cache_only)
         self._watchers.append(w)
         w.start()
         return w
@@ -394,9 +400,12 @@ class KubeWatch:
     the informer pattern. Reconnects with the last seen resourceVersion;
     relists on 410 Gone."""
 
-    def __init__(self, store: KubeObjectStore, kinds: List[str]) -> None:
+    def __init__(
+        self, store: KubeObjectStore, kinds: List[str], cache_only: bool = False
+    ) -> None:
         self._store = store
         self._kinds = kinds
+        self._cache_only = cache_only
         self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -468,7 +477,8 @@ class KubeWatch:
             # cache BEFORE delivery: a reconcile woken by this event sees
             # a cache at least as fresh as the event itself
             self._store.cache.apply(etype, kind, obj)
-        self._q.put(WatchEvent(type=etype, kind=kind, obj=obj))
+        if not self._cache_only:
+            self._q.put(WatchEvent(type=etype, kind=kind, obj=obj))
 
     def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
         try:

@@ -112,6 +112,7 @@ class Operator:
         self._stopping = threading.Event()
         self.elector = None  # FileLeaseElector | KubeLeaseElector
         self.node_inventory = None  # kube mode: slice pool from node labels
+        self._podgroup_watch = None  # kube mode + gang: cache-only informer
         # storage persistence (ref main.go:97-100): backends resolved at
         # start() so every registered workload gets a persist controller
         self.object_backend = None
@@ -216,6 +217,15 @@ class Operator:
             # registered, so with zero controllers there is nothing to
             # wait for.
             kinds = sorted({*self.reconcilers, "Pod", "Service"})
+            if self.config.enable_gang_scheduling and self.store.has_kind("PodGroup"):
+                # the gang admitter mirrors PodGroups every reconcile; a
+                # cache-only watch keeps those reads off the apiserver.
+                # Guarded by discovery: without the CRD the pump would
+                # relist a 404 forever and sync would stall startup
+                # (mirror writes already tolerate the missing kind).
+                self._podgroup_watch = self.store.watch(
+                    ["PodGroup"], cache_only=True)
+                kinds.append("PodGroup")
             if not self.store.wait_for_cache_sync(kinds, timeout=30.0):
                 log.warning("informer cache not synced within 30s; reads stay uncached")
         if (
@@ -276,6 +286,8 @@ class Operator:
 
     def stop(self) -> None:
         self._stopping.set()
+        if self._podgroup_watch is not None:
+            self._podgroup_watch.stop()
         if self.node_inventory is not None:
             self.node_inventory.stop()
         self.manager.stop()

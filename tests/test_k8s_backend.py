@@ -2,6 +2,7 @@
 fake-client suites (SURVEY.md §4), but over real HTTP: KubeClient +
 KubeObjectStore against the embedded fake apiserver, then the full
 operator converging a TFJob with the test playing kubelet."""
+import json
 import threading
 import time
 
@@ -704,6 +705,49 @@ def test_workload_converges_over_kube_store(srv, kind):
         _play_kubelet(kstore, name, PodPhase.SUCCEEDED, stop, n=n_pods,
                       container=cfg["container"])
         assert op.wait_for_condition(job, "Succeeded", timeout=15), kind
+    finally:
+        stop.set()
+        op.stop()
+
+
+def test_gang_podgroup_reads_served_from_cache(srv):
+    """With gang enabled, PodGroup mirror reads ride a cache-only watch:
+    after sync, repeated reconciles issue no podgroup GET/LIST traffic."""
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    kstore = KubeObjectStore(KubeClient(srv.url))
+    op = Operator(
+        OperatorConfig(workloads="jax", enable_gang_scheduling=True,
+                       tpu_slices=["v5e-8"]),
+        store=kstore,
+    )
+    op.register_all()
+    op.start()
+    stop = threading.Event()
+    try:
+        assert kstore.cache.synced("PodGroup")
+        manifest = json.loads(json.dumps(JAXJOB_GANG))
+        manifest["metadata"]["name"] = "cache-gang"
+        job = op.apply(manifest)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            pods = kstore.list("Pod", "default", {"job-name": "cache-gang"})
+            if len(pods) == 2:
+                break
+            time.sleep(0.05)
+
+        st = srv._httpd.state
+        with st.lock:
+            st.requests.clear()
+        _play_kubelet(kstore, "cache-gang", PodPhase.RUNNING, stop,
+                      container="jax")
+        assert op.wait_for_condition(job, "Running", timeout=15)
+        with st.lock:
+            pg_gets = [
+                (m, p) for (m, p, w) in st.requests
+                if m == "GET" and "/podgroups" in p and not w
+            ]
+        assert pg_gets == []
     finally:
         stop.set()
         op.stop()
