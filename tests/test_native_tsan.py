@@ -70,7 +70,9 @@ def test_loader_concurrency_under_tsan(tmp_path):
     env["TSAN_OPTIONS"] = "exitcode=66 report_thread_leaks=0"
     proc = subprocess.run(
         [sys.executable, "-c", DRIVER, shard],
-        capture_output=True, text=True, timeout=300, env=env,
+        # generous: TSan slows the loader ~10x and a loaded machine (e.g. a
+        # concurrent XLA compile) can starve the subprocess further
+        capture_output=True, text=True, timeout=600, env=env,
     )
     assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-3000:]
     assert proc.returncode == 0, (proc.returncode, proc.stderr[-3000:])
