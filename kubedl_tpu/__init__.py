@@ -16,10 +16,17 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, re-designed TPU-first)
   metrics/      job metrics, event-driven gauges             (ref: pkg/metrics)
   codesync/     git code-sync injection                      (ref: pkg/code_sync)
   storage/      job/pod/event history backends               (ref: pkg/storage)
-  models/       JAX flagship models (Llama, MNIST)           (net-new TPU compute path)
-  ops/          Pallas kernels (flash/ring attention)        (net-new TPU compute path)
-  parallel/     mesh, shardings, SPMD train step             (net-new TPU compute path)
-  train/        coordinator bootstrap, trainer, checkpoints  (net-new TPU compute path)
+  k8s/          apiserver store, informer cache, Lease      (ref: client-go/controller-runtime)
+                election, GKE placement, node inventory,
+                admission webhooks, fake apiserver
+  models/       Llama/Mistral/Gemma + MoE/ViT/embeddings,    (net-new TPU compute path)
+                KV-cache decode, serving engine, LoRA,
+                int8 quant, HF importer
+  ops/          Pallas flash attention (+sliding window),    (net-new TPU compute path)
+                ring + Ulysses context parallelism
+  parallel/     mesh, shardings, SPMD train step, GPipe      (net-new TPU compute path)
+  train/        coordinator bootstrap, trainer, DPO, serve,  (net-new TPU compute path)
+                generate, checkpoints
   utils/        serde, exit codes, logging
 """
 
