@@ -14,6 +14,7 @@ JSON API backed by `models/serving.ServingEngine`:
     POST /prefix     {"tokens": [...]}  -> {"prefix_id": N}   (shared
                       system prompts prefill once; see register_prefix)
     GET  /stats      -> ServingEngine.stats()
+    GET  /metrics    -> Prometheus text format (kubedl_serving_* gauges)
     GET  /healthz    -> {"ok": true}
 
 One background thread drives `engine.step()` whenever work is pending —
@@ -148,6 +149,26 @@ class _Handler(BaseHTTPRequestHandler):
             stats = self.svc.engine.stats()
             stats["ticks"] = self.svc.ticks
             return self._send(200, stats)
+        if self.path == "/metrics":
+            # Prometheus text format, matching the operator's exporter
+            # conventions (docs/metrics.md) so one scrape config covers
+            # operator and serving pods
+            stats = self.svc.engine.stats()
+            stats["ticks"] = self.svc.ticks
+            lines = []
+            for key, val in sorted(stats.items()):
+                if not isinstance(val, (int, float)):
+                    continue
+                name = f"kubedl_serving_{key}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {float(val)}")
+            payload = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
         self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self) -> None:  # noqa: N802

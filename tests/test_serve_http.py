@@ -199,3 +199,16 @@ def test_text_api_with_hf_tokenizer(tmp_path):
         18783, {"text": "hello tpu world", "max_new_tokens": 4})
     assert out is not None and rc == 0
     assert len(out["tokens"]) <= 4 and isinstance(out["text"], str)
+
+
+def test_prometheus_metrics_endpoint(server):
+    base, _ = server
+    # some traffic so the gauges are non-trivial
+    _post(f"{base}/generate", {"tokens": [3, 4], "max_new_tokens": 2})
+    body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+    assert "# TYPE kubedl_serving_tokens_out gauge" in body
+    lines = dict(
+        l.split(" ", 1) for l in body.splitlines() if not l.startswith("#"))
+    assert float(lines["kubedl_serving_tokens_out"]) >= 2
+    assert float(lines["kubedl_serving_slots"]) == 3
+    assert "kubedl_serving_slot_utilization" in lines
