@@ -33,6 +33,29 @@ LABEL_GROUP_NAME = "group-name"
 LABEL_JOB_NAME = "job-name"
 LABEL_JOB_ROLE = "job-role"
 
+# Multislice: which TPU slice of a multi-slice gang a pod belongs to
+# (workloads/jaxjob.py stamps it; the slice admitter places by it).
+LABEL_SLICE_ID = "kubedl-tpu.io/slice-id"
+
+
+def slice_group(total: int, num_slices: int, index: int):
+    """THE multislice grouping convention, in one place: `total` workers
+    divide into `num_slices` contiguous index groups. Returns
+    (slice_id, in_slice_index, per_slice). Everything that reasons about
+    slice membership — env injection (workloads/jaxjob.py), GKE worker
+    identity (k8s/gke.py), gang placement (gang/slice_admitter.py) — must
+    go through this so the three can never drift apart.
+
+    Degenerate inputs (num_slices < 2, or total not divisible) collapse to
+    single-slice semantics: everything in slice 0, index unchanged.
+    """
+    num_slices = int(num_slices or 1)
+    total = int(total or 0)
+    if num_slices < 2 or total % num_slices:
+        return 0, index, max(total, 1)
+    per_slice = total // num_slices
+    return index // per_slice, index % per_slice, per_slice
+
 ANNOTATION_GIT_SYNC_CONFIG = "kubedl.io/git-sync-config"
 ANNOTATION_TENANCY = "kubedl.io/tenancy"
 

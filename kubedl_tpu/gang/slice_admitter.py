@@ -19,7 +19,12 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from kubedl_tpu.api.common import LABEL_REPLICA_INDEX, ReplicaSpec
+from kubedl_tpu.api.common import (
+    LABEL_REPLICA_INDEX,
+    LABEL_SLICE_ID,
+    ReplicaSpec,
+    slice_group,
+)
 from kubedl_tpu.api.meta import ObjectMeta
 from kubedl_tpu.core.store import (
     AlreadyExists,
@@ -44,12 +49,14 @@ class PodGroupSpec:
     min_member: int = 0
     tpu_chips: int = 0
     tpu_slice: str = ""
+    num_slices: int = 1
 
 
 @dataclass
 class PodGroupStatus:
     phase: str = "Pending"  # Pending | Reserved
-    slice_name: str = ""
+    slice_name: str = ""  # first reserved slice (printer column)
+    slice_names: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -69,9 +76,18 @@ class _GangState:
     min_member: int = 0
     tpu_chips: int = 0
     requested_slice: str = ""
-    slice_name: Optional[str] = None
+    # reserved slices, ordered by slice-id; empty = waiting. A gang asks
+    # for num_slices whole slices (multislice JAXJob spans several slices
+    # over DCN) and gets all of them or none.
+    slice_names: List[str] = field(default_factory=list)
+    num_slices: int = 1
+    total_member: int = 0  # total replicas (min_member can be lower)
     priority: int = 0
     seq: int = 0  # admission order for FIFO tie-break
+
+    @property
+    def slice_name(self) -> Optional[str]:
+        return self.slice_names[0] if self.slice_names else None
 
 
 class TPUSliceAdmitter(GangScheduler):
@@ -117,10 +133,17 @@ class TPUSliceAdmitter(GangScheduler):
             self._slices = new
             changed_keys = []
             for key, state in self._gangs.items():
-                if state.slice_name is not None and (
-                    state.slice_name not in new or state.slice_name in invalidated
+                if state.slice_names and any(
+                    s not in new or s in invalidated for s in state.slice_names
                 ):
-                    state.slice_name = None
+                    # all-or-nothing holds for revocation too: losing any
+                    # slice of a multislice gang frees the survivors and
+                    # sends the whole gang back to waiting
+                    for s in state.slice_names:
+                        info = new.get(s)
+                        if info is not None and info.reserved_by == key:
+                            info.reserved_by = None
+                    state.slice_names = []
                     changed_keys.append(key)
             self._solo = {
                 pod_key: sname for pod_key, sname in self._solo.items()
@@ -138,23 +161,25 @@ class TPUSliceAdmitter(GangScheduler):
             state = self._gangs.get(gang_key)
             if state is None:
                 return
-            phase = "Reserved" if state.slice_name else "Pending"
+            phase = "Reserved" if state.slice_names else "Pending"
             slice_name = state.slice_name or ""
+            slice_names = list(state.slice_names)
         try:
             # the no-change check may serve from the informer cache; a
             # WRITE needs the fresh resourceVersion (a cached rv makes
             # the swallowed Conflict below permanent — pool changes get
             # no follow-up reconcile to retry)
             pg = self.store.get("PodGroup", namespace, name)
-            if (pg.status.phase, pg.status.slice_name) == (phase, slice_name):
+            if (pg.status.phase, pg.status.slice_names) == (phase, slice_names):
                 return
             pg = read_fresh(self.store, "PodGroup", namespace, name)
         except NotFound:
             return
-        if (pg.status.phase, pg.status.slice_name) == (phase, slice_name):
+        if (pg.status.phase, pg.status.slice_names) == (phase, slice_names):
             return
         pg.status.phase = phase
         pg.status.slice_name = slice_name
+        pg.status.slice_names = slice_names
         try:
             write_status(self.store, pg)
         except (Conflict, NotFound):
@@ -185,10 +210,12 @@ class TPUSliceAdmitter(GangScheduler):
                     int(s.replicas or 0) * s.template.spec.tpu_chips()
                     for s in replicas.values()
                 )
+                num_slices = max(int(getattr(job.spec, "num_slices", 1) or 1), 1)
                 self._seq += 1
                 state = _GangState(
                     min_member=min_member, tpu_chips=chips,
                     requested_slice=requested_slice,
+                    num_slices=num_slices, total_member=total,
                     priority=priority, seq=self._seq,
                 )
                 self._gangs[key] = state
@@ -210,10 +237,11 @@ class TPUSliceAdmitter(GangScheduler):
         key = f"{job.metadata.namespace}/{job.metadata.name}"
         with self._lock:
             state = self._gangs.pop(key, None)
-            if state and state.slice_name:
-                info = self._slices.get(state.slice_name)
-                if info and info.reserved_by == key:
-                    info.reserved_by = None
+            if state:
+                for sname in state.slice_names:
+                    info = self._slices.get(sname)
+                    if info and info.reserved_by == key:
+                        info.reserved_by = None
         try:
             self.store.delete("PodGroup", job.metadata.namespace, job.metadata.name)
         except NotFound:
@@ -236,12 +264,21 @@ class TPUSliceAdmitter(GangScheduler):
                 return None  # gang not created yet; stay Pending
             if state.tpu_chips <= 0:
                 return Placement(node_name="local-cpu")
-            if state.slice_name is None:
+            if not state.slice_names:
                 self._reserve_waiting()
-            if state.slice_name is None:
-                return None  # no slice free (or higher-priority gangs ahead)
-            info = self._slices[state.slice_name]
-            return self._place_on_slice(pod, info)
+            if not state.slice_names:
+                return None  # no slices free (or higher-priority gangs ahead)
+            # multislice: the pod's slice-id label picks which reserved
+            # slice it lands on (workloads/jaxjob.py stamps contiguous
+            # worker groups); single-slice gangs have exactly one entry
+            try:
+                slice_idx = int(pod.metadata.labels.get(LABEL_SLICE_ID, "0"))
+            except ValueError:
+                slice_idx = 0
+            if not (0 <= slice_idx < len(state.slice_names)):
+                return None  # label out of range for the reservation
+            info = self._slices[state.slice_names[slice_idx]]
+            return self._place_on_slice(pod, info, gang=state)
 
     def release(self, pod) -> None:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -293,35 +330,59 @@ class TPUSliceAdmitter(GangScheduler):
         waiting = sorted(
             (
                 (k, s) for k, s in self._gangs.items()
-                if s.slice_name is None and s.tpu_chips > 0
+                if not s.slice_names and s.tpu_chips > 0
             ),
             key=lambda kv: (-kv[1].priority, kv[1].seq),
         )
         granted = []
         for key, state in waiting:
             self._try_reserve(key, state)
-            if state.slice_name is not None:
+            if state.slice_names:
                 granted.append(key)
+            elif self._feasible(state):
+                # Head-of-line blocking: a feasible-but-unsatisfied gang
+                # (e.g. a multislice gang holding out for N simultaneously
+                # free slices) keeps its place — later gangs must NOT
+                # leapfrog it, or a steady stream of small jobs starves it
+                # forever (it never holds partial reservations, so every
+                # freed slice would otherwise be snatched). Infeasible
+                # gangs (demand exceeds the pool itself) don't block.
+                break
         return granted
 
-    def _try_reserve(self, key: str, state: _GangState) -> None:
-        if state.slice_name is not None or state.tpu_chips <= 0:
-            return
-        candidates = self._free_slices()
+    def _feasible(self, state: _GangState) -> bool:
+        """Could this gang EVER be satisfied by the current pool (counting
+        busy slices as eventually freeable)? Gates head-of-line blocking so
+        an impossible request doesn't wedge the queue."""
+        return len(self._matching_slices(state, self._slices.values())) >= max(
+            state.num_slices, 1
+        )
+
+    def _matching_slices(self, state: _GangState, pool) -> List[SliceInfo]:
+        """Slices that satisfy the gang's PER-SLICE demand (explicit slice
+        type, or chips: the job's total divides over its slices; ceil keeps
+        ragged specs safe)."""
+        per_slice_chips = -(-state.tpu_chips // max(state.num_slices, 1))
         if state.requested_slice:
             want = parse_slice_type(state.requested_slice)
-            candidates = [
-                s for s in candidates
+            return [
+                s for s in pool
                 if s.type.generation == want.generation and s.type.chips >= want.chips
             ]
-        else:
-            candidates = [s for s in candidates if s.type.chips >= state.tpu_chips]
-        if not candidates:
+        return [s for s in pool if s.type.chips >= per_slice_chips]
+
+    def _try_reserve(self, key: str, state: _GangState) -> None:
+        if state.slice_names or state.tpu_chips <= 0:
             return
-        # tightest fit first — keep big slices free for big gangs
-        best = min(candidates, key=lambda s: s.type.chips)
-        best.reserved_by = key
-        state.slice_name = best.name
+        n = max(state.num_slices, 1)
+        candidates = self._matching_slices(state, self._free_slices())
+        if len(candidates) < n:
+            return  # all-or-nothing across ALL the gang's slices
+        # tightest fits first — keep big slices free for big gangs
+        chosen = sorted(candidates, key=lambda s: s.type.chips)[:n]
+        for s in chosen:
+            s.reserved_by = key
+        state.slice_names = [s.name for s in chosen]
 
     def _assign_solo(self, pod, chips: int) -> Optional[Placement]:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -337,11 +398,17 @@ class TPUSliceAdmitter(GangScheduler):
             self._solo[key] = best.name
             return self._place_on_slice(pod, best)
 
-    def _place_on_slice(self, pod, info: SliceInfo) -> Placement:
+    def _place_on_slice(
+        self, pod, info: SliceInfo, gang: Optional[_GangState] = None
+    ) -> Placement:
         try:
             index = int(pod.metadata.labels.get(LABEL_REPLICA_INDEX, "0"))
         except ValueError:
             index = 0
+        if gang is not None and gang.num_slices > 1:
+            # worker id is PER SLICE (matches GKE's TPU_WORKER_ID scoping);
+            # same contiguous-group convention as env injection
+            _, index, _ = slice_group(gang.total_member, gang.num_slices, index)
         coords = host_coords(info.type)
         order = ring_order(coords)
         host = order[index % len(order)] if order else 0
@@ -364,10 +431,12 @@ class TPUSliceAdmitter(GangScheduler):
                 min_member=state.min_member,
                 tpu_chips=state.tpu_chips,
                 tpu_slice=state.requested_slice,
+                num_slices=state.num_slices,
             ),
             status=PodGroupStatus(
-                phase="Reserved" if state.slice_name else "Pending",
+                phase="Reserved" if state.slice_names else "Pending",
                 slice_name=state.slice_name or "",
+                slice_names=list(state.slice_names),
             ),
         )
         try:
@@ -375,8 +444,8 @@ class TPUSliceAdmitter(GangScheduler):
                 "PodGroup", pg.metadata.namespace, pg.metadata.name)
             if (
                 existing.spec == pg.spec
-                and (existing.status.phase, existing.status.slice_name)
-                == (pg.status.phase, pg.status.slice_name)
+                and (existing.status.phase, existing.status.slice_names)
+                == (pg.status.phase, pg.status.slice_names)
             ):
                 return  # common case: cached read says nothing to write
             # writing: re-read FRESH for a current resourceVersion
@@ -388,8 +457,8 @@ class TPUSliceAdmitter(GangScheduler):
                     # spec changes (min_member, chips, slice request) ride
                     # the main path; status is preserved by the store
                     pg.metadata = self.store.update(pg).metadata
-                if (existing.status.phase, existing.status.slice_name) != (
-                    pg.status.phase, pg.status.slice_name
+                if (existing.status.phase, existing.status.slice_names) != (
+                    pg.status.phase, pg.status.slice_names
                 ):
                     # phase/slice live in status -> /status subresource PUT
                     write_status(self.store, pg)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+from kubedl_tpu.api.common import slice_group
 from kubedl_tpu.executor.tpu_topology import parse_slice_type
 
 GKE_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
@@ -69,10 +70,19 @@ def gke_tpu_mutator(job, template, rt: str, index: int, spec) -> None:
     template.spec.node_selector.update(selectors)
 
     n = int(spec.replicas or 0)
+    # Multislice jobs (JAXJob spec.numSlices > 1): TPU worker identity is
+    # scoped PER SLICE — each slice's libtpu expects ids 0..per_slice-1 and
+    # hostnames listing only its own slice's workers (cross-slice traffic
+    # is DCN via the MEGASCALE_* envs, workloads/jaxjob.py).
+    num_slices = max(int(getattr(job.spec, "num_slices", 1) or 1), 1)
+    lo, hi, worker_id = 0, n, index
+    if num_slices > 1 and n % num_slices == 0:
+        slice_id, worker_id, per_slice = slice_group(n, num_slices, index)
+        lo, hi = slice_id * per_slice, (slice_id + 1) * per_slice
     hostnames = ",".join(
         f"{job.metadata.name}-{rt.lower()}-{i}.{job.metadata.namespace}"
-        for i in range(n)
+        for i in range(lo, hi)
     )
     for c in template.spec.containers:
-        c.env.setdefault("TPU_WORKER_ID", str(index))
+        c.env.setdefault("TPU_WORKER_ID", str(worker_id))
         c.env.setdefault("TPU_WORKER_HOSTNAMES", hostnames)

@@ -27,6 +27,59 @@ AXIS_ORDER = ("data", "fsdp", "stage", "tensor", "context", "expert")
 BATCH_AXES = ("data", "fsdp")
 
 ENV_MESH = "KUBEDL_MESH"
+# DCN (cross-slice) axes of a multislice job, injected by the operator next
+# to KUBEDL_MESH (which holds the per-slice ICI axes). Present => the
+# program builds a hybrid mesh so collectives on these axes ride DCN and
+# never cut an ICI ring mid-slice.
+ENV_DCN_MESH = "KUBEDL_DCN_MESH"
+
+
+def parse_dcn_mesh_env(value: Optional[str] = None) -> Optional[Dict[str, int]]:
+    """Parse KUBEDL_DCN_MESH ("data=2"). None when unset/empty (single
+    slice); unlike KUBEDL_MESH there is no -1 default — cross-slice axes
+    are always explicit in the JAXJob spec."""
+    value = value if value is not None else os.environ.get(ENV_DCN_MESH, "")
+    if not value:
+        return None
+    axes = {name: 1 for name in AXIS_ORDER}
+    for part in value.split(","):
+        if not part.strip():
+            continue
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in axes:
+            raise ValueError(f"unknown mesh axis {name!r} (known: {AXIS_ORDER})")
+        size = int(size)
+        if size < 1:
+            raise ValueError(f"DCN axis {name!r} must be >=1, got {size}")
+        axes[name] = size
+    return axes
+
+
+def build_mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
+    """The one mesh entrypoint for workload programs: flat mesh from
+    KUBEDL_MESH, or a hybrid ICIxDCN mesh when the operator injected
+    KUBEDL_DCN_MESH (multislice JAXJob, workloads/jaxjob.py)."""
+    dcn = parse_dcn_mesh_env()
+    if dcn is None:
+        return build_mesh(parse_mesh_env(), devices=devices)
+    ici = parse_mesh_env()
+    if any(v == -1 for v in ici.values()):
+        # -1 fill: resolve against per-slice device count
+        n = len(list(devices if devices is not None else jax.devices()))
+        per_slice, rem = divmod(n, math.prod(dcn.values()))
+        if rem:
+            raise ValueError(
+                f"{n} devices not divisible by DCN axes {dcn}")
+        wild = [k for k, v in ici.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"only one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in ici.values() if v != -1)
+        if per_slice % fixed:
+            raise ValueError(
+                f"{per_slice} per-slice devices not divisible by {fixed}")
+        ici[wild[0]] = per_slice // fixed
+    return build_hybrid_mesh(ici, dcn, devices=devices)
 
 
 def parse_mesh_env(value: Optional[str] = None) -> Dict[str, int]:

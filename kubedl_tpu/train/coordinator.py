@@ -20,6 +20,12 @@ log = logging.getLogger("kubedl_tpu.coordinator")
 ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
 ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"
+# Multislice identity (workloads/jaxjob.py, numSlices > 1): which DCN-joined
+# slice this process belongs to. The mesh layout itself comes from
+# KUBEDL_DCN_MESH (parallel/mesh.py); these are for program-level use —
+# logging, per-slice data sharding, profiling labels.
+ENV_NUM_SLICES = "KUBEDL_NUM_SLICES"
+ENV_SLICE_ID = "KUBEDL_SLICE_ID"
 
 
 @dataclass
@@ -27,10 +33,16 @@ class ProcessInfo:
     coordinator_address: Optional[str]
     num_processes: int
     process_id: int
+    num_slices: int = 1
+    slice_id: int = 0
 
     @property
     def is_distributed(self) -> bool:
         return self.num_processes > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def process_info() -> ProcessInfo:
@@ -38,6 +50,8 @@ def process_info() -> ProcessInfo:
         coordinator_address=os.environ.get(ENV_COORDINATOR_ADDRESS),
         num_processes=int(os.environ.get(ENV_NUM_PROCESSES, "1")),
         process_id=int(os.environ.get(ENV_PROCESS_ID, "0")),
+        num_slices=int(os.environ.get(ENV_NUM_SLICES, "1")),
+        slice_id=int(os.environ.get(ENV_SLICE_ID, "0")),
     )
 
 

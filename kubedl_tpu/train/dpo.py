@@ -106,7 +106,7 @@ def main(argv=None) -> int:
     import optax
 
     from kubedl_tpu.models import llama
-    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
     from kubedl_tpu.train.preference import make_dpo_step
 
     if args.hf_model:
@@ -124,7 +124,7 @@ def main(argv=None) -> int:
             seed=args.seed, label="base")
         if base is None:
             return 1
-    mesh = build_mesh(parse_mesh_env())
+    mesh = build_mesh_from_env()
     rules = ShardingRules()
     print(f"mesh: {dict(mesh.shape)} model={args.hf_model or args.model} "
           f"beta={args.beta}", flush=True)

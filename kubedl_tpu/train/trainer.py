@@ -119,7 +119,7 @@ def main(argv=None) -> int:
     import optax
 
     from kubedl_tpu.models import llama
-    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
     from kubedl_tpu.parallel.train_step import make_train_step
 
     import dataclasses
@@ -143,7 +143,8 @@ def main(argv=None) -> int:
     if args.ce_chunks > 1:
         config = dataclasses.replace(config, ce_chunks=args.ce_chunks)
 
-    mesh = build_mesh(parse_mesh_env())
+    # hybrid ICIxDCN when the operator injected KUBEDL_DCN_MESH (multislice)
+    mesh = build_mesh_from_env()
     rules = ShardingRules()
     model_name = args.hf_model or args.model
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "

@@ -35,7 +35,7 @@ def main(argv=None) -> int:
     import optax
 
     from kubedl_tpu.models import vit
-    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh, parse_mesh_env
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
     from kubedl_tpu.parallel.train_step import make_train_step
 
     config = {
@@ -46,7 +46,7 @@ def main(argv=None) -> int:
     # unfused path below its measured min-seq crossover on its own — no
     # per-model override needed (ops/flash_attention.py)
 
-    mesh = build_mesh(parse_mesh_env())
+    mesh = build_mesh_from_env()
     rules = ShardingRules()
 
     params = vit.init(config, jax.random.PRNGKey(0))

@@ -22,6 +22,11 @@ ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
 # runtime default).
 COORDINATOR_PORT = 8471
 
+# Port of the Megascale (multislice DCN) coordinator on slice-0 worker-0 —
+# libtpu's default; injected as MEGASCALE_COORDINATOR_ADDRESS next to the
+# coordination-service envs for numSlices > 1 jobs (workloads/jaxjob.py).
+MEGASCALE_PORT = 8080
+
 ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
 ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"

@@ -21,10 +21,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import (
+    LABEL_SLICE_ID,
     ReplicaSpec,
     ReplicaType,
     RestartPolicy,
     RunPolicy,
+    slice_group,
 )
 from kubedl_tpu.api.job import BaseJob
 from kubedl_tpu.controllers.base import BaseWorkloadController
@@ -62,6 +64,17 @@ class MeshSpec:
     def encode(self) -> str:
         return ",".join(f"{k}={v}" for k, v in self.axis_dict().items())
 
+    def encode_sparse(self) -> str:
+        """Only the non-trivial axes — the KUBEDL_DCN_MESH wire form, where
+        unset axes default to 1 (parallel/mesh.py parse_dcn_mesh_env)."""
+        return ",".join(f"{k}={v}" for k, v in self.axis_dict().items() if v != 1)
+
+    def product(self) -> int:
+        p = 1
+        for v in self.axis_dict().values():
+            p *= v
+        return p
+
 
 @dataclass
 class CheckpointSpec:
@@ -79,6 +92,14 @@ class JAXJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     mesh: Optional[MeshSpec] = None
     checkpoint: Optional[CheckpointSpec] = None
+    # Multislice: the job spans num_slices TPU slices joined by DCN.
+    # `mesh` stays the per-slice (ICI) axes; `dcn_mesh` declares which
+    # axes span slices (default data=num_slices — the standard recipe:
+    # data parallel over DCN, fsdp/tensor/context inside each slice).
+    # Workers divide evenly into slices by index; the gang admitter
+    # reserves num_slices whole slices atomically or nothing.
+    num_slices: int = 1
+    dcn_mesh: Optional[MeshSpec] = None
     # Persistent XLA compile cache dir (a mounted volume / GCS path):
     # after a preemption the restarted slice replays compiles from cache
     # instead of paying minutes of XLA again. Injected as JAX's native
@@ -129,10 +150,57 @@ class JAXJobController(BaseWorkloadController):
     def reconcile_orders(self):
         return [ReplicaType.WORKER]
 
+    def validate_job(self, job) -> List[str]:
+        errs = []
+        ns = int(job.spec.num_slices or 1)
+        workers = int(
+            (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec()).replicas
+            or 0
+        )
+        if ns < 1:
+            errs.append(f"spec.numSlices must be >=1, got {ns}")
+        elif ns > 1:
+            if workers % ns:
+                errs.append(
+                    f"spec.numSlices={ns} must divide the Worker replica "
+                    f"count {workers} (each slice gets an equal worker group)"
+                )
+            if job.spec.dcn_mesh is not None and job.spec.dcn_mesh.product() != ns:
+                errs.append(
+                    f"spec.dcnMesh axes multiply to "
+                    f"{job.spec.dcn_mesh.product()}, must equal "
+                    f"spec.numSlices={ns}"
+                )
+        elif job.spec.dcn_mesh is not None:
+            errs.append("spec.dcnMesh requires spec.numSlices > 1")
+        return errs
+
     def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
         env = {}
         if job.spec.mesh is not None:
             env["KUBEDL_MESH"] = job.spec.mesh.encode()
+        ns = int(job.spec.num_slices or 1)
+        if ns > 1:
+            # Multislice: per-slice worker groups by index; libtpu's
+            # Megascale DCN transport bootstraps from MEGASCALE_* the way
+            # single-slice jobs bootstrap from the coordination service.
+            workers = int(
+                (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec())
+                .replicas or 0
+            )
+            slice_id, _, _ = slice_group(workers, ns, index)
+            dcn = job.spec.dcn_mesh
+            dcn_encoded = dcn.encode_sparse() if dcn is not None else f"data={ns}"
+            env["KUBEDL_NUM_SLICES"] = str(ns)
+            env["KUBEDL_SLICE_ID"] = str(slice_id)
+            env["KUBEDL_DCN_MESH"] = dcn_encoded
+            env["MEGASCALE_NUM_SLICES"] = str(ns)
+            env["MEGASCALE_SLICE_ID"] = str(slice_id)
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                f"{common.service_dns(job, REPLICA_WORKER, 0)}"
+                f":{common.MEGASCALE_PORT}"
+            )
+            pod_template.metadata.labels[LABEL_SLICE_ID] = str(slice_id)
         ckpt = job.spec.checkpoint
         if ckpt is not None and ckpt.path:
             env["KUBEDL_CHECKPOINT_PATH"] = ckpt.path

@@ -29,3 +29,55 @@ def test_hybrid_mesh_runs_collectives():
 def test_hybrid_mesh_device_count_mismatch():
     with pytest.raises(ValueError, match="needs 16 devices"):
         build_hybrid_mesh({"fsdp": 8}, {"data": 2})
+
+
+# ---------------------------------------------------------------------------
+# operator-injected env -> mesh (the multislice runtime entrypoint)
+# ---------------------------------------------------------------------------
+
+
+def test_build_mesh_from_env_flat(monkeypatch):
+    from kubedl_tpu.parallel.mesh import ENV_DCN_MESH, ENV_MESH, build_mesh_from_env
+
+    monkeypatch.setenv(ENV_MESH, "data=2,tensor=4")
+    monkeypatch.delenv(ENV_DCN_MESH, raising=False)
+    m = build_mesh_from_env()
+    assert m.shape["data"] == 2 and m.shape["tensor"] == 4
+
+
+def test_build_mesh_from_env_hybrid(monkeypatch):
+    from kubedl_tpu.parallel.mesh import ENV_DCN_MESH, ENV_MESH, build_mesh_from_env
+
+    # what a numSlices=2 JAXJob's pods see: per-slice ICI axes + DCN data
+    monkeypatch.setenv(ENV_MESH, "fsdp=2,tensor=2")
+    monkeypatch.setenv(ENV_DCN_MESH, "data=2")
+    m = build_mesh_from_env()
+    assert dict(m.shape)["data"] == 2
+    assert dict(m.shape)["fsdp"] == 2
+    # collectives execute over the hybrid mesh
+    x = jax.device_put(
+        jnp.arange(8.0), NamedSharding(m, P(("data", "fsdp", "tensor")))
+    )
+    total = jax.jit(lambda x: jnp.sum(x), out_shardings=NamedSharding(m, P()))(x)
+    assert float(total) == 28.0
+
+
+def test_build_mesh_from_env_hybrid_wildcard(monkeypatch):
+    from kubedl_tpu.parallel.mesh import ENV_DCN_MESH, build_mesh_from_env
+
+    # unset KUBEDL_MESH defaults to data=-1: the fill resolves against the
+    # PER-SLICE device count (8 devices / 2 slices = 4 per slice)
+    monkeypatch.delenv("KUBEDL_MESH", raising=False)
+    monkeypatch.setenv(ENV_DCN_MESH, "data=2")
+    m = build_mesh_from_env()
+    assert dict(m.shape)["data"] == 8
+
+
+def test_parse_dcn_mesh_env_rejects_bad_axes(monkeypatch):
+    from kubedl_tpu.parallel.mesh import parse_dcn_mesh_env
+
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        parse_dcn_mesh_env("bogus=2")
+    with pytest.raises(ValueError, match=">=1"):
+        parse_dcn_mesh_env("data=-1")
+    assert parse_dcn_mesh_env("") is None

@@ -1,0 +1,258 @@
+"""Multislice JAXJob: numSlices/dcnMesh spec -> Megascale env + slice-id
+labels (workloads/jaxjob.py), atomic N-slice gang reservation
+(gang/slice_admitter.py), and the hybrid mesh built from the injected envs
+(parallel/mesh.py build_mesh_from_env).
+
+The reference has no multislice notion (its gangs are one PodGroup —
+ref pkg/gang_schedule/batch_scheduler/scheduler.go:59-90); this is the
+TPU-native extension: one job = several TPU slices joined by DCN, with
+the same all-or-nothing admission semantics extended across slices.
+"""
+import pytest
+
+from kubedl_tpu.api.common import (
+    LABEL_REPLICA_INDEX,
+    LABEL_SLICE_ID,
+    ReplicaSpec,
+)
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import (
+    Container,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubedl_tpu.api.validation import validate_common
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+from kubedl_tpu.utils.serde import from_dict
+from kubedl_tpu.workloads.jaxjob import JAXJob, JAXJobController
+
+from tests.test_workloads import (
+    container_manifest,
+    pod_env,
+    reconcile_once,
+)
+
+
+def _multislice_job(workers=4, num_slices=2, chips=4, dcn_mesh=None, name="ms1"):
+    spec = {
+        "jaxReplicaSpecs": {"Worker": {"replicas": workers, "template": {"spec": {
+            "containers": [{
+                "name": "jax", "image": "img",
+                "resources": {"limits": {"google.com/tpu": chips}},
+            }],
+        }}}},
+        "numSlices": num_slices,
+        "mesh": {"fsdp": 2, "tensor": 2},
+    }
+    if dcn_mesh is not None:
+        spec["dcnMesh"] = dcn_mesh
+    return from_dict(JAXJob, {"metadata": {"name": name}, "spec": spec})
+
+
+# ---------------------------------------------------------------------------
+# env injection
+# ---------------------------------------------------------------------------
+
+
+def test_multislice_env_and_labels():
+    ctrl = JAXJobController()
+    job = _multislice_job(workers=4, num_slices=2)
+    store, _ = reconcile_once(ctrl, job)
+    # contiguous worker groups: 0,1 -> slice 0; 2,3 -> slice 1
+    for index, slice_id in [(0, 0), (1, 0), (2, 1), (3, 1)]:
+        env = pod_env(store, f"ms1-worker-{index}")
+        assert env["KUBEDL_NUM_SLICES"] == "2"
+        assert env["KUBEDL_SLICE_ID"] == str(slice_id)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == str(slice_id)
+        # Megascale coordinator is slice-0 worker-0 on the libtpu port
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == (
+            "ms1-worker-0.default.svc:8080"
+        )
+        # the DEFAULT cross-slice layout is data-parallel over DCN
+        assert env["KUBEDL_DCN_MESH"] == "data=2"
+        # the coordination service still spans ALL processes of the job
+        assert env["KUBEDL_NUM_PROCESSES"] == "4"
+        assert env["KUBEDL_PROCESS_ID"] == str(index)
+        pod = store.get("Pod", "default", f"ms1-worker-{index}")
+        assert pod.metadata.labels[LABEL_SLICE_ID] == str(slice_id)
+
+
+def test_multislice_explicit_dcn_mesh():
+    ctrl = JAXJobController()
+    job = _multislice_job(workers=4, num_slices=4, dcn_mesh={"data": 2, "fsdp": 2})
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "ms1-worker-3")
+    assert env["KUBEDL_DCN_MESH"] == "data=2,fsdp=2"
+    assert env["KUBEDL_SLICE_ID"] == "3"
+
+
+def test_single_slice_job_has_no_multislice_env():
+    ctrl = JAXJobController()
+    job = from_dict(JAXJob, {
+        "metadata": {"name": "ms1"},
+        "spec": {"jaxReplicaSpecs": {"Worker": {"replicas": 2, "template": {
+            "spec": {"containers": [container_manifest("jax")]}}}}},
+    })
+    store, _ = reconcile_once(ctrl, job)
+    env = pod_env(store, "ms1-worker-0")
+    assert "KUBEDL_NUM_SLICES" not in env
+    assert "MEGASCALE_COORDINATOR_ADDRESS" not in env
+    pod = store.get("Pod", "default", "ms1-worker-0")
+    assert LABEL_SLICE_ID not in pod.metadata.labels
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+
+def test_validate_num_slices_must_divide_workers():
+    ctrl = JAXJobController()
+    job = _multislice_job(workers=3, num_slices=2)
+    ctrl.set_defaults(job)
+    errs = validate_common(job, ctrl) + ctrl.validate_job(job)
+    assert any("must divide" in e for e in errs)
+
+
+def test_validate_dcn_mesh_product_must_match():
+    ctrl = JAXJobController()
+    job = _multislice_job(workers=4, num_slices=2, dcn_mesh={"data": 4})
+    ctrl.set_defaults(job)
+    errs = ctrl.validate_job(job)
+    assert any("dcnMesh" in e for e in errs)
+
+
+def test_validate_dcn_mesh_requires_multislice():
+    ctrl = JAXJobController()
+    job = _multislice_job(workers=4, num_slices=1, dcn_mesh={"data": 1})
+    ctrl.set_defaults(job)
+    errs = ctrl.validate_job(job)
+    assert any("numSlices > 1" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# gang admission across N slices
+# ---------------------------------------------------------------------------
+
+
+def _gang_pod(job, adm, index: int, slice_id: int, chips=4, name=None):
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=name or f"{job.metadata.name}-worker-{index}",
+            namespace=job.metadata.namespace or "default",
+            labels={
+                LABEL_REPLICA_INDEX: str(index),
+                LABEL_SLICE_ID: str(slice_id),
+            },
+        ),
+        spec=PodSpec(containers=[
+            Container(name="jax", resources=ResourceRequirements(
+                limits={"google.com/tpu": chips}))
+        ]),
+    )
+    adm.bind_pod_to_gang(job, pod)
+    return pod
+
+
+def test_gang_reserves_all_slices_or_none():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-4", "v5e-4", "v5e-4"])
+    job = _multislice_job(workers=4, num_slices=2, chips=2)  # 8 chips / 2 slices
+    state = adm.create_gang(job, job.spec.replica_specs)
+    assert len(state.slice_names) == 2
+    assert len(set(state.slice_names)) == 2
+
+    # a second 2-slice gang sees only one free slice: all-or-nothing
+    job2 = _multislice_job(workers=4, num_slices=2, chips=2, name="ms2")
+    state2 = adm.create_gang(job2, job2.spec.replica_specs)
+    assert state2.slice_names == []
+
+    # freeing the first gang grants BOTH slices to the waiter
+    adm.delete_gang(job)
+    adm._reserve_waiting()
+    assert len(adm.get_gang("default", "ms2").slice_names) == 2
+
+
+def test_pods_place_on_their_slice_with_per_slice_worker_ids():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8", "v5e-8"])
+    job = _multislice_job(workers=4, num_slices=2, chips=4)
+    state = adm.create_gang(job, job.spec.replica_specs)
+    assert len(state.slice_names) == 2
+
+    placements = {}
+    for index, slice_id in [(0, 0), (1, 0), (2, 1), (3, 1)]:
+        p = adm.assign(_gang_pod(job, adm, index, slice_id))
+        assert p is not None
+        placements[index] = p
+    assert placements[0].slice_name == placements[1].slice_name
+    assert placements[2].slice_name == placements[3].slice_name
+    assert placements[0].slice_name != placements[2].slice_name
+    # worker ids restart per slice (GKE TPU_WORKER_ID scoping)
+    assert placements[2].worker_id == placements[0].worker_id
+    assert placements[3].worker_id == placements[1].worker_id
+
+
+def test_pool_shrink_revokes_whole_multislice_gang():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-4", "v5e-4"])
+    job = _multislice_job(workers=4, num_slices=2, chips=2)
+    state = adm.create_gang(job, job.spec.replica_specs)
+    assert len(state.slice_names) == 2
+    survivor = state.slice_names[0]
+
+    # drop the second slice from the pool: the gang loses EVERYTHING
+    infos = [s for s in adm._slices.values() if s.name == survivor]
+    adm.set_pool(infos)
+    state = adm.get_gang("default", "ms1")
+    assert state.slice_names == []
+    # the surviving slice is free again, not leaked
+    assert adm._slices[survivor].reserved_by is None
+
+
+def test_podgroup_mirror_carries_slice_names():
+    store = ObjectStore()
+    adm = TPUSliceAdmitter.with_pool(store, ["v5e-4", "v5e-4"])
+    job = _multislice_job(workers=4, num_slices=2, chips=2)
+    adm.create_gang(job, job.spec.replica_specs)
+    pg = store.get("PodGroup", "default", "ms1")
+    assert pg.spec.num_slices == 2
+    assert pg.status.phase == "Reserved"
+    assert len(pg.status.slice_names) == 2
+    assert pg.status.slice_name == pg.status.slice_names[0]
+
+
+def test_waiting_multislice_gang_is_not_starved():
+    """Head-of-line blocking: freed slices are held for the FIFO-front
+    multislice gang instead of leaking to later single-slice gangs
+    (the no-partial-reservation design would otherwise starve it)."""
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-4", "v5e-4"])
+    holder = _multislice_job(workers=2, num_slices=1, chips=2, name="holder")
+    adm.create_gang(holder, holder.spec.replica_specs)
+
+    big = _multislice_job(workers=4, num_slices=2, chips=2, name="big")
+    gb = adm.create_gang(big, big.spec.replica_specs)
+    assert gb.slice_names == []  # only one slice free
+
+    late = _multislice_job(workers=2, num_slices=1, chips=2, name="late")
+    gl = adm.create_gang(late, late.spec.replica_specs)
+    # the free slice must NOT leapfrog to the later gang
+    assert gl.slice_names == []
+
+    adm.delete_gang(holder)
+    adm._reserve_waiting()
+    assert len(adm.get_gang("default", "big").slice_names) == 2
+    assert adm.get_gang("default", "late").slice_names == []
+
+
+def test_infeasible_gang_does_not_block_the_queue():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-4"])
+    impossible = _multislice_job(workers=4, num_slices=2, chips=2, name="imp")
+    gi = adm.create_gang(impossible, impossible.spec.replica_specs)
+    assert gi.slice_names == []  # pool has one slice, gang needs two
+
+    small = _multislice_job(workers=2, num_slices=1, chips=2, name="small")
+    gs = adm.create_gang(small, small.spec.replica_specs)
+    # the impossible request must not wedge everyone behind it
+    assert len(gs.slice_names) == 1
