@@ -462,13 +462,10 @@ def _tpu_child(results_path: str) -> int:
         lens = [5, 9] if small else [33, 150, 80, 250, 61, 190, 40, 120]
         prompts = [rng.integers(1, config.vocab_size, size=n).astype(np.int32)
                    for n in lens for _ in range(2)]
-        # warm up ONE prompt per distinct bucket so the timed run pays
-        # zero compilation (insert/tick compile on the first of these too)
-        from kubedl_tpu.models.serving import _bucket
-        seen = {}
-        for pr in prompts:
-            seen.setdefault(_bucket(len(pr), eng.prompt_buckets), pr)
-        eng.serve_all(list(seen.values()), max_new_tokens=2)
+        # warm up with the SAME traffic shape so the timed run pays zero
+        # compilation: every prefill bucket AND every fused tick-block
+        # size the admission pattern produces (serving.py step_block)
+        eng.serve_all(prompts, max_new_tokens=new)
         t0 = time.perf_counter()
         eng.serve_all(prompts, max_new_tokens=new)
         dt = time.perf_counter() - t0

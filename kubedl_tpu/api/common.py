@@ -51,7 +51,7 @@ def slice_group(total: int, num_slices: int, index: int):
     """
     num_slices = int(num_slices or 1)
     total = int(total or 0)
-    if num_slices < 2 or total % num_slices:
+    if num_slices < 2 or total <= 0 or total % num_slices:
         return 0, index, max(total, 1)
     per_slice = total // num_slices
     return index // per_slice, index % per_slice, per_slice

@@ -335,28 +335,48 @@ class TPUSliceAdmitter(GangScheduler):
             key=lambda kv: (-kv[1].priority, kv[1].seq),
         )
         granted = []
+        shielded: List[_GangState] = []
         for key, state in waiting:
-            self._try_reserve(key, state)
+            self._try_reserve(key, state, shielded)
             if state.slice_names:
                 granted.append(key)
             elif self._feasible(state):
-                # Head-of-line blocking: a feasible-but-unsatisfied gang
+                # Anti-starvation shield: a feasible-but-unsatisfied gang
                 # (e.g. a multislice gang holding out for N simultaneously
-                # free slices) keeps its place — later gangs must NOT
-                # leapfrog it, or a steady stream of small jobs starves it
-                # forever (it never holds partial reservations, so every
-                # freed slice would otherwise be snatched). Infeasible
-                # gangs (demand exceeds the pool itself) don't block.
-                break
+                # free slices) keeps first claim on every slice matching
+                # its demand — later gangs may only reserve slices OUTSIDE
+                # that set, or a steady stream of small jobs would snatch
+                # each freed slice forever (the gang never holds partial
+                # reservations). Gangs with disjoint demands (different
+                # slice type) still proceed; infeasible gangs (demand
+                # exceeds the pool itself) shield nothing.
+                shielded.append(state)
         return granted
 
     def _feasible(self, state: _GangState) -> bool:
         """Could this gang EVER be satisfied by the current pool (counting
-        busy slices as eventually freeable)? Gates head-of-line blocking so
-        an impossible request doesn't wedge the queue."""
+        busy slices as eventually freeable)? Gates the anti-starvation
+        shield so an impossible request doesn't wedge the queue."""
         return len(self._matching_slices(state, self._slices.values())) >= max(
             state.num_slices, 1
         )
+
+    def _shielded_slices(self, exclude: Optional[List[_GangState]] = None):
+        """Names of free slices held back for earlier waiting gangs."""
+        if not exclude:
+            return set()
+        out = set()
+        for g in exclude:
+            out.update(s.name for s in self._matching_slices(g, self._free_slices()))
+        return out
+
+    def _waiting_shields(self) -> List[_GangState]:
+        """Feasible waiting gangs, as seen by the SOLO-pod path: standalone
+        pods must not snatch slices a queued gang is holding out for."""
+        return [
+            s for s in self._gangs.values()
+            if not s.slice_names and s.tpu_chips > 0 and self._feasible(s)
+        ]
 
     def _matching_slices(self, state: _GangState, pool) -> List[SliceInfo]:
         """Slices that satisfy the gang's PER-SLICE demand (explicit slice
@@ -371,11 +391,20 @@ class TPUSliceAdmitter(GangScheduler):
             ]
         return [s for s in pool if s.type.chips >= per_slice_chips]
 
-    def _try_reserve(self, key: str, state: _GangState) -> None:
+    def _try_reserve(
+        self,
+        key: str,
+        state: _GangState,
+        exclude: Optional[List[_GangState]] = None,
+    ) -> None:
         if state.slice_names or state.tpu_chips <= 0:
             return
         n = max(state.num_slices, 1)
-        candidates = self._matching_slices(state, self._free_slices())
+        shielded = self._shielded_slices(exclude)
+        candidates = [
+            s for s in self._matching_slices(state, self._free_slices())
+            if s.name not in shielded
+        ]
         if len(candidates) < n:
             return  # all-or-nothing across ALL the gang's slices
         # tightest fits first — keep big slices free for big gangs
@@ -390,7 +419,15 @@ class TPUSliceAdmitter(GangScheduler):
             existing = self._solo.get(key)
             if existing:
                 return self._place_on_slice(pod, self._slices[existing])
-            candidates = [s for s in self._free_slices() if s.type.chips >= chips]
+            # gangs outrank solo pods: slices a feasible waiting gang
+            # matches are off limits, or a trickle of standalone pods
+            # would starve a multislice gang exactly like small gangs
+            # would (see _reserve_waiting)
+            shielded = self._shielded_slices(self._waiting_shields())
+            candidates = [
+                s for s in self._free_slices()
+                if s.type.chips >= chips and s.name not in shielded
+            ]
             if not candidates:
                 return None
             best = min(candidates, key=lambda s: s.type.chips)

@@ -57,6 +57,8 @@ class Request:
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
     done: bool = False
+    cache_len: int = 0  # prompt(+prefix) tokens + device ticks consumed
+
     submitted_at: float = field(default_factory=time.monotonic)
     finished_at: Optional[float] = None
 
@@ -122,6 +124,13 @@ class ServingEngine:
         self._prefill = jax.jit(prefill_fn)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
         self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
+        # fused multi-tick block (lax.scan): ONE host<->device sync per K
+        # tokens instead of per token. Over a remote-tunnel chip the
+        # per-tick device_get round trip dominates (~100x the step's
+        # compute for a small model); k is static and power-of-2-bounded
+        # so at most log2(max) variants compile.
+        self._tick_block = jax.jit(
+            self._tick_block_impl, static_argnums=(5,), donate_argnums=(1,))
 
         # prefix caching (shared system prompts): prefix K/V computed once
         # into a uniform batch-1 cache; suffixes append via fixed-size
@@ -183,6 +192,21 @@ class ServingEngine:
         # old position is dead data the next admission overwrites)
         cache["lengths"] = jnp.where(active, cache["lengths"], old_lengths)
         return cache, nxt
+
+    def _tick_block_impl(self, params, cache, cur_tokens, active, key, k):
+        """k ticks chained on-device; returns the [k, slots] token block.
+        Activity can't change mid-block (no admission, no EOS check on the
+        device), so tokens past a request's EOS are generated and trimmed
+        host-side — bounded waste the sync savings dwarf."""
+
+        def body(carry, subkey):
+            cache, cur = carry
+            cache, nxt = self._tick_impl(params, cache, cur, active, subkey)
+            return (cache, nxt), nxt
+
+        (cache, cur), toks = jax.lax.scan(
+            body, (cache, cur_tokens), jax.random.split(key, k))
+        return cache, cur, toks
 
     # -- public API --------------------------------------------------------
 
@@ -273,6 +297,10 @@ class ServingEngine:
         return logits, cache
 
     def _admit(self) -> None:
+        # dispatch the whole admission wave (prefills + inserts are async),
+        # then fetch every first token in ONE device_get — a per-request
+        # sync would pay the host<->device round trip once per admission
+        wave = []  # (slot, first_token_device)
         while self._queue and None in self._slot_req:
             req = self._queue.popleft()
             slot = self._slot_req.index(None)
@@ -303,8 +331,13 @@ class ServingEngine:
                 self.cur_tokens, self.active)
             self._slot_req[slot] = req
             self._admitted += 1
-            # the prefill-sampled token is the request's first emission
-            self._emit(slot, int(jax.device_get(first)))
+            req.cache_len = t
+            wave.append((slot, first))
+        if wave:
+            # the prefill-sampled token is each request's first emission
+            firsts = np.asarray(jax.device_get(jnp.stack([f for _, f in wave])))
+            for (slot, _), tok in zip(wave, firsts):
+                self._emit(slot, int(tok))
 
     def _emit(self, slot: int, token: int) -> None:
         req = self._slot_req[slot]
@@ -358,15 +391,67 @@ class ServingEngine:
         emitted = np.asarray(jax.device_get(nxt))
         for slot, req in enumerate(self._slot_req):
             if req is not None:
+                req.cache_len += 1
                 self._emit(slot, int(emitted[slot]))
         return n_active
+
+    def step_block(self, max_block: int = 32) -> int:
+        """Admit, then advance up to `max_block` ticks with ONE host sync.
+
+        The block size adapts down to (a) the smallest per-request token
+        budget left, so no request overshoots max_new_tokens; (b) the KV
+        headroom of the fullest active slot, so chained writes can't
+        overflow the cache; (c) a small cap while requests are queued
+        (a slot freed mid-block can't admit) or an EOS is possible
+        (post-EOS tokens are wasted compute). Sizes are floored to powers
+        of two so at most log2(max_block) scan variants ever compile.
+        Falls back to step() when the block degenerates to one tick.
+        """
+        self._admit()
+        reqs = [r for r in self._slot_req if r is not None]
+        if not reqs:
+            return 0
+        k = min(r.max_new_tokens - len(r.tokens) for r in reqs)
+        k = min(k, max_block)
+        if any(r.eos_token is not None for r in reqs):
+            k = min(k, 8)  # post-EOS ticks are pure waste; stay short
+        elif self._queue:
+            # a slot freed mid-block can't admit; bound the wait without
+            # giving back the sync savings
+            k = min(k, max(max_block // 2, 8))
+        if k <= 1:
+            return self.step()
+        # round UP to the next power of two and trim the overshoot on the
+        # host: a handful of wasted ticks (<= k-1 small-batch decode steps)
+        # buys whole round-trip syncs (63 needed = 2x32-blocks, not
+        # 32+16+8+4+2+1). The KV headroom of the fullest slot is a hard
+        # ceiling — chained writes must never overflow the cache.
+        k = 1 << max(k - 1, 1).bit_length()
+        if k > max_block:  # round-up must not break the caller's cap
+            k = 1 << (max_block.bit_length() - 1)
+        head = self.max_len - max(r.cache_len for r in reqs)
+        if k > head:
+            k = 1 << (head.bit_length() - 1) if head >= 1 else 0
+        if k <= 1:
+            return self.step()
+        self._key, sub = jax.random.split(self._key)
+        self.cache, self.cur_tokens, toks = self._tick_block(
+            self.params, self.cache, self.cur_tokens, self.active, sub, int(k))
+        self._ticks += k
+        block = np.asarray(jax.device_get(toks))  # [k, slots]
+        for i in range(k):
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    req.cache_len += 1
+                    self._emit(slot, int(block[i, slot]))
+        return len(reqs)
 
     def serve_all(self, prompts, max_new_tokens: int,
                   eos_token: Optional[int] = None) -> List[List[int]]:
         """Submit everything, run to drain, return per-prompt tokens."""
         reqs = [self.submit(p, max_new_tokens, eos_token) for p in prompts]
         while not all(r.done for r in reqs):
-            self.step()
+            self.step_block()
         return [r.tokens for r in reqs]
 
     def stats(self) -> Dict:

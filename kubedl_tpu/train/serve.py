@@ -60,16 +60,23 @@ def parse_args(argv=None):
                    help="int8 KV cache with exact scale folding — half the "
                         "per-token cache read at long contexts")
     p.add_argument("--max-steps", type=int, default=0,
-                   help="stop after N engine ticks (smoke tests); 0 = forever")
+                   help="stop after N pump passes, each up to --decode-block "
+                        "device ticks (smoke tests); 0 = forever")
+    p.add_argument("--decode-block", type=int, default=8,
+                   help="max ticks fused per host sync (serving.py "
+                        "step_block): bigger amortizes dispatch/sync "
+                        "overhead, smaller tightens streaming latency; "
+                        "1 = tick per sync")
     return p.parse_args(argv)
 
 
 class _Service:
     """Engine + queue pump shared by all HTTP handler threads."""
 
-    def __init__(self, engine, tokenizer=None) -> None:
+    def __init__(self, engine, tokenizer=None, decode_block: int = 8) -> None:
         self.engine = engine
         self.tokenizer = tokenizer
+        self.decode_block = max(int(decode_block), 1)
         self._lock = threading.Lock()  # engine calls are single-threaded
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -86,7 +93,12 @@ class _Service:
                 if not self.engine.has_pending():
                     self._work.clear()
                     continue
-                self.engine.step()
+                if self.decode_block > 1:
+                    self.engine.step_block(self.decode_block)
+                else:
+                    self.engine.step()
+                # pump passes, not device ticks: the smoke-mode budget
+                # just needs a monotonic progress counter
                 self.ticks += 1
 
     def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int],
@@ -282,7 +294,7 @@ def main(argv=None) -> int:
         temperature=args.temperature,
         kv_dtype="int8" if args.kv_int8 else None,
     )
-    svc = _Service(engine, tokenizer=tokenizer)
+    svc = _Service(engine, tokenizer=tokenizer, decode_block=args.decode_block)
     httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)
     httpd.daemon_threads = True
     httpd.svc = svc  # type: ignore[attr-defined]

@@ -64,15 +64,20 @@ def main(argv=None) -> int:
         lookup_features,
         table_specs,
     )
-    from kubedl_tpu.parallel.mesh import ENV_MESH, build_mesh, parse_mesh_env
+    from kubedl_tpu.parallel.mesh import (
+        ENV_DCN_MESH,
+        ENV_MESH,
+        build_mesh,
+        build_mesh_from_env,
+    )
 
     devices = jax.devices()
     n = len(devices)
-    if os.environ.get(ENV_MESH):
-        axes = parse_mesh_env()
+    if os.environ.get(ENV_MESH) or os.environ.get(ENV_DCN_MESH):
+        mesh = build_mesh_from_env()  # hybrid ICIxDCN when multislice
     else:
-        axes = {"tensor": n}  # SparseCore layout: whole slice shards the tables
-    mesh = build_mesh(axes)
+        # SparseCore layout: whole slice shards the tables
+        mesh = build_mesh({"tensor": n})
     n_shards = mesh.shape["tensor"]
 
     features = tuple(

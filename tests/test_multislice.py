@@ -256,3 +256,52 @@ def test_infeasible_gang_does_not_block_the_queue():
     gs = adm.create_gang(small, small.spec.replica_specs)
     # the impossible request must not wedge everyone behind it
     assert len(gs.slice_names) == 1
+
+
+def test_disjoint_slice_type_gang_is_not_blocked():
+    """The anti-starvation shield covers only slices matching the blocked
+    gang's demand — a gang wanting a DIFFERENT slice type sails past."""
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5p-8", "v5e-4"])
+    # occupy the only v5p slice
+    from kubedl_tpu.api.common import RunPolicy, SchedulingPolicy
+
+    holder = _multislice_job(workers=2, num_slices=1, chips=2, name="holder")
+    holder.spec.run_policy = RunPolicy(
+        scheduling_policy=SchedulingPolicy(tpu_slice="v5p-8"))
+    adm.create_gang(holder, holder.spec.replica_specs)
+
+    blocked = _multislice_job(workers=2, num_slices=1, chips=2, name="blocked")
+    blocked.spec.run_policy = RunPolicy(
+        scheduling_policy=SchedulingPolicy(tpu_slice="v5p-8"))
+    gb = adm.create_gang(blocked, blocked.spec.replica_specs)
+    assert gb.slice_names == []  # v5p busy; gang waits (feasible -> shields v5p)
+
+    other = _multislice_job(workers=2, num_slices=1, chips=2, name="other")
+    other.spec.run_policy = RunPolicy(
+        scheduling_policy=SchedulingPolicy(tpu_slice="v5e-4"))
+    go = adm.create_gang(other, other.spec.replica_specs)
+    # demands are disjoint: the idle v5e slice must be granted
+    assert len(go.slice_names) == 1
+
+
+def test_solo_pods_cannot_starve_waiting_gang():
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-4", "v5e-4"])
+    holder = _multislice_job(workers=2, num_slices=1, chips=2, name="holder")
+    adm.create_gang(holder, holder.spec.replica_specs)
+    big = _multislice_job(workers=4, num_slices=2, chips=2, name="big")
+    assert adm.create_gang(big, big.spec.replica_specs).slice_names == []
+
+    # a standalone TPU pod (no gang) must NOT grab the free slice the
+    # waiting gang needs
+    solo = Pod(
+        metadata=ObjectMeta(name="solo", namespace="default"),
+        spec=PodSpec(containers=[
+            Container(name="t", resources=ResourceRequirements(
+                limits={"google.com/tpu": 2}))
+        ]),
+    )
+    assert adm.assign(solo) is None
+
+    adm.delete_gang(holder)
+    adm._reserve_waiting()
+    assert len(adm.get_gang("default", "big").slice_names) == 2

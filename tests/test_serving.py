@@ -190,3 +190,56 @@ def test_int8_kv_serving_close_to_fp(model):
                 for a, b in zip(seq_fp, seq_q8))
     total = sum(len(o) for o in out_fp)
     assert agree / total >= 0.8, (out_fp, out_q8)
+
+
+def test_step_block_matches_single_steps(model):
+    """The fused tick block (step_block) must emit EXACTLY what per-tick
+    stepping emits — same cache math, one sync. Mixed budgets exercise
+    the k=min(remaining) bound; the power-of-two round-up overshoot must
+    be trimmed."""
+    params, config = model
+    rng = np.random.default_rng(1)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+        for n in (4, 9, 6)
+    ]
+    budgets = [5, 13, 13]
+
+    eng_a = ServingEngine(params, config, slots=2, max_len=64)
+    reqs_a = [eng_a.submit(p, b) for p, b in zip(prompts, budgets)]
+    while not all(r.done for r in reqs_a):
+        eng_a.step()
+
+    eng_b = ServingEngine(params, config, slots=2, max_len=64)
+    reqs_b = [eng_b.submit(p, b) for p, b in zip(prompts, budgets)]
+    while not all(r.done for r in reqs_b):
+        eng_b.step_block()
+
+    for a, b, budget in zip(reqs_a, reqs_b, budgets):
+        assert len(b.tokens) == budget
+        assert a.tokens == b.tokens
+
+
+def test_step_block_respects_eos(model):
+    params, config = model
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, config.vocab_size, size=5).astype(np.int32)
+    # learn the greedy continuation, then replay with its 3rd token as EOS
+    probe = ServingEngine(params, config, slots=1, max_len=64)
+    base = probe.serve_all([prompt], max_new_tokens=12)[0]
+    eos = base[2]
+
+    eng = ServingEngine(params, config, slots=1, max_len=64)
+    out = eng.serve_all([prompt], max_new_tokens=12, eos_token=eos)[0]
+    assert out == base[:3]  # stops AT the eos token, overshoot trimmed
+
+
+def test_step_block_never_overflows_cache(model):
+    """Round-up blocks must respect KV headroom: budget that would fill
+    the cache exactly still completes (chained writes stop at max_len)."""
+    params, config = model
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, config.vocab_size, size=6).astype(np.int32)
+    eng = ServingEngine(params, config, slots=1, max_len=16, prompt_buckets=[8])
+    out = eng.serve_all([prompt], max_new_tokens=10)[0]
+    assert len(out) == 10
