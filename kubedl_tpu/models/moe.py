@@ -2,15 +2,21 @@
 
 The reference has no expert parallelism (SURVEY.md §2.4: "Expert parallelism
 (EP): absent"); this is the net-new TPU-native path behind the JAXJob mesh
-spec's `expert` axis. Design is the GShard/Switch dense-dispatch recipe —
-the shape XLA pipelines best on TPU — rather than gather/scatter send-recv:
+spec's `expert` axis:
 
   * top-k gating with a fixed per-expert capacity C (static shape — no
     data-dependent shapes under jit);
-  * dispatch/combine are one-hot einsums: `[S,E,C] x [S,d] -> [E,C,d]`.
-    With tokens sharded over data/fsdp and the expert dim sharded over the
-    "expert" mesh axis, the sharding constraint on the `[E,C,d]` buffer
-    makes XLA insert the all-to-all over ICI — no hand-written collective;
+  * routing is GATHER/SCATTER, not GShard's dense one-hot einsums: the
+    `[S,E,C] x [S,d]` dispatch/combine matmuls cost S*E*C*d FLOPs EACH —
+    at bench shapes (S=8k, E=4, C=5.1k, d=1k) that equals the expert FFN
+    compute itself and capped measured MFU at 0.30. Building the slot->
+    token index map once (scatter of S indices) and gathering rows moves
+    O(E*C*d) bytes instead, leaving the MXU to the expert matmuls.
+    Dropped tokens and empty slots route to a zero row via a sentinel
+    index — same static shapes, same Switch drop semantics;
+  * the `[E,C,d]` buffer's sharding constraint still makes XLA insert the
+    token all-to-all over ICI when tokens are data-sharded and experts
+    expert-sharded — no hand-written collective;
   * per-expert FFN is one batched einsum over the expert dim — E local
     matmuls on each expert shard, MXU-shaped;
   * auxiliary load-balance loss (mean-prob x mean-assignment, GShard
@@ -75,17 +81,24 @@ def _top_k_gating(
     gate_logits: jax.Array,  # [S, E] f32
     top_k: int,
     capacity: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (dispatch [S,E,C], combine [S,E,C], aux_loss scalar)."""
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Routing as INDICES instead of one-hot planes.
+
+    Returns (experts [k,S] i32, slots [k,S] i32, weights [k,S] f32,
+    keep [k,S] bool, aux_loss scalar): for each token and each of its k
+    choices, which expert, which capacity slot inside that expert, the
+    renormalized combine weight, and whether the slot fit under capacity.
+    """
     s, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits, axis=-1)
 
     # iterative top-k: pick argmax, mask, repeat (k is tiny and static)
     remaining = probs
-    masks, gates = [], []
+    masks, gates, experts = [], [], []
     for _ in range(top_k):
         idx = jnp.argmax(remaining, axis=-1)
         onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        experts.append(idx.astype(jnp.int32))
         masks.append(onehot)
         gates.append(jnp.sum(probs * onehot, axis=-1))
         remaining = remaining * (1.0 - onehot)
@@ -96,24 +109,27 @@ def _top_k_gating(
     aux_loss = e * jnp.sum(me * ce)
 
     # per-expert slot assignment in token order, k=0 choices first
-    dispatch = jnp.zeros((s, e, capacity), jnp.float32)
-    combine = jnp.zeros((s, e, capacity), jnp.float32)
+    slots, keeps = [], []
     pos_offset = jnp.zeros((e,), jnp.float32)
     for k in range(top_k):
         m = masks[k]
         pos_in_expert = jnp.cumsum(m, axis=0) - m + pos_offset  # [S, E]
         pos_offset = pos_offset + jnp.sum(m, axis=0)
-        keep = m * (pos_in_expert < capacity)
-        slot = jnp.sum(pos_in_expert * m, axis=-1).astype(jnp.int32)  # [S]
-        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [S, C]
-        disp_k = keep[:, :, None] * slot_oh[:, None, :]
-        dispatch = dispatch + disp_k
-        combine = combine + disp_k * gates[k][:, None, None]
+        slot = jnp.sum(pos_in_expert * m, axis=-1)  # [S]
+        slots.append(slot.astype(jnp.int32))
+        keeps.append(slot < capacity)
 
-    # renormalize combine weights over the experts that actually kept the token
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = combine / jnp.maximum(denom, 1e-9)
-    return dispatch, combine, aux_loss
+    weights = jnp.stack(gates) * jnp.stack(keeps)  # [k, S]
+    # renormalize over the choices that actually kept the token
+    weights = weights / jnp.maximum(
+        jnp.sum(weights, axis=0, keepdims=True), 1e-9)
+    return (
+        jnp.stack(experts),
+        jnp.stack(slots),
+        weights,
+        jnp.stack(keeps),
+        aux_loss,
+    )
 
 
 def moe_mlp(
@@ -140,7 +156,7 @@ def moe_mlp(
 
     hf = h.reshape(s, d)
     gate_logits = hf.astype(jnp.float32) @ params["router"]
-    dispatch, combine, aux = _top_k_gating(gate_logits, top_k, c)
+    experts, slots, weights, keeps, aux = _top_k_gating(gate_logits, top_k, c)
 
     def emm(x, w, eq):
         """Batched expert matmul; int8 stacks ({q, s}, models/quant.py)
@@ -150,8 +166,18 @@ def moe_mlp(
                 x.dtype)[:, None, :]
         return jnp.einsum(eq, x, w)
 
-    # tokens -> expert slots: the all-to-all (from the sharding constraint)
-    expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(h.dtype), hf)
+    # tokens -> expert slots, by index: invert (expert, slot) -> token.
+    # Unfilled slots and dropped tokens point at the sentinel row s, a
+    # zero vector — slot uniqueness (cumsum assignment) makes set order
+    # irrelevant; mode="drop" discards the sentinel writes themselves.
+    flat = experts * c + slots  # [k, S] in [0, e*c)
+    flat = jnp.where(keeps, flat, e * c)
+    token_of_slot = jnp.full((e * c,), s, jnp.int32)
+    arange_s = jnp.arange(s, dtype=jnp.int32)
+    for k in range(flat.shape[0]):
+        token_of_slot = token_of_slot.at[flat[k]].set(arange_s, mode="drop")
+    hf_pad = jnp.concatenate([hf, jnp.zeros((1, d), hf.dtype)], axis=0)
+    expert_in = hf_pad[token_of_slot].reshape(e, c, d)
     expert_in = constrain(expert_in, "expert", None, "embed")
     gate = jax.nn.silu(
         emm(expert_in, params["w1"], "ecd,edf->ecf").astype(jnp.float32)
@@ -159,6 +185,10 @@ def moe_mlp(
     up = emm(expert_in, params["w3"], "ecd,edf->ecf")
     out = emm(gate * up, params["w2"], "ecf,efd->ecd")
     out = constrain(out, "expert", None, "embed")
-    # expert slots -> tokens: the reverse all-to-all
-    y = jnp.einsum("sec,ecd->sd", combine.astype(h.dtype), out)
+    # expert slots -> tokens: k weighted gathers (the reverse route)
+    out_pad = jnp.concatenate(
+        [out.reshape(e * c, d), jnp.zeros((1, d), out.dtype)], axis=0)
+    y = jnp.zeros((s, d), h.dtype)
+    for k in range(flat.shape[0]):
+        y = y + weights[k][:, None].astype(h.dtype) * out_pad[flat[k]]
     return y.reshape(b, t, d), aux
