@@ -40,6 +40,7 @@ def init_kv_cache(
     max_len: int,
     uniform: bool = False,
     kv_dtype: Optional[str] = None,
+    ring: bool = False,
 ) -> Dict:
     """Per-layer K/V buffers (model dtype) + write positions.
 
@@ -65,9 +66,30 @@ def init_kv_cache(
     K/V are LISTS of per-layer arrays, not a stacked [n_layers, ...]
     tensor: in the scan token loop each leaf is its own donated carry
     buffer, so the per-step write is in place — a stacked cache forced
-    an unstack/update/restack that recopied cache memory every token."""
+    an unstack/update/restack that recopied cache memory every token.
+
+    ring=True (sliding-window models only): the buffers hold just the
+    WINDOW most recent positions, [b, h, window, d], written at
+    `lengths % window` — O(window) HBM instead of O(max_len), the
+    long-context serving memory win on top of the window-narrowed read.
+    `lengths` still counts TOTAL tokens (it may exceed the buffer), and
+    the dict carries a "ring" marker key so decode paths pick the
+    wrapped-position attention (a pytree-STRUCTURE property: ring and
+    flat caches compile separately, like uniform/ragged). Single-token
+    decode only — block verify would need window+T-1 rows."""
     if kv_dtype not in (None, "int8"):
         raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+    if ring:
+        if not config.sliding_window:
+            raise ValueError("ring=True requires config.sliding_window")
+        if max_len < int(config.sliding_window):
+            # a buffer below the window would wrap away keys the window
+            # mask still expects — silent divergence. A cache this small
+            # doesn't benefit from ring anyway; use a flat cache.
+            raise ValueError(
+                f"ring cache needs max_len >= sliding_window "
+                f"({config.sliding_window}), got {max_len}; drop ring=True")
+        max_len = int(config.sliding_window)
     shape = (batch, config.n_kv_heads, max_len, config.head_dim)
     store_dt = jnp.int8 if kv_dtype == "int8" else config.dtype
     cache = {
@@ -80,7 +102,22 @@ def init_kv_cache(
         sshape = (batch, config.n_kv_heads, max_len)
         cache["ks"] = [jnp.ones(sshape, jnp.bfloat16) for _ in range(config.n_layers)]
         cache["vs"] = [jnp.ones(sshape, jnp.bfloat16) for _ in range(config.n_layers)]
+    if ring:
+        cache["ring"] = jnp.zeros((), jnp.int32)  # structure marker only
     return cache
+
+
+def _ring_positions(total, L):
+    """Absolute position held by each ring slot, given `total` tokens seen.
+
+    Slot j holds the LAST write whose index ≡ j (mod L): that is
+    p(j) = total-1 - ((total-1 - j) mod L); slots never written yet
+    (total < L) come out negative and must be masked. `total` is [b]
+    (or scalar); returns [b, L] (or [L])."""
+    total = jnp.asarray(total)
+    j = jnp.arange(L)
+    last = total[..., None] - 1  # broadcast over slots
+    return last - jnp.mod(last - j, L)
 
 
 def _quantize_kv(x):
@@ -100,7 +137,7 @@ def _quantize_kv(x):
 
 
 def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
-                   window=None):
+                   window=None, ring_total=None):
     """q [b,hq,tq,d] vs cache [b,hkv,L,d]; query t in row i attends cache
     positions < its limit. `limits` is [b] (per-row limit, tq == 1) or
     [b, tq] (per-row per-query — the block verify path, where query t
@@ -120,8 +157,10 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
     With a sliding window, the cache READ is first narrowed to the
     window + tq - 1 rows any query can attend (per-row dynamic slice):
     decode is bandwidth-bound, so at long contexts the per-token cache
-    traffic scales with the WINDOW, not max_len. (The buffers themselves
-    stay O(max_len); a ring-buffer cache is the next step.)"""
+    traffic scales with the WINDOW, not max_len. Ring caches
+    (init_kv_cache(ring=True)) shrink the BUFFERS to O(window) too;
+    `ring_total` then carries the per-row total token count and slot
+    positions are recovered modulo the buffer length."""
     b, hq, tq, d = q.shape
     hkv, L = ck.shape[1], ck.shape[2]
     cd = q.dtype  # compute dtype; int8 codes convert on the operand read
@@ -130,7 +169,15 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
         lim = limits[:, None]  # [b] -> per-row, tq must be 1
     else:
         lim = limits  # [b, tq]
-    if window is not None and L > window + tq - 1:
+    if ring_total is not None:
+        # ring cache: L == window rows hold the latest positions wrapped
+        # at lengths % L; recover each slot's ABSOLUTE position so the
+        # standard window mask applies; never-written slots (negative
+        # position) are masked out
+        totals = jnp.broadcast_to(  # scalar (uniform) or [b] (ragged)
+            jnp.reshape(jnp.asarray(ring_total), (-1,)), (b,))
+        k_pos = _ring_positions(totals, L)
+    elif window is not None and L > window + tq - 1:
         ws = window + tq - 1  # static: covers every query's window
         start = jnp.clip(lim[:, 0] - window, 0, L - ws)  # [b]
 
@@ -161,6 +208,8 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
         # (lim-1-window, lim-1], i.e. k_pos >= lim - window
         attend &= k_pos[:, None, None, None, :] >= (
             lim[:, None, None, :, None] - window)
+    if ring_total is not None:
+        attend &= k_pos[:, None, None, None, :] >= 0  # unwritten ring slots
     s = jnp.where(attend, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if v_scale is not None:
@@ -194,13 +243,16 @@ def decode_step(
         logits, cache = decode_block_step(params, token[:, None], cache, config)
         return logits[:, 0], cache
     max_cap = cache["k"][0].shape[2]
-    if not isinstance(pos, jax.core.Tracer) and int(jnp.max(pos)) + 1 > max_cap:
+    ring = "ring" in cache
+    if (not ring and not isinstance(pos, jax.core.Tracer)
+            and int(jnp.max(pos)) + 1 > max_cap):
         # same guard as decode_block_step: a clamped write offset would
         # silently overwrite the last cache position for the full rows
         raise ValueError(
             f"ragged cache row at {int(jnp.max(pos))} of {max_cap} positions; "
             f"appending 1 more overflows it — init a larger max_len"
         )
+    wpos = jnp.mod(pos, max_cap) if ring else pos  # ring: wrap the write
 
     positions = pos[:, None]  # [b, 1] — per-row RoPE positions
     write_row = jax.vmap(
@@ -229,32 +281,36 @@ def decode_step(
         if int8_kv:
             qk, sk = _quantize_kv(k)
             qv, sv = _quantize_kv(v)
-            ck = write_row(cache["k"][i], qk, pos)
-            cv = write_row(cache["v"][i], qv, pos)
-            cks = write_scale(cache["ks"][i], sk, pos)
-            cvs = write_scale(cache["vs"][i], sv, pos)
+            ck = write_row(cache["k"][i], qk, wpos)
+            cv = write_row(cache["v"][i], qv, wpos)
+            cks = write_scale(cache["ks"][i], sk, wpos)
+            cvs = write_scale(cache["vs"][i], sv, wpos)
             new_ks.append(cks)
             new_vs.append(cvs)
         else:
-            ck = write_row(cache["k"][i], k.astype(c.dtype), pos)
-            cv = write_row(cache["v"][i], v.astype(c.dtype), pos)
+            ck = write_row(cache["k"][i], k.astype(c.dtype), wpos)
+            cv = write_row(cache["v"][i], v.astype(c.dtype), wpos)
         new_k.append(ck)
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
-                              window=c.sliding_window)
+                              window=c.sliding_window,
+                              ring_total=(pos + 1) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
 
-    cache = {
+    out_cache = {
         "k": new_k,
         "v": new_v,
         "lengths": pos + 1,
     }
     if int8_kv:
-        cache["ks"] = new_ks
-        cache["vs"] = new_vs
+        out_cache["ks"] = new_ks
+        out_cache["vs"] = new_vs
+    if ring:
+        out_cache["ring"] = cache["ring"]
+    cache = out_cache
     logits = _lm_head(x, params, c)[:, 0]  # [b, vocab]
     return logits, cache
 
@@ -285,15 +341,22 @@ def decode_block_step(
         raise ValueError("decode_block_step requires a uniform cache "
                          "(init_kv_cache(..., uniform=True))")
     max_cap = cache["k"][0].shape[2]
+    ring = "ring" in cache
+    if ring and T > 1:
+        # a T-block can wrap over its own writes and earlier queries of
+        # the block would need positions the ring already evicted
+        raise ValueError("ring caches support single-token steps only")
     if T > max_cap:
         raise ValueError(f"block of {T} tokens exceeds cache max_len {max_cap}")
-    if not isinstance(pos, jax.core.Tracer) and int(pos) + T > max_cap:
+    if (not ring and not isinstance(pos, jax.core.Tracer)
+            and int(pos) + T > max_cap):
         # appending past capacity would CLAMP the write offset and
         # silently corrupt earlier positions — the multi-turn footgun
         raise ValueError(
             f"cache holds {int(pos)} of {max_cap} positions; appending "
             f"{T} more overflows it — init a larger max_len"
         )
+    wpos = jnp.mod(pos, max_cap) if ring else pos  # ring: wrap the write
     int8_kv = "ks" in cache
     positions = jnp.broadcast_to((pos + jnp.arange(T, dtype=jnp.int32))[None], (b, T))
     limits = positions + 1  # query i sees cache < pos + i + 1
@@ -313,22 +376,23 @@ def decode_block_step(
         if int8_kv:
             qk, sk = _quantize_kv(k)
             qv, sv = _quantize_kv(v)
-            ck = jax.lax.dynamic_update_slice(cache["k"][i], qk, (0, 0, pos, 0))
-            cv = jax.lax.dynamic_update_slice(cache["v"][i], qv, (0, 0, pos, 0))
-            cks = jax.lax.dynamic_update_slice(cache["ks"][i], sk, (0, 0, pos))
-            cvs = jax.lax.dynamic_update_slice(cache["vs"][i], sv, (0, 0, pos))
+            ck = jax.lax.dynamic_update_slice(cache["k"][i], qk, (0, 0, wpos, 0))
+            cv = jax.lax.dynamic_update_slice(cache["v"][i], qv, (0, 0, wpos, 0))
+            cks = jax.lax.dynamic_update_slice(cache["ks"][i], sk, (0, 0, wpos))
+            cvs = jax.lax.dynamic_update_slice(cache["vs"][i], sv, (0, 0, wpos))
             new_ks.append(cks)
             new_vs.append(cvs)
         else:
             ck = jax.lax.dynamic_update_slice(
-                cache["k"][i], k.astype(c.dtype), (0, 0, pos, 0))
+                cache["k"][i], k.astype(c.dtype), (0, 0, wpos, 0))
             cv = jax.lax.dynamic_update_slice(
-                cache["v"][i], v.astype(c.dtype), (0, 0, pos, 0))
+                cache["v"][i], v.astype(c.dtype), (0, 0, wpos, 0))
         new_k.append(ck)
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, limits, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
-                              window=c.sliding_window)
+                              window=c.sliding_window,
+                              ring_total=(pos + T) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)
@@ -337,6 +401,8 @@ def decode_block_step(
     if int8_kv:
         out_cache["ks"] = new_ks
         out_cache["vs"] = new_vs
+    if ring:
+        out_cache["ring"] = cache["ring"]
     if return_hidden:
         # pre-head activations for callers that only head a subset (the
         # chunked prefill heads ONE row after its scan; the full

@@ -77,6 +77,7 @@ class ServingEngine:
         seed: int = 0,
         max_prefixes: int = 8,
         kv_dtype=None,
+        ring: Optional[bool] = None,
     ) -> None:
         self.params = params
         self.config = config
@@ -97,9 +98,18 @@ class ServingEngine:
         self.temperature = temperature
         self._key = jax.random.PRNGKey(seed)
         self.kv_dtype = kv_dtype  # None | "int8" (half the cache HBM/read)
+        # ring cache (sliding-window models): live K/V buffers hold only
+        # the window, [slots, h, W, d] — max_len stays the LOGICAL token
+        # budget per slot, decoupled from buffer HBM. Default: on
+        # whenever the window is smaller than max_len.
+        if ring is None:
+            ring = bool(config.sliding_window) and config.sliding_window < max_len
+        if ring and not config.sliding_window:
+            raise ValueError("ring=True requires config.sliding_window")
+        self.ring = ring
 
         self.cache = decode.init_kv_cache(config, slots, max_len,
-                                          kv_dtype=kv_dtype)
+                                          kv_dtype=kv_dtype, ring=ring)
         self.cur_tokens = jnp.zeros((slots,), jnp.int32)
         self.active = jnp.zeros((slots,), jnp.bool_)
         self._slot_req: List[Optional[Request]] = [None] * slots
@@ -161,17 +171,34 @@ class ServingEngine:
 
     def _insert_impl(self, cache, row_cache, slot, length, first_token,
                      cur_tokens, active):
-        """Splice a prefilled batch-1 cache into `slot` of the live batch."""
+        """Splice a prefilled batch-1 cache into `slot` of the live batch.
+
+        Ring caches: the scratch prefill is full-layout (position p at
+        row p); the live buffer holds only W rows at p % W. The splice
+        GATHERS the last min(t, W) prompt positions into ring order —
+        slot j gets position t-1-((t-1-j) mod W); never-written slots
+        (t < W) gather a clamped row the attention mask ignores."""
         out = {}
+        ring = "ring" in cache
+        if ring:
+            W = cache["k"][0].shape[2]
+            scratch_len = row_cache["k"][0].shape[2]
+            ring_idx = jnp.clip(  # ONE wrap formula, shared with attend
+                decode._ring_positions(length[0], W), 0, scratch_len - 1)
         for name in ("k", "v", "ks", "vs"):
             if name not in cache:
                 continue
+            smalls = row_cache[name]
+            if ring:
+                smalls = [jnp.take(sm, ring_idx, axis=2) for sm in smalls]
             out[name] = [
                 jax.lax.dynamic_update_slice_in_dim(big, small, slot, axis=0)
-                for big, small in zip(cache[name], row_cache[name])
+                for big, small in zip(cache[name], smalls)
             ]
         out["lengths"] = jax.lax.dynamic_update_slice(
             cache["lengths"], length, (slot,))
+        if ring:
+            out["ring"] = cache["ring"]
         cur_tokens = jax.lax.dynamic_update_slice(
             cur_tokens, first_token[None], (slot,))
         active = jax.lax.dynamic_update_slice(
@@ -219,6 +246,11 @@ class ServingEngine:
         Each registered prefix holds a full batch-1 [max_len] K/V buffer
         on device; register a handful, not thousands."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if self.ring:
+            # suffix-append runs block steps, which a ring cache cannot
+            # honor (a block can wrap over its own in-flight positions)
+            raise ValueError("prefix caching is unsupported with ring "
+                             "(sliding-window) caches")
         if tokens.size == 0:
             raise ValueError("empty prefix")
         if tokens.size >= self.max_len:

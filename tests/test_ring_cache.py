@@ -1,0 +1,122 @@
+"""Ring-buffer KV cache (init_kv_cache(ring=True)): O(window) buffers for
+sliding-window models must decode EXACTLY like the O(max_len) full cache
+— the window mask already hides everything outside the window, so wrapping
+the buffer only changes where rows live, never what attention sees.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.models import decode, llama
+from kubedl_tpu.models.serving import ServingEngine
+
+WINDOW = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    # fp32 + greedy: cross-layout parity must be exact, not tie-flippy
+    config = llama.LlamaConfig.tiny(
+        use_flash=False, dtype=jnp.float32, sliding_window=WINDOW)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def test_ring_buffer_is_window_sized(model):
+    _, config = model
+    cache = decode.init_kv_cache(config, 2, 64, ring=True)
+    assert cache["k"][0].shape[2] == WINDOW
+    assert "ring" in cache
+
+
+def test_ring_requires_sliding_window():
+    config = llama.LlamaConfig.tiny(use_flash=False)
+    with pytest.raises(ValueError, match="sliding_window"):
+        decode.init_kv_cache(config, 1, 32, ring=True)
+
+
+def test_ring_positions_formula():
+    # total=3, L=4: slots 0..2 hold positions 0..2, slot 3 unwritten (<0)
+    p = np.asarray(decode._ring_positions(jnp.asarray([3]), 4))[0]
+    assert list(p) == [0, 1, 2, -1]
+    # total=11, L=4: positions 7..10 live at slots 7%4..10%4
+    p = np.asarray(decode._ring_positions(jnp.asarray([11]), 4))[0]
+    assert sorted(p) == [7, 8, 9, 10]
+    for j, pos in enumerate(p):
+        assert pos % 4 == j
+
+
+def test_ring_decode_matches_full_cache_uniform(model):
+    """Token-by-token uniform decode: ring == full, well past the wrap."""
+    params, config = model
+    t, steps = 5, 20  # total 25 tokens >> window 8: several wraps
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(1, config.vocab_size, (1, t)), jnp.int32)
+
+    full = decode.init_kv_cache(config, 1, t + steps, uniform=True)
+    ring = decode.init_kv_cache(config, 1, t + steps, uniform=True, ring=True)
+    logits_f, full = decode.prefill(params, prompt, full, config)
+    # ring prefill: feed the prompt token-at-a-time (block steps cannot
+    # ring); both paths then greedy-decode from the same state
+    for i in range(t):
+        logits_r, ring = decode.decode_step(params, prompt[:, i], ring, config)
+    np.testing.assert_allclose(
+        np.asarray(logits_f), np.asarray(logits_r), rtol=1e-5, atol=1e-5)
+
+    tok_f = jnp.argmax(logits_f, axis=-1).astype(jnp.int32)
+    tok_r = jnp.argmax(logits_r, axis=-1).astype(jnp.int32)
+    outs_f, outs_r = [], []
+    for _ in range(steps):
+        lf, full = decode.decode_step(params, tok_f, full, config)
+        lr, ring = decode.decode_step(params, tok_r, ring, config)
+        tok_f = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+        tok_r = jnp.argmax(lr, axis=-1).astype(jnp.int32)
+        outs_f.append(int(tok_f[0]))
+        outs_r.append(int(tok_r[0]))
+    assert outs_f == outs_r
+
+
+def test_ring_serving_matches_full_cache(model):
+    """The serving engine with ring buffers emits exactly what the
+    full-cache engine emits — ragged slots, mixed lengths, mid-flight
+    admission, generation length several times the window."""
+    params, config = model
+    rng = np.random.default_rng(1)
+    prompts = [
+        rng.integers(1, config.vocab_size, size=n).astype(np.int32)
+        for n in (3, 7, 12, 5)
+    ]
+    new = 24  # 3x the window
+
+    eng_full = ServingEngine(params, config, slots=2, max_len=64, ring=False)
+    out_full = eng_full.serve_all(prompts, max_new_tokens=new)
+
+    eng_ring = ServingEngine(params, config, slots=2, max_len=64)
+    assert eng_ring.ring  # auto-on: window 8 < max_len 64
+    assert eng_ring.cache["k"][0].shape[2] == WINDOW
+    out_ring = eng_ring.serve_all(prompts, max_new_tokens=new)
+
+    assert out_full == out_ring
+
+
+def test_ring_engine_rejects_prefix_caching(model):
+    params, config = model
+    eng = ServingEngine(params, config, slots=2, max_len=64)
+    with pytest.raises(ValueError, match="ring"):
+        eng.register_prefix([1, 2, 3])
+
+
+def test_ring_int8_kv_close_to_fp(model):
+    params, config = model
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(1, config.vocab_size, size=6).astype(np.int32)]
+    fp = ServingEngine(params, config, slots=1, max_len=48)
+    q = ServingEngine(params, config, slots=1, max_len=48, kv_dtype="int8")
+    assert fp.ring and q.ring
+    out_fp = fp.serve_all(prompts, max_new_tokens=16)[0]
+    out_q = q.serve_all(prompts, max_new_tokens=16)[0]
+    # int8 KV rounds; greedy picks should still mostly agree on a tiny net
+    agree = sum(a == b for a, b in zip(out_fp, out_q)) / len(out_fp)
+    assert agree >= 0.5, (out_fp, out_q)
