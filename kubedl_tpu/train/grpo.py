@@ -1,0 +1,313 @@
+"""GRPO post-training workload — prompts in, reward-tuned policy out.
+
+JAXJob-deployable CLI over train/rl.py: reads JSONL prompts, samples G
+completions per prompt from the CURRENT policy with the KV-cache decode
+stack (models/decode.generate — one compiled dispatch per rollout
+batch), scores them with a pluggable reward, and runs the sharded GRPO
+update (mesh from KUBEDL_MESH like the trainer). Checkpoints the FULL
+policy TrainState so generate/serve restore it with the ordinary
+--checkpoint-path.
+
+Data format — one JSON object per line:
+
+    {"prompt": [ids...]}
+
+Rewards (pick one):
+  --reward token-match   fraction of completion tokens == --reward-token
+                         (trivially learnable; smoke/CI default)
+  --reward length        -|gen_len - --target-len| / max-new-tokens,
+                         gen_len = tokens before the first --eos-id
+  --reward-module m:fn   import m, call fn(prompt_ids, completion_ids)
+                         -> float per completion (real use: verifiers,
+                         reward models)
+
+The frozen KL reference is the STARTING policy (base weights from
+--hf-model / --ref-checkpoint-path / fresh init), as in DPO.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-grpo")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face base weights (policy AND reference init)")
+    p.add_argument("--ref-checkpoint-path", default="",
+                   help="trainer Orbax dir for the base weights (else fresh "
+                        "init / --hf-model)")
+    p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
+                   help="JSONL prompts; synthetic prompts when empty")
+    p.add_argument("--steps", type=int,
+                   default=int(os.environ.get("KUBEDL_STEPS", 50)),
+                   help="rollout->update iterations")
+    p.add_argument("--prompts-per-step", type=int, default=4)
+    p.add_argument("--group-size", type=int, default=8,
+                   help="G completions sampled per prompt")
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--inner-epochs", type=int, default=1,
+                   help="updates per rollout batch (ratio clipping only "
+                        "bites past the first)")
+    p.add_argument("--lr", type=float, default=1e-6)
+    p.add_argument("--clip-eps", type=float, default=0.2)
+    p.add_argument("--kl-coef", type=float, default=0.04)
+    p.add_argument("--grad-clip", type=float, default=1.0)
+    p.add_argument("--accum-steps", type=int, default=1)
+    p.add_argument("--reward", default="token-match",
+                   choices=["token-match", "length"])
+    p.add_argument("--reward-token", type=int, default=5)
+    p.add_argument("--target-len", type=int, default=16)
+    p.add_argument("--eos-id", type=int, default=-1,
+                   help=">=0: completions end at the first occurrence "
+                        "(trims seq_lens and the length reward)")
+    p.add_argument("--reward-module", default="",
+                   help="'module.path:fn' overriding --reward")
+    p.add_argument("--log-every", type=int, default=5)
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--checkpoint-interval", type=int, default=50)
+    p.add_argument("--allow-fresh-init", action="store_true",
+                   help="train from random base weights when no "
+                        "--hf-model/--ref-checkpoint-path weights exist")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.reward == "length" and not args.reward_module and args.eos_id < 0:
+        p.error("--reward length needs --eos-id: without a stop token "
+                "every completion is exactly --max-new-tokens long, every "
+                "group's reward is constant, and training is a no-op")
+    if args.temperature <= 0:
+        p.error("--temperature must be > 0: greedy rollouts make all G "
+                "samples of a group identical, which zeroes every "
+                "group-normalized advantage")
+    return args
+
+
+def load_prompts(path: str, limit_len: int):
+    """JSONL -> list of id-lists; prompts longer than limit_len are
+    skipped with a count."""
+    prompts, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ids = json.loads(line)["prompt"]
+            if not ids or len(ids) > limit_len:
+                skipped += 1
+                continue
+            prompts.append([int(t) for t in ids])
+    if skipped:
+        print(f"data: skipped {skipped} prompts over {limit_len} tokens",
+              flush=True)
+    if not prompts:
+        raise ValueError(f"no usable prompts in {path}")
+    return prompts
+
+
+def make_reward_fn(args):
+    """(prompt_ids, completion_ids) -> float. completion_ids is already
+    EOS-trimmed when --eos-id is set."""
+    if args.reward_module:
+        mod_name, _, fn_name = args.reward_module.partition(":")
+        fn = getattr(importlib.import_module(mod_name), fn_name or "reward")
+        return fn
+    if args.reward == "token-match":
+        tok = args.reward_token
+
+        def token_match(prompt_ids, completion_ids):
+            if not completion_ids:
+                return 0.0
+            return sum(1 for t in completion_ids if t == tok) / len(completion_ids)
+
+        return token_match
+
+    def length_reward(prompt_ids, completion_ids):
+        return -abs(len(completion_ids) - args.target_len) / max(
+            args.max_new_tokens, 1)
+
+    return length_reward
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubedl_tpu.models import decode, llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
+    from kubedl_tpu.train.rl import group_advantages, make_grpo_step
+
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        base, config = load_hf(args.hf_model)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+        from kubedl_tpu.train.generate import restore_or_init
+
+        base = restore_or_init(
+            config, args.ref_checkpoint_path,
+            allow_fresh_init=(args.allow_fresh_init
+                              or not args.ref_checkpoint_path),
+            seed=args.seed, label="base")
+        if base is None:
+            return 1
+    mesh = build_mesh_from_env()
+    rules = ShardingRules()
+    print(f"mesh: {dict(mesh.shape)} model={args.hf_model or args.model} "
+          f"G={args.group_size} kl={args.kl_coef}", flush=True)
+
+    tx = optax.adamw(args.lr, weight_decay=0.0)
+    if args.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
+    # one update per rollout (the default) is strictly on-policy: the
+    # loss substitutes stop_gradient of its own forward for old_lp and
+    # the dedicated sampling-time logprob pass is skipped entirely
+    use_old = args.inner_epochs > 1
+    init_state, lp_fn, ref_fn, step = make_grpo_step(
+        base, config, tx, mesh, rules=rules, clip_eps=args.clip_eps,
+        kl_coef=args.kl_coef, accum_steps=args.accum_steps,
+        use_old_logprobs=use_old,
+    )
+    state = init_state(jax.tree.map(jnp.asarray, base))
+    del base
+
+    rng = np.random.default_rng(args.seed)
+    max_prompt = config.max_seq_len - args.max_new_tokens
+    if args.data_path:
+        prompts = load_prompts(args.data_path, max_prompt)
+        print(f"data: {len(prompts)} prompts from {args.data_path}", flush=True)
+    else:
+        n = max(args.prompts_per_step * 4, 16)
+        plen = min(16, max_prompt)
+        prompts = [list(rng.integers(1, config.vocab_size, plen))
+                   for _ in range(n)]
+        print(f"data: {n} synthetic prompts (no --data-path)", flush=True)
+
+    reward_fn = make_reward_fn(args)
+    uniform = len({len(p) for p in prompts}) == 1
+    pad_to = max(len(p) for p in prompts)
+    K = args.max_new_tokens
+    temp = args.temperature  # parse_args rejects <= 0 (group collapse)
+
+    @jax.jit
+    def rollout_uniform(p, toks, key):
+        return decode.generate(p, toks, config, K, temperature=temp, key=key)
+
+    @jax.jit
+    def rollout_ragged(p, toks, lengths, key):
+        return decode.generate(p, toks, config, K, temperature=temp,
+                               key=key, lengths=lengths)
+
+    mngr = None
+    start_step = 0
+    if args.checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        mngr = ocp.CheckpointManager(
+            args.checkpoint_path,
+            options=ocp.CheckpointManagerOptions(max_to_keep=2, create=True),
+        )
+        latest = mngr.latest_step()
+        if latest is not None:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+            state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+            start_step = latest
+            print(f"restored policy checkpoint at step {start_step}", flush=True)
+
+    import time
+
+    B, G = args.prompts_per_step, args.group_size
+    t0 = time.time()
+    base_key = jax.random.PRNGKey(args.seed)
+    for it in range(start_step + 1, args.steps + 1):
+        # -- rollout: B prompts x G samples, one compiled dispatch.
+        # Prompt picks and sampling keys are derived from the STEP
+        # index, so preemption resume at `latest` continues the data/
+        # noise schedule instead of replaying it from step 1 ------------
+        it_rng = np.random.default_rng((args.seed, it))
+        pick = it_rng.choice(len(prompts), size=B, replace=len(prompts) < B)
+        batch_prompts = [prompts[i] for i in pick]
+        plens = np.array([len(p) for p in batch_prompts], np.int32)
+        toks = np.zeros((B, pad_to), np.int32)
+        for i, p in enumerate(batch_prompts):
+            toks[i, :len(p)] = p
+        tiled = np.repeat(toks, G, axis=0)          # [B*G, pad_to]
+        tiled_plens = np.repeat(plens, G)           # [B*G]
+        sub = jax.random.fold_in(base_key, it)
+        if uniform:
+            comp = rollout_uniform(state.params, jnp.asarray(tiled), sub)
+        else:
+            comp = rollout_ragged(state.params, jnp.asarray(tiled),
+                                  jnp.asarray(tiled_plens), sub)
+        comp = np.asarray(comp)                     # [B*G, K]
+
+        # -- rewards + group-normalized advantages (host) -----------------
+        n = B * G
+        full = np.zeros((n, pad_to + K), np.int32)
+        seq_lens = np.zeros(n, np.int32)
+        rewards = np.zeros(n, np.float32)
+        for i in range(n):
+            pl = tiled_plens[i]
+            c = comp[i]
+            if args.eos_id >= 0:
+                hits = np.nonzero(c == args.eos_id)[0]
+                # reward sees the text BEFORE the stop token; training
+                # keeps the stop token itself, so emitting EOS is an
+                # action the policy gradient can credit (a length
+                # reward is unlearnable otherwise)
+                gen = c[: hits[0]] if len(hits) else c
+                train_c = c[: hits[0] + 1] if len(hits) else c
+            else:
+                gen = train_c = c
+            full[i, :pl] = tiled[i, :pl]
+            full[i, pl:pl + len(train_c)] = train_c
+            seq_lens[i] = pl + len(train_c)
+            rewards[i] = reward_fn(list(tiled[i, :pl]), list(gen))
+        adv = np.asarray(
+            group_advantages(rewards.reshape(B, G))).reshape(n)
+
+        # -- ref (+ old, when off-policy) logprobs, then the update(s) ----
+        lp_batch = (jnp.asarray(full), jnp.asarray(tiled_plens),
+                    jnp.asarray(seq_lens))
+        ref_lp = ref_fn(lp_batch)
+        if use_old:
+            old_lp, _ = lp_fn(state.params, lp_batch)
+            train_batch = (*lp_batch, jnp.asarray(adv), old_lp, ref_lp)
+        else:
+            train_batch = (*lp_batch, jnp.asarray(adv), ref_lp)
+        for _ in range(args.inner_epochs):
+            state, metrics = step(state, train_batch)
+
+        if it % args.log_every == 0 or it == args.steps:
+            m = {k: float(v) for k, v in metrics.items()}
+            print(f"step {it}: reward={rewards.mean():.3f}"
+                  f"+-{rewards.std():.3f} loss={m['loss']:.4f} "
+                  f"kl={m['kl']:.4f} clip={m['clip_frac']:.2f}", flush=True)
+        if mngr is not None and (it % args.checkpoint_interval == 0
+                                 or it == args.steps):
+            mngr.save(it, args=ocp.args.StandardSave(state))
+    if mngr is not None:
+        mngr.wait_until_finished()
+        print(f"saved policy checkpoint at step {args.steps}", flush=True)
+    print(f"done: {args.steps - start_step} steps in "
+          f"{time.time() - t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

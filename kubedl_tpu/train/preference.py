@@ -106,9 +106,12 @@ def sequence_logprobs(
     mesh=None,
     rules=None,
     with_aux: bool = False,
+    per_token: bool = False,
 ):
     """Sum log p(token_i | <i) over continuation positions — [n] f32
-    (+ the MoE aux loss when with_aux)."""
+    (+ the MoE aux loss when with_aux). per_token=True skips the sum and
+    returns ([n, T-1] logprobs, [n, T-1] f32 continuation mask) instead —
+    the shape GRPO's per-token importance ratios need (train/rl.py)."""
     rules_ = rules
     x, aux = llama._backbone(params, tokens, config, mesh, rules_ or
                              llama.ShardingRules())
@@ -126,7 +129,10 @@ def sequence_logprobs(
     # target token at position i+1 belongs to the continuation iff
     # i+1 >= prompt_len and i+1 < seq_len
     mask = (pos + 1 >= prompt_lens[:, None]) & (pos + 1 < seq_lens[:, None])
-    out = jnp.sum(pred * mask, axis=-1)
+    if per_token:
+        out = (pred, mask.astype(jnp.float32))
+    else:
+        out = jnp.sum(pred * mask, axis=-1)
     return (out, aux) if with_aux else out
 
 

@@ -1,0 +1,191 @@
+"""GRPO — critic-free RL post-training on the same sharded machinery.
+
+Group Relative Policy Optimization (Shao et al., 2024, DeepSeekMath):
+sample G completions per prompt from the current policy, score each with
+a scalar reward, and use the group-normalized reward as the advantage
+for every token of that completion:
+
+    A_i = (r_i - mean_G(r)) / (std_G(r) + eps)
+
+No value network — the group mean IS the baseline, which is what makes
+GRPO a natural fit for the decode stack: rollouts are ordinary
+models/decode.generate calls, and the update is one more loss over the
+Llama backbone. The update is the PPO clipped surrogate over per-token
+importance ratios plus an explicit per-token KL penalty to the frozen
+reference policy (the k3 estimator — unbiased, always >= 0):
+
+    rho_t  = exp(logp_t - logp_old_t)
+    L_pg   = -mean_t[ min(rho_t A, clip(rho_t, 1-eps, 1+eps) A) ]
+    KL_t   = exp(ref_t - logp_t) - (ref_t - logp_t) - 1
+    L      = L_pg + kl_coef * mean_t[KL_t]   (+ MoE router aux term)
+
+Built like train/preference.py (DPO): pure loss over the Llama
+backbone, sharded through parallel/train_step.make_train_step so
+dp/fsdp/tp meshes and gradient accumulation apply unchanged. The frozen
+reference and the sampling-time ("old") policy never enter the
+differentiated graph: both sets of per-token logprobs are computed once
+per rollout batch by a shared jitted forward and passed into the step
+as batch data. The reference tree is sharded and passed as a jit
+argument (a closure would bake a replicated copy into the executable)
+— same OOM-avoidance rule as DPO.
+
+The reference operator has no RL (or any training) code — this extends
+the post-training family (trainer SFT/LoRA, DPO) that rides the same
+JAXJob deployment surface (ref parity anchor: the workload-program slot
+launched by `/root/reference/controllers/` pods; see docs/tutorial).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.train.preference import sequence_logprobs
+
+
+def group_advantages(rewards: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """[b, G] rewards -> [b, G] group-normalized advantages.
+
+    Each prompt's G samples are normalized against their own mean/std;
+    a constant group (std 0 — e.g. reward saturated) gets zero
+    advantage rather than an eps-amplified noise direction."""
+    mean = jnp.mean(rewards, axis=-1, keepdims=True)
+    std = jnp.std(rewards, axis=-1, keepdims=True)
+    return (rewards - mean) / (std + eps)
+
+
+def grpo_loss(
+    params: Dict,
+    tokens: jax.Array,       # [n, T] int32 — prompt + completion, padded
+    prompt_lens: jax.Array,  # [n] — completion starts here
+    seq_lens: jax.Array,     # [n] — true length incl. prompt
+    advantages: jax.Array,   # [n] f32 — one group-normalized value per seq
+    old_logprobs,            # [n, T-1] policy at sampling time, or None
+    ref_logprobs: jax.Array,  # [n, T-1] — frozen reference
+    config: llama.LlamaConfig,
+    clip_eps: float = 0.2,
+    kl_coef: float = 0.04,
+    mesh=None,
+    rules=None,
+):
+    """(scalar loss, metrics). Token-mean over completion positions
+    (sequence advantage broadcast to its tokens, the GRPO convention).
+
+    old_logprobs=None means strictly on-policy (one update per rollout):
+    the sampling-time logprobs ARE the current ones, so instead of a
+    separate forward the loss uses stop_gradient(lp) — ratio is exactly
+    1 by construction and the surrogate reduces to vanilla REINFORCE
+    with the group baseline, one full forward pass cheaper per step."""
+    (lp, mask), aux = sequence_logprobs(
+        params, tokens, prompt_lens, seq_lens, config,
+        mesh=mesh, rules=rules, with_aux=True, per_token=True,
+    )
+    if old_logprobs is None:
+        old_logprobs = jax.lax.stop_gradient(lp)
+    n_tok = jnp.maximum(jnp.sum(mask), 1.0)
+    adv = advantages[:, None]  # broadcast over tokens
+    ratio = jnp.exp(lp - old_logprobs)
+    clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+    surrogate = jnp.minimum(ratio * adv, clipped * adv)
+    pg_loss = -jnp.sum(surrogate * mask) / n_tok
+    # k3 KL estimator vs the frozen reference (Schulman): unbiased,
+    # non-negative, low-variance near ref — the standard GRPO penalty
+    delta = ref_logprobs - lp
+    kl = jnp.sum((jnp.exp(delta) - delta - 1.0) * mask) / n_tok
+    loss = pg_loss + kl_coef * kl
+    if config.n_experts > 0:
+        loss = loss + config.moe_aux_coef * aux
+    metrics = {
+        "pg_loss": pg_loss,
+        "kl": kl,
+        "ratio_mean": jnp.sum(ratio * mask) / n_tok,
+        "clip_frac": jnp.sum(
+            ((ratio < 1.0 - clip_eps) | (ratio > 1.0 + clip_eps)) * mask
+        ) / n_tok,
+        "completion_logprob": jnp.sum(lp * mask) / n_tok,
+    }
+    return loss, metrics
+
+
+def make_grpo_step(
+    ref_params: Dict,
+    config: llama.LlamaConfig,
+    tx,
+    mesh,
+    rules=None,
+    clip_eps: float = 0.2,
+    kl_coef: float = 0.04,
+    param_spec_tree=None,
+    accum_steps: int = 1,
+    use_old_logprobs: bool = True,
+):
+    """(init_state, logprob_fn, ref_logprob_fn, grpo_step) over the mesh.
+
+    `logprob_fn(params, batch) -> ([n, T-1] lp, mask)` is the shared
+    jitted forward for sampling-time ("old") logprobs — call it with
+    `state.params` right after rollout, BEFORE any update of this
+    batch's inner epochs. `ref_logprob_fn(batch)` runs the frozen
+    sharded reference through the same executable. `grpo_step(state,
+    (tokens, prompt_lens, seq_lens, advantages, old_lp, ref_lp))` is
+    the donated sharded update.
+
+    use_old_logprobs=False (strictly on-policy, one update per rollout)
+    drops old_lp from the step's batch tuple — grpo_loss substitutes
+    stop_gradient of the current forward, saving the dedicated
+    sampling-time logprob pass entirely."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubedl_tpu.parallel.mesh import ShardingRules
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    rules = rules or ShardingRules()
+    if param_spec_tree is None:
+        param_spec_tree = llama.param_specs(config, rules)
+    param_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    ref_sharded = jax.device_put(ref_params, param_sharding)
+
+    @jax.jit
+    def _lp_fn(p, batch):
+        tokens, prompt_lens, seq_lens = batch
+        (lp, mask), _ = sequence_logprobs(
+            p, tokens, prompt_lens, seq_lens, config,
+            mesh=mesh, rules=rules, with_aux=True, per_token=True,
+        )
+        return lp, mask
+
+    def logprob_fn(p, batch):
+        return _lp_fn(p, batch)
+
+    def ref_logprob_fn(batch):
+        return _lp_fn(ref_sharded, batch)[0]
+
+    def loss_fn(params, batch):
+        if use_old_logprobs:
+            tokens, prompt_lens, seq_lens, advantages, old_lp, ref_lp = batch
+        else:
+            tokens, prompt_lens, seq_lens, advantages, ref_lp = batch
+            old_lp = None
+        return grpo_loss(
+            params, tokens, prompt_lens, seq_lens, advantages, old_lp,
+            ref_lp, config, clip_eps=clip_eps, kl_coef=kl_coef,
+            mesh=mesh, rules=rules,
+        )
+
+    batch_spec = (
+        rules.spec("batch", None),  # tokens [n, T]
+        rules.spec("batch"),        # prompt_lens [n]
+        rules.spec("batch"),        # seq_lens [n]
+        rules.spec("batch"),        # advantages [n]
+        *([rules.spec("batch", None)] if use_old_logprobs else []),
+        rules.spec("batch", None),  # ref logprobs [n, T-1]
+    )
+    init_state, grpo_step = make_train_step(
+        loss_fn, tx, mesh, param_spec_tree, batch_spec, rules,
+        accum_steps=accum_steps, has_aux=True,
+    )
+    return init_state, logprob_fn, ref_logprob_fn, grpo_step
