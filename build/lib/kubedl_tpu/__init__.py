@@ -1,0 +1,33 @@
+"""kubedl_tpu — a TPU-native distributed-training job orchestration framework.
+
+A brand-new framework with the capability surface of KubeDL
+(reference: /root/reference, surveyed in SURVEY.md): a single operator
+reconciles TFJob / PyTorchJob / XGBoostJob / XDLJob — plus a first-class
+JAXJob — into gang-admitted, TPU-slice-placed workloads, replacing
+per-framework rendezvous (TF_CONFIG, NCCL MASTER_ADDR, ZooKeeper) with a
+single JAX/XLA coordination-service topology over ICI/DCN.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1, re-designed TPU-first):
+  api/          common job vocabulary + workload CRD types   (ref: pkg/job_controller/api/v1, api/*)
+  core/         object store, watch, informers, workqueues   (ref: k8s apimachinery / controller-runtime)
+  controllers/  shared reconciler engine + workload plugins  (ref: pkg/job_controller, controllers/*)
+  executor/     pod runtime (local processes) + TPU topology (net-new; kubelet-equivalent)
+  gang/         all-or-nothing TPU-slice admission           (ref: pkg/gang_schedule)
+  metrics/      job metrics, event-driven gauges             (ref: pkg/metrics)
+  codesync/     git code-sync injection                      (ref: pkg/code_sync)
+  storage/      job/pod/event history backends               (ref: pkg/storage)
+  k8s/          apiserver store, informer cache, Lease      (ref: client-go/controller-runtime)
+                election, GKE placement, node inventory,
+                admission webhooks, fake apiserver
+  models/       Llama/Mistral/Gemma + MoE/ViT/embeddings,    (net-new TPU compute path)
+                KV-cache decode, serving engine, LoRA,
+                int8 quant, HF importer
+  ops/          Pallas flash attention (+sliding window),    (net-new TPU compute path)
+                ring + Ulysses context parallelism
+  parallel/     mesh, shardings, SPMD train step, GPipe      (net-new TPU compute path)
+  train/        coordinator bootstrap, trainer, DPO, serve,  (net-new TPU compute path)
+                generate, checkpoints
+  utils/        serde, exit codes, logging
+"""
+
+__version__ = "0.1.0"

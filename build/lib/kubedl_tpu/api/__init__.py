@@ -1,0 +1,1 @@
+from kubedl_tpu.api import common, meta, pod  # noqa: F401

@@ -1,0 +1,329 @@
+"""Common job API ("common v1") — the shared vocabulary for every workload.
+
+Re-derives the reference's pkg/job_controller/api/v1/types.go:23-191
+(JobStatus/ReplicaSpec/RunPolicy/conditions) and the condition machine of
+pkg/util/status.go:50-137, whose invariants are behavioral API:
+  * Failed is sticky — once JobFailed is set no condition may change,
+  * Running and Restarting are mutually exclusive,
+  * Running flips to False (not removed) when a terminal condition lands.
+
+TPU-native extensions over the reference:
+  * RunPolicy.success_policy promotes XDL's min-finish-workers semantics
+    (ref api/xdl/v1alpha1/types.go:38-49) to the common layer,
+  * SchedulingPolicy gains TPU slice topology fields so gang admission can be
+    all-or-nothing per slice (ref SchedulingPolicy.MinAvailable at
+    types.go:189-191 existed but was never plumbed — we plumb it).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.meta import now
+from kubedl_tpu.api.pod import PodPhase, PodTemplateSpec
+
+# ---------------------------------------------------------------------------
+# Labels / annotations (ref pkg/job_controller/api/v1/constants.go:3-33)
+# ---------------------------------------------------------------------------
+
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_JOB_ROLE = "job-role"
+
+# Multislice: which TPU slice of a multi-slice gang a pod belongs to
+# (workloads/jaxjob.py stamps it; the slice admitter places by it).
+LABEL_SLICE_ID = "kubedl-tpu.io/slice-id"
+
+
+def slice_group(total: int, num_slices: int, index: int):
+    """THE multislice grouping convention, in one place: `total` workers
+    divide into `num_slices` contiguous index groups. Returns
+    (slice_id, in_slice_index, per_slice). Everything that reasons about
+    slice membership — env injection (workloads/jaxjob.py), GKE worker
+    identity (k8s/gke.py), gang placement (gang/slice_admitter.py) — must
+    go through this so the three can never drift apart.
+
+    Degenerate inputs (num_slices < 2, or total not divisible) collapse to
+    single-slice semantics: everything in slice 0, index unchanged.
+    """
+    num_slices = int(num_slices or 1)
+    total = int(total or 0)
+    if num_slices < 2 or total <= 0 or total % num_slices:
+        return 0, index, max(total, 1)
+    per_slice = total // num_slices
+    return index // per_slice, index % per_slice, per_slice
+
+ANNOTATION_GIT_SYNC_CONFIG = "kubedl.io/git-sync-config"
+ANNOTATION_TENANCY = "kubedl.io/tenancy"
+
+JOB_ROLE_MASTER = "master"
+
+GROUP_NAME = "kubedl-tpu.io"
+
+# ---------------------------------------------------------------------------
+# Enums
+# ---------------------------------------------------------------------------
+
+
+class ReplicaType(str, enum.Enum):
+    # The union of replica types across workloads; each workload declares the
+    # subset it supports (ref: per-workload types.go files).
+    MASTER = "Master"
+    WORKER = "Worker"
+    CHIEF = "Chief"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+    SCHEDULER = "Scheduler"
+    EXTEND_ROLE = "ExtendRole"
+    COORDINATOR = "Coordinator"  # JAXJob (net-new)
+
+
+class JobConditionType(str, enum.Enum):
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class ConditionStatus(str, enum.Enum):
+    TRUE = "True"
+    FALSE = "False"
+    UNKNOWN = "Unknown"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    UNDEFINED = ""
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class RestartPolicy(str, enum.Enum):
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    # ExitCode: 1-127 permanent, retryable set per utils/exit_codes.py
+    # (ref pkg/job_controller/api/v1/types.go:150-156).
+    EXIT_CODE = "ExitCode"
+
+
+# Condition reasons (ref pkg/util/status.go:10-19).
+REASON_JOB_CREATED = "JobCreated"
+REASON_JOB_RUNNING = "JobRunning"
+REASON_JOB_RESTARTING = "JobRestarting"
+REASON_JOB_SUCCEEDED = "JobSucceeded"
+REASON_JOB_FAILED = "JobFailed"
+
+
+# ---------------------------------------------------------------------------
+# Spec types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicaSpec:
+    """Ref pkg/job_controller/api/v1/types.go:65-79."""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: Optional[RestartPolicy] = None
+
+
+@dataclass
+class SuccessPolicy:
+    """XDL's min-finish success policy promoted to the common layer.
+
+    Ref api/xdl/v1alpha1/types.go:38-49 + controllers/xdl/status.go
+    calculateMinFinish: percentage takes precedence over the absolute
+    number when both are set; percentage uses ceil. We additionally clamp
+    the absolute number to the worker count (the reference lets an
+    over-large MinFinishWorkerNum make the job unfinishable).
+    """
+
+    min_finish_worker_num: Optional[int] = None
+    min_finish_worker_percentage: Optional[int] = None
+
+    def min_finish(self, total_workers: int) -> int:
+        if self.min_finish_worker_percentage is not None:
+            pct = min(max(self.min_finish_worker_percentage, 0), 100)
+            return -(-total_workers * pct // 100)  # ceil division
+        if self.min_finish_worker_num is not None:
+            return min(self.min_finish_worker_num, total_workers)
+        return total_workers
+
+
+@dataclass
+class SchedulingPolicy:
+    """Ref types.go:189-191 + TPU-native slice fields (net-new)."""
+
+    min_available: Optional[int] = None
+    # TPU slice requested for the whole gang, e.g. "v5e-8", "v5p-32".
+    tpu_slice: str = ""
+    # Physical topology request, e.g. "2x4" / "4x4x4".
+    tpu_topology: str = ""
+    # Admission priority: higher wins a freed slice; ties go FIFO by gang
+    # creation (net-new — the reference delegates ordering to kube-batch).
+    priority: int = 0
+
+
+@dataclass
+class RunPolicy:
+    """Ref types.go:162-185."""
+
+    clean_pod_policy: Optional[CleanPodPolicy] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+    success_policy: Optional[SuccessPolicy] = None
+
+
+# ---------------------------------------------------------------------------
+# Status types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReplicaStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobCondition:
+    type: JobConditionType = JobConditionType.CREATED
+    status: ConditionStatus = ConditionStatus.TRUE
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[float] = None
+    last_transition_time: Optional[float] = None
+
+
+@dataclass
+class JobStatus:
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+
+
+# ---------------------------------------------------------------------------
+# Condition machine (ref pkg/util/status.go:25-137)
+# ---------------------------------------------------------------------------
+
+
+def get_condition(status: JobStatus, ctype: JobConditionType) -> Optional[JobCondition]:
+    for c in status.conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def has_condition(status: JobStatus, ctype: JobConditionType) -> bool:
+    c = get_condition(status, ctype)
+    return c is not None and c.status == ConditionStatus.TRUE
+
+
+def is_created(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.CREATED)
+
+
+def is_running(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.RUNNING)
+
+
+def is_restarting(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.RESTARTING)
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def update_job_conditions(
+    status: JobStatus, ctype: JobConditionType, reason: str, message: str
+) -> None:
+    """Set condition `ctype` True, preserving the reference's invariants.
+
+    Ref pkg/util/status.go:88-137 — Failed sticky; no-op when status+reason
+    unchanged; transition time preserved when only reason/message change;
+    Running<->Restarting mutual exclusion; Running demoted to False on
+    terminal conditions.
+    """
+    if is_failed(status):
+        return
+
+    ts = now()
+    cond = JobCondition(
+        type=ctype,
+        status=ConditionStatus.TRUE,
+        reason=reason,
+        message=message,
+        last_update_time=ts,
+        last_transition_time=ts,
+    )
+    current = get_condition(status, ctype)
+    if current is not None and current.status == cond.status and current.reason == cond.reason:
+        return
+    if current is not None and current.status == cond.status:
+        cond.last_transition_time = current.last_transition_time
+
+    kept: List[JobCondition] = []
+    for c in status.conditions:
+        if ctype == JobConditionType.RESTARTING and c.type == JobConditionType.RUNNING:
+            continue
+        if ctype == JobConditionType.RUNNING and c.type == JobConditionType.RESTARTING:
+            continue
+        if c.type == ctype:
+            continue
+        if (
+            ctype in (JobConditionType.FAILED, JobConditionType.SUCCEEDED)
+            and c.type == JobConditionType.RUNNING
+        ):
+            c.status = ConditionStatus.FALSE
+        kept.append(c)
+    kept.append(cond)
+    status.conditions = kept
+
+
+def replica_key(rtype) -> str:
+    """Canonical status-map key for a replica type.
+
+    Replica types are open strings in the reference (custom roles like XDL's
+    ExtendRole are legal), so unknown names pass through instead of raising.
+    """
+    if isinstance(rtype, ReplicaType):
+        return rtype.value
+    return str(rtype)
+
+
+def initialize_replica_statuses(status: JobStatus, replica_types) -> None:
+    """Reset the given types' tallies, preserving others (ref status.go:9-16)."""
+    for rt in replica_types:
+        status.replica_statuses[replica_key(rt)] = ReplicaStatus()
+
+
+def update_job_replica_statuses(status: JobStatus, rtype, pod) -> None:
+    """Tally one pod's phase into the replica status (ref status.go:18-27)."""
+    rs = status.replica_statuses.setdefault(replica_key(rtype), ReplicaStatus())
+    phase = pod.status.phase
+    if phase == PodPhase.RUNNING:
+        rs.active += 1
+    elif phase == PodPhase.SUCCEEDED:
+        rs.succeeded += 1
+    elif phase == PodPhase.FAILED:
+        rs.failed += 1

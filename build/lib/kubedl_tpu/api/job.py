@@ -1,0 +1,38 @@
+"""Base job object — the common shape every workload CRD shares.
+
+Each workload type (TFJob/PyTorchJob/XGBoostJob/XDLJob/JAXJob) is a dataclass
+with `metadata`, a spec carrying `replica_specs` + `run_policy`, and a common
+`JobStatus`. The wire field name for replica specs varies per workload
+(`tfReplicaSpecs`, `pytorchReplicaSpecs`, ... — ref api/*/types.go) and is
+declared via dataclass field metadata.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from kubedl_tpu.api.common import JobStatus, ReplicaSpec, RunPolicy
+from kubedl_tpu.api.meta import ObjectMeta
+
+
+@dataclass
+class BaseJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+@dataclass
+class BaseJob:
+    # Every workload CRD declares `subresources: status: {}`
+    # (config/crd/bases/*.yaml, matching ref kubeflow.org_tfjobs.yaml:31):
+    # status writes must go through the store's update_status().
+    STATUS_SUBRESOURCE = True
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: BaseJobSpec = field(default_factory=BaseJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+    kind: str = "Job"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

@@ -1,0 +1,61 @@
+"""Object metadata — the apimachinery subset the framework needs.
+
+Ref: k8s.io/apimachinery ObjectMeta/OwnerReference as used throughout
+/root/reference (e.g. pkg/job_controller/job_controller.go:114-126
+GenOwnerReference). Timestamps are float epoch seconds internally and
+RFC3339 on the wire.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def now() -> float:
+    return time.time()
+
+
+def rfc3339(ts: Optional[float]) -> Optional[str]:
+    if ts is None:
+        return None
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    creation_timestamp: Optional[float] = None
+    deletion_timestamp: Optional[float] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+
+    def controller_ref(self) -> Optional[OwnerReference]:
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
+
+
+def namespaced_name(namespace: str, name: str) -> str:
+    return f"{namespace}/{name}"

@@ -1,0 +1,177 @@
+"""Pod / Service object model — the core-v1 subset the reconciler engine needs.
+
+Mirrors the shape KubeDL consumes from k8s.io/api/core/v1 (containers with
+env/ports/resources, pod phases, container termination state with exit codes
+— ref pkg/job_controller/pod.go:285-307 reads
+`status.containerStatuses[].state.terminated.exitCode`), plus TPU-native
+additions: `tpu` resource requests and slice topology hints on PodSpec.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.meta import ObjectMeta
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+
+@dataclass
+class ResourceRequirements:
+    # Flat map, e.g. {"cpu": 1.0, "memory": 2e9, "google.com/tpu": 4}.
+    # Ref uses full k8s Quantity; a float map carries the same decisions.
+    requests: Dict[str, float] = field(default_factory=dict)
+    limits: Dict[str, float] = field(default_factory=dict)
+
+    def tpu_chips(self) -> int:
+        return int(self.limits.get("google.com/tpu", self.requests.get("google.com/tpu", 0)))
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    working_dir: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    # k8s envVar entries that aren't plain name/value (valueFrom secret/
+    # configmap refs) — preserved verbatim for apiserver round-trips
+    # (k8s/store.py wire translation); the local executor ignores them.
+    env_raw: List[Dict] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: ResourceRequirements = field(default_factory=ResourceRequirements)
+    volume_mounts: List["VolumeMount"] = field(default_factory=list)
+
+    def port_named(self, name: str) -> Optional[int]:
+        for p in self.ports:
+            if p.name == name:
+                return p.container_port
+        return None
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    # mount only this subdirectory of the volume (k8s volumeMounts.subPath)
+    sub_path: str = ""
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    # "emptyDir" | "hostPath"; emptyDir maps to a per-pod temp dir locally.
+    kind: str = "emptyDir"
+    host_path: str = ""
+
+
+class PodRestartPolicy(str, enum.Enum):
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    restart_policy: PodRestartPolicy = PodRestartPolicy.NEVER
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    # TPU-native: which slice/topology this pod wants, resolved by the slice
+    # admitter (gang/) into a placement. E.g. "2x4" on v5e.
+    tpu_topology: str = ""
+
+    def tpu_chips(self) -> int:
+        return sum(c.resources.tpu_chips() for c in self.containers)
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class ContainerStateTerminated:
+    exit_code: int = 0
+    reason: str = ""
+    message: str = ""
+    finished_at: Optional[float] = None
+
+
+@dataclass
+class ContainerStatus:
+    name: str = ""
+    restart_count: int = 0
+    ready: bool = False
+    terminated: Optional[ContainerStateTerminated] = None
+
+
+@dataclass
+class PodCondition:
+    type: str = ""
+    status: str = "True"
+    last_transition_time: Optional[float] = None
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[float] = None
+    # TPU-native: placement assigned by the slice admitter.
+    node_name: str = ""
+    tpu_slice: str = ""
+    tpu_worker_id: int = -1
+    message: str = ""
+
+    def ready_time(self) -> Optional[float]:
+        for c in self.conditions:
+            if c.type == "Ready" and c.status == "True":
+                return c.last_transition_time
+        return None
+
+
+@dataclass
+class Pod:
+    # Pods serve /status on a real apiserver (kubelet owns it): status
+    # writes must go through the store's update_status().
+    STATUS_SUBRESOURCE = True
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+    kind: str = "Pod"
+
+
+@dataclass
+class ServiceSpec:
+    # Always headless (cluster_ip None) — one stable DNS name per replica,
+    # ref pkg/job_controller/service.go:263-275.
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+    cluster_ip: str = "None"
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    kind: str = "Service"

@@ -1,0 +1,86 @@
+"""Admission-style spec validation — the webhook the reference never wrote.
+
+The reference ships webhook/certmanager kustomize scaffolding but zero
+webhook Go code (SURVEY.md §2.3 Deploy/config row); invalid specs surface
+as reconcile-time errors. Here validation runs at apply time (Operator.apply
+and `kubedl-tpu validate`), the moral equivalent of a validating admission
+webhook: reject early with field-path messages instead of failing mid-
+reconcile. Workload controllers add their own rules via the
+`validate_job(job)` hook (e.g. PyTorch requires a Master replica — ref
+controllers/pytorch/status.go:63-91 errors there instead).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from kubedl_tpu.api.common import CleanPodPolicy, RestartPolicy
+
+
+class ValidationError(ValueError):
+    def __init__(self, kind: str, name: str, errors: List[str]) -> None:
+        self.errors = list(errors)
+        super().__init__(
+            f"{kind} {name!r} is invalid: " + "; ".join(self.errors)
+        )
+
+
+def validate_common(job, controller) -> List[str]:
+    """Rules every workload shares; returns field-path error strings."""
+    errs: List[str] = []
+    if not job.metadata.name:
+        errs.append("metadata.name: required")
+    specs = controller.replica_specs(job)
+    if not specs:
+        errs.append("spec.replicaSpecs: at least one replica type required")
+    for rtype, spec in (specs or {}).items():
+        path = f"spec.replicaSpecs[{rtype}]"
+        if spec.replicas is not None and spec.replicas < 0:
+            errs.append(f"{path}.replicas: must be >= 0, got {spec.replicas}")
+        containers = spec.template.spec.containers
+        if not containers:
+            errs.append(f"{path}.template.spec.containers: required")
+        seen = set()
+        for i, c in enumerate(containers):
+            if not c.name:
+                errs.append(f"{path}.template.spec.containers[{i}].name: required")
+            elif c.name in seen:
+                errs.append(
+                    f"{path}.template.spec.containers[{i}].name: duplicate {c.name!r}"
+                )
+            seen.add(c.name)
+        if spec.restart_policy is not None and not isinstance(
+            spec.restart_policy, RestartPolicy
+        ):
+            errs.append(f"{path}.restartPolicy: unknown {spec.restart_policy!r}")
+    rp = controller.run_policy(job)
+    if rp is not None:
+        if rp.clean_pod_policy is not None and not isinstance(
+            rp.clean_pod_policy, CleanPodPolicy
+        ):
+            errs.append(f"spec.runPolicy.cleanPodPolicy: unknown {rp.clean_pod_policy!r}")
+        for fname, v in (
+            ("ttlSecondsAfterFinished", rp.ttl_seconds_after_finished),
+            ("activeDeadlineSeconds", rp.active_deadline_seconds),
+            ("backoffLimit", rp.backoff_limit),
+        ):
+            if v is not None and v < 0:
+                errs.append(f"spec.runPolicy.{fname}: must be >= 0, got {v}")
+        sp = rp.success_policy
+        if sp is not None and sp.min_finish_worker_percentage is not None and not (
+            0 <= sp.min_finish_worker_percentage <= 100
+        ):
+            errs.append(
+                "spec.runPolicy.successPolicy.minFinishWorkRate: must be in "
+                f"[0, 100], got {sp.min_finish_worker_percentage}"
+            )
+    return errs
+
+
+def validate(job, controller) -> None:
+    """Raise ValidationError if the (already defaulted) job is invalid."""
+    errs = validate_common(job, controller)
+    extra = getattr(controller, "validate_job", None)
+    if extra is not None:
+        errs.extend(extra(job) or [])
+    if errs:
+        raise ValidationError(job.kind, job.metadata.name, errs)

@@ -1,0 +1,507 @@
+"""kubedl-tpu CLI — run jobs locally or serve the operator.
+
+    python -m kubedl_tpu.cli run -f examples/tf_job_mnist.yaml
+    python -m kubedl_tpu.cli operator --metrics-port 8443 --workloads '*'
+    python -m kubedl_tpu.cli validate -f job.yaml
+
+Flag names keep parity with the reference's startup flags
+(ref main.go:54-66, docs/startup_flags.md): --max-reconciles,
+--gang-scheduler-name, --workloads; TPU-native additions: --tpu-slices.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import yaml
+
+from kubedl_tpu.api.common import is_failed, is_succeeded
+from kubedl_tpu.api.validation import ValidationError, validate as api_validate
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH
+from kubedl_tpu.core.store import NotFound
+from kubedl_tpu.operator import Operator, OperatorConfig
+from kubedl_tpu.server import OperatorHTTPServer
+
+
+def _load_manifests(path: str):
+    with open(path) as f:
+        return [m for m in yaml.safe_load_all(f) if m]
+
+
+def _mk_operator(args) -> Operator:
+    return Operator(
+        OperatorConfig(
+            max_reconciles=args.max_reconciles,
+            enable_gang_scheduling=bool(args.tpu_slices) or args.gang,
+            gang_scheduler_name=args.gang_scheduler_name,
+            tpu_slices=args.tpu_slices,
+            workloads=args.workloads,
+            object_storage=args.object_storage,
+            event_storage=args.event_storage,
+            storage_db_path=args.storage_db_path,
+            enable_leader_election=getattr(args, "enable_leader_election", False),
+            leader_lease_path=getattr(args, "leader_lease_path", DEFAULT_LEASE_PATH),
+            leader_lease_duration=getattr(args, "leader_lease_duration", 15.0),
+            leader_renew_period=getattr(args, "leader_renew_period", 5.0),
+            leader_retry_period=getattr(args, "leader_retry_period", 2.0),
+            kube_api_url=getattr(args, "kube_api_url", ""),
+            kube_namespace=getattr(args, "kube_namespace", "default"),
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# client commands (kubectl-style, against a running `operator` server)
+# ---------------------------------------------------------------------------
+
+
+def _client_request(args, method: str, path: str, body=None):
+    import urllib.error
+    import urllib.request
+
+    url = args.server.rstrip("/") + path
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    token = args.api_token or os.environ.get("KUBEDL_API_TOKEN", "")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            ctype = r.headers.get("Content-Type", "")
+            raw = r.read().decode()
+    except urllib.error.HTTPError as e:
+        print(f"error: HTTP {e.code}: {e.read().decode()}", file=sys.stderr)
+        return None
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach {url}: {e.reason}", file=sys.stderr)
+        return None
+    return json.loads(raw) if ctype.startswith("application/json") else raw
+
+
+def _job_phase(status) -> str:
+    """Latest True condition type — the kubectl STATUS column."""
+    for c in reversed((status or {}).get("conditions") or []):
+        if str(c.get("status", "")).lower() in ("true", "1"):
+            return str(c.get("type", "Unknown"))
+    return "Pending"
+
+
+def _format_row(row, widths) -> str:
+    return "".join(str(c).ljust(widths[i]) for i, c in enumerate(row)).rstrip()
+
+
+def _grow_widths(widths, row) -> None:
+    """Widen columns for a continuation row longer than anything in the
+    initial snapshot, so later rows stay aligned with each other."""
+    for i, cell in enumerate(row):
+        if i < len(widths):
+            widths[i] = max(widths[i], len(str(cell)) + 2)
+
+
+def _print_table(rows):
+    """Print aligned rows; returns the column widths so continuation rows
+    (watch mode) can keep the alignment."""
+    if not rows:
+        return []
+    widths = [max(len(str(r[i])) for r in rows) + 2 for i in range(len(rows[0]))]
+    for r in rows:
+        print(_format_row(r, widths), flush=True)
+    return widths
+
+
+def cmd_get(args) -> int:
+    if args.name:
+        if getattr(args, "watch", False):
+            print("error: -w/--watch applies to the list form "
+                  f"(kubedl-tpu get {args.kind} -w)", file=sys.stderr)
+            return 2
+        obj = _client_request(
+            args, "GET", f"/apis/{args.kind}/{args.namespace}/{args.name}"
+        )
+        if obj is None:
+            return 1
+        print(json.dumps(obj, indent=2, default=str))
+        return 0
+
+    def snapshot():
+        listing = _client_request(args, "GET", f"/apis/{args.kind}")
+        if listing is None:
+            return None
+        rows = []
+        for item in listing.get("items", []):
+            meta = item.get("metadata") or {}
+            if not args.all_namespaces and meta.get("namespace") != args.namespace:
+                continue
+            rows.append((meta.get("namespace", ""), meta.get("name", ""),
+                         _job_phase(item.get("status"))))
+        return rows
+
+    rows = snapshot()
+    if rows is None:
+        return 1
+    header = ("NAMESPACE", "NAME", "STATUS")
+    widths = _print_table([header] + rows)
+    if not getattr(args, "watch", False):
+        return 0
+    # kubectl -w: poll and print rows whose status changed, appeared, or
+    # were deleted, keeping the initial table's column alignment; each
+    # row flushes so piped output streams. Transient request failures
+    # are retried a few times before giving up. KUBEDL_WATCH_MAX bounds
+    # the loop for tests; default runs until interrupted.
+    seen = dict(((ns, name), st) for ns, name, st in rows)
+    max_polls = int(os.environ.get("KUBEDL_WATCH_MAX", "0"))
+    polls = failures = 0
+    try:
+        while not max_polls or polls < max_polls:
+            time.sleep(float(os.environ.get("KUBEDL_WATCH_INTERVAL", "2")))
+            polls += 1
+            rows = snapshot()
+            if rows is None:
+                failures += 1
+                if failures >= 3:
+                    print("error: watch lost the server (3 consecutive "
+                          "failures)", file=sys.stderr)
+                    return 1
+                continue
+            failures = 0
+            current = set()
+            for ns, name, st in rows:
+                current.add((ns, name))
+                if seen.get((ns, name)) != st:
+                    seen[(ns, name)] = st
+                    _grow_widths(widths, (ns, name, st))
+                    print(_format_row((ns, name, st), widths), flush=True)
+            for key in sorted(set(seen) - current):
+                del seen[key]
+                _grow_widths(widths, (key[0], key[1], "Deleted"))
+                print(_format_row((key[0], key[1], "Deleted"), widths),
+                      flush=True)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_apply(args) -> int:
+    rc = 0
+    for path in args.files:
+        for manifest in _load_manifests(path):
+            kind = manifest.get("kind", "")
+            out = _client_request(args, "POST", f"/apis/{kind}", body=manifest)
+            if out is None:
+                rc = 1
+                continue
+            meta = out.get("metadata") or {}
+            print(f"applied {kind} {meta.get('namespace')}/{meta.get('name')}")
+    return rc
+
+
+def cmd_delete(args) -> int:
+    out = _client_request(
+        args, "DELETE", f"/apis/{args.kind}/{args.namespace}/{args.name}"
+    )
+    if out is None:
+        return 1
+    print(f"deleted {args.kind} {args.namespace}/{args.name}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    path = f"/logs/{args.namespace}/{args.pod}"
+    params = []
+    if args.container:
+        params.append(f"container={args.container}")
+    if args.tail is not None:
+        params.append(f"tail={args.tail}")
+    if params:
+        path += "?" + "&".join(params)
+    out = _client_request(args, "GET", path)
+    if out is None:
+        return 1
+    sys.stdout.write(out if isinstance(out, str) else str(out))
+    return 0
+
+
+def cmd_events(args) -> int:
+    listing = _client_request(args, "GET", f"/events/{args.namespace}")
+    if listing is None:
+        return 1
+    rows = [("TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
+    for e in listing.get("items", []):
+        inv = e.get("involvedObject") or e.get("involved_object") or {}
+        rows.append((
+            e.get("type", ""), e.get("reason", ""),
+            f"{inv.get('kind', '')}/{inv.get('name', '')}",
+            e.get("count", 1), e.get("message", ""),
+        ))
+    _print_table(rows)
+    return 0
+
+
+def cmd_top(args) -> int:
+    """kubectl-top-style view of the operator: TPU slice pool utilization
+    plus per-controller reconcile health (from /debug/vars)."""
+    vars_ = _client_request(args, "GET", "/debug/vars")
+    if vars_ is None:
+        return 1
+    pool = vars_.get("slice_pool")
+    if pool:
+        print(f"slice pool: {pool['chips_reserved']}/{pool['chips_total']} chips "
+              f"reserved ({pool['utilization']:.0%}), "
+              f"{pool['slices_reserved']}/{pool['slices_total']} slices")
+        rows = [("SLICE", "TYPE", "CHIPS", "RESERVED BY")]
+        for s in pool.get("slices", []):
+            rows.append((s["name"], s["type"], s.get("chips", ""),
+                         s.get("reserved_by") or "-"))
+        _print_table(rows)
+        print()
+    rows = [("CONTROLLER", "RECONCILES", "ERRORS", "REQUEUES", "QUEUE", "MEAN_MS")]
+    for name, c in sorted((vars_.get("controllers") or {}).items()):
+        rows.append((name, c.get("reconciles", 0), c.get("errors", 0),
+                     c.get("requeues", 0), c.get("queue_depth", ""),
+                     round(c.get("mean_seconds", 0.0) * 1e3, 2)))
+    _print_table(rows)
+    return 0
+
+
+def cmd_run(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    op.start()
+    server = None
+    if args.metrics_port:
+        server = OperatorHTTPServer(op, port=args.metrics_port)
+        port = server.start()
+        print(f"serving metrics/API on http://127.0.0.1:{port}")
+    rc = 0
+    try:
+        jobs = [op.apply(m) for p in args.files for m in _load_manifests(p)]
+        for job in jobs:
+            print(f"applied {job.kind} {job.metadata.namespace}/{job.metadata.name}")
+        deadline = time.monotonic() + args.timeout
+        pending = {(j.kind, j.metadata.namespace, j.metadata.name) for j in jobs}
+        last_report = 0.0
+        while pending and time.monotonic() < deadline:
+            for key in list(pending):
+                kind, ns, name = key
+                try:
+                    fresh = op.store.get(kind, ns, name)
+                except NotFound:
+                    print(f"{kind} {ns}/{name}: deleted before completion")
+                    pending.discard(key)
+                    rc = 1
+                    continue
+                if is_succeeded(fresh.status):
+                    print(f"{kind} {ns}/{name}: Succeeded")
+                    pending.discard(key)
+                elif is_failed(fresh.status):
+                    cond = fresh.status.conditions[-1]
+                    print(f"{kind} {ns}/{name}: Failed — {cond.message}")
+                    pending.discard(key)
+                    rc = 1
+            if time.monotonic() - last_report > 5:
+                last_report = time.monotonic()
+                for kind, ns, name in pending:
+                    phases = [
+                        (p.metadata.name, p.status.phase.value)
+                        for p in op.store.list("Pod", namespace=ns)
+                        if p.metadata.labels.get("job-name") == name
+                    ]
+                    print(f"waiting on {kind} {ns}/{name}: pods={phases}")
+            time.sleep(0.1)
+        if pending:
+            print(f"timed out waiting for: {sorted(pending)}")
+            rc = 1
+    finally:
+        if server:
+            server.stop()
+        op.stop()
+    return rc
+
+
+def cmd_operator(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    # Construct the server BEFORE op.start(): its token validation can
+    # raise (non-loopback bind without a token), and failing here must not
+    # leave a leader lease held or manager threads running.
+    server = OperatorHTTPServer(
+        op, host=args.bind, port=args.metrics_port or 8443,
+        token=getattr(args, "api_token", None),
+    )
+    if args.enable_leader_election:
+        print(f"acquiring leadership lease at {args.leader_lease_path} ...")
+    op.start()
+    if op.elector is not None:
+        print(f"elected leader as {op.elector.identity}")
+    port = server.start()
+    print(f"kubedl-tpu operator serving on http://{args.bind}:{port} "
+          f"(kinds: {sorted(op.reconcilers)})")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        op.stop()
+    return 0
+
+
+def cmd_webhook(args) -> int:
+    """Serve admission webhooks until interrupted (docs/kubernetes.md)."""
+    from kubedl_tpu.k8s.webhook import AdmissionWebhookServer
+
+    srv = AdmissionWebhookServer(
+        bind=args.bind, port=args.port,
+        certfile=args.tls_cert or None, keyfile=args.tls_key or None,
+    ).start()
+    scheme = "https" if args.tls_cert else "http"
+    print(f"admission webhook on {scheme}://{args.bind}:{srv.port} "
+          f"(/validate /mutate /healthz)", flush=True)
+    try:
+        import signal as _signal
+
+        _signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+def cmd_validate(args) -> int:
+    op = _mk_operator(args)
+    op.register_all()
+    rc = 0
+    for path in args.files:
+        for m in _load_manifests(path):
+            kind = m.get("kind", "")
+            canonical = op._kind_by_lower.get(kind.lower())
+            if canonical is None:
+                print(f"{path}: unknown kind {kind!r}")
+                rc = 1
+                continue
+            engine = op.reconcilers[canonical]
+            from kubedl_tpu.utils.serde import from_dict
+
+            job = from_dict(engine.controller.job_type(), m)
+            engine.controller.set_defaults(job)
+            try:
+                api_validate(job, engine.controller)
+            except ValidationError as e:
+                print(f"{path}: INVALID — {e}")
+                rc = 1
+                continue
+            n = sum(int(s.replicas or 0) for s in engine.controller.replica_specs(job).values())
+            print(f"{path}: {canonical} {job.metadata.name} ok ({n} replicas)")
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kubedl-tpu")
+    parser.add_argument("--max-reconciles", type=int, default=1)
+    parser.add_argument("--workloads", default="*")
+    parser.add_argument("--gang-scheduler-name", default="tpu-slice")
+    parser.add_argument("--gang", action="store_true", help="enable gang scheduling")
+    parser.add_argument("--tpu-slices", nargs="*", default=[],
+                        help="TPU pool, e.g. v5e-8 v5p-32")
+    # persistence flags (ref --object-storage/--event-storage, persist_controller.go:30-74)
+    parser.add_argument("--object-storage", default="",
+                        help="object history backend name, e.g. sqlite")
+    parser.add_argument("--event-storage", default="",
+                        help="event history backend name, e.g. sqlite")
+    parser.add_argument("--storage-db-path", default=":memory:",
+                        help="database path for the sqlite backend")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="run job manifests to completion locally")
+    p_run.add_argument("-f", "--files", nargs="+", required=True)
+    p_run.add_argument("--timeout", type=float, default=600.0)
+    p_run.add_argument("--metrics-port", type=int, default=0)
+    p_run.set_defaults(fn=cmd_run)
+
+    p_op = sub.add_parser("operator", help="serve the operator over HTTP")
+    p_op.add_argument("--bind", default="127.0.0.1")
+    p_op.add_argument("--metrics-port", type=int, default=8443)
+    # ref main.go:56: leader election defaults ON for the deployed operator
+    p_op.add_argument("--enable-leader-election", action=argparse.BooleanOptionalAction,
+                      default=True)
+    p_op.add_argument("--leader-lease-path", default=DEFAULT_LEASE_PATH)
+    # kube mode elects on a coordination.k8s.io Lease; client-go-ish timing
+    p_op.add_argument("--leader-lease-duration", type=float, default=15.0)
+    p_op.add_argument("--leader-renew-period", type=float, default=5.0)
+    p_op.add_argument("--leader-retry-period", type=float, default=2.0)
+    p_op.add_argument("--kube-api-url", default="",
+                      help="reconcile real cluster objects through this "
+                           "kube-apiserver ('in-cluster' = service account)")
+    p_op.add_argument("--kube-namespace", default="default")
+    p_op.add_argument("--api-token", default=None,
+                      help="bearer token for the HTTP API (env KUBEDL_API_TOKEN); "
+                           "REQUIRED for non-loopback --bind")
+    p_op.set_defaults(fn=cmd_operator)
+
+    p_val = sub.add_parser("validate", help="parse and default manifests")
+    p_val.add_argument("-f", "--files", nargs="+", required=True)
+    p_val.set_defaults(fn=cmd_validate)
+
+    p_wh = sub.add_parser(
+        "webhook",
+        help="serve admission webhooks (/validate + /mutate AdmissionReview)",
+    )
+    p_wh.add_argument("--bind", default="0.0.0.0")
+    p_wh.add_argument("--port", type=int, default=9443)
+    p_wh.add_argument("--tls-cert", default="",
+                      help="TLS cert path (apiserver requires HTTPS)")
+    p_wh.add_argument("--tls-key", default="")
+    p_wh.set_defaults(fn=cmd_webhook)
+
+    # kubectl-style client commands against a running `operator` server
+    def client_parser(name, help_):
+        p = sub.add_parser(name, help=help_)
+        p.add_argument("--server", default=os.environ.get(
+            "KUBEDL_SERVER", "http://127.0.0.1:8443"))
+        p.add_argument("--api-token", default=None,
+                       help="bearer token (env KUBEDL_API_TOKEN)")
+        p.add_argument("-n", "--namespace", default="default")
+        return p
+
+    p_get = client_parser("get", "list jobs of a kind, or show one as JSON")
+    p_get.add_argument("kind")
+    p_get.add_argument("name", nargs="?", default="")
+    p_get.add_argument("-A", "--all-namespaces", action="store_true")
+    p_get.add_argument("-w", "--watch", action="store_true",
+                       help="poll and print status changes until interrupted")
+    p_get.set_defaults(fn=cmd_get)
+
+    p_apply = client_parser("apply", "submit manifests to the operator")
+    p_apply.add_argument("-f", "--files", nargs="+", required=True)
+    p_apply.set_defaults(fn=cmd_apply)
+
+    p_del = client_parser("delete", "delete a job")
+    p_del.add_argument("kind")
+    p_del.add_argument("name")
+    p_del.set_defaults(fn=cmd_delete)
+
+    p_logs = client_parser("logs", "print a pod's container logs")
+    p_logs.add_argument("pod")
+    p_logs.add_argument("-c", "--container", default="")
+    p_logs.add_argument("--tail", type=int, default=None)
+    p_logs.set_defaults(fn=cmd_logs)
+
+    p_ev = client_parser("events", "list events in a namespace")
+    p_ev.set_defaults(fn=cmd_events)
+
+    p_top = client_parser("top", "slice-pool utilization + controller health")
+    p_top.set_defaults(fn=cmd_top)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

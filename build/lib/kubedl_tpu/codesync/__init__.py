@@ -1,0 +1,25 @@
+"""Code-sync subsystem — git clone injection for replica pods.
+
+Ref pkg/code_sync/: jobs annotated with `kubedl.io/git-sync-config` get an
+init container per replica that clones user code into a shared emptyDir
+before the main containers start.
+"""
+from kubedl_tpu.codesync.handler import (
+    DEFAULT_CODE_ROOT_PATH,
+    DEFAULT_GIT_SYNC_IMAGE,
+    GIT_SYNC_CONTAINER_NAME,
+    GIT_SYNC_VOLUME_NAME,
+    CodeSyncer,
+    GitSyncHandler,
+    GitSyncOptions,
+)
+
+__all__ = [
+    "DEFAULT_CODE_ROOT_PATH",
+    "DEFAULT_GIT_SYNC_IMAGE",
+    "GIT_SYNC_CONTAINER_NAME",
+    "GIT_SYNC_VOLUME_NAME",
+    "CodeSyncer",
+    "GitSyncHandler",
+    "GitSyncOptions",
+]

@@ -1,0 +1,118 @@
+"""Native git-sync — the executable behind the injected init container.
+
+The reference delegates cloning to the `kubedl/git-sync:v1` image
+(ref git_sync_handler.go:12); running pods as local processes needs a
+native equivalent. Reads the same `GIT_SYNC_*` env contract, clones
+`GIT_SYNC_REPO` into `GIT_SYNC_ROOT/GIT_SYNC_DEST`, checks out
+branch/revision, retries up to `GIT_SYNC_MAX_SYNC_FAILURES` times, and
+exits (one-time mode).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+
+def _git(args, cwd=None, env=None):
+    return subprocess.run(
+        ["git"] + args, cwd=cwd, env=env, capture_output=True, text=True
+    )
+
+
+def sync_once(repo: str, root: str, dest: str, branch: str, rev: str, depth: str,
+              user: str, password: str, ssh_key_file: str = "") -> None:
+    os.makedirs(root, exist_ok=True)
+    target = os.path.join(root, dest)
+    if os.path.isdir(os.path.join(target, ".git")):
+        shutil.rmtree(target)  # one-time mode: always a fresh checkout
+
+    env = dict(os.environ)
+    env.setdefault("GIT_TERMINAL_PROMPT", "0")
+    if ssh_key_file:
+        import shlex
+
+        env["GIT_SSH_COMMAND"] = (
+            f"ssh -i {shlex.quote(ssh_key_file)} -o StrictHostKeyChecking=accept-new"
+        )
+    askpass = None
+    if user and password:
+        # credentials go through an ephemeral GIT_ASKPASS helper — never in
+        # the URL, so they land in neither argv nor .git/config
+        import stat
+        import tempfile
+
+        fd, askpass = tempfile.mkstemp(prefix="git-askpass-", suffix=".py")
+        with os.fdopen(fd, "w") as f:
+            f.write(
+                "#!%s\nimport os, sys\n"
+                "q = sys.argv[1].lower() if len(sys.argv) > 1 else ''\n"
+                "print(os.environ['GIT_SYNC_USERNAME'] if 'username' in q"
+                " else os.environ['GIT_SYNC_PASSWORD'])\n" % sys.executable
+            )
+        os.chmod(askpass, stat.S_IRWXU)
+        env["GIT_ASKPASS"] = askpass
+        env["GIT_SYNC_USERNAME"] = user
+        env["GIT_SYNC_PASSWORD"] = password
+
+    try:
+        clone = ["clone"]
+        if depth:
+            clone += ["--depth", depth]
+        if branch:
+            clone += ["--branch", branch]
+        clone += [repo, target]
+        r = _git(clone, env=env)
+        if r.returncode != 0:
+            raise RuntimeError(f"git clone failed: {r.stderr.strip()}")
+
+        if rev:
+            r = _git(["checkout", rev], cwd=target, env=env)
+            if r.returncode != 0:
+                raise RuntimeError(f"git checkout {rev} failed: {r.stderr.strip()}")
+    finally:
+        if askpass:
+            os.unlink(askpass)
+
+
+def main() -> int:
+    repo = os.environ.get("GIT_SYNC_REPO", "")
+    if not repo:
+        print("GIT_SYNC_REPO not set", file=sys.stderr)
+        return 1
+    # under the local executor the emptyDir volume is a temp dir exported
+    # as KUBEDL_VOLUME_GIT_SYNC; on a real cluster the mount IS the root
+    root = (
+        os.environ.get("KUBEDL_VOLUME_GIT_SYNC")
+        or os.environ.get("GIT_SYNC_ROOT", "/code")
+    )
+    dest = os.environ.get("GIT_SYNC_DEST", "code")
+    branch = os.environ.get("GIT_SYNC_BRANCH", "")
+    rev = os.environ.get("GIT_SYNC_REV", "")
+    depth = os.environ.get("GIT_SYNC_DEPTH", "")
+    user = os.environ.get("GIT_SYNC_USERNAME", "")
+    password = os.environ.get("GIT_SYNC_PASSWORD", "")
+    ssh_key_file = ""
+    if os.environ.get("GIT_SYNC_SSH", "").lower() == "true":
+        ssh_key_file = os.environ.get("GIT_SSH_KEY_FILE", "")
+    max_failures = int(os.environ.get("GIT_SYNC_MAX_SYNC_FAILURES", "3"))
+
+    attempt = 0
+    while True:
+        try:
+            sync_once(repo, root, dest, branch, rev, depth, user, password,
+                      ssh_key_file=ssh_key_file)
+            print(f"synced {repo} -> {os.path.join(root, dest)}")
+            return 0
+        except (RuntimeError, OSError) as e:
+            attempt += 1
+            print(f"sync attempt {attempt} failed: {e}", file=sys.stderr)
+            if attempt > max_failures:
+                return 1
+            time.sleep(min(2 ** attempt, 10))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""Code-sync injection — clone user code into every replica before start.
+
+Ref pkg/code_sync/{sync_handler.go,git_sync_handler.go}: jobs annotated with
+`kubedl.io/git-sync-config` (JSON) get one init container per replica that
+clones the repo into a shared emptyDir, which is then mounted into every
+main container at `workingDir/destPath`. Env names (`GIT_SYNC_*`) are kept
+verbatim for compatibility with the upstream git-sync image; the container
+also carries a native command (`python -m kubedl_tpu.codesync.git_sync`) so
+the local executor can perform the sync without any image runtime.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import posixpath
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+from kubedl_tpu.api.common import ANNOTATION_GIT_SYNC_CONFIG
+from kubedl_tpu.api.pod import Container, Volume, VolumeMount
+
+DEFAULT_CODE_ROOT_PATH = "/code"  # ref sync_handler.go:12
+DEFAULT_GIT_SYNC_IMAGE = "kubedl/git-sync:v1"  # ref git_sync_handler.go:12
+GIT_SYNC_CONTAINER_NAME = "git-sync-code"
+GIT_SYNC_VOLUME_NAME = "git-sync"
+
+
+@dataclass
+class GitSyncOptions:
+    """Ref git_sync_handler.go gitSyncOptions (SyncOptions inlined)."""
+
+    source: str = ""
+    image: str = ""
+    root_path: str = ""
+    dest_path: str = ""
+    envs: Dict[str, str] = field(default_factory=dict)
+    branch: str = ""
+    revision: str = ""
+    depth: str = ""
+    max_failures: int = 0
+    ssh: bool = False
+    ssh_file: str = ""
+    user: str = ""
+    password: str = ""
+
+    @classmethod
+    def parse(cls, raw: str) -> "GitSyncOptions":
+        data = json.loads(raw)
+        envs = data.get("envs") or {}
+        if isinstance(envs, list):  # k8s EnvVar list form
+            envs = {e["name"]: e.get("value", "") for e in envs}
+        return cls(
+            source=data.get("source", ""),
+            image=data.get("image", ""),
+            root_path=data.get("rootPath", ""),
+            dest_path=data.get("destPath", ""),
+            envs=envs,
+            branch=data.get("branch", ""),
+            revision=data.get("revision", ""),
+            depth=str(data.get("depth", "") or ""),
+            max_failures=int(data.get("maxFailures", 0) or 0),
+            ssh=bool(data.get("ssh", False)),
+            ssh_file=data.get("sshFile", ""),
+            user=data.get("user", ""),
+            password=data.get("password", ""),
+        )
+
+    def set_defaults(self) -> None:
+        """Ref git_sync_handler.go setDefaultSyncOpts."""
+        if not self.root_path:
+            self.root_path = DEFAULT_CODE_ROOT_PATH
+        if not self.dest_path:
+            # project name from the git URL, .git suffix stripped
+            last = self.source.rstrip("/").rsplit("/", 1)[-1]
+            self.dest_path = last[:-4] if last.endswith(".git") else last
+        if not self.image:
+            self.image = DEFAULT_GIT_SYNC_IMAGE
+        if self.max_failures == 0:
+            self.max_failures = 3
+
+    def sync_envs(self) -> Dict[str, str]:
+        """Ref git_sync_handler.go setSyncOptsEnvs — same env-name contract."""
+        envs = dict(self.envs)
+        envs["GIT_SYNC_REPO"] = self.source
+        # one-time mode: the init container must exit (ref comment "Critical")
+        envs["GIT_SYNC_ONE_TIME"] = "true"
+        envs["GIT_SYNC_MAX_SYNC_FAILURES"] = str(self.max_failures)
+        if self.branch:
+            envs["GIT_SYNC_BRANCH"] = self.branch
+        if self.revision:
+            envs["GIT_SYNC_REV"] = self.revision
+        if self.depth:
+            envs["GIT_SYNC_DEPTH"] = self.depth
+        if self.root_path:
+            envs["GIT_SYNC_ROOT"] = self.root_path
+        if self.dest_path:
+            envs["GIT_SYNC_DEST"] = self.dest_path
+        if self.ssh:
+            envs["GIT_SYNC_SSH"] = "true"
+            if self.ssh_file:
+                envs["GIT_SSH_KEY_FILE"] = self.ssh_file
+        if self.user:
+            envs["GIT_SYNC_USERNAME"] = self.user
+        if self.password:
+            envs["GIT_SYNC_PASSWORD"] = self.password
+        return envs
+
+
+class GitSyncHandler:
+    """Builds the clone init container (ref gitSyncHandler.InitContainer)."""
+
+    def init_container(
+        self, raw_config: str, volume_name: str
+    ) -> Tuple[Container, GitSyncOptions]:
+        opts = GitSyncOptions.parse(raw_config)
+        if not opts.source:
+            raise ValueError("git-sync config requires 'source'")
+        opts.set_defaults()
+        # command left empty so the git-sync image's own entrypoint runs on a
+        # cluster; the local executor (which has no image runtime) recognizes
+        # the GIT_SYNC_REPO env and substitutes the native sync runner
+        # (executor/local.py), keeping one injected spec valid for both.
+        container = Container(
+            name=GIT_SYNC_CONTAINER_NAME,
+            image=opts.image,
+            env=opts.sync_envs(),
+            volume_mounts=[VolumeMount(name=volume_name, mount_path=opts.root_path)],
+        )
+        return container, opts
+
+
+class CodeSyncer:
+    """Engine plugin: inject sync init containers into replica specs each
+    reconcile pass (ref InjectCodeSyncInitContainers, job.go:99-103)."""
+
+    def __init__(self) -> None:
+        self._git = GitSyncHandler()
+
+    def inject(self, job, replicas) -> None:
+        raw = (job.metadata.annotations or {}).get(ANNOTATION_GIT_SYNC_CONFIG)
+        if not raw:
+            return
+        init_container, opts = self._git.init_container(raw, GIT_SYNC_VOLUME_NAME)
+        dest = opts.dest_path
+        for spec in replicas.values():
+            pod_spec = spec.template.spec
+            if any(c.name == GIT_SYNC_CONTAINER_NAME for c in pod_spec.init_containers):
+                continue  # already injected this pass
+            ic = copy.deepcopy(init_container)
+            # the clone inherits the main container's resources
+            # (ref injectCodeSyncInitContainer resources deep-copy)
+            if pod_spec.containers:
+                ic.resources = copy.deepcopy(pod_spec.containers[0].resources)
+            pod_spec.init_containers.append(ic)
+            pod_spec.volumes.append(Volume(name=GIT_SYNC_VOLUME_NAME, kind="emptyDir"))
+            for c in pod_spec.containers:
+                # subPath so the checkout itself (volume-root/dest) lands at
+                # workingDir/dest, not workingDir/dest/dest; containers with
+                # no workingDir fall back to the absolute sync root so the
+                # mountPath is never relative (k8s rejects relative paths)
+                c.volume_mounts.append(
+                    VolumeMount(
+                        name=GIT_SYNC_VOLUME_NAME,
+                        mount_path=posixpath.join(c.working_dir or opts.root_path, dest),
+                        sub_path=dest,
+                    )
+                )

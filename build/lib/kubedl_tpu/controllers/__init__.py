@@ -1,0 +1,3 @@
+from kubedl_tpu.controllers.interface import WorkloadController  # noqa: F401
+from kubedl_tpu.controllers.base import BaseWorkloadController  # noqa: F401
+from kubedl_tpu.controllers.engine import EngineConfig, JobReconciler  # noqa: F401

@@ -1,0 +1,234 @@
+"""BaseWorkloadController — shared defaulting + the general status machine.
+
+The reference duplicates an `updateGeneralJobStatus` per workload
+(controllers/tensorflow/status.go:56-212, controllers/pytorch/status.go,
+controllers/xgboost/job.go:120-147, controllers/xdl/status.go:61-160). The
+logic is one machine with four knobs, so here it is written once:
+
+  * master-driven success: if the job declares a master-ish replica type, its
+    completion/running state drives the job (TF Chief/Master, PyTorch Master,
+    XGBoost Master);
+  * worker-driven success: otherwise all-workers-done OR the worker-0
+    heuristic (TF status.go:62-101) completes the job;
+  * min-finish success: XDL's policy, via RunPolicy.success_policy;
+  * failed>0: Restarting when a retryable restart happened this pass, else
+    Failed (sticky, with completion time).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from kubedl_tpu.api.common import (
+    CleanPodPolicy,
+    JobConditionType,
+    JobStatus,
+    LABEL_REPLICA_INDEX,
+    REASON_JOB_FAILED,
+    REASON_JOB_RESTARTING,
+    REASON_JOB_RUNNING,
+    REASON_JOB_SUCCEEDED,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    is_failed,
+    is_restarting,
+    is_succeeded,
+    replica_key,
+    update_job_conditions,
+)
+from kubedl_tpu.api.meta import now
+from kubedl_tpu.api.pod import PodPhase
+from kubedl_tpu.controllers import utils
+from kubedl_tpu.controllers.interface import WorkloadController
+
+
+class BaseWorkloadController(WorkloadController):
+    """Implements the shared parts; workloads override the knobs."""
+
+    # Engine + store are attached by the operator wiring (operator.py).
+    engine = None
+
+    # -- knobs -----------------------------------------------------------
+
+    @property
+    def master_types(self) -> List[str]:
+        """Replica types whose completion drives job success (may be empty)."""
+        return []
+
+    @property
+    def worker_type(self) -> str:
+        return str(ReplicaType.WORKER.value)
+
+    def use_worker0_completed_heuristic(self) -> bool:
+        """TF-only: worker-0 Succeeded with exit 0 completes the job."""
+        return False
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        return RestartPolicy.NEVER
+
+    def default_clean_pod_policy(self):
+        return CleanPodPolicy.RUNNING
+
+    # Manifest replica-type key canonicalization, e.g. {"worker": "Worker"}
+    # (ref api/*/defaults.go camel-casing); applied by set_defaults.
+    replica_key_map: Dict[str, str] = {}
+
+    # -- defaulting (ref api/*/defaults.go) ------------------------------
+
+    def set_defaults(self, job) -> None:
+        specs = self.replica_specs(job)
+        for key in list(specs):
+            canonical = self.replica_key_map.get(key.lower())
+            if canonical and canonical != key:
+                if canonical in specs:
+                    raise ValueError(
+                        f"replica specs contain both {key!r} and {canonical!r}"
+                    )
+                specs[canonical] = specs.pop(key)
+        for rtype, spec in specs.items():
+            if spec.replicas is None:
+                spec.replicas = 1
+            if spec.restart_policy is None:
+                spec.restart_policy = self.default_restart_policy(rtype)
+            self._set_default_port(spec)
+        rp = self.run_policy(job)
+        if rp.clean_pod_policy is None:
+            rp.clean_pod_policy = self.default_clean_pod_policy()
+
+    def _set_default_port(self, spec: ReplicaSpec) -> None:
+        for container in spec.template.spec.containers:
+            if container.name != self.default_container_name:
+                continue
+            if container.port_named(self.default_port_name) is None:
+                from kubedl_tpu.api.pod import ContainerPort
+
+                container.ports.append(
+                    ContainerPort(
+                        name=self.default_port_name, container_port=self.default_port
+                    )
+                )
+
+    # -- master role (ref controllers/tensorflow/util.go:23-30) ----------
+
+    def is_master_role(self, replicas, rtype: str, index: int) -> bool:
+        return rtype in self.master_types
+
+    # -- the general status machine --------------------------------------
+
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], status: JobStatus, restart: bool
+    ) -> None:
+        previous_restarting = is_restarting(status)
+        previous_failed = is_failed(status)
+
+        worker0_completed = False
+        if self.use_worker0_completed_heuristic() and self.engine is not None:
+            worker0_completed = self._worker0_completed(job)
+
+        if status.start_time is None:
+            status.start_time = now()
+
+        has_master = any(t in replicas for t in self.master_types)
+
+        for rtype, spec in replicas.items():
+            rs = status.replica_statuses.get(replica_key(rtype))
+            if rs is None:
+                continue
+            total = int(spec.replicas or 0)
+            expected = total - rs.succeeded
+            running = rs.active
+            failed = rs.failed
+
+            if has_master:
+                if rtype in self.master_types:
+                    if running > 0:
+                        update_job_conditions(
+                            status, JobConditionType.RUNNING, REASON_JOB_RUNNING,
+                            f"{self.kind} {job.metadata.name} is running.",
+                        )
+                    if expected == 0:
+                        self._mark_succeeded(job, status)
+            else:
+                if rtype == self.worker_type:
+                    min_finish = self._min_finish(job, total)
+                    if (expected == 0 or worker0_completed or rs.succeeded >= min_finish):
+                        self._mark_succeeded(job, status)
+                    elif running > 0:
+                        update_job_conditions(
+                            status, JobConditionType.RUNNING, REASON_JOB_RUNNING,
+                            f"{self.kind} {job.metadata.name} is running.",
+                        )
+
+            if failed > 0:
+                if restart:
+                    update_job_conditions(
+                        status, JobConditionType.RESTARTING, REASON_JOB_RESTARTING,
+                        f"{self.kind} {job.metadata.name} is restarting because "
+                        f"{failed} {rtype} replica(s) failed.",
+                    )
+                    if self.engine is not None and not previous_restarting:
+                        if self.engine.metrics:
+                            self.engine.metrics.failure_inc()
+                        if self.engine.recorder:
+                            self.engine.recorder.warning(
+                                job, REASON_JOB_RESTARTING,
+                                f"{self.kind} {job.metadata.name} is restarting.",
+                            )
+                else:
+                    if status.completion_time is None:
+                        status.completion_time = now()
+                    update_job_conditions(
+                        status, JobConditionType.FAILED, REASON_JOB_FAILED,
+                        f"{self.kind} {job.metadata.name} is failed because "
+                        f"{failed} {rtype} replica(s) failed.",
+                    )
+                    if self.engine is not None and not previous_failed:
+                        if self.engine.metrics:
+                            self.engine.metrics.failure_inc()
+                        if self.engine.recorder:
+                            self.engine.recorder.warning(
+                                job, REASON_JOB_FAILED,
+                                f"{self.kind} {job.metadata.name} failed: "
+                                f"{failed} {rtype} replica(s) failed.",
+                            )
+
+    def _min_finish(self, job, total_workers: int) -> int:
+        rp = self.run_policy(job)
+        if rp.success_policy is not None:
+            return rp.success_policy.min_finish(total_workers)
+        return total_workers
+
+    def _mark_succeeded(self, job, status: JobStatus) -> None:
+        previous_succeeded = is_succeeded(status)
+        if status.completion_time is None:
+            status.completion_time = now()
+        update_job_conditions(
+            status, JobConditionType.SUCCEEDED, REASON_JOB_SUCCEEDED,
+            f"{self.kind} {job.metadata.name} successfully completed.",
+        )
+        if self.engine is not None and not previous_succeeded:
+            if self.engine.metrics:
+                self.engine.metrics.success_inc()
+            if self.engine.recorder:
+                self.engine.recorder.normal(
+                    job, REASON_JOB_SUCCEEDED,
+                    f"{self.kind} {job.metadata.name} successfully completed.",
+                )
+
+    def _worker0_completed(self, job) -> bool:
+        """Ref controllers/tensorflow/status.go:62-101."""
+        pods = self.engine.get_pods_for_job(job)
+        for pod in utils.filter_pods_for_replica_type(pods, self.worker_type):
+            try:
+                index = int(pod.metadata.labels.get(LABEL_REPLICA_INDEX, "-1"))
+            except ValueError:
+                continue
+            if index != 0:
+                continue
+            exit_code = None
+            for cs in pod.status.container_statuses:
+                if cs.name == self.default_container_name and cs.terminated:
+                    exit_code = cs.terminated.exit_code
+                    break
+            return exit_code == 0 and pod.status.phase == PodPhase.SUCCEEDED
+        return False

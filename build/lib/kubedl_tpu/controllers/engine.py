@@ -1,0 +1,806 @@
+"""The shared job reconciler engine — one engine drives every workload.
+
+Re-derives the reference's generic runtime (ref pkg/job_controller/job.go:56-345,
+pod.go:212-442, service.go:188-295, expectations.go) as a single
+watch-driven reconcile engine over the native object store:
+
+  watch events -> expectation bookkeeping -> workqueue -> reconcile(key):
+    gang create -> code-sync inject -> list+claim pods/services ->
+    backoff/deadline checks -> terminal cleanup (CleanPodPolicy, TTL, gang
+    delete) OR per-replica-type pod/service diffing -> workload status
+    machine -> status write-back (optimistic, conflict-aware).
+
+Deliberate fixes over the reference, called out inline:
+  * services-per-replica is asked of the workload via
+    `needs_service_for_replica` instead of special-casing PyTorch
+    (ref job.go:223-227);
+  * expectations use increment semantics instead of set semantics so two
+    creates in one pass cannot cancel each other's bookkeeping.
+"""
+from __future__ import annotations
+
+import copy
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import (
+    CleanPodPolicy,
+    JobConditionType,
+    JobStatus,
+    LABEL_JOB_NAME,
+    LABEL_JOB_ROLE,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    JOB_ROLE_MASTER,
+    REASON_JOB_CREATED,
+    REASON_JOB_FAILED,
+    ReplicaSpec,
+    RestartPolicy,
+    initialize_replica_statuses,
+    is_created,
+    is_failed,
+    is_restarting,
+    is_running,
+    is_succeeded,
+    update_job_conditions,
+    update_job_replica_statuses,
+)
+from kubedl_tpu.api.meta import OwnerReference, now
+from kubedl_tpu.api.pod import (
+    ContainerPort,
+    Pod,
+    PodPhase,
+    PodRestartPolicy,
+    Service,
+    ServiceSpec,
+)
+from kubedl_tpu.controllers import utils
+from kubedl_tpu.controllers.interface import WorkloadController
+from kubedl_tpu.core import events as ev
+from kubedl_tpu.core.expectations import ControllerExpectations
+from kubedl_tpu.core.manager import ControllerRunner, Result
+from kubedl_tpu.core.store import (
+    ADDED,
+    DELETED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    read_fresh,
+    write_status,
+)
+from kubedl_tpu.utils.exit_codes import is_retryable_exit_code
+from kubedl_tpu.utils.joblog import job_logger
+
+log = logging.getLogger("kubedl_tpu.engine")
+
+EXIT_CODE_MAGIC = 0xBEEF  # "no terminated default container seen" sentinel
+
+# Failure-retry pacing (ref BackoffStatesQueue rate limiter defaults).
+BACKOFF_BASE_DELAY_S = 0.005
+BACKOFF_MAX_DELAY_S = 60.0
+
+
+@dataclass
+class EngineConfig:
+    enable_gang_scheduling: bool = False
+    cluster_domain: str = ""  # CUSTOM_CLUSTER_DOMAIN equivalent
+    # Pod-template mutation hooks applied after set_cluster_spec, e.g. the
+    # GKE TPU adapter (k8s/gke.py): fn(job, template, rt, index, spec)
+    pod_mutators: List = field(default_factory=list)
+
+
+def pods_expectation_key(job_key: str, rt: str) -> str:
+    return f"{job_key}/{rt.lower()}/pods"
+
+
+def services_expectation_key(job_key: str, rt: str) -> str:
+    return f"{job_key}/{rt.lower()}/services"
+
+
+class JobReconciler:
+    """One instance per workload kind, sharing a store/recorder/metrics."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        controller: WorkloadController,
+        recorder=None,
+        metrics=None,
+        gang_scheduler=None,
+        code_syncer=None,
+        config: Optional[EngineConfig] = None,
+    ) -> None:
+        self.store = store
+        self.controller = controller
+        self.recorder = recorder or ev.EventRecorder(store)
+        self.metrics = metrics
+        self.gang = gang_scheduler
+        self.code_syncer = code_syncer
+        self.config = config or EngineConfig()
+        self.expectations = ControllerExpectations()
+        self.runner: Optional[ControllerRunner] = None
+        # Dedicated failure-backoff states (ref job_controller.go:85-88
+        # BackoffStatesQueue) — counts only observed pod failures, never
+        # status-write conflicts, so conflict churn can't burn the
+        # backoff limit.
+        self._failure_backoff: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # Watch wiring (ref tfjob_controller.go:128-164 and pod.go:53-163)
+    # ------------------------------------------------------------------
+
+    def setup(self, runner: ControllerRunner) -> None:
+        self.runner = runner
+        runner.watch(self.controller.kind, self._on_job_event)
+        runner.watch("Pod", self._on_pod_event)
+        runner.watch("Service", self._on_service_event)
+
+    def _on_job_event(self, event) -> None:
+        job = event.obj
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        if event.type == DELETED:
+            self._failure_backoff.pop(key, None)
+            for rt in self.controller.replica_specs(job):
+                self.expectations.delete_expectations(pods_expectation_key(key, rt))
+                self.expectations.delete_expectations(services_expectation_key(key, rt))
+            if self.metrics:
+                self.metrics.deleted_inc()
+                self.metrics.observe_gone(key)
+            return
+        if event.type == ADDED and self.metrics and not job.status.conditions:
+            self.metrics.created_inc()
+        self.runner.enqueue(key)
+
+    def _resolve_owner_key(self, obj) -> Optional[str]:
+        ref = obj.metadata.controller_ref()
+        if ref is None or ref.kind != self.controller.kind:
+            return None
+        return f"{obj.metadata.namespace}/{ref.name}"
+
+    def _on_pod_event(self, event) -> None:
+        pod = event.obj
+        key = self._resolve_owner_key(pod)
+        if key is None:
+            return
+        rt = pod.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        if event.type == ADDED:
+            self.expectations.creation_observed(pods_expectation_key(key, rt))
+        elif event.type == DELETED:
+            self.expectations.deletion_observed(pods_expectation_key(key, rt))
+        self.runner.enqueue(key)
+
+    def _on_service_event(self, event) -> None:
+        svc = event.obj
+        key = self._resolve_owner_key(svc)
+        if key is None:
+            return
+        rt = svc.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        if event.type == ADDED:
+            self.expectations.creation_observed(services_expectation_key(key, rt))
+        elif event.type == DELETED:
+            self.expectations.deletion_observed(services_expectation_key(key, rt))
+        self.runner.enqueue(key)
+
+    # ------------------------------------------------------------------
+    # Reconcile entry (ref tfjob_controller.go:90-124 -> job.go:56-266)
+    # ------------------------------------------------------------------
+
+    def reconcile(self, key: str) -> Result:
+        namespace, name = key.split("/", 1)
+        try:
+            job = self.store.get(self.controller.kind, namespace, name)
+        except NotFound:
+            return Result()
+
+        self.controller.set_defaults(job)
+        replicas = self.controller.replica_specs(job)
+
+        if not self._satisfied_expectations(key, replicas):
+            return Result()
+
+        try:
+            return self._reconcile_job(job, replicas)
+        except Conflict:
+            return Result(requeue=True)
+
+    def _satisfied_expectations(self, key: str, replicas) -> bool:
+        return all(
+            self.expectations.satisfied(pods_expectation_key(key, rt))
+            and self.expectations.satisfied(services_expectation_key(key, rt))
+            for rt in replicas
+        )
+
+    # ------------------------------------------------------------------
+    # The master sync (ref job.go:56-266)
+    # ------------------------------------------------------------------
+
+    def _reconcile_job(self, job, replicas: Dict[str, ReplicaSpec]) -> Result:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        status: JobStatus = copy.deepcopy(self.controller.job_status(job))
+        old_status = copy.deepcopy(status)
+        run_policy = self.controller.run_policy(job)
+
+        if not status.conditions:
+            update_job_conditions(
+                status,
+                JobConditionType.CREATED,
+                REASON_JOB_CREATED,
+                f"{self.controller.kind} {job.metadata.name} is created.",
+            )
+
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.gang.create_gang(job, replicas)
+
+        if self.code_syncer is not None:
+            # a bad annotation must not wedge the reconcile loop
+            # (ref job.go:99-103 logs and continues on code-sync errors)
+            try:
+                self.code_syncer.inject(job, replicas)
+            except Exception as e:
+                self.recorder.warning(job, "FailedCodeSync", f"code-sync injection failed: {e}")
+
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+
+        previous_retry = self._failure_backoff.get(key, 0)
+        active_pods = utils.filter_active_pods(pods)
+        active = len(active_pods)
+        failed = utils.filter_pod_count(pods, PodPhase.FAILED)
+        total_replicas = utils.get_total_replicas(replicas)
+        prev_failed = utils.get_total_failed_replicas(status.replica_statuses)
+
+        job_exceeds_limit = False
+        failure_message = ""
+        job_has_new_failure = failed > prev_failed
+        if run_policy.backoff_limit is not None:
+            exceeds_backoff = (
+                job_has_new_failure
+                and active != total_replicas
+                and previous_retry + 1 > run_policy.backoff_limit
+            )
+            past_backoff = self._past_backoff_limit(run_policy, replicas, pods)
+            if exceeds_backoff or past_backoff:
+                job_exceeds_limit = True
+                failure_message = (
+                    f"Job {job.metadata.name} has failed because it has reached "
+                    f"the specified backoff limit"
+                )
+        if not job_exceeds_limit and self._past_active_deadline(run_policy, status):
+            job_exceeds_limit = True
+            failure_message = (
+                f"Job {job.metadata.name} has failed because it was active "
+                f"longer than specified deadline"
+            )
+            status.completion_time = status.completion_time or now()
+
+        if is_succeeded(status) or is_failed(status) or job_exceeds_limit:
+            return self._finalize_job(
+                job, replicas, status, old_status, run_policy, pods,
+                job_exceeds_limit, failure_message,
+            )
+
+        if self.controller.restart_whole_gang(job, replicas):
+            failed_retryable = self._gang_failed_retryable(replicas, pods)
+            if failed_retryable:
+                return self._restart_gang(
+                    job, replicas, status, old_status, pods, failed_retryable,
+                    previous_retry, job_has_new_failure,
+                )
+
+        restart = [False]
+        for rtype in self.controller.reconcile_orders():
+            rt_key = str(rtype.value)
+            spec = replicas.get(rt_key)
+            if spec is None:
+                continue
+            self._reconcile_pods(job, status, pods, rt_key, spec, replicas, restart)
+            # Generalized from the reference's PyTorch-only special case
+            # (ref job.go:223-227).
+            if self.controller.needs_service_for_replica(rt_key):
+                self._reconcile_services(job, services, rt_key, spec)
+
+        self.controller.update_job_status(job, replicas, status, restart[0])
+
+        if self.metrics:
+            if is_created(old_status) and is_running(status) and not is_running(old_status):
+                self.metrics.first_pod_launch_delay(job, active_pods, status)
+            if (
+                utils.get_total_active_replicas(status.replica_statuses) == total_replicas
+                and utils.get_total_active_replicas(old_status.replica_statuses) < total_replicas
+                and not is_restarting(old_status)
+            ):
+                self.metrics.all_pods_launch_delay(job, pods, status)
+            self.metrics.observe_status(key, status)
+
+        return self._write_status_and_pace_retry(
+            job, status, old_status, key, previous_retry, job_has_new_failure
+        )
+
+    def _write_status_and_pace_retry(
+        self, job, status, old_status, key: str,
+        previous_retry: int, job_has_new_failure: bool,
+    ) -> Result:
+        """Shared tail of the normal and gang-restart reconcile paths."""
+        if status != old_status:
+            self._write_status(job, status)
+        if job_has_new_failure:
+            # Count the failure and pace the retry exponentially; a
+            # status-write Conflict requeue deliberately does NOT reach
+            # this counter (it raises out of _write_status above).
+            self._failure_backoff[key] = previous_retry + 1
+            return Result(
+                requeue_after=min(
+                    BACKOFF_BASE_DELAY_S * (2 ** previous_retry), BACKOFF_MAX_DELAY_S
+                )
+            )
+        return Result()
+
+    # ------------------------------------------------------------------
+    # Slice gang restart (net-new; SURVEY.md §5 slice-level health)
+    # ------------------------------------------------------------------
+
+    def _gang_failed_retryable(self, replicas, pods: List[Pod]) -> List[Pod]:
+        """Failed pods whose replica policy is ExitCode with a retryable code.
+
+        Returns [] when ANY failure is permanent: a deterministic crash on
+        one rank tears down its peers with SIGTERM (retryable 143), and a
+        gang restart keyed on those peers would delete the evidence and
+        loop the slice forever — the normal per-pod path must instead leave
+        the permanently-failed pod in place so the job fails."""
+        retryable = []
+        for rt_key, spec in replicas.items():
+            if spec.restart_policy != RestartPolicy.EXIT_CODE:
+                continue
+            for pod in utils.filter_pods_for_replica_type(pods, rt_key):
+                if pod.status.phase != PodPhase.FAILED:
+                    continue
+                code = self._default_container_exit_code(pod)
+                if code != EXIT_CODE_MAGIC and is_retryable_exit_code(code):
+                    retryable.append(pod)
+                else:
+                    # Permanent code OR no observed exit code (eviction,
+                    # node loss): the per-pod path treats both as
+                    # non-retryable, so the gang path must stand aside too.
+                    return []
+        return retryable
+
+    def _restart_gang(
+        self, job, replicas, status, old_status, pods: List[Pod],
+        failed_pods: List[Pod], previous_retry: int, job_has_new_failure: bool,
+    ) -> Result:
+        """Delete EVERY non-succeeded pod so the slice re-forms atomically.
+
+        A TPU slice admits all-or-nothing and every rank blocks in
+        jax.distributed.initialize at startup — restarting only the failed
+        index (ref pod.go:296-304) would leave that rank hanging against
+        peers that are mid-run. One restart event, not one per pod."""
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        for pod in failed_pods:
+            self.recorder.normal(
+                job,
+                ev.REASON_EXIT_WITH_CODE,
+                f"Pod: {pod.metadata.namespace}.{pod.metadata.name} exited "
+                f"with code {self._default_container_exit_code(pod)}",
+            )
+        self.recorder.normal(
+            job,
+            "SliceRestarting",
+            f"Retryable failure in {len(failed_pods)} gang replica(s); "
+            f"restarting all replicas so the slice re-forms",
+        )
+        deleted = 0
+        for rt_key in replicas:
+            initialize_replica_statuses(status, [rt_key])
+            for pod in utils.filter_pods_for_replica_type(pods, rt_key):
+                update_job_replica_statuses(status, rt_key, pod)
+                if pod.status.phase != PodPhase.SUCCEEDED:
+                    self._delete_pod(job, pod)
+                    deleted += 1
+        job_logger(log, job).info(
+            "restarted whole gang (%d of %d pods deleted) after %d retryable failure(s)",
+            deleted, len(pods), len(failed_pods),
+        )
+        if self.metrics:
+            self.metrics.restarted_inc()
+        self.controller.update_job_status(job, replicas, status, True)
+        return self._write_status_and_pace_retry(
+            job, status, old_status, key, previous_retry, job_has_new_failure
+        )
+
+    # ------------------------------------------------------------------
+    # Terminal path (ref job.go:158-204, 321-345)
+    # ------------------------------------------------------------------
+
+    def _finalize_job(
+        self, job, replicas, status, old_status, run_policy, pods,
+        job_exceeds_limit: bool, failure_message: str,
+    ) -> Result:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        self._failure_backoff.pop(key, None)  # terminal: forget backoff state
+        self._delete_pods_and_services(run_policy, job, pods)
+
+        result = self._cleanup_job(run_policy, status, job)
+
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.recorder.normal(job, "JobTerminated", "Job has been terminated. Deleting gang")
+            self.gang.delete_gang(job)
+
+        if job_exceeds_limit:
+            self.recorder.normal(job, REASON_JOB_FAILED, failure_message)
+            if status.completion_time is None:
+                status.completion_time = now()
+            update_job_conditions(
+                status, JobConditionType.FAILED, REASON_JOB_FAILED, failure_message
+            )
+            if self.metrics:
+                self.metrics.failure_inc()
+
+        if is_succeeded(status):
+            for rs in status.replica_statuses.values():
+                rs.succeeded += rs.active
+                rs.active = 0
+
+        if self.metrics:
+            key = f"{job.metadata.namespace}/{job.metadata.name}"
+            self.metrics.observe_status(key, status)
+
+        if status != old_status:
+            self._write_status(job, status)
+        return result
+
+    def _delete_pods_and_services(self, run_policy, job, pods: List[Pod]) -> None:
+        """Ref job.go:29-52."""
+        if not pods:
+            return
+        policy = run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
+        if policy == CleanPodPolicy.NONE:
+            return
+        for pod in pods:
+            if policy == CleanPodPolicy.RUNNING and pod.status.phase != PodPhase.RUNNING:
+                continue
+            self._delete_pod(job, pod)
+            # Pod and service share a name (ref job.go:46-48).
+            try:
+                self.store.delete("Service", pod.metadata.namespace, pod.metadata.name)
+            except NotFound:
+                pass
+
+    def _cleanup_job(self, run_policy, status, job) -> Result:
+        """TTL-after-finished (ref job.go:321-345)."""
+        ttl = run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            return Result()
+        if status.completion_time is None:
+            raise RuntimeError(
+                f"cleanup job {job.metadata.name}: completion time not set"
+            )
+        delete_time = status.completion_time + ttl
+        current = now()
+        if current >= delete_time:
+            try:
+                self.store.delete(self.controller.kind, job.metadata.namespace, job.metadata.name)
+            except NotFound:
+                pass
+            return Result()
+        return Result(requeue_after=delete_time - current)
+
+    # ------------------------------------------------------------------
+    # Pod reconcile (ref pod.go:212-310)
+    # ------------------------------------------------------------------
+
+    def _reconcile_pods(
+        self, job, status: JobStatus, pods: List[Pod], rt: str,
+        spec: ReplicaSpec, replicas, restart,
+    ) -> None:
+        typed_pods = utils.filter_pods_for_replica_type(pods, rt)
+        num_replicas = int(spec.replicas or 0)
+        initialize_replica_statuses(status, [rt])
+
+        jlog = job_logger(log, job, rtype=rt)
+        slices = utils.get_pod_slices(typed_pods, num_replicas)
+        for index, pod_slice in enumerate(slices):
+            if len(pod_slice) > 1:
+                jlog.warning("too many pods for index %d", index)
+            elif not pod_slice:
+                master_role = self.controller.is_master_role(replicas, rt, index)
+                try:
+                    self._create_new_pod(job, rt, index, spec, master_role)
+                except AlreadyExists:
+                    # Terminating leftovers with the same name (ref pod.go:256-279):
+                    # repair expectations so the next reconcile isn't gated forever.
+                    key = f"{job.metadata.namespace}/{job.metadata.name}"
+                    self.expectations.creation_observed(pods_expectation_key(key, rt))
+                    self.expectations.creation_observed(services_expectation_key(key, rt))
+                    raise
+            else:
+                pod = pod_slice[0]
+                exit_code = self._default_container_exit_code(pod)
+                if exit_code != EXIT_CODE_MAGIC:
+                    self.recorder.normal(
+                        job,
+                        ev.REASON_EXIT_WITH_CODE,
+                        f"Pod: {pod.metadata.namespace}.{pod.metadata.name} "
+                        f"exited with code {exit_code}",
+                    )
+                if spec.restart_policy == RestartPolicy.EXIT_CODE:
+                    if pod.status.phase == PodPhase.FAILED and is_retryable_exit_code(exit_code):
+                        job_logger(log, job, rtype=rt, index=index, pod=pod.metadata.name).info(
+                            "restarting pod (exit %d)", exit_code
+                        )
+                        self._delete_pod(job, pod)
+                        restart[0] = True
+                        if self.metrics:
+                            self.metrics.restarted_inc()
+                update_job_replica_statuses(status, rt, pod)
+
+    def _create_new_pod(self, job, rt: str, index: int, spec: ReplicaSpec, master_role: bool) -> None:
+        """Ref pod.go:312-442."""
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        labels = utils.gen_labels(job.metadata.name)
+        labels[LABEL_REPLICA_TYPE] = rt.lower()
+        labels[LABEL_REPLICA_INDEX] = str(index)
+        if master_role:
+            labels[LABEL_JOB_ROLE] = JOB_ROLE_MASTER
+
+        template = copy.deepcopy(spec.template)
+        template.metadata.name = utils.gen_general_name(job.metadata.name, rt, index)
+        template.metadata.labels.update(labels)
+
+        self.controller.set_cluster_spec(job, template, rt, index)
+        for mutate in self.config.pod_mutators:
+            mutate(job, template, rt, index, spec)
+
+        if template.spec.restart_policy != PodRestartPolicy.NEVER:
+            self.recorder.warning(
+                job,
+                "SettedPodTemplateRestartPolicy",
+                "Restart policy in pod template will be overwritten by restart policy in replica spec",
+            )
+        # ExitCode is implemented by the controller (delete+recreate), so the
+        # pod-level policy maps to Never (ref pod.go:435-442).
+        if spec.restart_policy == RestartPolicy.EXIT_CODE or spec.restart_policy is None:
+            template.spec.restart_policy = PodRestartPolicy.NEVER
+        else:
+            template.spec.restart_policy = PodRestartPolicy(spec.restart_policy.value)
+
+        pod = Pod(metadata=copy.deepcopy(template.metadata), spec=copy.deepcopy(template.spec))
+        pod.metadata.namespace = job.metadata.namespace
+        pod.metadata.owner_references = [self._owner_ref(job)]
+
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.gang.bind_pod_to_gang(job, pod)
+
+        self.expectations.raise_expectations(pods_expectation_key(key, rt), 1, 0)
+        try:
+            self.store.create(pod)
+        except AlreadyExists:
+            self.recorder.warning(job, ev.REASON_FAILED_CREATE_POD, f"pod {pod.metadata.name} already exists")
+            raise
+        except Exception as e:
+            self.expectations.creation_observed(pods_expectation_key(key, rt))
+            self.recorder.warning(job, ev.REASON_FAILED_CREATE_POD, f"Error creating: {e}")
+            raise
+        self.recorder.normal(job, ev.REASON_SUCCESSFUL_CREATE_POD, f"Created pod: {pod.metadata.name}")
+
+    def _default_container_exit_code(self, pod: Pod) -> int:
+        """Exit code of the workload's default container, or EXIT_CODE_MAGIC
+        when no terminated state has been observed (ref pod.go:285-294)."""
+        for cs in pod.status.container_statuses:
+            if cs.name == self.controller.default_container_name and cs.terminated:
+                return cs.terminated.exit_code
+        return EXIT_CODE_MAGIC
+
+    def _delete_pod(self, job, pod: Pod) -> None:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        rt = pod.metadata.labels.get(LABEL_REPLICA_TYPE, "")
+        self.expectations.raise_expectations(pods_expectation_key(key, rt), 0, 1)
+        try:
+            self.store.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            self.expectations.deletion_observed(pods_expectation_key(key, rt))
+            return
+        except Exception as e:
+            self.expectations.deletion_observed(pods_expectation_key(key, rt))
+            self.recorder.warning(job, ev.REASON_FAILED_DELETE_POD, f"Error deleting: {e}")
+            raise
+        self.recorder.normal(job, ev.REASON_SUCCESSFUL_DELETE_POD, f"Deleted pod: {pod.metadata.name}")
+
+    # ------------------------------------------------------------------
+    # Service reconcile (ref service.go:188-295)
+    # ------------------------------------------------------------------
+
+    def _reconcile_services(self, job, services: List[Service], rt: str, spec: ReplicaSpec) -> None:
+        typed = [s for s in services if s.metadata.labels.get(LABEL_REPLICA_TYPE) == rt.lower()]
+        num_replicas = int(spec.replicas or 0)
+        slices: List[List[Service]] = [[] for _ in range(num_replicas)]
+        for svc in typed:
+            raw = svc.metadata.labels.get(LABEL_REPLICA_INDEX)
+            try:
+                index = int(raw) if raw is not None else -1
+            except ValueError:
+                index = -1
+            if 0 <= index < num_replicas:
+                slices[index].append(svc)
+        for index, svc_slice in enumerate(slices):
+            if len(svc_slice) > 1:
+                job_logger(log, job, rtype=rt).warning("too many services for index %d", index)
+            elif not svc_slice:
+                self._create_new_service(job, rt, index, spec)
+
+    def _get_port_from_job(self, spec: ReplicaSpec) -> int:
+        """Named port of the default container (ref service.go:221-234)."""
+        for container in spec.template.spec.containers:
+            if container.name == self.controller.default_container_name:
+                port = container.port_named(self.controller.default_port_name)
+                if port:
+                    return port
+        return self.controller.default_port
+
+    def _create_new_service(self, job, rt: str, index: int, spec: ReplicaSpec) -> None:
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        labels = utils.gen_labels(job.metadata.name)
+        labels[LABEL_REPLICA_TYPE] = rt.lower()
+        labels[LABEL_REPLICA_INDEX] = str(index)
+        port = self._get_port_from_job(spec)
+        svc = Service(
+            spec=ServiceSpec(
+                cluster_ip="None",
+                selector=dict(labels),
+                ports=[ContainerPort(name=self.controller.default_port_name, container_port=port)],
+            )
+        )
+        svc.metadata.name = utils.gen_general_name(job.metadata.name, rt, index)
+        svc.metadata.namespace = job.metadata.namespace
+        svc.metadata.labels = labels
+        svc.metadata.owner_references = [self._owner_ref(job)]
+
+        self.expectations.raise_expectations(services_expectation_key(key, rt), 1, 0)
+        try:
+            self.store.create(svc)
+        except AlreadyExists:
+            self.expectations.creation_observed(services_expectation_key(key, rt))
+            return
+        except Exception as e:
+            self.expectations.creation_observed(services_expectation_key(key, rt))
+            self.recorder.warning(job, ev.REASON_FAILED_CREATE_SERVICE, f"Error creating: {e}")
+            raise
+        self.recorder.normal(
+            job, ev.REASON_SUCCESSFUL_CREATE_SERVICE, f"Created service: {svc.metadata.name}"
+        )
+
+    # ------------------------------------------------------------------
+    # Listing + adoption (ref pod.go:166-186, service_ref_manager.go:48-110)
+    # ------------------------------------------------------------------
+
+    def _owner_ref(self, job) -> OwnerReference:
+        return OwnerReference(
+            api_version=self.controller.api_version,
+            kind=self.controller.kind,
+            name=job.metadata.name,
+            uid=job.metadata.uid,
+            controller=True,
+            block_owner_deletion=True,
+        )
+
+    def _selector_matches(self, job, obj) -> bool:
+        selector = utils.gen_labels(job.metadata.name)
+        return all(obj.metadata.labels.get(k) == v for k, v in selector.items())
+
+    def _can_adopt(self, job) -> bool:
+        """Uncached deletion-timestamp recheck before the first adoption
+        (ref pkg/job_controller/util.go:33-49 RecheckDeletionTimestamp):
+        adopting while the job is being deleted would resurrect orphans."""
+        try:
+            fresh = read_fresh(
+                self.store, self.controller.kind,
+                job.metadata.namespace, job.metadata.name,
+            )
+        except NotFound:
+            return False
+        return fresh.metadata.deletion_timestamp is None
+
+    def _claim(self, job, objs):
+        """Adopt matching orphans / release owned objects whose labels
+        drifted (ref pkg/job_controller/service_ref_manager.go:48-110
+        ClaimServices semantics, shared by the pod path)."""
+        claimed = []
+        can_adopt: Optional[bool] = None  # lazily checked, at most once
+        for obj in objs:
+            matches = self._selector_matches(job, obj)
+            ref = obj.metadata.controller_ref()
+            if ref is not None:
+                if ref.uid != job.metadata.uid:
+                    continue  # owned by someone else
+                if matches:
+                    claimed.append(obj)
+                    continue
+                # Owned but labels drifted: release so another controller
+                # (or nobody) can own it; ignore races — next pass retries.
+                obj.metadata.owner_references = [
+                    r for r in obj.metadata.owner_references
+                    if r.uid != job.metadata.uid
+                ]
+                try:
+                    self.store.update(obj)
+                except (Conflict, NotFound):
+                    pass
+                continue
+            if not matches or obj.metadata.deletion_timestamp is not None:
+                continue
+            if can_adopt is None:
+                can_adopt = self._can_adopt(job)
+            if not can_adopt:
+                continue
+            obj.metadata.owner_references.append(self._owner_ref(job))
+            try:
+                self.store.update(obj)
+                claimed.append(obj)
+            except (Conflict, NotFound):
+                pass
+        return claimed
+
+    def get_pods_for_job(self, job) -> List[Pod]:
+        # List the whole namespace (not just selector matches) so owned
+        # objects whose labels drifted are seen and released.
+        pods = self.store.list("Pod", namespace=job.metadata.namespace)
+        return self._claim(job, pods)
+
+    def get_services_for_job(self, job) -> List[Service]:
+        services = self.store.list("Service", namespace=job.metadata.namespace)
+        return self._claim(job, services)
+
+    # ------------------------------------------------------------------
+    # Limits (ref job.go:269-319)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _past_active_deadline(run_policy, status: JobStatus) -> bool:
+        if run_policy.active_deadline_seconds is None or status.start_time is None:
+            return False
+        return now() - status.start_time >= run_policy.active_deadline_seconds
+
+    @staticmethod
+    def _past_backoff_limit(run_policy, replicas, pods: List[Pod]) -> bool:
+        """Sum restart counts of Running pods for OnFailure/Always replicas."""
+        if run_policy.backoff_limit is None:
+            return False
+        total = 0
+        for rt, spec in replicas.items():
+            if spec.restart_policy not in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS):
+                continue
+            for pod in utils.filter_pods_for_replica_type(pods, rt):
+                if pod.status.phase != PodPhase.RUNNING:
+                    continue
+                total += sum(cs.restart_count for cs in pod.status.container_statuses)
+        if run_policy.backoff_limit == 0:
+            return total > 0
+        return total >= run_policy.backoff_limit
+
+    # ------------------------------------------------------------------
+    # Status write-back (ref UpdateJobStatusInApiServer impls)
+    # ------------------------------------------------------------------
+
+    def _write_status(self, job, status: JobStatus) -> None:
+        status.last_reconcile_time = now()
+        for _ in range(3):
+            try:
+                # uncached read: a cache-stale resourceVersion would make
+                # every attempt Conflict and burn the retry budget
+                fresh = read_fresh(
+                    self.store, self.controller.kind,
+                    job.metadata.namespace, job.metadata.name,
+                )
+            except NotFound:
+                return
+            fresh.status = copy.deepcopy(status)
+            try:
+                # /status subresource write — a main-path update would be
+                # silently dropped by a real apiserver (CRDs declare
+                # subresources.status; ref tensorflow/job.go:95-104)
+                write_status(self.store, fresh)
+                return
+            except Conflict:
+                continue
+        raise Conflict(f"status write for {job.metadata.name} kept conflicting")

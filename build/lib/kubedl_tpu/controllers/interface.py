@@ -1,0 +1,107 @@
+"""WorkloadController — the plugin contract every workload implements.
+
+Re-derives the reference's 21-method ControllerInterface
+(ref pkg/job_controller/api/v1/interface.go:10-76) in idiomatic Python, with
+one deliberate fix: the generic engine's hard-coded "services only for
+PyTorch Master" special case (ref pkg/job_controller/job.go:223-227) becomes
+`needs_service_for_replica(rtype)` so the layering stays clean
+(SURVEY.md §1 "layering wart to not reproduce").
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict, List
+
+from kubedl_tpu.api.common import JobStatus, ReplicaSpec, ReplicaType
+
+
+class WorkloadController(abc.ABC):
+    """Identity + typed hooks for one workload kind."""
+
+    # -- identity (ref interface.go ControllerName/GetAPIGroupVersionKind) --
+
+    @property
+    @abc.abstractmethod
+    def kind(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def api_version(self) -> str: ...
+
+    @property
+    def controller_name(self) -> str:
+        return f"{self.kind.lower()}-controller"
+
+    # -- job shape --------------------------------------------------------
+
+    @abc.abstractmethod
+    def job_type(self) -> type:
+        """The job dataclass (used to deserialize manifests)."""
+
+    @abc.abstractmethod
+    def replica_specs(self, job) -> Dict[str, ReplicaSpec]: ...
+
+    def run_policy(self, job):
+        return job.spec.run_policy
+
+    def job_status(self, job) -> JobStatus:
+        return job.status
+
+    @abc.abstractmethod
+    def set_defaults(self, job) -> None:
+        """Fill defaulted fields in-place (ref api/*/defaults.go)."""
+
+    # -- cluster spec (the rendezvous wiring) -----------------------------
+
+    @abc.abstractmethod
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        """Inject the distributed-bootstrap env into a pod template.
+
+        This is where TF_CONFIG / MASTER_ADDR / TASK_NAME / JAX coordinator
+        env used to live per framework; TPU-native controllers share the
+        coordinator-service wiring from controllers/tpu_env.py.
+        """
+
+    # -- defaults ---------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def default_container_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def default_port_name(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def default_port(self) -> int: ...
+
+    # -- reconcile shape --------------------------------------------------
+
+    @abc.abstractmethod
+    def reconcile_orders(self) -> List[ReplicaType]: ...
+
+    def is_master_role(self, replicas: Dict[str, ReplicaSpec], rtype: str, index: int) -> bool:
+        return False
+
+    def needs_service_for_replica(self, rtype: str) -> bool:
+        """Whether replicas of `rtype` get a headless Service (per-replica DNS)."""
+        return True
+
+    def restart_whole_gang(self, job, replicas: Dict[str, ReplicaSpec]) -> bool:
+        """Whether a retryable replica failure restarts ALL replicas.
+
+        TPU-slice semantics (SURVEY.md §5 slice-level health): a lone
+        restarted rank can never rejoin a running JAX coordination-service
+        barrier, and a slice readmits atomically — so gang-rendezvous
+        workloads restart as a unit. Default False keeps the reference's
+        per-pod delete+recreate (ref pod.go:296-304)."""
+        return False
+
+    # -- status machine ---------------------------------------------------
+
+    @abc.abstractmethod
+    def update_job_status(
+        self, job, replicas: Dict[str, ReplicaSpec], status: JobStatus, restart: bool
+    ) -> None:
+        """Workload-specific success/failure rules; mutates `status`."""

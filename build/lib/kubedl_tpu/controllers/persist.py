@@ -1,0 +1,189 @@
+"""Persistence mirror controllers — job / pod / event history.
+
+Ref controllers/persist/: watch-only controllers that mirror live objects
+into external stores, enabled by `--object-storage` / `--event-storage`
+flags plus the REGION env (persist_controller.go:30-74). Request keys carry
+the UID so a deleted object can still be closed out in the backend
+(persist/util/request.go). Here each controller is an ordinary
+ControllerRunner on the shared manager; the "external store" is any
+registered storage backend (sqlite by default).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from kubedl_tpu.core.manager import Result
+from kubedl_tpu.core.store import NotFound
+from kubedl_tpu.storage.converters import NoDependentOwner, NoReplicaTypeLabel
+from kubedl_tpu.storage.interface import EventStorageBackend, ObjectStorageBackend
+
+log = logging.getLogger("kubedl_tpu.persist")
+
+
+def _key(obj) -> str:
+    return f"{obj.metadata.namespace}/{obj.metadata.name}/{obj.metadata.uid}"
+
+
+def _parse(key: str):
+    ns, name, uid = key.split("/", 2)
+    return ns, name, uid
+
+
+class JobPersistController:
+    """Mirror one job kind into the object backend
+    (ref controllers/persist/object/job/job_persist_controller.go:46-93)."""
+
+    def __init__(self, controller, backend: ObjectStorageBackend, store, region: str = "") -> None:
+        self.controller = controller
+        self.backend = backend
+        self.store = store
+        self.region = region
+        self.runner = None
+
+    def setup(self, runner) -> None:
+        self.runner = runner
+        runner.watch(self.controller.kind, self._on_event)
+
+    def _on_event(self, event) -> None:
+        self.runner.enqueue(_key(event.obj))
+
+    def reconcile(self, key: str) -> Result:
+        ns, name, uid = _parse(key)
+        kind = self.controller.kind
+        try:
+            job = self.store.get(kind, ns, name)
+            if job.metadata.uid != uid:
+                raise NotFound(key)  # name reused by a newer job — old one is gone
+        except NotFound:
+            # live object gone: close out and soft-delete the record
+            self.backend.stop_job(ns, name, uid, self.region)
+            self.backend.delete_job(ns, name, uid, self.region)
+            return Result()
+        self.backend.save_job(
+            job,
+            kind,
+            self.controller.replica_specs(job),
+            self.controller.job_status(job),
+            self.region,
+        )
+        return Result()
+
+
+class PodPersistController:
+    """Mirror replica pods, resolving owner kind -> default container
+    (ref controllers/persist/object/pod/pod_persist_controller.go:81-140)."""
+
+    def __init__(
+        self,
+        backend: ObjectStorageBackend,
+        store,
+        container_by_kind: Dict[str, str],
+        region: str = "",
+    ) -> None:
+        self.backend = backend
+        self.store = store
+        self.container_by_kind = container_by_kind
+        self.region = region
+        self.runner = None
+
+    def setup(self, runner) -> None:
+        self.runner = runner
+        runner.watch("Pod", self._on_event)
+
+    def _on_event(self, event) -> None:
+        self.runner.enqueue(_key(event.obj))
+
+    def reconcile(self, key: str) -> Result:
+        ns, name, uid = _parse(key)
+        try:
+            pod = self.store.get("Pod", ns, name)
+            if pod.metadata.uid != uid:
+                raise NotFound(key)  # name reused (ExitCode restart recreates pods)
+        except NotFound:
+            self.backend.stop_pod(ns, name, uid)
+            return Result()
+        ref = pod.metadata.controller_ref()
+        if ref is None:
+            return Result()  # not a managed replica pod
+        container = self.container_by_kind.get(ref.kind)
+        if container is None:
+            return Result()  # owned by something we don't manage
+        try:
+            self.backend.save_pod(pod, container, self.region)
+        except (NoDependentOwner, NoReplicaTypeLabel):
+            pass  # label drift — skip rather than poison the queue
+        return Result()
+
+
+class EventPersistController:
+    """Mirror Events for managed objects only
+    (ref controllers/persist/event/events_event_handler.go:42-108)."""
+
+    def __init__(
+        self,
+        backend: EventStorageBackend,
+        store,
+        managed_kinds,
+        region: str = "",
+    ) -> None:
+        self.backend = backend
+        self.store = store
+        self.managed_kinds = set(managed_kinds) | {"Pod", "Service"}
+        self.region = region
+        self.runner = None
+
+    def setup(self, runner) -> None:
+        self.runner = runner
+        runner.watch("Event", self._on_event)
+
+    def _on_event(self, event) -> None:
+        if event.obj.involved_object.kind in self.managed_kinds:
+            self.runner.enqueue(_key(event.obj))
+
+    def reconcile(self, key: str) -> Result:
+        ns, name, _uid = _parse(key)
+        try:
+            ev = self.store.get("Event", ns, name)
+        except NotFound:
+            return Result()
+        self.backend.save_event(ev, self.region)
+        return Result()
+
+
+def setup_persist_controllers(
+    manager,
+    store,
+    workload_controllers: Dict[str, object],
+    object_backend: Optional[ObjectStorageBackend] = None,
+    event_backend: Optional[EventStorageBackend] = None,
+    region: str = "",
+) -> list:
+    """Wire persist controllers onto the manager (ref persist_controller.go:42-74).
+
+    `workload_controllers` maps kind -> WorkloadController for the enabled
+    workloads; job persistence fans out one controller per kind, exactly like
+    the reference's per-kind persist controllers.
+    """
+    created = []
+    if object_backend is not None:
+        for kind, wc in workload_controllers.items():
+            jpc = JobPersistController(wc, object_backend, store, region)
+            runner = manager.add_controller(f"{kind.lower()}-persist", jpc.reconcile)
+            jpc.setup(runner)
+            created.append(jpc)
+        containers = {
+            kind: wc.default_container_name for kind, wc in workload_controllers.items()
+        }
+        ppc = PodPersistController(object_backend, store, containers, region)
+        runner = manager.add_controller("pod-persist", ppc.reconcile)
+        ppc.setup(runner)
+        created.append(ppc)
+    if event_backend is not None:
+        epc = EventPersistController(
+            event_backend, store, workload_controllers.keys(), region
+        )
+        runner = manager.add_controller("event-persist", epc.reconcile)
+        epc.setup(runner)
+        created.append(epc)
+    return created

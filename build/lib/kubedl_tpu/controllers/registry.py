@@ -1,0 +1,43 @@
+"""Workload controller registry (ref controllers/controllers.go:31-47 +
+per-workload add_*.go init() registration), gated per deploy by the
+workload-gate expression."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from kubedl_tpu.utils.workload_gate import effective_expr, is_workload_enabled
+
+# name -> controller factory; populated below as workloads are implemented.
+_FACTORIES: dict = {}
+
+
+def register_workload(name: str, factory: Callable) -> None:
+    _FACTORIES[name] = factory
+
+
+def enabled_controllers(expr: str = "*", discover: Optional[Callable] = None) -> List:
+    """Controllers passing the gate expression; with `discover` (a
+    kind -> bool probe, e.g. KubeObjectStore.has_kind) and expr "auto",
+    only kinds whose CRD the API server serves are enabled — the
+    reference's discovery-API behavior (ref workload_gate.go:26-107)."""
+    auto = effective_expr(expr) in ("", "auto")
+    out = []
+    for name in sorted(_FACTORIES):
+        if not is_workload_enabled(name, expr):
+            continue
+        ctrl = _FACTORIES[name]()
+        if auto and discover is not None and not discover(ctrl.kind):
+            continue
+        out.append(ctrl)
+    return out
+
+
+def _populate() -> None:
+    # Imported lazily so api/controller modules stay import-cycle free.
+    try:
+        from kubedl_tpu.workloads import tensorflow, pytorch, xgboost, xdl, jaxjob  # noqa: F401
+    except ImportError:
+        pass
+
+
+_populate()

@@ -1,0 +1,74 @@
+"""Shared reconciler helpers (ref pkg/util/k8sutil/k8sutil.go:96-160,
+pkg/job_controller/pod.go:166-208)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import (
+    LABEL_GROUP_NAME,
+    LABEL_JOB_NAME,
+    LABEL_REPLICA_INDEX,
+    LABEL_REPLICA_TYPE,
+    GROUP_NAME,
+    ReplicaSpec,
+)
+from kubedl_tpu.api.pod import Pod, PodPhase
+
+
+def gen_general_name(job_name: str, rt: str, index) -> str:
+    return f"{job_name}-{rt.lower()}-{index}"
+
+
+def gen_labels(job_name: str) -> Dict[str, str]:
+    """Ref job_controller.go:128-136 — '/' in names replaced with '-'."""
+    return {
+        LABEL_GROUP_NAME: GROUP_NAME,
+        LABEL_JOB_NAME: job_name.replace("/", "-"),
+    }
+
+
+def filter_pods_for_replica_type(pods: List[Pod], rt: str) -> List[Pod]:
+    rt = rt.lower()
+    return [p for p in pods if p.metadata.labels.get(LABEL_REPLICA_TYPE) == rt]
+
+
+def get_pod_slices(pods: List[Pod], replicas: int) -> List[List[Pod]]:
+    """Bucket pods by their replica-index label (ref pod.go:189-208)."""
+    slices: List[List[Pod]] = [[] for _ in range(replicas)]
+    for pod in pods:
+        raw = pod.metadata.labels.get(LABEL_REPLICA_INDEX)
+        if raw is None:
+            continue
+        try:
+            index = int(raw)
+        except ValueError:
+            continue
+        if 0 <= index < replicas:
+            slices[index].append(pod)
+    return slices
+
+
+def filter_active_pods(pods: List[Pod]) -> List[Pod]:
+    """Active = not Succeeded/Failed and not being deleted (ref k8sutil.go:96-109)."""
+    return [
+        p
+        for p in pods
+        if p.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        and p.metadata.deletion_timestamp is None
+    ]
+
+
+def filter_pod_count(pods: List[Pod], phase: PodPhase) -> int:
+    return sum(1 for p in pods if p.status.phase == phase)
+
+
+def get_total_replicas(replicas: Dict[str, ReplicaSpec]) -> int:
+    return sum(int(spec.replicas or 0) for spec in replicas.values())
+
+
+def get_total_failed_replicas(replica_statuses) -> int:
+    return sum(rs.failed for rs in replica_statuses.values())
+
+
+def get_total_active_replicas(replica_statuses) -> int:
+    return sum(rs.active for rs in replica_statuses.values())

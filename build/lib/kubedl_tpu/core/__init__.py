@@ -1,0 +1,11 @@
+from kubedl_tpu.core.store import (  # noqa: F401
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    ObjectStore,
+    WatchEvent,
+)
+from kubedl_tpu.core.manager import Manager, Result  # noqa: F401

@@ -1,0 +1,117 @@
+"""Event recorder — cluster Events as first-class store objects.
+
+The reference emits k8s Events on every pod/service create/delete and job
+transition (ref pkg/job_controller/pod_control.go:34-47 reasons;
+controllers/tensorflow/status.go:139,183). Events here are ordinary store
+objects (kind "Event") so they flow through the same watch machinery the
+event-persistence controller consumes (ref controllers/persist/event/).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubedl_tpu.api.meta import ObjectMeta, now
+
+EVENT_TYPE_NORMAL = "Normal"
+EVENT_TYPE_WARNING = "Warning"
+
+# Event reasons (ref pod_control.go:34-47, job.go:24-27).
+REASON_SUCCESSFUL_CREATE_POD = "SuccessfulCreatePod"
+REASON_FAILED_CREATE_POD = "FailedCreatePod"
+REASON_SUCCESSFUL_DELETE_POD = "SuccessfulDeletePod"
+REASON_FAILED_DELETE_POD = "FailedDeletePod"
+REASON_SUCCESSFUL_CREATE_SERVICE = "SuccessfulCreateService"
+REASON_FAILED_CREATE_SERVICE = "FailedCreateService"
+REASON_SUCCESSFUL_DELETE_SERVICE = "SuccessfulDeleteService"
+REASON_FAILED_DELETE_SERVICE = "FailedDeleteService"
+REASON_JOB_FAILED = "JobFailed"
+REASON_JOB_RESTARTING = "JobRestarting"
+REASON_EXIT_WITH_CODE = "ExitedWithCode"
+
+
+@dataclass
+class ObjectReference:
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = EVENT_TYPE_NORMAL
+    count: int = 1
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None
+    kind: str = "Event"
+
+
+class EventRecorder:
+    """Writes (and de-dups by involved-object+reason) Events into the store."""
+
+    def __init__(self, store) -> None:
+        self._store = store
+        self._lock = threading.Lock()
+        self._seq = 0
+        # correlator cache: (ns, name, kind, reason, message) -> event name.
+        # Like client-go's EventCorrelator this is per-recorder in-memory
+        # state — it turns the repeat-coalesce path into one GET+PUT instead
+        # of an O(events) namespace LIST per emitted event (which would be a
+        # full HTTP round-trip against the kube-apiserver store). Bounded
+        # FIFO (dict preserves insertion order): eviction only costs a
+        # missed coalesce, never correctness.
+        self._names: dict = {}
+        self._names_cap = 4096
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        ref = ObjectReference(
+            kind=getattr(obj, "kind", ""),
+            namespace=obj.metadata.namespace,
+            name=obj.metadata.name,
+            uid=obj.metadata.uid,
+        )
+        ts = now()
+        key = (ref.namespace, ref.name, ref.kind, reason, message)
+        with self._lock:
+            cached_name = self._names.get(key)
+            self._seq += 1
+            name = f"{ref.name}.{self._seq:08x}"
+        if cached_name is not None:
+            # coalesce repeats, like the k8s event correlator
+            try:
+                ev = self._store.get("Event", ref.namespace, cached_name)
+                ev.count += 1
+                ev.last_timestamp = ts
+                self._store.update(ev)
+                return
+            except Exception:
+                pass  # event expired/conflicted: fall through to a new one
+        ev = Event(
+            metadata=ObjectMeta(name=name, namespace=ref.namespace),
+            involved_object=ref,
+            reason=reason,
+            message=message,
+            type=etype,
+            first_timestamp=ts,
+            last_timestamp=ts,
+        )
+        try:
+            self._store.create(ev)
+            with self._lock:
+                while len(self._names) >= self._names_cap:
+                    self._names.pop(next(iter(self._names)))
+                self._names[key] = name
+        except Exception:
+            pass
+
+    def normal(self, obj, reason: str, message: str) -> None:
+        self.event(obj, EVENT_TYPE_NORMAL, reason, message)
+
+    def warning(self, obj, reason: str, message: str) -> None:
+        self.event(obj, EVENT_TYPE_WARNING, reason, message)

@@ -1,0 +1,86 @@
+"""Controller expectations — the duplicate-creation gate.
+
+Re-derives k8s ControllerExpectations as used by the reference
+(ref pkg/job_controller/expectations.go:11-27 SatisfyExpectations,
+pkg/job_controller/util.go:51-57 key scheme): a reconcile is skipped until
+the watch stream has observed every create/delete the previous reconcile
+issued, preventing duplicate pod storms when the cache lags the writes.
+Expectations expire after a TTL so a lost watch event cannot wedge a job.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict
+
+EXPECTATION_TTL_SECONDS = 5 * 60.0
+
+
+def pods_key(job_key: str) -> str:
+    return f"{job_key}/pods"
+
+
+def services_key(job_key: str) -> str:
+    return f"{job_key}/services"
+
+
+@dataclass
+class _Entry:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = 0.0
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.monotonic() - self.timestamp > EXPECTATION_TTL_SECONDS
+
+
+class ControllerExpectations:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._set(key, adds=count, dels=0)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._set(key, adds=0, dels=count)
+
+    def _set(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            self._entries[key] = _Entry(adds=adds, dels=dels, timestamp=time.monotonic())
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = _Entry(timestamp=time.monotonic())
+            e.adds += adds
+            e.dels += dels
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, adds=1, dels=0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, adds=0, dels=1)
+
+    def _lower(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                e.adds -= adds
+                e.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return True
+            return e.fulfilled() or e.expired()
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)

@@ -1,0 +1,88 @@
+"""Leader election — single-active-operator HA.
+
+The reference enables controller-runtime leader election by default
+(`--enable-leader-election`, main.go:56,70-75): replicas of the operator
+race for a lease; only the leader reconciles, standbys block until it dies.
+This is the same contract for our process model: an exclusive flock on a
+lease file (on shared storage for multi-node HA, or local disk for
+single-node restarts). flock is released by the OS on process death, so a
+crashed leader hands over without a TTL protocol.
+"""
+from __future__ import annotations
+
+import fcntl
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+DEFAULT_LEASE_PATH = "/tmp/kubedl-tpu-leader.lock"
+
+
+class FileLeaseElector:
+    def __init__(
+        self,
+        lease_path: str = DEFAULT_LEASE_PATH,
+        identity: Optional[str] = None,
+        retry_period: float = 0.2,
+    ) -> None:
+        self.lease_path = lease_path
+        self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
+        self.retry_period = retry_period
+        self._fd: Optional[int] = None
+        self._lock = threading.Lock()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._fd is not None
+
+    def try_acquire(self) -> bool:
+        """One non-blocking acquisition attempt."""
+        with self._lock:
+            if self._fd is not None:
+                return True
+            fd = os.open(self.lease_path, os.O_CREAT | os.O_RDWR, 0o644)
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                os.close(fd)
+                return False
+            os.ftruncate(fd, 0)
+            os.write(fd, self.identity.encode())
+            self._fd = fd
+            return True
+
+    def acquire(
+        self,
+        timeout: Optional[float] = None,
+        stop: Optional[Callable[[], bool]] = None,
+    ) -> bool:
+        """Block (standby) until leadership is acquired, `timeout` elapses,
+        or `stop()` turns true."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.try_acquire():
+                return True
+            if stop is not None and stop():
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(self.retry_period)
+
+    def release(self) -> None:
+        with self._lock:
+            if self._fd is None:
+                return
+            try:
+                fcntl.flock(self._fd, fcntl.LOCK_UN)
+            finally:
+                os.close(self._fd)
+                self._fd = None
+
+    def holder(self) -> str:
+        """Best-effort identity of the current leader (for diagnostics)."""
+        try:
+            with open(self.lease_path) as f:
+                return f.read().strip()
+        except OSError:
+            return ""

@@ -1,0 +1,163 @@
+"""Controller manager — controller-runtime's Manager, natively.
+
+Mirrors the reference's manager bootstrap (ref main.go:70-111): controllers
+register watches + a reconcile function; the manager pumps store watch events
+through each controller's event handlers (which maintain expectations and
+enqueue keys), and runs worker threads that pull keys and call reconcile.
+`--max-reconciles` equivalent is `workers` per controller (ref main.go:59).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from kubedl_tpu.core.store import ObjectStore, WatchEvent
+from kubedl_tpu.core.workqueue import RateLimitingQueue
+
+log = logging.getLogger("kubedl_tpu.manager")
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: Optional[float] = None
+
+
+# handler(event) -> None; may enqueue keys on its controller's queue
+EventHandler = Callable[[WatchEvent], None]
+ReconcileFn = Callable[[str], Result]
+
+
+class ControllerRunner:
+    def __init__(self, name: str, reconcile: ReconcileFn, workers: int = 1) -> None:
+        self.name = name
+        self.reconcile = reconcile
+        self.workers = workers
+        self.queue = RateLimitingQueue()
+        # kind -> handlers interested in that kind's events
+        self.handlers: Dict[str, List[EventHandler]] = {}
+
+    def watch(self, kind: str, handler: EventHandler) -> None:
+        self.handlers.setdefault(kind, []).append(handler)
+
+    def enqueue(self, key: str) -> None:
+        self.queue.add(key)
+
+    def enqueue_after(self, key: str, delay: float) -> None:
+        self.queue.add_after(key, delay)
+
+
+class Manager:
+    def __init__(self, store: Optional[ObjectStore] = None, runtime_metrics=None) -> None:
+        self.store = store or ObjectStore()
+        # RuntimeMetrics sink (metrics/runtime_metrics.py); None disables
+        self.runtime_metrics = runtime_metrics
+        self._controllers: List[ControllerRunner] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._started = False
+
+    def add_controller(
+        self, name: str, reconcile: ReconcileFn, workers: int = 1
+    ) -> ControllerRunner:
+        c = ControllerRunner(name, reconcile, workers)
+        self._controllers.append(c)
+        if self.runtime_metrics is not None:
+            self.runtime_metrics.register_queue(name, c.queue.__len__)
+        return c
+
+    # -- run loop --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        kinds = sorted({k for c in self._controllers for k in c.handlers})
+        watch = self.store.watch(kinds or None)
+
+        def dispatch() -> None:
+            while not self._stop.is_set():
+                ev = watch.next(timeout=0.1)
+                if ev is None:
+                    continue
+                for c in self._controllers:
+                    for h in c.handlers.get(ev.kind, []):
+                        try:
+                            h(ev)
+                        except Exception:
+                            log.error(
+                                "handler error in %s: %s", c.name, traceback.format_exc()
+                            )
+
+        t = threading.Thread(target=dispatch, name="manager-dispatch", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+        for c in self._controllers:
+            for i in range(c.workers):
+                t = threading.Thread(
+                    target=self._worker, args=(c,), name=f"{c.name}-worker-{i}", daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self, c: ControllerRunner) -> None:
+        import time
+
+        rm = self.runtime_metrics
+        while not self._stop.is_set():
+            key = c.queue.get(timeout=0.1)
+            if key is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                result = c.reconcile(key)
+            except Exception:
+                log.error("reconcile %s %s failed: %s", c.name, key, traceback.format_exc())
+                if rm is not None:
+                    rm.observe_reconcile(c.name, time.perf_counter() - t0, error=True)
+                    rm.observe_requeue(c.name)
+                c.queue.add_rate_limited(key)
+                c.queue.done(key)
+                continue
+            if rm is not None:
+                rm.observe_reconcile(c.name, time.perf_counter() - t0)
+            if result is not None and result.requeue_after is not None:
+                c.queue.add_after(key, result.requeue_after)
+            elif result is not None and result.requeue:
+                if rm is not None:
+                    rm.observe_requeue(c.name)
+                c.queue.add_rate_limited(key)
+            else:
+                c.queue.forget(key)
+            c.queue.done(key)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for c in self._controllers:
+            c.queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -- test/CLI convenience -------------------------------------------
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
+        """Block until all queues are empty and stay empty for `settle` s."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        quiet_since = None
+        while time.monotonic() < deadline:
+            busy = any(len(c.queue) or c.queue._processing for c in self._controllers)
+            if busy:
+                quiet_since = None
+            else:
+                if quiet_since is None:
+                    quiet_since = time.monotonic()
+                elif time.monotonic() - quiet_since >= settle:
+                    return True
+            time.sleep(0.01)
+        return False

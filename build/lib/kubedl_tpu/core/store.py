@@ -1,0 +1,266 @@
+"""In-process object store — the framework's etcd + API-server equivalent.
+
+The reference delegates object storage/watch to the Kubernetes API server
+(SURVEY.md §1 L0). This framework is standalone, so the store provides the
+same contract natively: namespaced typed objects, optimistic concurrency via
+resourceVersion, label-selector lists, and watch streams that drive
+controllers. Deep copies cross the boundary in both directions, so cached
+mutation bugs (a classic controller-runtime hazard) cannot leak between
+clients — the same isolation the API server's serialization gives Go clients.
+"""
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from kubedl_tpu.api.meta import new_uid, now
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    pass
+
+
+class AlreadyExists(StoreError):
+    pass
+
+
+class Conflict(StoreError):
+    """resourceVersion mismatch — caller must re-read and retry."""
+
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str = ADDED
+    kind: str = ""
+    obj: Any = None
+
+
+def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _has_status_subresource(obj) -> bool:
+    """The flag lives on the API type itself (Pod.STATUS_SUBRESOURCE,
+    BaseJob.STATUS_SUBRESOURCE, ...) so the store's semantics don't depend
+    on which resource registries happen to be populated in this process."""
+    return bool(getattr(type(obj), "STATUS_SUBRESOURCE", False))
+
+
+def read_fresh(store, kind: str, namespace: str, name: str):
+    """Uncached read — bypasses a store's informer cache when it has one
+    (KubeObjectStore.get_fresh); falls back to plain get, which is already
+    authoritative for the in-memory store."""
+    fn = getattr(store, "get_fresh", None)
+    return fn(kind, namespace, name) if fn is not None else store.get(kind, namespace, name)
+
+
+def write_status(store, obj):
+    """Route a status write through the store's /status surface.
+
+    `update_status` is part of the store contract (both ObjectStore and
+    KubeObjectStore implement it); stores predating the contract fall back
+    to a main-path update, which is exactly right for them — a store
+    without the subresource split doesn't drop main-path status."""
+    fn = getattr(store, "update_status", None)
+    return fn(obj) if fn is not None else store.update(obj)
+
+
+class ObjectStore:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # kind -> "ns/name" -> object
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        self._rv = 0
+        self._watchers: List["Watch"] = []
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _emit(self, etype: str, kind: str, obj) -> None:
+        ev = WatchEvent(type=etype, kind=kind, obj=obj)
+        for w in list(self._watchers):
+            w._offer(ev)
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, obj):
+        kind = obj.kind
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            if _has_status_subresource(obj) and hasattr(obj, "status"):
+                # status is reset on create for subresource kinds, exactly
+                # like an apiserver with `subresources: status: {}`
+                obj.status = type(obj.status)()
+            bucket = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            if key in bucket:
+                raise AlreadyExists(f"{kind} {key} already exists")
+            if not obj.metadata.uid:
+                obj.metadata.uid = new_uid()
+            obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now()
+            obj.metadata.resource_version = self._next_rv()
+            bucket[key] = obj
+            out = copy.deepcopy(obj)
+            self._emit(ADDED, kind, copy.deepcopy(obj))
+            return out
+
+    def get(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            obj = self._objects.get(kind, {}).get(f"{namespace}/{name}")
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def _current_for_write(self, obj):
+        """Shared optimistic-concurrency preamble (caller holds the lock)."""
+        kind = obj.kind
+        key = self._key(obj)
+        cur = self._objects.get(kind, {}).get(key)
+        if cur is None:
+            raise NotFound(f"{kind} {key} not found")
+        if obj.metadata.resource_version != cur.metadata.resource_version:
+            raise Conflict(
+                f"{kind} {key}: resourceVersion {obj.metadata.resource_version} "
+                f"!= {cur.metadata.resource_version}"
+            )
+        return cur
+
+    def update(self, obj):
+        """Full-object update with optimistic concurrency.
+
+        For kinds with a `/status` subresource, status changes on this
+        path are silently dropped — exactly what a real apiserver does
+        with `subresources: status: {}` declared; use update_status().
+        """
+        kind = obj.kind
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            cur = self._current_for_write(obj)
+            obj = copy.deepcopy(obj)
+            obj.metadata.uid = cur.metadata.uid
+            obj.metadata.creation_timestamp = cur.metadata.creation_timestamp
+            obj.metadata.resource_version = self._next_rv()
+            if _has_status_subresource(cur) and hasattr(cur, "status"):
+                obj.status = copy.deepcopy(cur.status)
+            bucket[key] = obj
+            out = copy.deepcopy(obj)
+            self._emit(MODIFIED, kind, copy.deepcopy(obj))
+            return out
+
+    def update_status(self, obj):
+        """Write ONLY the object's status (the `/status` subresource PUT —
+        ref controllers/tensorflow/job.go:95-104 r.Status().Update). Spec,
+        labels, and the rest of the stored object are left untouched. For
+        kinds without the subresource this degrades to a full update."""
+        kind = obj.kind
+        if not _has_status_subresource(obj):
+            return self.update(obj)
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            key = self._key(obj)
+            cur = self._current_for_write(obj)
+            new = copy.deepcopy(cur)
+            new.status = copy.deepcopy(obj.status)
+            new.metadata.resource_version = self._next_rv()
+            bucket[key] = new
+            out = copy.deepcopy(new)
+            self._emit(MODIFIED, kind, copy.deepcopy(new))
+            return out
+
+    def delete(self, kind: str, namespace: str, name: str):
+        with self._lock:
+            bucket = self._objects.get(kind, {})
+            key = f"{namespace}/{name}"
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFound(f"{kind} {key} not found")
+            obj.metadata.deletion_timestamp = now()
+            self._emit(DELETED, kind, copy.deepcopy(obj))
+            return obj
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        with self._lock:
+            out = []
+            for obj in self._objects.get(kind, {}).values():
+                if namespace is not None and obj.metadata.namespace != namespace:
+                    continue
+                if not match_labels(obj.metadata.labels, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+            return out
+
+    def kinds(self) -> List[str]:
+        with self._lock:
+            return [k for k, v in self._objects.items() if v]
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(self, kinds: Optional[List[str]] = None) -> "Watch":
+        """Subscribe to events; optionally restricted to `kinds`.
+
+        The stream replays current objects as ADDED first (informer-style
+        initial list+watch), then live events.
+        """
+        w = Watch(self, kinds)
+        with self._lock:
+            for kind in kinds or list(self._objects.keys()):
+                for obj in self._objects.get(kind, {}).values():
+                    w._offer(WatchEvent(type=ADDED, kind=kind, obj=copy.deepcopy(obj)))
+            self._watchers.append(w)
+        return w
+
+
+class Watch:
+    def __init__(self, store: ObjectStore, kinds: Optional[List[str]]) -> None:
+        self._store = store
+        self._kinds = set(kinds) if kinds else None
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = False
+
+    def _offer(self, ev: WatchEvent) -> None:
+        if self._stopped:
+            return
+        if self._kinds is not None and ev.kind not in self._kinds:
+            return
+        self._q.put(ev)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped = True
+        with self._store._lock:
+            if self in self._store._watchers:
+                self._store._watchers.remove(self)
+        self._q.put(None)

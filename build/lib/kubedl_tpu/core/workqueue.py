@@ -1,0 +1,120 @@
+"""Rate-limited work queue — controller-runtime's workqueue, natively.
+
+Semantics mirror k8s.io/client-go/util/workqueue as consumed by the reference
+(ref pkg/job_controller/job_controller.go:85-88 BackoffStatesQueue):
+  * dedup: a key added while queued coalesces; added while being processed is
+    re-queued after done(),
+  * per-key exponential backoff via add_rate_limited/forget,
+  * delayed adds via add_after (used for TTL requeues, ref job.go:321-345).
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0) -> None:
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._cond = threading.Condition()
+        self._queue: List[str] = []
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._delayed: List[Tuple[float, int, str]] = []  # heap of (when, seq, key)
+        self._seq = 0
+        self._failures: Dict[str, int] = {}
+        self._shutdown = False
+
+    # -- core queue ------------------------------------------------------
+
+    def add(self, key: str) -> None:
+        with self._cond:
+            if self._shutdown or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key not in self._processing:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[str]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                self._drain_delayed_locked()
+                if self._queue:
+                    key = self._queue.pop(0)
+                    self._dirty.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return None
+                waits = []
+                if self._delayed:
+                    waits.append(max(self._delayed[0][0] - now, 0.0))
+                if deadline is not None:
+                    waits.append(deadline - now)
+                self._cond.wait(min(waits) if waits else None)
+
+    def done(self, key: str) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._queue.append(key)
+                self._cond.notify()
+
+    # -- delay / rate limiting ------------------------------------------
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            self._cond.notify()
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._cond:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        delay = min(self._base_delay * (2**n), self._max_delay)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._cond:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: str) -> int:
+        with self._cond:
+            return self._failures.get(key, 0)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- internals (call with lock held) --------------------------------
+
+    def _drain_delayed_locked(self) -> None:
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, key = heapq.heappop(self._delayed)
+            if key not in self._dirty:
+                self._dirty.add(key)
+                if key not in self._processing:
+                    self._queue.append(key)
+

@@ -1,0 +1,50 @@
+"""GangScheduler plugin interface (ref pkg/gang_schedule/interface.go:30-50).
+
+Same contract as the reference — create/bind/get/delete — with the kube-batch
+PodGroup implementation replaced by all-or-nothing TPU-slice admission
+(SURVEY.md §2.4): a gang maps to one pod slice; partial placement is refused.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional
+
+from kubedl_tpu.api.common import ReplicaSpec
+
+ANNOTATION_GANG_NAME = "kubedl.io/gang-name"
+
+
+class GangScheduler(abc.ABC):
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def create_gang(self, job, replicas: Dict[str, ReplicaSpec]):
+        """Idempotently create the gang entity for a job."""
+
+    @abc.abstractmethod
+    def bind_pod_to_gang(self, job, pod) -> None:
+        """Mark a pod as a member of its job's gang."""
+
+    @abc.abstractmethod
+    def get_gang(self, namespace: str, name: str): ...
+
+    @abc.abstractmethod
+    def delete_gang(self, job) -> None: ...
+
+
+class GangRegistry:
+    """Ref pkg/gang_schedule/registry/registry.go:27-70."""
+
+    def __init__(self) -> None:
+        self._schedulers: Dict[str, GangScheduler] = {}
+
+    def register(self, scheduler: GangScheduler) -> None:
+        self._schedulers[scheduler.name] = scheduler
+
+    def get(self, name: str) -> Optional[GangScheduler]:
+        return self._schedulers.get(name)
+
+    def names(self):
+        return sorted(self._schedulers)

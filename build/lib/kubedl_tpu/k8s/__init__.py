@@ -1,0 +1,23 @@
+"""Kubernetes backend — the operator's real-cluster execution path.
+
+The in-process ObjectStore (core/store.py) gives the engine a native
+etcd+apiserver; this package gives it the actual kube-apiserver instead
+(ref L0, SURVEY.md §1): a REST client speaking the Kubernetes wire
+protocol, a KubeObjectStore adapter with the exact ObjectStore surface so
+the reconcile engine runs unmodified over either, GKE TPU pod mutation
+(node selectors + TPU_WORKER_HOSTNAMES), and an embedded fake apiserver
+implementing the same wire protocol for hermetic e2e tests (the envtest
+analogue the reference lacks, SURVEY.md §4).
+"""
+from kubedl_tpu.k8s.client import KubeApiError, KubeClient
+from kubedl_tpu.k8s.resources import ResourceInfo, register_kind, resource_for
+from kubedl_tpu.k8s.store import KubeObjectStore
+
+__all__ = [
+    "KubeApiError",
+    "KubeClient",
+    "KubeObjectStore",
+    "ResourceInfo",
+    "register_kind",
+    "resource_for",
+]

@@ -1,0 +1,462 @@
+"""Embedded fake kube-apiserver — hermetic wire-protocol test harness.
+
+The reference tests against fake clients only (SURVEY.md §4: "no envtest
+binaries"); this goes one step further and serves the actual HTTP wire
+protocol so KubeClient/KubeObjectStore are exercised end-to-end: JSON
+CRUD with resourceVersion optimistic concurrency (409 Conflict), 404/409
+errors, labelSelector lists, chunked watch streams, and the /apis
+discovery endpoints the workload gate's `auto` mode probes
+(ref pkg/util/workloadgate/workload_gate.go:26-107).
+
+State is raw JSON dicts — the server never imports the typed API, so a
+client bug can't be masked by sharing dataclasses with the store under
+test.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+# /api/v1/namespaces/{ns}/{plural}[/{name}[/status]]
+_CORE_RE = re.compile(
+    r"^/api/v1/namespaces/([^/]+)/([^/]+)(?:/([^/]+)(?:/(status))?)?$"
+)
+# /apis/{group}/{version}/namespaces/{ns}/{plural}[/{name}[/status]]
+_GROUP_RE = re.compile(
+    r"^/apis/([^/]+)/([^/]+)/namespaces/([^/]+)/([^/]+)(?:/([^/]+)(?:/(status))?)?$"
+)
+# cluster-scoped core resources, e.g. /api/v1/nodes[/{name}[/status]]
+_CLUSTER_RE = re.compile(r"^/api/v1/([^/]+)(?:/([^/]+)(?:/(status))?)?$")
+_DISCOVERY_RE = re.compile(r"^/apis/([^/]+)/([^/]+)$")
+
+# namespace key used for cluster-scoped objects in the state buckets
+CLUSTER_NS = ""
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.rv = 0
+        # (gv, plural) -> {(ns, name): object dict}
+        self.objects: Dict[Tuple[str, str], Dict[Tuple[str, str], Dict]] = {}
+        # registered resources: (gv, plural) -> kind
+        self.resources: Dict[Tuple[str, str], str] = {}
+        # resources serving a /status subresource: main-path writes have
+        # their status silently dropped, like a real apiserver with
+        # `subresources: status: {}` in the CRD
+        self.status_subresources: set = set()
+        # cluster-scoped resources (no namespace segment), e.g. ("v1","nodes")
+        self.cluster_resources: set = set()
+        self.watchers: List["_Watcher"] = []
+        self.uid = 0
+        # (method, path-sans-query, is_watch) per request — lets tests
+        # assert the informer cache eliminated hot-path HTTP traffic
+        self.requests: List[Tuple[str, str, bool]] = []
+
+    def next_rv(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+    def emit(self, etype: str, gv: str, plural: str, obj: Dict) -> None:
+        for w in list(self.watchers):
+            w.offer(etype, gv, plural, obj)
+
+
+class _Watcher:
+    def __init__(self, gv: str, plural: str, namespace: str) -> None:
+        self.gv = gv
+        self.plural = plural
+        self.namespace = namespace
+        self.events: "list" = []
+        self.cond = threading.Condition()
+        self.closed = False
+
+    def offer(self, etype: str, gv: str, plural: str, obj: Dict) -> None:
+        if (gv, plural) != (self.gv, self.plural):
+            return
+        if obj.get("metadata", {}).get("namespace") != self.namespace:
+            return
+        with self.cond:
+            self.events.append({"type": etype, "object": obj})
+            self.cond.notify_all()
+
+    def take(self, timeout: float) -> List[Dict]:
+        with self.cond:
+            if not self.events:
+                self.cond.wait(timeout)
+            out, self.events = self.events, []
+            return out
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    for clause in selector.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        k, _, v = clause.partition("=")
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "FakeKubeApiserver/1.0"
+
+    # quiet the default per-request stderr logging
+    def log_message(self, fmt, *args):  # noqa: A003
+        pass
+
+    @property
+    def state(self) -> _State:
+        return self.server.state  # type: ignore[attr-defined]
+
+    def _send_json(self, status: int, body: Dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _error(self, status: int, message: str, reason: str) -> None:
+        self._send_json(status, {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "message": message, "reason": reason, "code": status,
+        })
+
+    def _record(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        is_watch = "watch=true" in (parsed.query or "")
+        st = self.state
+        with st.lock:
+            st.requests.append((method, parsed.path, is_watch))
+
+    def _auth_ok(self) -> bool:
+        token = self.server.token  # type: ignore[attr-defined]
+        if not token:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {token}":
+            return True
+        self._error(401, "Unauthorized", "Unauthorized")
+        return False
+
+    def _route(self) -> Optional[Tuple[str, str, str, Optional[str], Optional[str]]]:
+        """-> (gv, plural, namespace, name, subresource) or None."""
+        path = urllib.parse.urlparse(self.path).path
+        m = _CORE_RE.match(path)
+        if m:
+            ns, plural, name, sub = m.groups()
+            return "v1", plural, ns, name, sub
+        m = _GROUP_RE.match(path)
+        if m:
+            group, version, ns, plural, name, sub = m.groups()
+            return f"{group}/{version}", plural, ns, name, sub
+        m = _CLUSTER_RE.match(path)
+        if m:
+            plural, name, sub = m.groups()
+            if ("v1", plural) in self.state.cluster_resources:
+                return "v1", plural, CLUSTER_NS, name, sub
+        return None
+
+    def _params(self) -> Dict[str, str]:
+        qs = urllib.parse.urlparse(self.path).query
+        return {k: v[0] for k, v in urllib.parse.parse_qs(qs).items()}
+
+    # -- discovery --------------------------------------------------------
+
+    def _discovery(self, path: str) -> bool:
+        st = self.state
+        if path == "/api/v1":
+            gv = "v1"
+        else:
+            m = _DISCOVERY_RE.match(path)
+            if m:
+                gv = f"{m.group(1)}/{m.group(2)}"
+            elif path == "/apis":
+                with st.lock:
+                    groups = sorted({gv.split("/")[0] for gv, _ in st.resources if "/" in gv})
+                self._send_json(200, {
+                    "kind": "APIGroupList",
+                    "groups": [{"name": g, "versions": []} for g in groups],
+                })
+                return True
+            else:
+                return False
+        with st.lock:
+            resources = [
+                {"name": plural, "kind": kind, "namespaced": True}
+                for (g, plural), kind in sorted(st.resources.items())
+                if g == gv
+            ]
+        self._send_json(200, {
+            "kind": "APIResourceList", "groupVersion": gv, "resources": resources,
+        })
+        return True
+
+    # -- verbs ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        self._record("GET")
+        if not self._auth_ok():
+            return
+        path = urllib.parse.urlparse(self.path).path
+        if self._discovery(path):
+            return
+        route = self._route()
+        if route is None:
+            return self._error(404, f"unknown path {path}", "NotFound")
+        gv, plural, ns, name, sub = route
+        st = self.state
+        if (gv, plural) not in st.resources:
+            return self._error(404, f"resource {gv}/{plural} not registered", "NotFound")
+        if sub and (gv, plural) not in st.status_subresources:
+            return self._error(404, f"{plural} has no status subresource", "NotFound")
+        if name:
+            with st.lock:
+                obj = st.objects.get((gv, plural), {}).get((ns, name))
+            if obj is None:
+                return self._error(404, f"{plural} {ns}/{name} not found", "NotFound")
+            # GET of /status returns the whole object, like the real thing
+            return self._send_json(200, obj)
+        params = self._params()
+        if params.get("watch") == "true":
+            return self._watch(gv, plural, ns, params)
+        selector = params.get("labelSelector", "")
+        with st.lock:
+            items = [
+                o for (ons, _), o in sorted(st.objects.get((gv, plural), {}).items())
+                if ons == ns
+                and _match_selector(o.get("metadata", {}).get("labels") or {}, selector)
+            ]
+            rv = str(st.rv)
+        self._send_json(200, {
+            "kind": "List", "apiVersion": gv,
+            "metadata": {"resourceVersion": rv}, "items": items,
+        })
+
+    def _watch(self, gv: str, plural: str, ns: str, params: Dict[str, str]) -> None:
+        st = self.state
+        w = _Watcher(gv, plural, ns)
+        since = int(params.get("resourceVersion", "0") or "0")
+        with st.lock:
+            # replay events newer than the requested resourceVersion by
+            # sending current objects with rv > since as ADDED
+            backlog = [
+                {"type": "ADDED", "object": o}
+                for (ons, _), o in sorted(st.objects.get((gv, plural), {}).items())
+                if ons == ns
+                and int(o.get("metadata", {}).get("resourceVersion", "0")) > since
+            ]
+            st.watchers.append(w)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for ev in backlog:
+                send_chunk(json.dumps(ev).encode() + b"\n")
+            while not w.closed:
+                for ev in w.take(timeout=0.5):
+                    send_chunk(json.dumps(ev).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            with st.lock:
+                if w in st.watchers:
+                    st.watchers.remove(w)
+            self.close_connection = True
+
+    def _read_body(self) -> Optional[Dict]:
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        if not length:
+            return None
+        return json.loads(self.rfile.read(length))
+
+    def do_POST(self) -> None:  # noqa: N802
+        self._record("POST")
+        if not self._auth_ok():
+            return
+        route = self._route()
+        if route is None:
+            return self._error(404, "unknown path", "NotFound")
+        gv, plural, ns, _, sub = route
+        st = self.state
+        if sub:
+            return self._error(405, "create not allowed on subresource", "MethodNotAllowed")
+        if (gv, plural) not in st.resources:
+            return self._error(404, f"resource {gv}/{plural} not registered", "NotFound")
+        obj = self._read_body() or {}
+        # status is reset on create for subresource-enabled kinds — the
+        # apiserver owns the main path, status owners write /status later
+        if (gv, plural) in st.status_subresources:
+            obj.pop("status", None)
+        meta = obj.setdefault("metadata", {})
+        meta["namespace"] = ns
+        name = meta.get("name", "")
+        if not name:
+            return self._error(422, "metadata.name required", "Invalid")
+        with st.lock:
+            bucket = st.objects.setdefault((gv, plural), {})
+            if (ns, name) in bucket:
+                return self._error(
+                    409, f'{plural} "{name}" already exists', "AlreadyExists"
+                )
+            st.uid += 1
+            meta.setdefault("uid", f"fake-uid-{st.uid}")
+            meta.setdefault("creationTimestamp", time.time())
+            meta["resourceVersion"] = st.next_rv()
+            bucket[(ns, name)] = obj
+            st.emit("ADDED", gv, plural, obj)
+        self._send_json(201, obj)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        self._record("PUT")
+        if not self._auth_ok():
+            return
+        route = self._route()
+        if route is None or route[3] is None:
+            return self._error(404, "unknown path", "NotFound")
+        gv, plural, ns, name, sub = route
+        st = self.state
+        has_status = (gv, plural) in st.status_subresources
+        if sub and not has_status:
+            return self._error(404, f"{plural} has no status subresource", "NotFound")
+        obj = self._read_body() or {}
+        meta = obj.setdefault("metadata", {})
+        meta["namespace"] = ns
+        meta["name"] = name
+        with st.lock:
+            bucket = st.objects.setdefault((gv, plural), {})
+            cur = bucket.get((ns, name))
+            if cur is None:
+                return self._error(404, f"{plural} {ns}/{name} not found", "NotFound")
+            cur_rv = cur.get("metadata", {}).get("resourceVersion")
+            if str(meta.get("resourceVersion", "")) != str(cur_rv):
+                return self._error(
+                    409,
+                    f"Operation cannot be fulfilled on {plural} {name!r}: "
+                    f"the object has been modified",
+                    "Conflict",
+                )
+            if sub:
+                # /status PUT: only the status (and nothing else) changes
+                new = json.loads(json.dumps(cur))
+                if "status" in obj:
+                    new["status"] = obj["status"]
+                else:
+                    new.pop("status", None)
+                obj = new
+            else:
+                meta["uid"] = cur["metadata"].get("uid")
+                meta["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
+                if has_status:
+                    # main-path PUT: incoming status is SILENTLY dropped —
+                    # the exact real-apiserver behavior that makes missing
+                    # update_status() calls a production bug
+                    if "status" in cur:
+                        obj["status"] = cur["status"]
+                    else:
+                        obj.pop("status", None)
+            obj["metadata"]["resourceVersion"] = st.next_rv()
+            bucket[(ns, name)] = obj
+            st.emit("MODIFIED", gv, plural, obj)
+        self._send_json(200, obj)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        self._record("DELETE")
+        if not self._auth_ok():
+            return
+        route = self._route()
+        if route is None or route[3] is None:
+            return self._error(404, "unknown path", "NotFound")
+        gv, plural, ns, name, sub = route
+        if sub:
+            return self._error(405, "delete not allowed on subresource", "MethodNotAllowed")
+        st = self.state
+        with st.lock:
+            bucket = st.objects.get((gv, plural), {})
+            obj = bucket.pop((ns, name), None)
+            if obj is None:
+                return self._error(404, f"{plural} {ns}/{name} not found", "NotFound")
+            obj.setdefault("metadata", {})["deletionTimestamp"] = 1
+            st.emit("DELETED", gv, plural, obj)
+        self._send_json(200, obj)
+
+
+class FakeApiServer:
+    """`with FakeApiServer() as srv: KubeClient(srv.url)` — that's the API."""
+
+    def __init__(self, token: Optional[str] = None) -> None:
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.state = _State()  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.register_resource("v1", "pods", "Pod", status_subresource=True)
+        self.register_resource("v1", "services", "Service")
+        self.register_resource("v1", "events", "Event")
+        self.register_resource("coordination.k8s.io/v1", "leases", "Lease")
+        self.register_resource("v1", "nodes", "Node", namespaced=False)
+        self.register_resource(
+            "scheduling.kubedl-tpu.io/v1alpha1", "podgroups", "PodGroup",
+            status_subresource=True,
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def register_resource(
+        self,
+        gv: str,
+        plural: str,
+        kind: str,
+        status_subresource: bool = False,
+        namespaced: bool = True,
+    ) -> None:
+        state: _State = self._httpd.state  # type: ignore[attr-defined]
+        with state.lock:
+            state.resources[(gv, plural)] = kind
+            if status_subresource:
+                state.status_subresources.add((gv, plural))
+            if not namespaced:
+                state.cluster_resources.add((gv, plural))
+
+    def register_workload_crds(self) -> None:
+        from kubedl_tpu.k8s.resources import register_workload_kinds, registered_kinds
+
+        register_workload_kinds()
+        for kind, info in registered_kinds().items():
+            self.register_resource(
+                info.api_version, info.plural, kind,
+                status_subresource=info.status_subresource,
+            )
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "FakeApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

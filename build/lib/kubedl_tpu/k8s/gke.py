@@ -1,0 +1,88 @@
+"""GKE TPU pod mutation — node selectors + worker-topology env injection.
+
+SURVEY.md §7 step 3: pods whose containers request `google.com/tpu` get
+
+  * nodeSelector `cloud.google.com/gke-tpu-accelerator` (e.g.
+    "tpu-v5p-slice") and `cloud.google.com/gke-tpu-topology` (e.g. "2x2x4")
+    so GKE places them on the right pod-slice node pool;
+  * `TPU_WORKER_ID` = replica index and `TPU_WORKER_HOSTNAMES` = the
+    comma-joined headless-service DNS names of every worker in the replica
+    set — a direct reuse of the reference's per-replica DNS scheme
+    (ref controllers/tensorflow/tensorflow.go:122-136) applied to the GKE
+    TPU bootstrap contract.
+
+Wired into the engine as a pod mutator (EngineConfig.pod_mutators), so
+every workload controller gets it without per-workload code — the same
+generalization this repo applies to the PyTorch service special case.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from kubedl_tpu.api.common import slice_group
+from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+GKE_TPU_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+# JAXJob/annotation key naming the slice type, e.g. "v5p-32"
+ANNOTATION_SLICE_TYPE = "kubedl.io/tpu-slice-type"
+
+
+def _accelerator_label(generation: str) -> str:
+    # GKE names node pools tpu-<gen>-slice (v5e is "v5litepod")
+    gen = {"v5e": "v5litepod", "v6e": "v6e-slice"}.get(generation)
+    if gen == "v6e-slice":
+        return "tpu-v6e-slice"
+    if gen:
+        return f"tpu-{gen}-slice"
+    return f"tpu-{generation}-slice"
+
+
+def slice_type_for_job(job) -> Optional[str]:
+    """Slice type from runPolicy.schedulingPolicy.tpuSlice (the common-API
+    field the gang admitter also reads) or the shared annotation."""
+    ann = job.metadata.annotations.get(ANNOTATION_SLICE_TYPE)
+    if ann:
+        return ann
+    policy = getattr(getattr(job, "spec", None), "run_policy", None)
+    sched = getattr(policy, "scheduling_policy", None)
+    return getattr(sched, "tpu_slice", "") or None
+
+
+def requests_tpu(pod_spec) -> bool:
+    return any(
+        c.resources and c.resources.tpu_chips() > 0 for c in pod_spec.containers
+    )
+
+
+def gke_tpu_mutator(job, template, rt: str, index: int, spec) -> None:
+    """EngineConfig.pod_mutators hook: mutate `template` in place."""
+    if not requests_tpu(template.spec):
+        return
+    slice_name = slice_type_for_job(job)
+    selectors: Dict[str, str] = {}
+    if slice_name:
+        st = parse_slice_type(slice_name)
+        selectors[GKE_TPU_ACCELERATOR] = _accelerator_label(st.generation)
+        selectors[GKE_TPU_TOPOLOGY] = st.topology_str
+    template.spec.node_selector.update(selectors)
+
+    n = int(spec.replicas or 0)
+    # Multislice jobs (JAXJob spec.numSlices > 1): TPU worker identity is
+    # scoped PER SLICE — each slice's libtpu expects ids 0..per_slice-1 and
+    # hostnames listing only its own slice's workers (cross-slice traffic
+    # is DCN via the MEGASCALE_* envs, workloads/jaxjob.py).
+    num_slices = max(int(getattr(job.spec, "num_slices", 1) or 1), 1)
+    lo, hi, worker_id = 0, n, index
+    if num_slices > 1 and n % num_slices == 0:
+        slice_id, worker_id, per_slice = slice_group(n, num_slices, index)
+        lo, hi = slice_id * per_slice, (slice_id + 1) * per_slice
+    hostnames = ",".join(
+        f"{job.metadata.name}-{rt.lower()}-{i}.{job.metadata.namespace}"
+        for i in range(lo, hi)
+    )
+    for c in template.spec.containers:
+        c.env.setdefault("TPU_WORKER_ID", str(worker_id))
+        c.env.setdefault("TPU_WORKER_HOSTNAMES", hostnames)

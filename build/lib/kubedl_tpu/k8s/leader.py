@@ -1,0 +1,237 @@
+"""Apiserver-backed leader election — coordination.k8s.io/v1 Lease.
+
+The reference elects through controller-runtime's manager, default ON
+(ref main.go:56,70-75): operator replicas race for a Lease; exactly one
+reconciles, standbys block, and a standby takes over when the leader
+stops renewing. The file-flock elector (core/leader.py) covers local
+mode; in kube mode two replicas on different nodes never see each
+other's flock, so the lease must live in the apiserver.
+
+Protocol (the client-go leaderelection algorithm, re-derived):
+  * acquire: create the Lease with ourselves as holder; if it exists,
+    take over only when `renewTime + leaseDurationSeconds` has passed
+    (leaseTransitions increments), else stand by and retry
+  * renew: a background thread PUTs a fresh renewTime every
+    renew_period; optimistic concurrency (409) means a usurper's write
+    loses cleanly
+  * lose: if renewal cannot land within the lease duration, leadership
+    is LOST — `on_lost` fires so the operator can stop reconciling
+    (the reference's process simply exits; same contract)
+  * release: clear holderIdentity so a standby acquires immediately
+"""
+from __future__ import annotations
+
+import calendar
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from kubedl_tpu.k8s.client import KubeApiError, KubeClient
+
+log = logging.getLogger("kubedl_tpu.k8s.leader")
+
+LEASE_PATH = "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases"
+
+
+def _now_rfc3339() -> str:
+    t = time.time()
+    frac = int((t % 1) * 1e6)
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(t)) + f".{frac:06d}Z"
+
+
+def _parse_rfc3339(s: str) -> float:
+    # calendar.timegm, NOT mktime-minus-timezone: mktime applies the
+    # host's DST rules, shifting the parse by an hour half the year and
+    # making standbys usurp a healthy leader.
+    base, _, frac = s.rstrip("Z").partition(".")
+    epoch = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+    return epoch + (float(f"0.{frac}") if frac else 0.0)
+
+
+class KubeLeaseElector:
+    """One Lease, many candidates, at most one leader."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str = "default",
+        name: str = "kubedl-tpu-leader",
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+        retry_period: float = 2.0,
+        on_lost: Optional[Callable[[], None]] = None,
+    ) -> None:
+        import os
+        import uuid
+
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        # uuid suffix like client-go: two candidates in one process (or a
+        # recycled pid) must never share an identity, or each mistakes
+        # the other's lease for its own and "re-acquires" it
+        self.identity = identity or (
+            f"{os.uname().nodename}-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        )
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_lost = on_lost
+        self._is_leader = threading.Event()
+        self._stop_renew = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+
+    # -- wire helpers ------------------------------------------------------
+
+    def _path(self, name: str = "") -> str:
+        p = LEASE_PATH.format(ns=self.namespace)
+        return f"{p}/{name}" if name else p
+
+    def _get(self) -> Optional[dict]:
+        try:
+            return self.client.request("GET", self._path(self.name))
+        except KubeApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def _spec(self, transitions: int, acquire_time: Optional[str] = None) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration) or 1,
+            "acquireTime": acquire_time or _now_rfc3339(),
+            "renewTime": _now_rfc3339(),
+            "leaseTransitions": transitions,
+        }
+
+    # -- election ----------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def try_acquire(self) -> bool:
+        try:
+            lease = self._get()
+            if lease is None:
+                self.client.request("POST", self._path(), body={
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.name, "namespace": self.namespace},
+                    "spec": self._spec(transitions=0),
+                })
+                return self._won()
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity") or ""
+            if holder and holder != self.identity:
+                renew = spec.get("renewTime") or spec.get("acquireTime")
+                duration = float(spec.get("leaseDurationSeconds") or self.lease_duration)
+                if renew and time.time() - _parse_rfc3339(renew) < duration:
+                    return False  # live leader: stand by
+            # expired, released, or already ours: take (over)
+            transitions = int(spec.get("leaseTransitions") or 0)
+            if holder != self.identity:
+                transitions += 1
+            lease["spec"] = self._spec(
+                transitions,
+                acquire_time=None if holder != self.identity else spec.get("acquireTime"),
+            )
+            self.client.request("PUT", self._path(self.name), body=lease)
+            return self._won()
+        except KubeApiError as e:
+            if e.status in (409, 404):
+                return False  # lost the race; retry next period
+            raise
+
+    def _won(self) -> bool:
+        self._is_leader.set()
+        self._stop_renew.clear()
+        self._renew_thread = threading.Thread(
+            target=self._renew_loop, name="lease-renew", daemon=True
+        )
+        self._renew_thread.start()
+        log.info("leader election won identity=%s lease=%s/%s",
+                 self.identity, self.namespace, self.name)
+        return True
+
+    def acquire(
+        self,
+        timeout: Optional[float] = None,
+        stop: Optional[Callable[[], bool]] = None,
+    ) -> bool:
+        """Block as a standby until elected, `timeout` elapses, or `stop()`
+        turns true — the manager-start contract of the file elector."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.try_acquire():
+                return True
+            if stop is not None and stop():
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(self.retry_period)
+
+    def _renew_loop(self) -> None:
+        misses_deadline = None
+        while not self._stop_renew.wait(self.renew_period):
+            try:
+                lease = self._get()
+                spec = (lease or {}).get("spec") or {}
+                if lease is None or spec.get("holderIdentity") != self.identity:
+                    self._lost("lease taken by another candidate")
+                    return
+                spec["renewTime"] = _now_rfc3339()
+                self.client.request("PUT", self._path(self.name), body=lease)
+                misses_deadline = None
+            except KubeApiError as e:
+                if e.status == 409:
+                    continue  # raced our own write ordering; re-read next tick
+                if misses_deadline is None:
+                    misses_deadline = time.monotonic() + self.lease_duration
+                if time.monotonic() >= misses_deadline:
+                    self._lost(f"renewal failing past lease duration: {e}")
+                    return
+            except Exception as e:  # noqa: BLE001 — transport blip: keep trying
+                if misses_deadline is None:
+                    misses_deadline = time.monotonic() + self.lease_duration
+                if time.monotonic() >= misses_deadline:
+                    self._lost(f"renewal failing past lease duration: {e}")
+                    return
+
+    def _lost(self, why: str) -> None:
+        log.error("leadership LOST (%s) identity=%s", why, self.identity)
+        self._is_leader.clear()
+        if self.on_lost is not None:
+            try:
+                self.on_lost()
+            except Exception:  # noqa: BLE001
+                log.exception("on_lost callback failed")
+
+    def release(self) -> None:
+        """Graceful handoff: stop renewing and clear the holder so a
+        standby wins on its next retry instead of waiting out the TTL."""
+        self._stop_renew.set()
+        if (
+            self._renew_thread is not None
+            and self._renew_thread is not threading.current_thread()
+        ):
+            # current_thread guard: on_lost handlers may call back into
+            # release() from the renew thread itself
+            self._renew_thread.join(timeout=2.0)
+        if not self._is_leader.is_set():
+            return
+        self._is_leader.clear()
+        try:
+            lease = self._get()
+            if lease and (lease.get("spec") or {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                lease["spec"]["renewTime"] = _now_rfc3339()
+                self.client.request("PUT", self._path(self.name), body=lease)
+        except KubeApiError:
+            pass  # best effort; TTL expiry covers it
+
+    def holder(self) -> str:
+        lease = self._get()
+        return ((lease or {}).get("spec") or {}).get("holderIdentity") or ""

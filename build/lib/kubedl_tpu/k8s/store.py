@@ -1,0 +1,502 @@
+"""KubeObjectStore — the core ObjectStore surface over a kube-apiserver.
+
+The reconcile engine (controllers/engine.py) and manager (core/manager.py)
+run unmodified over either store: create/get/update/delete/list raise the
+same NotFound/AlreadyExists/Conflict, and watch() yields the same
+WatchEvent stream (initial list replayed as ADDED, informer-style, then
+live events with reconnect-on-drop). Objects cross the boundary as the
+same typed dataclasses; serde translates to/from the k8s JSON wire, with
+resourceVersion mapped str<->int at this edge.
+
+Ref: this replaces what controller-runtime's client+informer cache do for
+the reference (L0, SURVEY.md §1).
+"""
+from __future__ import annotations
+
+import copy
+import logging
+import queue
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from kubedl_tpu.core.store import (
+    ADDED,
+    DELETED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    StoreError,
+    WatchEvent,
+)
+from kubedl_tpu.k8s.client import KubeApiError, KubeClient
+from kubedl_tpu.k8s.resources import register_workload_kinds, resource_for
+from kubedl_tpu.utils.serde import from_dict, to_dict
+
+log = logging.getLogger("kubedl_tpu.k8s.store")
+
+
+# -- k8s wire translation ---------------------------------------------------
+# Internal API types diverge from the k8s wire in three places: env is a
+# plain dict (k8s: list of {name, value}), resource quantities are floats
+# (k8s: strings like "500m"/"1Gi"), and resourceVersion is an int (k8s:
+# string). Translate at this edge so a REAL apiserver accepts our pods.
+
+from kubedl_tpu.utils.serde import parse_quantity as _quantity_to_float
+
+
+def _float_to_quantity(v: float) -> str:
+    if float(v).is_integer():
+        return str(int(v))
+    milli = v * 1000
+    if milli.is_integer():
+        return f"{int(milli)}m"
+    return str(v)
+
+
+def _pod_spec_to_wire(spec: Dict) -> None:
+    for key in ("containers", "initContainers"):
+        for c in spec.get(key) or []:
+            env = c.get("env")
+            if isinstance(env, dict):
+                # envRaw entries (valueFrom etc., preserved by decode) go
+                # first; plain vars follow in INSERTION order — kubelet
+                # expands $(VAR) only from earlier entries, so sorting
+                # would break dependent env vars.
+                raw = c.pop("envRaw", None) or []
+                raw_names = {e.get("name") for e in raw}
+                c["env"] = list(raw) + [
+                    {"name": k, "value": str(v)}
+                    for k, v in env.items() if k not in raw_names
+                ]
+            res = c.get("resources")
+            if isinstance(res, dict):
+                for rk in ("requests", "limits"):
+                    if isinstance(res.get(rk), dict):
+                        res[rk] = {k: _float_to_quantity(v) for k, v in res[rk].items()}
+
+
+def _pod_spec_from_wire(spec: Dict) -> None:
+    for key in ("containers", "initContainers"):
+        for c in spec.get(key) or []:
+            env = c.get("env")
+            if isinstance(env, list):
+                # split: plain name/value pairs -> the internal dict;
+                # valueFrom-style entries -> envRaw so an update round-trip
+                # can't strip a secretKeyRef into an empty string
+                plain, raw = {}, []
+                for e in env:
+                    if "name" not in e:
+                        continue
+                    if set(e) <= {"name", "value"}:
+                        plain[e["name"]] = e.get("value", "")
+                    else:
+                        raw.append(e)
+                c["env"] = plain
+                if raw:
+                    c["envRaw"] = raw
+            res = c.get("resources")
+            if isinstance(res, dict):
+                for rk in ("requests", "limits"):
+                    if isinstance(res.get(rk), dict):
+                        res[rk] = {
+                            k: _quantity_to_float(v) for k, v in res[rk].items()
+                        }
+
+
+def _walk_pod_specs(body: Dict, kind: str, fn) -> None:
+    if kind == "Pod":
+        if isinstance(body.get("spec"), dict):
+            fn(body["spec"])
+        return
+    # workload kinds: every replica template carries a pod spec
+    spec = body.get("spec")
+    if not isinstance(spec, dict):
+        return
+    for k, v in spec.items():
+        if k.endswith("ReplicaSpecs") or k == "replicaSpecs":
+            for rspec in (v or {}).values():
+                tmpl_spec = ((rspec or {}).get("template") or {}).get("spec")
+                if isinstance(tmpl_spec, dict):
+                    fn(tmpl_spec)
+
+
+def _encode(obj) -> Dict:
+    info = resource_for(obj.kind)
+    body = to_dict(obj)
+    body["apiVersion"] = info.api_version
+    body["kind"] = obj.kind
+    meta = body.setdefault("metadata", {})
+    rv = meta.pop("resourceVersion", None)
+    if rv:
+        meta["resourceVersion"] = str(rv)
+    _walk_pod_specs(body, obj.kind, _pod_spec_to_wire)
+    return body
+
+
+def _decode(kind: str, body: Dict):
+    info = resource_for(kind)
+    body = dict(body)
+    meta = dict(body.get("metadata") or {})
+    rv = meta.get("resourceVersion")
+    if rv is not None:
+        meta["resourceVersion"] = int(rv)
+    body["metadata"] = meta
+    _walk_pod_specs(body, kind, _pod_spec_from_wire)
+    if info.cls is None:
+        return body
+    obj = from_dict(info.cls, body)
+    obj.kind = kind
+    return obj
+
+
+def _selector_param(label_selector: Optional[Dict[str, str]]) -> Dict[str, str]:
+    if not label_selector:
+        return {}
+    return {"labelSelector": ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))}
+
+
+class _InformerCache:
+    """Watch-synced read cache — the informer half of controller-runtime.
+
+    Fed by the KubeWatch pump that owns each kind (cache applied BEFORE the
+    event is delivered, so a reconcile triggered by an event always sees a
+    cache at least as new as the event). `get`/`list` serve from here once
+    a kind is synced, making the reconcile hot path HTTP-free — the
+    reference reads from the informer cache the same way (SURVEY §3.2,
+    ref pkg/job_controller/job.go:106-116)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._synced: Dict[str, bool] = {}
+        # kind -> (ns, name) -> decoded object
+        self._objects: Dict[str, Dict[tuple, Any]] = {}
+
+    _NOT_SYNCED = object()  # sentinel: caller must fall back to HTTP
+
+    def synced(self, kind: str) -> bool:
+        with self._lock:
+            return self._synced.get(kind, False)
+
+    def begin_sync(self, kind: str) -> None:
+        with self._lock:
+            self._synced[kind] = False
+            self._objects[kind] = {}
+
+    def mark_synced(self, kind: str) -> None:
+        with self._lock:
+            self._synced[kind] = True
+
+    def apply(self, etype: str, kind: str, obj) -> None:
+        key = (obj.metadata.namespace, obj.metadata.name)
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            if etype == DELETED:
+                bucket.pop(key, None)
+                return
+            cur = bucket.get(key)
+            # guard against replay of an older snapshot overwriting a
+            # newer event (two pumps or a relist race)
+            if cur is not None and cur.metadata.resource_version > obj.metadata.resource_version:
+                return
+            bucket[key] = obj
+
+    def get(self, kind: str, namespace: str, name: str):
+        """-> object copy, None (synced and absent), or _NOT_SYNCED.
+        The synced check and the read share one lock acquisition, so a
+        concurrent relist (begin_sync clears the bucket) can never serve
+        an empty bucket as truth."""
+        with self._lock:
+            if not self._synced.get(kind, False):
+                return self._NOT_SYNCED
+            obj = self._objects.get(kind, {}).get((namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str, namespace: str, label_selector):
+        """-> sorted list of copies, or _NOT_SYNCED (same atomicity note)."""
+        with self._lock:
+            if not self._synced.get(kind, False):
+                return self._NOT_SYNCED
+            items = [
+                copy.deepcopy(o)
+                for (ns, _), o in self._objects.get(kind, {}).items()
+                if ns == namespace
+                and all(
+                    o.metadata.labels.get(k) == v
+                    for k, v in (label_selector or {}).items()
+                )
+            ]
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return items
+
+
+class KubeObjectStore:
+    def __init__(self, client: KubeClient, namespace: str = "default") -> None:
+        register_workload_kinds()
+        self.client = client
+        self.default_namespace = namespace
+        self._watchers: List["KubeWatch"] = []
+        self.cache = _InformerCache()
+        # kind -> the KubeWatch pump feeding the cache for that kind (one
+        # informer per kind; extra watches don't double-feed)
+        self._cache_feeders: Dict[str, "KubeWatch"] = {}
+        self._feeder_lock = threading.Lock()
+
+    # -- CRUD (same contract as core.store.ObjectStore) -------------------
+
+    def create(self, obj):
+        info = resource_for(obj.kind)
+        try:
+            body = self.client.request(
+                "POST", info.path(obj.metadata.namespace), body=_encode(obj)
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
+    def get(self, kind: str, namespace: str, name: str):
+        obj = self.cache.get(kind, namespace, name)
+        if obj is _InformerCache._NOT_SYNCED:
+            return self.get_fresh(kind, namespace, name)
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        return obj
+
+    def get_fresh(self, kind: str, namespace: str, name: str):
+        """Uncached apiserver GET — for reads that must not be stale
+        (adoption's deletion-timestamp recheck, status-write rv refresh;
+        ref pkg/job_controller/util.go:33-49 uses the uncached reader)."""
+        info = resource_for(kind)
+        try:
+            body = self.client.request("GET", info.path(namespace, name))
+        except KubeApiError as e:
+            raise _map_error(e, kind, f"{namespace}/{name}") from e
+        return _decode(kind, body)
+
+    def update(self, obj):
+        info = resource_for(obj.kind)
+        try:
+            body = self.client.request(
+                "PUT",
+                info.path(obj.metadata.namespace, obj.metadata.name),
+                body=_encode(obj),
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
+    def update_status(self, obj):
+        """PUT to the `/status` subresource. Required for every kind whose
+        CRD declares `subresources: status: {}` (all five workload CRDs +
+        podgroups, config/crd/bases/) — a real apiserver silently drops
+        status changes sent to the main resource path.
+        Ref: controllers/tensorflow/job.go:95-104 r.Status().Update."""
+        info = resource_for(obj.kind)
+        if not info.status_subresource:
+            return self.update(obj)
+        try:
+            body = self.client.request(
+                "PUT",
+                info.status_path(obj.metadata.namespace, obj.metadata.name),
+                body=_encode(obj),
+            )
+        except KubeApiError as e:
+            raise _map_error(e, obj.kind, self._key(obj)) from e
+        return _decode(obj.kind, body)
+
+    def delete(self, kind: str, namespace: str, name: str):
+        info = resource_for(kind)
+        try:
+            body = self.client.request("DELETE", info.path(namespace, name))
+        except KubeApiError as e:
+            raise _map_error(e, kind, f"{namespace}/{name}") from e
+        return _decode(kind, body) if body else None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Any]:
+        info = resource_for(kind)
+        ns = namespace if namespace is not None else self.default_namespace
+        cached = self.cache.list(kind, ns, label_selector)
+        if cached is not _InformerCache._NOT_SYNCED:
+            return cached
+        try:
+            body = self.client.request(
+                "GET", info.path(ns), params=_selector_param(label_selector)
+            )
+        except KubeApiError as e:
+            raise _map_error(e, kind, ns) from e
+        items = []
+        for item in body.get("items", []):
+            items.append(_decode(kind, item))
+        items.sort(key=lambda o: (o.metadata.namespace, o.metadata.name))
+        return items
+
+    # -- discovery (workload gate `auto`, ref workload_gate.go:26-107) ----
+
+    def has_kind(self, kind: str) -> bool:
+        """True iff the API server serves this kind's CRD.
+
+        A 404 means "group/version not installed" -> False; any other
+        error (apiserver blip, RBAC) raises, so a caller doing startup
+        discovery fails loudly instead of silently disabling every
+        workload (the operator pod then restarts and retries)."""
+        info = resource_for(kind)
+        try:
+            body = self.client.request("GET", info.base_path())
+        except KubeApiError as e:
+            if e.status == 404:
+                return False
+            raise StoreError(f"discovery for {kind} failed: {e}") from e
+        return any(r.get("kind") == kind for r in (body or {}).get("resources", []))
+
+    # -- watch ------------------------------------------------------------
+
+    def watch(
+        self, kinds: Optional[List[str]] = None, cache_only: bool = False
+    ) -> "KubeWatch":
+        """cache_only=True feeds the informer cache without queueing
+        events — for kinds nothing reconciles on (e.g. PodGroups, which
+        the gang admitter reads per pass) where an undrained queue would
+        grow unboundedly."""
+        w = KubeWatch(self, kinds or [], cache_only=cache_only)
+        self._watchers.append(w)
+        w.start()
+        return w
+
+    def wait_for_cache_sync(self, kinds: List[str], timeout: float = 30.0) -> bool:
+        """Block until the informer cache has replayed the initial list for
+        every kind (controller-runtime's WaitForCacheSync). Returns False
+        on timeout — callers keep running; reads just stay HTTP until the
+        pumps catch up."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            if all(self.cache.synced(k) for k in kinds):
+                return True
+            time.sleep(0.02)
+        return all(self.cache.synced(k) for k in kinds)
+
+    @staticmethod
+    def _key(obj) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+
+def _map_error(e: KubeApiError, kind: str, key: str) -> StoreError:
+    if e.status == 404:
+        return NotFound(f"{kind} {key} not found")
+    if e.status == 409 and "already exists" in e.message.lower():
+        return AlreadyExists(f"{kind} {key} already exists")
+    if e.status == 409:
+        return Conflict(f"{kind} {key}: {e.message}")
+    return StoreError(f"{kind} {key}: {e}")
+
+
+class KubeWatch:
+    """One list+watch thread per kind, multiplexed into a single queue —
+    the informer pattern. Reconnects with the last seen resourceVersion;
+    relists on 410 Gone."""
+
+    def __init__(
+        self, store: KubeObjectStore, kinds: List[str], cache_only: bool = False
+    ) -> None:
+        self._store = store
+        self._kinds = kinds
+        self._cache_only = cache_only
+        self._q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._stopped = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._conns: list = []  # live watch connections, closed on stop()
+
+    def start(self) -> None:
+        for kind in self._kinds:
+            t = threading.Thread(
+                target=self._pump, args=(kind,), name=f"kubewatch-{kind}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, kind: str) -> None:
+        info = resource_for(kind)
+        store = self._store
+        ns = store.default_namespace
+        # Claim the informer role for this kind: exactly one pump feeds
+        # the read cache so two watches can't fight over relist resets.
+        with store._feeder_lock:
+            feeds_cache = store._cache_feeders.setdefault(kind, self) is self
+        rv: Optional[str] = None
+        try:
+            while not self._stopped.is_set():
+                try:
+                    if rv is None:
+                        if feeds_cache:
+                            store.cache.begin_sync(kind)
+                        body = store.client.request("GET", info.path(ns))
+                        rv = str((body.get("metadata") or {}).get("resourceVersion", "0"))
+                        for item in body.get("items", []):
+                            self._offer(ADDED, kind, item, feeds_cache)
+                        if feeds_cache:
+                            store.cache.mark_synced(kind)
+                    for etype, obj in store.client.watch(
+                        info.path(ns), params={"resourceVersion": rv},
+                        conn_holder=self._conns, abort=self._stopped.is_set,
+                    ):
+                        if self._stopped.is_set():
+                            return
+                        if etype == "ERROR":
+                            rv = None  # 410 Gone mid-stream: relist
+                            break
+                        item_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if item_rv is not None:
+                            rv = str(item_rv)
+                        self._offer(etype, kind, obj, feeds_cache)
+                except KubeApiError as e:
+                    if e.status == 410:
+                        rv = None
+                    self._stopped.wait(0.2)
+                except Exception:  # noqa: BLE001 — transport blips: back off, retry
+                    if not self._stopped.is_set():
+                        self._stopped.wait(0.5)
+        finally:
+            if feeds_cache:
+                with store._feeder_lock:
+                    if store._cache_feeders.get(kind) is self:
+                        del store._cache_feeders[kind]
+                store.cache.begin_sync(kind)  # stale cache must not serve reads
+
+    def _offer(self, etype: str, kind: str, body: Dict, feeds_cache: bool = False) -> None:
+        try:
+            obj = _decode(kind, body)
+        except Exception:  # noqa: BLE001 — skip undecodable objects
+            log.warning("undecodable %s watch event dropped", kind)
+            return
+        if feeds_cache:
+            # cache BEFORE delivery: a reconcile woken by this event sees
+            # a cache at least as fresh as the event itself
+            self._store.cache.apply(etype, kind, obj)
+        if not self._cache_only:
+            self._q.put(WatchEvent(type=etype, kind=kind, obj=obj))
+
+    def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        # Unblock pumps parked in the chunked read so their feeder/cache
+        # cleanup runs promptly. socket.shutdown (not conn.close) — close
+        # would need the buffered reader's lock, which the blocked reader
+        # thread holds, deadlocking the stopper.
+        for conn in list(self._conns):
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        self._q.put(None)

@@ -1,0 +1,301 @@
+"""Admission webhook server — the webhook the reference scaffolds but
+never implements (SURVEY §2.3: kustomize webhook/certmanager scaffolding,
+zero webhook Go code).
+
+Serves Kubernetes `admission.k8s.io/v1 AdmissionReview` over HTTP(S):
+
+  * POST /validate — decode the incoming workload object, apply defaults
+    to a scratch copy, run the same rule set as apply-time validation
+    (api/validation.py); deny with field-path messages on failure.
+  * POST /mutate — apply the workload's defaulters and respond with an
+    RFC 6902 JSON patch (base64, `patchType: JSONPatch`) transforming
+    the submitted object into its defaulted form — so objects created
+    by ANY client (kubectl, CI, GitOps) land defaulted, exactly what
+    the reference's `SetDefaults_*` funcs needed a webhook for.
+
+TLS: the apiserver requires HTTPS for webhooks; pass cert/key paths
+(cert-manager or `make webhook-certs` self-signed). Tests exercise the
+wire protocol over plain HTTP. Unknown kinds fail OPEN (allowed, with a
+warning) so the webhook can be registered with a broad rule without
+bricking unrelated objects.
+"""
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+log = logging.getLogger("kubedl_tpu.k8s.webhook")
+
+
+# -- RFC 6902 diff -----------------------------------------------------------
+
+
+def _escape(seg: str) -> str:
+    return seg.replace("~", "~0").replace("/", "~1")
+
+
+def json_patch(old, new, path: str = "") -> List[Dict]:
+    """Minimal RFC 6902 diff: add/replace/remove; lists that differ are
+    replaced wholesale (always valid, never clever)."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        ops: List[Dict] = []
+        for key in old:
+            if key not in new:
+                ops.append({"op": "remove", "path": f"{path}/{_escape(key)}"})
+        for key, nval in new.items():
+            sub = f"{path}/{_escape(key)}"
+            if key not in old:
+                ops.append({"op": "add", "path": sub, "value": nval})
+            else:
+                ops.extend(json_patch(old[key], nval, sub))
+        return ops
+    if old != new:
+        return [{"op": "replace", "path": path or "/", "value": new}]
+    return []
+
+
+def apply_patch(doc, ops: List[Dict]):
+    """Reference implementation of patch application (tests + local use)."""
+    doc = copy.deepcopy(doc)
+
+    def resolve(path):
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in path.split("/")[1:]]
+        parent = doc
+        for p in parts[:-1]:
+            parent = parent[int(p)] if isinstance(parent, list) else parent[p]
+        return parent, parts[-1] if parts else ""
+
+    for op in ops:
+        parent, leaf = resolve(op["path"])
+        key = int(leaf) if isinstance(parent, list) else leaf
+        if op["op"] == "move":
+            src_parent, src_leaf = resolve(op["from"])
+            src_key = int(src_leaf) if isinstance(src_parent, list) else src_leaf
+            parent[key] = src_parent[src_key]
+            del src_parent[src_key]
+        elif op["op"] in ("add", "replace"):
+            parent[key] = op["value"]
+        elif op["op"] == "remove":
+            del parent[key]
+    return doc
+
+
+# -- admission logic ---------------------------------------------------------
+
+
+_CONTROLLERS: Optional[Dict] = None
+
+
+def _controllers_by_kind():
+    global _CONTROLLERS
+    if _CONTROLLERS is None:
+        from kubedl_tpu.controllers.registry import enabled_controllers
+        from kubedl_tpu.k8s.resources import register_workload_kinds
+
+        register_workload_kinds()
+        _CONTROLLERS = {c.kind: c for c in enabled_controllers("*")}
+    return _CONTROLLERS
+
+
+def _replica_specs_wire_name(controller) -> str:
+    """Wire name of the workload's replica-specs map (tfReplicaSpecs, ...),
+    read from the spec dataclass's field metadata like serde does."""
+    import dataclasses
+
+    spec_obj = controller.job_type()().spec
+    for f in dataclasses.fields(spec_obj):
+        if f.name == "replica_specs":
+            return f.metadata.get("name", "replicaSpecs")
+    return "replicaSpecs"
+
+
+def _mutate_ops(pre: Dict, post: Dict, replica_field: str) -> List[Dict]:
+    """Diff the PRE-default encode against the POST-default encode — both
+    come from the same typed decode, so fields the internal model doesn't
+    carry appear in NEITHER side and the patch can never strip them from
+    the user's object. Replica-key canonicalization ("worker" -> "Worker")
+    is emitted as a `move` + in-place sub-diff, so everything the user put
+    under the old key (tolerations, affinity, ...) survives the rename."""
+    pre = copy.deepcopy(pre)
+    post = copy.deepcopy(post)
+    ops: List[Dict] = []
+    pre_specs = (pre.get("spec") or {}).get(replica_field)
+    post_specs = (post.get("spec") or {}).get(replica_field)
+    if isinstance(pre_specs, dict) and isinstance(post_specs, dict):
+        base = f"/spec/{_escape(replica_field)}"
+        for old_key in list(pre_specs):
+            if old_key in post_specs:
+                continue
+            new_key = next(
+                (nk for nk in post_specs
+                 if nk.lower() == old_key.lower() and nk not in pre_specs),
+                None,
+            )
+            if new_key is None:
+                continue
+            ops.append({
+                "op": "move",
+                "from": f"{base}/{_escape(old_key)}",
+                "path": f"{base}/{_escape(new_key)}",
+            })
+            ops.extend(json_patch(
+                pre_specs[old_key], post_specs[new_key],
+                f"{base}/{_escape(new_key)}",
+            ))
+            del pre_specs[old_key]
+            del post_specs[new_key]
+    ops.extend(json_patch(pre, post))
+    return ops
+
+
+def review_response(review: Dict, mutate: bool) -> Dict:
+    """AdmissionReview request dict -> AdmissionReview response dict."""
+    from kubedl_tpu.api.validation import ValidationError, validate
+    from kubedl_tpu.k8s.store import _decode, _encode
+
+    req = review.get("request") or {}
+    uid = req.get("uid", "")
+    obj = req.get("object") or {}
+    kind = (obj.get("kind") or req.get("kind", {}).get("kind") or "")
+
+    def respond(allowed, message="", warnings=None, patch_ops=None):
+        resp = {"uid": uid, "allowed": allowed}
+        if message:
+            resp["status"] = {"message": message, "code": 200 if allowed else 422}
+        if warnings:
+            resp["warnings"] = warnings
+        if patch_ops:
+            resp["patchType"] = "JSONPatch"
+            resp["patch"] = base64.b64encode(
+                json.dumps(patch_ops).encode()).decode()
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": resp,
+        }
+
+    controller = _controllers_by_kind().get(kind)
+    if controller is None:
+        return respond(True, warnings=[
+            f"kubedl-tpu webhook: kind {kind!r} not handled — allowed unchanged"])
+    try:
+        job = _decode(kind, obj)
+        defaulted = copy.deepcopy(job)
+        controller.set_defaults(defaulted)
+        if mutate:
+            pre = _encode(job)
+            post = _encode(defaulted)
+            # never patch fields the apiserver owns
+            pre.pop("status", None)
+            post.pop("status", None)
+            ops = _mutate_ops(pre, post, _replica_specs_wire_name(controller))
+            return respond(True, patch_ops=ops)
+        validate(defaulted, controller)
+        return respond(True)
+    except ValidationError as e:
+        return respond(False, message=str(e))
+    except Exception as e:  # noqa: BLE001 — malformed object: deny with why
+        return respond(False, message=f"{type(e).__name__}: {e}")
+
+
+# -- HTTP server -------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "KubedlTPUWebhook/1.0"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    def _send(self, status: int, body: Dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path not in ("/validate", "/mutate"):
+            return self._send(404, {"message": f"unknown path {self.path}"})
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        try:
+            review = json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            return self._send(400, {"message": f"bad AdmissionReview: {e}"})
+        self._send(200, review_response(review, mutate=self.path == "/mutate"))
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/healthz":
+            return self._send(200, {"ok": True})
+        self._send(404, {"message": "POST AdmissionReview to /validate or /mutate"})
+
+
+class _WebhookHTTPServer(ThreadingHTTPServer):
+    """TLS wraps the ACCEPTED socket inside the worker thread, never the
+    listener: a wrapped listener performs the handshake inside the single
+    accept loop, so one client that connects and sends nothing would
+    wedge every admission request behind it."""
+
+    ssl_context: Optional[ssl.SSLContext] = None
+
+    def finish_request(self, request, client_address):
+        if self.ssl_context is not None:
+            request.settimeout(10.0)
+            try:
+                request = self.ssl_context.wrap_socket(request, server_side=True)
+            except (ssl.SSLError, OSError) as e:
+                log.debug("TLS handshake from %s failed: %s", client_address, e)
+                return
+        self.RequestHandlerClass(request, client_address, self)
+
+
+class AdmissionWebhookServer:
+    """`AdmissionWebhookServer(certfile=..., keyfile=...).start()` — HTTPS
+    when certs are given (the apiserver requires it), plain HTTP otherwise
+    (tests, local smoke)."""
+
+    def __init__(
+        self,
+        bind: str = "0.0.0.0",
+        port: int = 9443,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+    ) -> None:
+        self._httpd = _WebhookHTTPServer((bind, port), _Handler)
+        self._httpd.daemon_threads = True
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._httpd.ssl_context = ctx
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "AdmissionWebhookServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="admission-webhook", daemon=True
+        )
+        self._thread.start()
+        log.info("admission webhook serving on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "AdmissionWebhookServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

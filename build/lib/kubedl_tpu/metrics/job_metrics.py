@@ -1,0 +1,165 @@
+"""Job metrics — identical metric surface to the reference, event-driven.
+
+Metric names/labels match docs/metrics.md + pkg/metrics/job_metrics.go:32-61:
+  kubedl_jobs_created/deleted/successful/failed/restarted{kind}
+  kubedl_jobs_running/pending{kind}
+  kubedl_jobs_first_pod_launch_delay_seconds{kind,name,namespace,uid}
+  kubedl_jobs_all_pods_launch_delay_seconds{kind,name,namespace,uid}
+
+One deliberate fix (SURVEY.md §6 scaling hazard): running/pending gauges are
+maintained event-on-status-change, not by listing every job of a kind on each
+scrape (ref pkg/metrics/status_counter.go:35-47).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from kubedl_tpu.api.common import JobStatus, is_created, is_failed, is_running, is_succeeded
+from kubedl_tpu.api.pod import Pod
+
+
+class JobMetrics:
+    def __init__(self, kind: str, registry: Optional["MetricsRegistry"] = None) -> None:
+        self.kind = kind
+        self.registry = registry
+        self._lock = threading.Lock()
+        self.created = 0
+        self.deleted = 0
+        self.successful = 0
+        self.failed = 0
+        self.restarted = 0
+        # event-driven gauge state: job key -> "running"|"pending"
+        self._gauge_state: Dict[str, str] = {}
+        self.first_launch_delays: List[Tuple[str, float]] = []
+        self.all_launch_delays: List[Tuple[str, float]] = []
+        if registry is not None:
+            registry.register(self)
+
+    # -- counters --------------------------------------------------------
+
+    def created_inc(self) -> None:
+        with self._lock:
+            self.created += 1
+
+    def deleted_inc(self) -> None:
+        with self._lock:
+            self.deleted += 1
+
+    def success_inc(self) -> None:
+        with self._lock:
+            self.successful += 1
+
+    def failure_inc(self) -> None:
+        with self._lock:
+            self.failed += 1
+
+    def restarted_inc(self) -> None:
+        with self._lock:
+            self.restarted += 1
+
+    # -- event-driven gauges --------------------------------------------
+
+    def observe_status(self, key: str, status: JobStatus) -> None:
+        with self._lock:
+            if is_failed(status) or is_succeeded(status):
+                self._gauge_state.pop(key, None)
+            elif is_running(status):
+                self._gauge_state[key] = "running"
+            elif is_created(status) and len(status.conditions) == 1:
+                # pending = Created is the only condition (ref status_counter.go:67-75)
+                self._gauge_state[key] = "pending"
+            else:
+                self._gauge_state.pop(key, None)
+
+    def observe_gone(self, key: str) -> None:
+        with self._lock:
+            self._gauge_state.pop(key, None)
+
+    @property
+    def running(self) -> int:
+        with self._lock:
+            return sum(1 for v in self._gauge_state.values() if v == "running")
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return sum(1 for v in self._gauge_state.values() if v == "pending")
+
+    # -- launch-delay histograms (ref job_metrics.go:139-194) ------------
+
+    def first_pod_launch_delay(self, job, active_pods: List[Pod], status: JobStatus) -> None:
+        """Delay from job creation to the FIRST pod becoming Ready."""
+        times = [p.status.ready_time() for p in active_pods if p.status.ready_time()]
+        if not times or job.metadata.creation_timestamp is None:
+            return
+        delay = min(times) - job.metadata.creation_timestamp
+        if delay >= 0:
+            with self._lock:
+                self.first_launch_delays.append((job.metadata.name, delay))
+
+    def all_pods_launch_delay(self, job, pods: List[Pod], status: JobStatus) -> None:
+        """Delay from job creation until ALL pods are Ready."""
+        times = [p.status.ready_time() for p in pods]
+        if not times or any(t is None for t in times):
+            return
+        if job.metadata.creation_timestamp is None:
+            return
+        delay = max(times) - job.metadata.creation_timestamp
+        if delay >= 0:
+            with self._lock:
+                self.all_launch_delays.append((job.metadata.name, delay))
+
+
+class MetricsRegistry:
+    """Aggregates per-kind JobMetrics; renders Prometheus text exposition."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, JobMetrics] = {}
+
+    def register(self, jm: JobMetrics) -> None:
+        with self._lock:
+            self._metrics[jm.kind] = jm
+
+    def get(self, kind: str) -> Optional[JobMetrics]:
+        with self._lock:
+            return self._metrics.get(kind)
+
+    def for_kind(self, kind: str) -> JobMetrics:
+        with self._lock:
+            jm = self._metrics.get(kind)
+        if jm is None:
+            jm = JobMetrics(kind, registry=self)
+        return jm
+
+    def render(self) -> str:
+        """Prometheus text format (metric names per docs/metrics.md)."""
+        lines: List[str] = []
+
+        def counter(name: str, help_: str, attr: str) -> None:
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} counter")
+            for kind, jm in sorted(self._metrics.items()):
+                lines.append(f'{name}{{kind="{kind}"}} {getattr(jm, attr)}')
+
+        counter("kubedl_jobs_created", "Counts number of jobs created", "created")
+        counter("kubedl_jobs_deleted", "Counts number of jobs deleted", "deleted")
+        counter("kubedl_jobs_successful", "Counts number of jobs successful", "successful")
+        counter("kubedl_jobs_failed", "Counts number of jobs failed", "failed")
+        counter("kubedl_jobs_restarted", "Counts number of jobs restarted", "restarted")
+        for gname, attr in (("kubedl_jobs_running", "running"), ("kubedl_jobs_pending", "pending")):
+            lines.append(f"# HELP {gname} Counts number of jobs {attr}")
+            lines.append(f"# TYPE {gname} gauge")
+            for kind, jm in sorted(self._metrics.items()):
+                lines.append(f'{gname}{{kind="{kind}"}} {getattr(jm, attr)}')
+        for hname, attr in (
+            ("kubedl_jobs_first_pod_launch_delay_seconds", "first_launch_delays"),
+            ("kubedl_jobs_all_pods_launch_delay_seconds", "all_launch_delays"),
+        ):
+            lines.append(f"# HELP {hname} Launch delay histogram")
+            lines.append(f"# TYPE {hname} histogram")
+            for kind, jm in sorted(self._metrics.items()):
+                for name, delay in getattr(jm, attr):
+                    lines.append(f'{hname}{{kind="{kind}",name="{name}"}} {delay:.6f}')
+        return "\n".join(lines) + "\n"

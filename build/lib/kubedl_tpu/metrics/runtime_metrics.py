@@ -1,0 +1,174 @@
+"""Reconcile-engine health metrics — net-new over the reference.
+
+SURVEY.md §5 flags that the reference has no tracing/profiling at all (no
+pprof, no reconcile-latency measurement) and prescribes adding a pprof-style
+debug endpoint plus reconcile-latency histograms in the rebuild. This module
+is that: per-controller reconcile duration histograms + error counters
+(folded in by the manager's worker loop) and live workqueue depth gauges,
+rendered in Prometheus text format alongside the job metrics and exposed as
+JSON on the server's /debug/vars.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+
+class _Histogram:
+    __slots__ = ("counts", "total", "sum")
+
+    def __init__(self) -> None:
+        self.counts = [0] * (len(BUCKETS) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.total += 1
+        self.sum += v
+        for i, b in enumerate(BUCKETS):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class RuntimeMetrics:
+    """Thread-safe collector for the reconcile engine."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._durations: Dict[str, _Histogram] = {}
+        self._errors: Dict[str, int] = {}
+        self._requeues: Dict[str, int] = {}
+        # controller name -> queue-depth callable, registered by the manager
+        self._queue_depth: Dict[str, Callable[[], int]] = {}
+        # slice-pool snapshot callable (TPUSliceAdmitter.utilization)
+        self._slice_pool: Optional[Callable[[], Dict]] = None
+
+    def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
+        with self._lock:
+            h = self._durations.get(controller)
+            if h is None:
+                h = self._durations[controller] = _Histogram()
+            h.observe(seconds)
+            if error:
+                self._errors[controller] = self._errors.get(controller, 0) + 1
+
+    def observe_requeue(self, controller: str) -> None:
+        with self._lock:
+            self._requeues[controller] = self._requeues.get(controller, 0) + 1
+
+    def register_queue(self, controller: str, depth_fn: Callable[[], int]) -> None:
+        with self._lock:
+            self._queue_depth[controller] = depth_fn
+
+    def register_slice_pool(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns TPUSliceAdmitter.utilization()-shaped dicts."""
+        with self._lock:
+            self._slice_pool = snapshot_fn
+
+    # -- exposition ------------------------------------------------------
+
+    def render(self) -> str:
+        """Prometheus text format."""
+        with self._lock:
+            lines: List[str] = [
+                "# HELP kubedl_reconcile_duration_seconds Reconcile latency per controller",
+                "# TYPE kubedl_reconcile_duration_seconds histogram",
+            ]
+            for name in sorted(self._durations):
+                h = self._durations[name]
+                cum = 0
+                for b, c in zip(BUCKETS, h.counts):
+                    cum += c
+                    lines.append(
+                        f'kubedl_reconcile_duration_seconds_bucket{{controller="{name}",le="{b}"}} {cum}'
+                    )
+                lines.append(
+                    f'kubedl_reconcile_duration_seconds_bucket{{controller="{name}",le="+Inf"}} {h.total}'
+                )
+                lines.append(
+                    f'kubedl_reconcile_duration_seconds_sum{{controller="{name}"}} {h.sum:.6f}'
+                )
+                lines.append(
+                    f'kubedl_reconcile_duration_seconds_count{{controller="{name}"}} {h.total}'
+                )
+            lines.append("# HELP kubedl_reconcile_errors_total Reconcile errors per controller")
+            lines.append("# TYPE kubedl_reconcile_errors_total counter")
+            for name, n in sorted(self._errors.items()):
+                lines.append(f'kubedl_reconcile_errors_total{{controller="{name}"}} {n}')
+            lines.append("# HELP kubedl_reconcile_requeues_total Rate-limited requeues per controller")
+            lines.append("# TYPE kubedl_reconcile_requeues_total counter")
+            for name, n in sorted(self._requeues.items()):
+                lines.append(f'kubedl_reconcile_requeues_total{{controller="{name}"}} {n}')
+            lines.append("# HELP kubedl_workqueue_depth Current workqueue depth per controller")
+            lines.append("# TYPE kubedl_workqueue_depth gauge")
+            for name, fn in sorted(self._queue_depth.items()):
+                try:
+                    depth = fn()
+                except Exception:
+                    depth = -1
+                lines.append(f'kubedl_workqueue_depth{{controller="{name}"}} {depth}')
+            slice_fn = self._slice_pool
+        # Call the pool snapshot OUTSIDE the metrics lock: it takes the
+        # admitter's lock, and holding both would pin a lock order that a
+        # callback into RuntimeMetrics could deadlock against.
+        if slice_fn is not None:
+            lines.append(
+                "# HELP kubedl_slice_utilization Fraction of pool TPU chips reserved"
+            )
+            lines.append("# TYPE kubedl_slice_utilization gauge")
+            try:
+                snap = slice_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                # explicit sentinel (like kubedl_workqueue_depth) so the
+                # series degrades visibly instead of flapping absent
+                snap = None
+            if snap is None:
+                lines.append("kubedl_slice_utilization -1")
+            else:
+                lines.append(f"kubedl_slice_utilization {snap['utilization']:.4f}")
+                for metric, key in (
+                    ("kubedl_slices_total", "slices_total"),
+                    ("kubedl_slices_reserved", "slices_reserved"),
+                    ("kubedl_slice_chips_total", "chips_total"),
+                    ("kubedl_slice_chips_reserved", "chips_reserved"),
+                ):
+                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(f"{metric} {snap[key]}")
+                lines.append("# TYPE kubedl_slice_reserved gauge")
+                for s in snap["slices"]:
+                    lines.append(
+                        f'kubedl_slice_reserved{{slice="{s["name"]}",type="{s["type"]}"}} '
+                        f'{1 if s["reserved_by"] else 0}'
+                    )
+        return "\n".join(lines) + "\n"
+
+    def debug_vars(self) -> Dict:
+        """JSON snapshot for /debug/vars (the pprof-style surface)."""
+        with self._lock:
+            out: Dict = {"controllers": {}}
+            for name, h in self._durations.items():
+                mean = h.sum / h.total if h.total else 0.0
+                out["controllers"][name] = {
+                    "reconciles": h.total,
+                    "errors": self._errors.get(name, 0),
+                    "requeues": self._requeues.get(name, 0),
+                    "mean_seconds": round(mean, 6),
+                }
+            for name, fn in self._queue_depth.items():
+                try:
+                    depth = fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    depth = -1
+                out["controllers"].setdefault(name, {})["queue_depth"] = depth
+            slice_fn = self._slice_pool
+        if slice_fn is not None:
+            try:
+                out["slice_pool"] = slice_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["slice_pool"] = None
+        out["threads"] = [t.name for t in threading.enumerate()]
+        return out

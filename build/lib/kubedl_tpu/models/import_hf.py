@@ -1,0 +1,155 @@
+"""Hugging Face Llama/Mistral/Gemma checkpoint importer.
+
+Maps a `transformers` Llama, Mistral, or Gemma state dict (identical
+key layout; Mistral adds sliding-window attention -> sliding_window;
+Gemma adds GeGLU, norm weights stored as w-1, and sqrt(d) embedding
+scaling -> act/norm_offset/embed_scale) onto this repo's param tree so
+real released weights run through the TPU-native stack (training,
+decode, serving) — and, just as importantly, gives the Llama
+implementation a gold-standard external parity check: logits must match
+HF's reference implementation (tests/test_import_hf.py pins it).
+
+Conventions line up by construction:
+  * our `_mm` computes x @ W with W [in, out]; torch Linear stores
+    [out, in] -> every projection transposes on import;
+  * our `_rope` is the half-split rotate_half formulation — the same
+    one HF Llama uses — so Q/K rows need NO permutation;
+  * our MLP is down(silu(gate(x)) * up(x)) with w1=gate, w3=up, w2=down.
+
+Import is torch -> numpy -> jax host-side; nothing here touches the
+device until the caller places the tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from kubedl_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config, **overrides) -> LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig."""
+    import jax.numpy as jnp
+
+    model_type = getattr(hf_config, "model_type", "llama")
+    if model_type not in ("llama", "mistral", "gemma"):
+        raise ValueError(
+            f"unsupported model_type {model_type!r} (llama, mistral, gemma)")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        d_model=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(hf_config, "num_key_value_heads", None)
+        or hf_config.num_attention_heads,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rms_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
+        # HF uses sliding_window in {None, 0} to mean "disabled"
+        sliding_window=(getattr(hf_config, "sliding_window", None) or None),
+        dtype=jnp.bfloat16,
+    )
+    if model_type == "gemma":
+        kw.update(
+            act="gelu_tanh",
+            norm_offset=1.0,  # HF stores RMSNorm weights as w - 1
+            embed_scale=float(hf_config.hidden_size) ** 0.5,
+        )
+
+    kw.update(overrides)
+    # refuse configs whose math this stack doesn't implement — importing
+    # them would produce degraded logits with exit 0
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and (scaling.get("rope_type") or scaling.get("type")) not in (None, "default"):
+        raise ValueError(
+            f"rope_scaling {scaling!r} not supported (plain RoPE only — "
+            f"Llama 3.1+ 'llama3'/'linear'/'dynamic' scaling isn't implemented)")
+    if getattr(hf_config, "attention_bias", False) or getattr(hf_config, "mlp_bias", False):
+        raise ValueError("attention/mlp bias tensors not supported "
+                         "(this stack's projections are bias-free)")
+    cfg = LlamaConfig(**kw)
+    expect_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    got_hd = getattr(hf_config, "head_dim", None) or expect_hd
+    if cfg.head_dim != got_hd:
+        raise ValueError(
+            f"head_dim mismatch: ours {cfg.head_dim}, HF {got_hd} — "
+            f"non-standard head_dim checkpoints aren't supported")
+    return cfg
+
+
+def params_from_state_dict(
+    state_dict: Dict[str, Any], config: LlamaConfig
+) -> Dict:
+    """Our param tree from an HF Llama state dict (torch tensors or arrays)."""
+    import jax.numpy as jnp
+
+    def arr(key: str, transpose: bool = False):
+        t = state_dict[key]
+        if hasattr(t, "detach"):  # torch tensor
+            t = t.detach().to("cpu").float().numpy()
+        a = np.asarray(t, np.float32)
+        if transpose:
+            a = a.T
+        return a
+
+    def cast(a):
+        return jnp.asarray(a).astype(config.dtype)
+
+    layers = []
+    for i in range(config.n_layers):
+        p = f"model.layers.{i}"
+        layers.append({
+            "attn_norm": jnp.asarray(arr(f"{p}.input_layernorm.weight"),
+                                     jnp.float32),
+            "wq": cast(arr(f"{p}.self_attn.q_proj.weight", transpose=True)),
+            "wk": cast(arr(f"{p}.self_attn.k_proj.weight", transpose=True)),
+            "wv": cast(arr(f"{p}.self_attn.v_proj.weight", transpose=True)),
+            "wo": cast(arr(f"{p}.self_attn.o_proj.weight", transpose=True)),
+            "mlp_norm": jnp.asarray(arr(f"{p}.post_attention_layernorm.weight"),
+                                    jnp.float32),
+            "w1": cast(arr(f"{p}.mlp.gate_proj.weight", transpose=True)),
+            "w3": cast(arr(f"{p}.mlp.up_proj.weight", transpose=True)),
+            "w2": cast(arr(f"{p}.mlp.down_proj.weight", transpose=True)),
+        })
+    params = {
+        "embed": cast(arr("model.embed_tokens.weight")),
+        "layers": layers,
+        "final_norm": jnp.asarray(arr("model.norm.weight"), jnp.float32),
+    }
+    if not config.tie_embeddings:
+        key = "lm_head.weight"
+        if key in state_dict:
+            params["lm_head"] = cast(arr(key, transpose=True))
+        else:  # checkpoint ties but config didn't say so
+            params["lm_head"] = cast(arr("model.embed_tokens.weight",
+                                         transpose=True))
+    return params
+
+
+def load_hf(
+    name_or_path: str,
+    config_overrides: Optional[Dict] = None,
+) -> Tuple[Dict, LlamaConfig]:
+    """(params, config) from a HF model name or local checkpoint dir."""
+    import transformers
+
+    hf_config = transformers.AutoConfig.from_pretrained(name_or_path)
+    config = config_from_hf(hf_config, **(config_overrides or {}))
+    # dtype='auto' + low_cpu_mem_usage: load at checkpoint dtype without
+    # a second fp32 copy — a 7B import otherwise peaks ~3x the bf16 tree
+    # and OOM-kills serve pods that fit the model fine. (The kwarg was
+    # renamed from torch_dtype; support both transformers generations.)
+    try:
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            name_or_path, dtype="auto", low_cpu_mem_usage=True)
+    except TypeError:
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            name_or_path, torch_dtype="auto", low_cpu_mem_usage=True)
+    try:
+        params = params_from_state_dict(model.state_dict(), config)
+    finally:
+        del model
+    return params, config

@@ -1,0 +1,560 @@
+"""Llama-family decoder — the flagship JAXJob workload (BASELINE.json
+config 4: "Llama-7B SPMD pretrain on v5p-32").
+
+Pure-functional JAX: params are a pytree of arrays, the forward is a plain
+jittable function, and every tensor carries a logical sharding spec
+(parallel/mesh.ShardingRules) so one model definition runs 1-chip or
+dp/fsdp/tp/cp-sharded unchanged — XLA inserts the collectives.
+
+TPU-first choices:
+  * bf16 params/activations, f32 RMSNorm epsilon path and logits
+    (MXU-friendly, HBM-light);
+  * attention via the Pallas flash kernel (ops/flash_attention.py) on a
+    single context shard, or ring attention (ops/ring_attention.py) when the
+    mesh's "context" axis > 1;
+  * per-layer jax.checkpoint (remat) to trade FLOPs for HBM on long
+    sequences;
+  * weights laid out so tensor-parallel matmuls contract over the sharded
+    dim exactly once (wo/w2 row-sharded -> one psum per block).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubedl_tpu.models.moe import moe_init, moe_mlp, moe_param_specs
+from kubedl_tpu.models.quant import matmul as _mm
+from kubedl_tpu.ops.flash_attention import flash_attention
+from kubedl_tpu.ops.ring_attention import ring_attention
+from kubedl_tpu.parallel import pipeline
+from kubedl_tpu.parallel.mesh import ShardingRules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # None = full recompute; "dots" saves matmul outputs and recomputes
+    # only elementwise ops (jax dots_with_no_batch_dims_saveable) — most
+    # of remat's HBM win at a fraction of its ~15-35% step-time cost
+    remat_policy: Optional[str] = None
+    use_flash: bool = True
+    # context-parallel attention strategy when the mesh's "context" axis
+    # is >1: "ring" rotates K/V with ppermute (any P, score memory t/P);
+    # "ulysses" all-to-alls into head shards and runs plain full-sequence
+    # attention per rank (cheaper comms at small P, capped at the head
+    # count) — see ops/ulysses.py for the trade-off.
+    context_parallel: str = "ring"
+    # family knobs (Gemma: gelu_tanh FFN, norm weight stored as w-1,
+    # embeddings scaled by sqrt(d_model))
+    act: str = "silu"  # "silu" | "gelu_tanh"
+    norm_offset: float = 0.0  # rms_norm multiplies by (weight + offset)
+    embed_scale: float = 1.0
+    # Mistral-style sliding-window attention: query i attends keys in
+    # (i - sliding_window, i]. None = full causal. Applies to prefill,
+    # decode, and training; not combined with context parallelism.
+    sliding_window: Optional[int] = None
+    tie_embeddings: bool = False
+    # >1: compute the training loss over this many vocab chunks instead of
+    # materializing [b, t, vocab] f32 logits (a 1 GB HBM round-trip at
+    # b8/s1024/V32k) — each chunk's lm_head matmul fuses with its logsumexp
+    # reduction and is recomputed in backward (see _next_token_ce_chunked).
+    # A memory knob, not a speed knob (measured ~5-9% slower on v5e).
+    # Ignored (with a one-time warning) on tensor-parallel meshes, where
+    # the head's vocab dim is sharded and the full-logits path applies.
+    ce_chunks: int = 0
+    # MoE (expert parallelism over the "expert" mesh axis): n_experts=0 means
+    # dense FFN; >0 replaces every FFN with a top-k-routed expert layer
+    n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.sliding_window is not None and self.sliding_window < 1:
+            # a window of 0 masks EVERY key: softmax over all -inf rows
+            # returns uniform garbage with exit 0 — refuse loudly
+            raise ValueError(
+                f"sliding_window must be >= 1 or None, got {self.sliding_window}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test/dry-run size."""
+        defaults = dict(
+            vocab_size=256, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=256, max_seq_len=256,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def config_for(name: str) -> "LlamaConfig":
+        """Named configs shared by the trainer/generate CLIs."""
+        factories = {
+            "tiny": LlamaConfig.tiny,
+            "bench-150m": LlamaConfig.bench_150m,
+            "bench-1b": LlamaConfig.bench_1b,
+            "llama-7b": LlamaConfig.llama_7b,
+        }
+        if name not in factories:
+            raise ValueError(
+                f"unknown model {name!r} (choose from {sorted(factories)})"
+            )
+        return factories[name]()
+
+    @staticmethod
+    def bench_150m(**kw) -> "LlamaConfig":
+        """~170M params — the single-chip quick-proof bench size."""
+        defaults = dict(
+            vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
+            n_kv_heads=8, d_ff=2816, max_seq_len=1024,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def bench_1b(**kw) -> "LlamaConfig":
+        """~1.1B params — fits one v5e chip (16 GB HBM) in bf16 + optimizer."""
+        defaults = dict(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=16, d_ff=5632, max_seq_len=2048,
+        )
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+
+def param_specs(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> Dict:
+    """PartitionSpec pytree matching init() — the sharding contract."""
+    r = rules or ShardingRules()
+    layer = {
+        "attn_norm": r.spec("embed"),
+        "wq": r.spec("embed", "heads"),
+        "wk": r.spec("embed", "heads"),
+        "wv": r.spec("embed", "heads"),
+        "wo": r.spec("heads", "embed"),
+        "mlp_norm": r.spec("embed"),
+    }
+    if config.n_experts > 0:
+        layer["moe"] = moe_param_specs(r)
+    else:
+        layer.update({
+            "w1": r.spec("embed", "mlp"),
+            "w3": r.spec("embed", "mlp"),
+            "w2": r.spec("mlp", "embed"),
+        })
+    specs = {
+        "embed": r.spec("vocab", "embed"),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+        "final_norm": r.spec("embed"),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = r.spec("embed", "vocab")
+    return specs
+
+
+def init(config: LlamaConfig, key: jax.Array) -> Dict:
+    """Initialize the param pytree (truncated-normal fan-in scaling)."""
+    d, dff, hd = config.d_model, config.d_ff, config.head_dim
+    nq, nkv = config.n_heads, config.n_kv_heads
+    dt = config.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (1.0 / np.sqrt(fan_in))).astype(dt)
+
+    keys = jax.random.split(key, config.n_layers + 3)
+    layers = []
+    for i in range(config.n_layers):
+        ks = jax.random.split(keys[i], 7)
+        norm_init = jnp.full((d,), 1.0 - config.norm_offset, jnp.float32)
+        layer = {
+            "attn_norm": norm_init,
+            "wq": dense(ks[0], (d, nq * hd), d),
+            "wk": dense(ks[1], (d, nkv * hd), d),
+            "wv": dense(ks[2], (d, nkv * hd), d),
+            "wo": dense(ks[3], (nq * hd, d), nq * hd),
+            "mlp_norm": norm_init,
+        }
+        if config.n_experts > 0:
+            layer["moe"] = moe_init(ks[4], d, dff, config.n_experts, dtype=dt)
+        else:
+            layer.update({
+                "w1": dense(ks[4], (d, dff), d),
+                "w3": dense(ks[5], (d, dff), d),
+                "w2": dense(ks[6], (dff, d), dff),
+            })
+        layers.append(layer)
+    params = {
+        "embed": dense(keys[-3], (config.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.full((d,), 1.0 - config.norm_offset, jnp.float32),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = dense(keys[-2], (d, config.vocab_size), d)
+    return params
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+
+def _remat_policy(name: Optional[str]):
+    if name is None:
+        return None  # save nothing: full recompute
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {name!r} (None | 'dots')")
+
+
+def rms_norm(x, weight, eps, offset: float = 0.0):
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    w = weight + offset if offset else weight
+    return (xf * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def _act(x, kind: str):
+    if kind == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if kind != "silu":
+        raise ValueError(f"unknown activation {kind!r} (silu, gelu_tanh)")
+    return jax.nn.silu(x)
+
+
+def _rope(x, positions, theta):
+    """Rotary embeddings over [b, h, t, d_head]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(angles)[:, None, :, :]  # [b, 1, t, half]
+    sin = jnp.sin(angles)[:, None, :, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, context_size):
+    b, t, d = x.shape
+    hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
+    h = rms_norm(x, layer["attn_norm"], config.rms_eps, config.norm_offset)
+    q = _mm(h, layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
+    k = _mm(h, layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    v = _mm(h, layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    if nq != nkv:
+        rep = nq // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if context_size > 1:
+        if config.sliding_window is not None:
+            raise NotImplementedError(
+                "sliding_window + context parallelism is not implemented "
+                "(a windowed ring would skip most hops; use full attention "
+                "on the context mesh or a single-shard windowed model)")
+        if config.context_parallel == "ulysses":
+            from kubedl_tpu.ops.ulysses import ulysses_attention
+
+            attn = ulysses_attention(
+                q, k, v, mesh=mesh, causal=True, use_flash=config.use_flash)
+        else:
+            attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+    elif config.use_flash:
+        attn = flash_attention(q, k, v, causal=True,
+                               window=config.sliding_window)
+    else:
+        from kubedl_tpu.ops.flash_attention import attention_reference
+
+        attn = attention_reference(q, k, v, causal=True,
+                                   window=config.sliding_window)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
+    return x + _mm(attn, layer["wo"]).astype(x.dtype)
+
+
+def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
+    """Dense or MoE FFN; returns (out, aux_loss)."""
+    h = rms_norm(x, layer["mlp_norm"], config.rms_eps, config.norm_offset)
+    if "moe" in layer:
+        y, aux = moe_mlp(
+            h, layer["moe"], top_k=config.expert_top_k,
+            capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
+        )
+        return x + y.astype(x.dtype), aux
+    gate = _act(_mm(h, layer["w1"]).astype(jnp.float32), config.act).astype(h.dtype)
+    up = _mm(h, layer["w3"])
+    return x + (_mm(gate * up, layer["w2"])).astype(x.dtype), jnp.zeros((), jnp.float32)
+
+
+def _constrainer(mesh, rules):
+    def constrain(x, *dims):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
+    return constrain
+
+
+def _backbone(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+) -> Tuple[jax.Array, jax.Array]:
+    """(pre-final-norm activations [batch, seq, d], summed MoE aux loss)."""
+    context_size = 1
+    if mesh is not None:
+        context_size = mesh.shape.get("context", 1)
+    constrain = _constrainer(mesh, rules)
+
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    # FSDP-gather the table's embed dim before the lookup: a gather whose
+    # output inherits a feature-dim sharding forces SPMD into an involuntary
+    # full rematerialization when the result is then batch-sharded; with the
+    # embed dim unsharded the output reshards by a cheap dynamic-slice.
+    tbl = constrain(params["embed"], "vocab", None)
+    x = tbl[tokens].astype(config.dtype)
+    if config.embed_scale != 1.0:
+        x = x * jnp.asarray(config.embed_scale, config.dtype)
+    x = constrain(x, "batch", "seq", None)
+
+    def layer_fn(carry, layer):
+        x, aux = carry
+        x = _attention_block(x, layer, config, positions, mesh, rules, context_size)
+        x = constrain(x, "batch", "seq", None)
+        x, a = _mlp_block(x, layer, config, mesh, rules)
+        return constrain(x, "batch", "seq", None), aux + a
+
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
+    aux = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x, aux = layer_fn((x, aux), layer)
+    return x, aux
+
+
+def forward_and_aux(
+    params: Dict,
+    tokens: jax.Array,  # [batch, seq] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(logits [batch, seq, vocab] f32, summed MoE aux loss — 0 when dense)."""
+    rules = rules or ShardingRules()
+    x, aux = _backbone(params, tokens, config, mesh, rules)
+    logits = _lm_head(x, params, config)
+    return _constrainer(mesh, rules)(logits, "batch", "seq", "vocab"), aux
+
+
+def forward(params, tokens, config: LlamaConfig, mesh=None, rules=None) -> jax.Array:
+    """Logits [batch, seq, vocab] (f32)."""
+    return forward_and_aux(params, tokens, config, mesh=mesh, rules=rules)[0]
+
+
+def _head_matrix(params, config: LlamaConfig):
+    """[d, vocab] LM head (possibly an int8 quantized leaf) — separate
+    weights or the tied embedding table."""
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T.astype(config.dtype)
+    return head
+
+
+def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
+    """Final norm + (tied or separate) LM head -> f32 logits."""
+    x = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    return _mm(x, _head_matrix(params, config)).astype(jnp.float32)
+
+
+def _next_token_ce(logits, targets):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def _next_token_ce_chunked(x, params, config: LlamaConfig, targets, n_chunks: int):
+    """CE without materializing [b, t, V] f32 logits.
+
+    lax.scan over vocab chunks: each chunk's lm_head matmul fuses with its
+    max/sumexp reduction (only [b, t] statistics leave the chunk), and
+    jax.checkpoint recomputes the chunk logits in backward instead of
+    saving them. Online-logsumexp merge across chunks is exact.
+    """
+    xn = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
+    head = _head_matrix(params, config)
+    d, V = head.shape
+    if V % n_chunks:
+        raise ValueError(f"vocab {V} not divisible by ce_chunks {n_chunks}")
+    cs = V // n_chunks
+    hc = jnp.moveaxis(head.reshape(d, n_chunks, cs), 1, 0)  # [n, d, cs]
+    offs = jnp.arange(n_chunks, dtype=targets.dtype) * cs
+
+    @jax.checkpoint
+    def chunk_stats(h_c, off):
+        logits = (xn @ h_c).astype(jnp.float32)  # [b, t, cs]
+        m = jnp.max(logits, axis=-1)
+        l = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+        in_chunk = (targets >= off) & (targets < off + cs)
+        idx = jnp.clip(targets - off, 0, cs - 1)
+        tl = jnp.take_along_axis(logits, idx[..., None], axis=-1)[..., 0]
+        tl = jnp.where(in_chunk, tl, -jnp.inf)
+        return m, l, tl
+
+    def body(carry, inp):
+        big_m, big_l, tgt = carry
+        m, l, tl = chunk_stats(*inp)
+        new_m = jnp.maximum(big_m, m)
+        big_l = big_l * jnp.exp(big_m - new_m) + l * jnp.exp(m - new_m)
+        # exactly one chunk holds each target, the rest contribute -inf
+        return (new_m, big_l, jnp.maximum(tgt, tl)), None
+
+    b, t = targets.shape
+    init = (
+        jnp.full((b, t), -jnp.inf, jnp.float32),
+        jnp.zeros((b, t), jnp.float32),
+        jnp.full((b, t), -jnp.inf, jnp.float32),
+    )
+    (big_m, big_l, tgt), _ = jax.lax.scan(body, init, (hc, offs))
+    lse = big_m + jnp.log(big_l)
+    return jnp.mean(lse - tgt)
+
+
+def loss_fn(params, tokens, config: LlamaConfig, mesh=None, rules=None):
+    """Next-token cross entropy (+ MoE aux); tokens [b, t], loss over [:, 1:].
+
+    With config.ce_chunks > 1 (and no vocab/tensor sharding to respect)
+    the loss runs chunked — the full logits tensor never exists.
+    """
+    rules = rules or ShardingRules()
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if config.ce_chunks > 1:
+        if mesh is None or mesh.shape.get("tensor", 1) == 1:
+            x, aux = _backbone(params, inputs, config, mesh, rules)
+            ce = _next_token_ce_chunked(x, params, config, targets, config.ce_chunks)
+            return ce + config.moe_aux_coef * aux
+        _warn_ce_chunks_ignored(mesh.shape.get("tensor", 1))
+    logits, aux = forward_and_aux(params, inputs, config, mesh=mesh, rules=rules)
+    return _next_token_ce(logits, targets) + config.moe_aux_coef * aux
+
+
+_warned_ce_chunks = False
+
+
+def _warn_ce_chunks_ignored(tensor_size: int) -> None:
+    global _warned_ce_chunks
+    if _warned_ce_chunks:
+        return
+    _warned_ce_chunks = True
+    import warnings
+
+    warnings.warn(
+        f"ce_chunks ignored: the mesh's tensor axis ({tensor_size}) shards the "
+        f"head's vocab dim, so the full-logits loss path applies",
+        stacklevel=3,
+    )
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel path ("stage" mesh axis; SURVEY.md §2.4 PP row)
+# ---------------------------------------------------------------------------
+
+
+def param_specs_pp(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> Dict:
+    """Spec pytree matching stack_params(): layer leaves gain a leading
+    layer dim sharded over "stage"."""
+    r = rules or ShardingRules()
+    base = param_specs(config, r)
+    layer0 = base["layers"][0]
+    base["layers"] = jax.tree_util.tree_map(
+        lambda s: P(*(r.rules["layers"] + tuple(s))), layer0,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return base
+
+
+def stack_params(params: Dict) -> Dict:
+    """Per-layer list-of-dicts -> stacked leaves [n_layers, ...] for the
+    pipelined forward (parallel/pipeline.py layout)."""
+    out = dict(params)
+    out["layers"] = pipeline.stack_layers(params["layers"])
+    return out
+
+
+def forward_pipelined(
+    params: Dict,  # stacked layout (stack_params)
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    n_microbatches: int = 4,
+) -> jax.Array:
+    """GPipe forward over the mesh's "stage" axis. Composes with data
+    parallelism; tensor/context/expert must be size 1 on a pipelined mesh
+    (those shardings need manual collectives inside shard_map)."""
+    if config.n_experts > 0:
+        raise ValueError("pipelined path requires dense FFN (n_experts=0)")
+    for ax in ("tensor", "context", "expert"):
+        if mesh.shape.get(ax, 1) != 1:
+            raise ValueError(f"pipelined mesh must have {ax}=1, got {mesh.shape[ax]}")
+    rules = rules or ShardingRules()
+    b, t = tokens.shape
+    positions1 = jnp.arange(t, dtype=jnp.int32)[None]
+
+    x = params["embed"][tokens].astype(config.dtype)
+
+    def layer_fn(a, layer):
+        pos = jnp.broadcast_to(positions1, (a.shape[0], t))
+        a = _attention_block(a, layer, config, pos, None, rules, 1)
+        a, _ = _mlp_block(a, layer, config)
+        return a
+
+    x = pipeline.microbatch(x, n_microbatches)
+    y = pipeline.pipeline_apply(
+        params["layers"], x, layer_fn, mesh=mesh, remat=config.remat
+    )
+    x = pipeline.unmicrobatch(y)
+    return _lm_head(x, params, config)
+
+
+def loss_fn_pp(
+    params, tokens, config: LlamaConfig, mesh: Mesh, rules=None, n_microbatches: int = 4
+):
+    logits = forward_pipelined(
+        params, tokens[:, :-1], config, mesh, rules=rules, n_microbatches=n_microbatches
+    )
+    return _next_token_ce(logits, tokens[:, 1:])

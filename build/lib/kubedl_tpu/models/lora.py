@@ -1,0 +1,156 @@
+"""LoRA — low-rank adapters for parameter-efficient fine-tuning.
+
+Hu et al., 2021: freeze the base weights, train per-projection low-rank
+deltas W' = W + (alpha/r) * A @ B with A [in, r] noise-init and
+B [r, out] zero-init, so step 0 is exactly the base model.
+
+TPU-first integration: no forward-code changes and no per-layer adapter
+branches — `merge()` is a pure pytree map producing ordinary Llama
+params, so the SAME jitted train step / decode / serving engine runs
+adapted models. During training the merge happens INSIDE the loss under
+jit (the base rides along as a non-differentiated argument, sharded
+with the regular param specs — never a jit closure constant), XLA fuses
+the rank-r matmul into the surrounding graph, and the optimizer state
+covers only the adapters — the 100x-smaller memory footprint that is
+LoRA's point.
+
+Adapters are replicated across the mesh (they are tiny; an all-gather
+of A@B per step would cost more than it saves).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import llama
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w1", "w3", "w2")
+
+
+def lora_init(
+    key: jax.Array,
+    params: Dict,
+    rank: int = 8,
+    targets: Tuple[str, ...] = DEFAULT_TARGETS,
+    dtype=jnp.float32,
+) -> Dict:
+    """Adapter pytree mirroring params' layer structure: per targeted
+    projection, {"a": [in, r] (fan-in noise), "b": [r, out] (zeros)}."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    adapters = {"layers": []}
+    for layer in params["layers"]:
+        entry = {}
+        for name in targets:
+            w = layer.get(name)
+            if w is None:  # e.g. MoE layers carry no dense w1/w3/w2
+                continue
+            key, sub = jax.random.split(key)
+            fan_in = w.shape[0]
+            entry[name] = {
+                "a": (jax.random.normal(sub, (fan_in, rank), jnp.float32)
+                      / np.sqrt(fan_in)).astype(dtype),
+                "b": jnp.zeros((rank, w.shape[1]), dtype),
+            }
+        adapters["layers"].append(entry)
+    if not any(adapters["layers"]):
+        # a typo'd target list would otherwise train zero parameters
+        # "successfully" — the loss just never moves
+        raise ValueError(
+            f"no adapter targets matched any layer: targets={targets!r}")
+    return adapters
+
+
+def merge(params: Dict, adapters: Dict, alpha: Optional[float] = None) -> Dict:
+    """Base + (alpha/r) * A@B -> ordinary Llama params (new tree; base
+    untouched). alpha defaults to the rank (scale 1.0)."""
+    if len(params["layers"]) != len(adapters["layers"]):
+        raise ValueError(
+            f"adapter/base layer-count mismatch: {len(adapters['layers'])} "
+            f"adapter layers vs {len(params['layers'])} model layers — "
+            f"wrong checkpoint/config pairing")
+    merged_layers = []
+    for layer, entry in zip(params["layers"], adapters["layers"]):
+        new_layer = dict(layer)
+        for name, ab in entry.items():
+            r = ab["a"].shape[1]
+            scale = (alpha if alpha is not None else float(r)) / float(r)
+            w = layer[name]
+            delta = (ab["a"].astype(jnp.float32) @ ab["b"].astype(jnp.float32))
+            new_layer[name] = (
+                w.astype(jnp.float32) + scale * delta
+            ).astype(w.dtype)
+        merged_layers.append(new_layer)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+def adapter_count(adapters: Dict) -> int:
+    return llama.param_count(adapters)
+
+
+def restore_and_merge(
+    base_params: Dict,
+    checkpoint_path: str,
+    alpha: Optional[float] = None,
+) -> Dict:
+    """Merge the newest adapter checkpoint under `checkpoint_path` (a
+    trainer --lora-rank run's Orbax dir) into base weights — the consumer
+    side of adapter-only checkpoints for generate/serve."""
+    from kubedl_tpu.train.generate import restore_params
+
+    adapters = restore_params(checkpoint_path, label="lora adapters")
+    if adapters is None:
+        raise ValueError(f"no adapter checkpoint under {checkpoint_path!r}")
+    return merge(base_params, adapters, alpha=alpha)
+
+
+def make_lora_step(
+    base_params: Dict,
+    config: llama.LlamaConfig,
+    tx,
+    mesh,
+    rules=None,
+    rank: int = 8,
+    alpha: Optional[float] = None,
+    targets: Tuple[str, ...] = DEFAULT_TARGETS,
+    key: Optional[jax.Array] = None,
+    accum_steps: int = 1,
+):
+    """(adapters0, init_state, lora_step) — the pretraining LM loss with
+    gradients flowing ONLY to the adapters; optimizer state is
+    adapter-sized. lora_step(state, tokens) like the plain train step."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubedl_tpu.parallel.mesh import ShardingRules, shard_pytree
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    rules = rules or ShardingRules()
+    adapters0 = lora_init(
+        key if key is not None else jax.random.PRNGKey(0),
+        base_params, rank=rank, targets=targets,
+    )
+    base_specs = llama.param_specs(config, rules)
+    base_sharded = shard_pytree(base_params, mesh, base_specs)
+    # adapters replicate: tiny tensors, gathered nowhere
+    adapter_specs = jax.tree_util.tree_map(lambda _: P(), adapters0)
+
+    def loss_fn(adapters, batch):
+        tokens, base = batch
+        merged = merge(base, adapters, alpha=alpha)
+        return llama.loss_fn(merged, tokens, config, mesh=mesh, rules=rules)
+
+    batch_spec = (rules.spec("batch", None), base_specs)
+    init_state, step = make_train_step(
+        loss_fn, tx, mesh, adapter_specs, batch_spec, rules,
+        accum_steps=accum_steps,
+    )
+
+    def lora_step(state, tokens):
+        return step(state, (tokens, base_sharded))
+
+    return adapters0, init_state, lora_step

@@ -1,0 +1,115 @@
+"""Weight-only int8 quantization for inference (decode/serving).
+
+Single-chip autoregressive decode is HBM-bandwidth-bound: every generated
+token re-reads the full weight set, so step time is ~(weight bytes)/(HBM
+GB/s). Storing matrix weights as int8 with a per-output-channel bf16 scale
+halves the bytes — the MXU still computes in bf16 (the int8->bf16 convert
+fuses into the matmul's operand read on XLA:TPU), so this is a pure
+bandwidth win with per-channel symmetric accuracy (max |w| per column).
+
+No counterpart in the reference (an orchestrator, ref README.md:6-28);
+this is TPU-serving capability for the JAXJob generate program
+(train/generate.py), same spirit as jax quantized-serving stacks.
+
+Usage:
+    qparams = quantize_params(params)           # llama pytree -> quant pytree
+    logits, cache = decode_step(qparams, ...)   # same entry points
+Training never sees quantized trees (grads through int8 are meaningless);
+`matmul` dispatches on leaf type so the model code is shared.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# Quantized-leaf marker: a dict with exactly these keys. Kept a plain dict
+# so the tree flattens/serializes like any other params pytree.
+_QKEYS = frozenset({"q", "s"})
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and frozenset(leaf.keys()) == _QKEYS
+
+
+def quantize(w: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel int8: w [in, out] -> q int8, s [out].
+
+    s = max|w[:, c]| / 127 per column c, so dequant q*s spans the column's
+    full range; zero columns get s=1 to avoid 0/0."""
+    if w.ndim != 2:
+        raise ValueError(f"quantize expects a 2-D matrix, got shape {w.shape}")
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=0)  # [out]
+    # round the scale to its stored bf16 value BEFORE quantizing, so the
+    # int codes compensate the scale's own rounding (|err| <= s/2 exactly)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.bfloat16)
+    q = jnp.clip(jnp.round(wf / s.astype(jnp.float32)), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize(leaf: Dict[str, jax.Array], dtype=jnp.bfloat16) -> jax.Array:
+    return (leaf["q"].astype(jnp.float32) * leaf["s"].astype(jnp.float32)).astype(dtype)
+
+
+def matmul(x: jax.Array, w: Any) -> jax.Array:
+    """x @ w for plain or quantized w; the scale applies to output columns
+    AFTER the contraction (exact: s is constant per column)."""
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+# Llama layer weights worth quantizing: the 2-D matmul operands. Norms
+# (f32 vectors) and the embedding table (row-gather, not a matmul read)
+# stay as-is; the LM head IS quantized — at [d, V] it is the single
+# largest per-token read.
+_LAYER_MATS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def quantize_stack(w: jax.Array) -> Dict[str, jax.Array]:
+    """Per-expert per-output-channel int8 for [E, in, out] stacks:
+    q int8 [E, in, out], s [E, out]."""
+    if w.ndim != 3:
+        raise ValueError(f"quantize_stack expects [E, in, out], got {w.shape}")
+    qs = jax.vmap(quantize)(w)
+    return {"q": qs["q"], "s": qs["s"]}
+
+
+def quantize_params(params: Dict) -> Dict:
+    """Llama param pytree -> same-shape tree with int8 matrix leaves.
+
+    The embedding stays bf16 (row-gather); with tied embeddings the head
+    path reads embed.T, so tie_embeddings models only benefit in the
+    layers. MoE expert stacks quantize per expert (the router stays f32 —
+    tiny, and gating is precision-sensitive)."""
+    out = {"embed": params["embed"], "final_norm": params["final_norm"]}
+    layers = []
+    for layer in params["layers"]:
+        ql = {}
+        for name, leaf in layer.items():
+            if name in _LAYER_MATS:
+                ql[name] = quantize(leaf)
+            elif name == "moe":
+                ql[name] = {
+                    k: (quantize_stack(v) if k in ("w1", "w3", "w2") else v)
+                    for k, v in leaf.items()
+                }
+            else:
+                ql[name] = leaf
+        layers.append(ql)
+    out["layers"] = layers
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"])
+    return out
+
+
+def tree_bytes(params: Dict) -> int:
+    """Total stored bytes of any params pytree (quantized or not). Note
+    this counts EVERY leaf — including the never-quantized embedding and
+    norms — so it reports whole-tree storage, not just matmul weights."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(params)
+    )

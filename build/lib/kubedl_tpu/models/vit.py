@@ -1,0 +1,189 @@
+"""Vision Transformer — the image-classification model family.
+
+The reference orchestrates TF/torch vision jobs (its MNIST examples) without
+owning a model; here the framework ships one. TPU-first choices:
+
+  * patch embedding is reshape + one matmul (a [P*P*C, D] projection) — the
+    MXU path, no im2col/conv lowering needed;
+  * pre-LN encoder blocks reuse the Pallas flash attention kernel
+    (ops/flash_attention.py, causal=False) when shapes are MXU-tileable,
+    falling back to plain XLA otherwise;
+  * bf16 activations with f32 layernorm/softmax statistics;
+  * param_specs map heads/mlp onto the "tensor" mesh axis and rows onto
+    "fsdp" — the same ShardingRules vocabulary as the Llama model, so
+    parallel/train_step.py drives both.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from kubedl_tpu.ops.flash_attention import attention_reference, flash_attention
+from kubedl_tpu.parallel.mesh import ShardingRules
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    n_channels: int = 3
+    n_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: jnp.dtype = jnp.bfloat16
+    ln_eps: float = 1e-6
+    use_flash: bool = True
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "ViTConfig":
+        kw.setdefault("image_size", 32)
+        kw.setdefault("patch_size", 8)
+        kw.setdefault("n_classes", 10)
+        kw.setdefault("d_model", 64)
+        kw.setdefault("n_layers", 2)
+        kw.setdefault("n_heads", 4)
+        kw.setdefault("d_ff", 128)
+        return cls(**kw)
+
+    @classmethod
+    def base(cls, **kw) -> "ViTConfig":
+        return cls(**kw)  # ViT-B/16 defaults above
+
+
+def _trunc(key, shape, fan_in, dtype):
+    return (
+        jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+        * (1.0 / np.sqrt(fan_in))
+    ).astype(dtype)
+
+
+def init(config: ViTConfig, key: jax.Array) -> Dict:
+    c = config
+    patch_dim = c.patch_size * c.patch_size * c.n_channels
+    keys = jax.random.split(key, 4 + c.n_layers)
+    params: Dict = {
+        "patch_embed": _trunc(keys[0], (patch_dim, c.d_model), patch_dim, c.dtype),
+        # +1 position for the CLS token; f32 like the norms
+        "pos_embed": jnp.zeros((c.n_patches + 1, c.d_model), jnp.float32),
+        "cls": jnp.zeros((c.d_model,), jnp.float32),
+        "head": _trunc(keys[1], (c.d_model, c.n_classes), c.d_model, jnp.float32),
+        "final_ln": {"scale": jnp.ones((c.d_model,), jnp.float32),
+                     "bias": jnp.zeros((c.d_model,), jnp.float32)},
+        "layers": [],
+    }
+    for i in range(c.n_layers):
+        ks = jax.random.split(keys[4 + i], 4)
+        params["layers"].append({
+            "ln1": {"scale": jnp.ones((c.d_model,), jnp.float32),
+                    "bias": jnp.zeros((c.d_model,), jnp.float32)},
+            "ln2": {"scale": jnp.ones((c.d_model,), jnp.float32),
+                    "bias": jnp.zeros((c.d_model,), jnp.float32)},
+            "wqkv": _trunc(ks[0], (c.d_model, 3 * c.d_model), c.d_model, c.dtype),
+            "wo": _trunc(ks[1], (c.d_model, c.d_model), c.d_model, c.dtype),
+            "w1": _trunc(ks[2], (c.d_model, c.d_ff), c.d_model, c.dtype),
+            "w2": _trunc(ks[3], (c.d_ff, c.d_model), c.d_ff, c.dtype),
+        })
+    return params
+
+
+def param_specs(config: ViTConfig, rules: Optional[ShardingRules] = None) -> Dict:
+    r = rules or ShardingRules()
+    layer = {
+        "ln1": {"scale": r.spec(None), "bias": r.spec(None)},
+        "ln2": {"scale": r.spec(None), "bias": r.spec(None)},
+        "wqkv": r.spec("embed", "mlp"),
+        "wo": r.spec("mlp", "embed"),
+        "w1": r.spec("embed", "mlp"),
+        "w2": r.spec("mlp", "embed"),
+    }
+    return {
+        "patch_embed": r.spec(None, "embed"),
+        "pos_embed": r.spec(None, "embed"),
+        "cls": r.spec(None),
+        "head": r.spec("embed", None),
+        "final_ln": {"scale": r.spec(None), "bias": r.spec(None)},
+        "layers": [layer for _ in range(config.n_layers)],
+    }
+
+
+def _layer_norm(x, p, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return out
+
+
+def patchify(images: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, C] -> [B, n_patches, patch*patch*C] by pure reshape."""
+    b, h, w, c = images.shape
+    gh, gw = h // patch, w // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def _encoder_block(x, layer, config: ViTConfig):
+    c = config
+    b, t, d = x.shape
+    h = _layer_norm(x, layer["ln1"], c.ln_eps).astype(c.dtype)
+    qkv = h @ layer["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(z):
+        return z.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    if c.use_flash:
+        attn = flash_attention(q, k, v, causal=False)
+    else:
+        attn = attention_reference(q, k, v, causal=False)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, t, d).astype(c.dtype)
+    x = x + (attn @ layer["wo"]).astype(jnp.float32)
+
+    h = _layer_norm(x, layer["ln2"], c.ln_eps).astype(c.dtype)
+    h = jax.nn.gelu((h @ layer["w1"]).astype(jnp.float32)).astype(c.dtype)
+    return x + (h @ layer["w2"]).astype(jnp.float32)
+
+
+def forward(params, images: jax.Array, config: ViTConfig) -> jax.Array:
+    """[B, H, W, C] images (f32 in [0,1)) -> [B, n_classes] f32 logits."""
+    c = config
+    x = patchify(images, c.patch_size).astype(c.dtype) @ params["patch_embed"]
+    x = x.astype(jnp.float32)
+    b = x.shape[0]
+    cls = jnp.broadcast_to(params["cls"], (b, 1, c.d_model))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    for layer in params["layers"]:
+        x = _encoder_block(x, layer, c)
+    x = _layer_norm(x, params["final_ln"], c.ln_eps)
+    return x[:, 0] @ params["head"]  # CLS token -> classes
+
+
+def loss_fn(params, batch, config: ViTConfig, mesh: Optional[Mesh] = None,
+            rules: Optional[ShardingRules] = None):
+    """batch = (images [B,H,W,C], labels [B]); mean cross entropy."""
+    images, labels = batch
+    logits = forward(params, images, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return -jnp.mean(ll)
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))

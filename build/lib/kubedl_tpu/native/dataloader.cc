@@ -1,0 +1,231 @@
+// Native token data loader — the host-side IO path feeding TPU training.
+//
+// The reference operator has no data path of its own (pure Go control
+// plane); the frameworks it launches bring their own C++ input pipelines
+// (tf.data, torch DataLoader workers). This is our equivalent for the
+// JAXJob runtime: keep the TPU fed without burning Python time on the host.
+//
+// Design:
+//   * token shards are flat little-endian int32 files, mmap'd (zero-copy,
+//     page-cache backed — the kernel does the readahead);
+//   * the shard set is cut into non-overlapping [seq_len] windows; a
+//     multiplicative-affine index permutation (a*i+b mod N, gcd(a,N)=1)
+//     gives a deterministic O(1)-memory global shuffle;
+//   * producer threads materialize whole [batch, seq_len] batches into a
+//     ring of slots; the consumer takes batches strictly in batch-id order,
+//     so output is reproducible regardless of thread count;
+//   * C ABI only (kdl_*) — bound from Python with ctypes (loader.py), no
+//     pybind11 dependency.
+//
+// Build: python -m kubedl_tpu.native.build  (g++ -O3 -shared -fPIC)
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <numeric>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Shard {
+  const int32_t* data = nullptr;
+  size_t n_tokens = 0;
+  size_t mapped_bytes = 0;
+  int fd = -1;
+};
+
+uint64_t gcd64(uint64_t a, uint64_t b) { return b ? gcd64(b, a % b) : a; }
+
+struct Loader {
+  std::vector<Shard> shards;
+  std::vector<uint64_t> window_prefix;  // cumulative windows per shard
+  uint64_t n_windows = 0;
+  int batch = 0;
+  int seq = 0;
+  // affine permutation params
+  uint64_t mul = 1, add = 0;
+
+  // ring of batch slots
+  int n_slots = 0;
+  std::vector<std::vector<int32_t>> slots;
+  std::vector<uint64_t> slot_id;       // which batch id occupies the slot
+  std::vector<bool> slot_ready;
+  uint64_t next_produce = 0;           // next batch id to hand to a producer
+  uint64_t next_consume = 0;           // next batch id the consumer expects
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::atomic<bool> closed{false};
+  std::vector<std::thread> threads;
+
+  uint64_t perm(uint64_t i) const { return (mul * i + add) % n_windows; }
+
+  void window_tokens(uint64_t w, int32_t* out) const {
+    // binary search the owning shard
+    size_t lo = 0, hi = shards.size();
+    while (lo + 1 < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (window_prefix[mid] <= w) lo = mid; else hi = mid;
+    }
+    uint64_t local = w - window_prefix[lo];
+    std::memcpy(out, shards[lo].data + local * seq, sizeof(int32_t) * seq);
+  }
+
+  void fill(uint64_t batch_id, int32_t* out) const {
+    for (int j = 0; j < batch; ++j) {
+      uint64_t w = perm((batch_id * (uint64_t)batch + j) % n_windows);
+      window_tokens(w, out + (size_t)j * seq);
+    }
+  }
+
+  void producer() {
+    for (;;) {
+      uint64_t id;
+      int slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        for (;;) {
+          if (closed.load()) return;
+          id = next_produce;
+          slot = (int)(id % n_slots);
+          // the slot is free once the consumer has passed its previous tenant
+          if (!slot_ready[slot] && id < next_consume + (uint64_t)n_slots) break;
+          cv_produce.wait(lk);
+        }
+        next_produce = id + 1;
+        slot_id[slot] = id;
+      }
+      fill(id, slots[slot].data());
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot_ready[slot] = true;
+      }
+      cv_consume.notify_all();
+    }
+  }
+
+  // returns 0 on success, -1 when closed
+  int next(int32_t* out) {
+    uint64_t id;
+    int slot;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      if (closed.load()) return -1;
+      // Claim the batch id BEFORE waiting: two concurrent consumers must
+      // never wait on the same id, or the loser clears slot_ready for the
+      // slot's NEXT tenant and rewinds next_consume (ring corruption +
+      // deadlock — caught by tests/test_native_tsan.py).
+      id = next_consume++;
+      slot = (int)(id % n_slots);
+      while (!(slot_ready[slot] && slot_id[slot] == id)) {
+        if (closed.load()) return -1;
+        cv_consume.wait(lk);
+      }
+    }
+    // Copy outside the lock: producers can't touch this slot until
+    // slot_ready is cleared below.
+    std::memcpy(out, slots[slot].data(), sizeof(int32_t) * (size_t)batch * seq);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      slot_ready[slot] = false;
+    }
+    cv_produce.notify_all();
+    return 0;
+  }
+
+  ~Loader() {
+    {
+      // store under the lock: a producer between its closed-check and
+      // cv.wait() would otherwise miss the notify and hang the join below
+      std::lock_guard<std::mutex> lk(mu);
+      closed.store(true);
+    }
+    cv_produce.notify_all();
+    cv_consume.notify_all();
+    for (auto& t : threads) if (t.joinable()) t.join();
+    for (auto& s : shards) {
+      if (s.data) munmap((void*)s.data, s.mapped_bytes);
+      if (s.fd >= 0) close(s.fd);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kdl_open(const char** paths, int n_paths, int batch, int seq,
+               uint64_t seed, int n_threads, int n_slots) {
+  if (n_paths <= 0 || batch <= 0 || seq <= 0) return nullptr;
+  auto* L = new Loader();
+  L->batch = batch;
+  L->seq = seq;
+  L->window_prefix.push_back(0);
+  for (int i = 0; i < n_paths; ++i) {
+    Shard s;
+    s.fd = open(paths[i], O_RDONLY);
+    if (s.fd < 0) { delete L; return nullptr; }
+    struct stat st;
+    if (fstat(s.fd, &st) != 0) { close(s.fd); delete L; return nullptr; }
+    s.mapped_bytes = (size_t)st.st_size;
+    s.n_tokens = s.mapped_bytes / sizeof(int32_t);
+    if (s.n_tokens / seq == 0) { close(s.fd); continue; }  // too small
+    s.data = (const int32_t*)mmap(nullptr, s.mapped_bytes, PROT_READ,
+                                  MAP_PRIVATE, s.fd, 0);
+    if (s.data == MAP_FAILED) { close(s.fd); delete L; return nullptr; }
+    madvise((void*)s.data, s.mapped_bytes, MADV_WILLNEED);
+    L->shards.push_back(s);
+    L->window_prefix.push_back(L->window_prefix.back() + s.n_tokens / seq);
+  }
+  L->n_windows = L->window_prefix.back();
+  if (L->n_windows == 0) { delete L; return nullptr; }
+
+  // affine shuffle: odd multiplier derived from the seed, coprime with N
+  uint64_t a = (seed * 6364136223846793005ULL + 1442695040888963407ULL) | 1ULL;
+  a %= L->n_windows;
+  if (a == 0) a = 1;
+  while (gcd64(a, L->n_windows) != 1) a = (a + 1) % L->n_windows ? (a + 1) : 1;
+  L->mul = a;
+  L->add = (seed * 2862933555777941757ULL + 3037000493ULL) % L->n_windows;
+
+  // n_threads == 0 disables the prefetch producers entirely (random-access
+  // batch_at() still works synchronously); negative means "default".
+  if (n_threads < 0) n_threads = 2;
+  if (n_slots < n_threads + 1) n_slots = n_threads + 1;
+  L->n_slots = n_slots;
+  L->slots.assign(n_slots, std::vector<int32_t>((size_t)batch * seq));
+  L->slot_id.assign(n_slots, ~0ULL);
+  L->slot_ready.assign(n_slots, false);
+  for (int i = 0; i < n_threads; ++i)
+    L->threads.emplace_back(&Loader::producer, L);
+  return L;
+}
+
+long kdl_num_windows(void* h) {
+  return h ? (long)((Loader*)h)->n_windows : -1;
+}
+
+int kdl_next(void* h, int32_t* out) {
+  return h ? ((Loader*)h)->next(out) : -1;
+}
+
+// Deterministic reference: fill batch `batch_id` synchronously (for tests
+// and the no-thread path).
+int kdl_batch_at(void* h, uint64_t batch_id, int32_t* out) {
+  if (!h) return -1;
+  ((Loader*)h)->fill(batch_id, out);
+  return 0;
+}
+
+void kdl_close(void* h) {
+  delete (Loader*)h;
+}
+
+}  // extern "C"

@@ -1,0 +1,202 @@
+"""Token data loader: ctypes binding over the native ring-buffer loader,
+with a pure-NumPy fallback that produces bit-identical batches.
+
+Usage:
+    loader = TokenLoader(shard_paths, batch=8, seq_len=1024, seed=0)
+    for _ in range(steps):
+        tokens = loader.next()           # np.int32 [batch, seq_len]
+
+`TokenLoader` prefers the native path (kubedl_tpu/native/dataloader.cc,
+built on demand); `PyTokenLoader` implements the identical affine-shuffled
+window schedule in NumPy, so the two are interchangeable and the tests
+assert equality. Shards are flat little-endian int32 token files
+(`write_shard` below produces them).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from kubedl_tpu.native.build import build as _build_native
+
+_lib = None
+_lib_tried = False
+
+
+def _native_lib():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    # test seam: point at an alternate build (e.g. the TSan-instrumented
+    # library from `python -m kubedl_tpu.native.build --tsan`)
+    path = os.environ.get("KUBEDL_NATIVE_LIB") or _build_native(quiet=True)
+    if not path:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.kdl_open.restype = ctypes.c_void_p
+    lib.kdl_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.kdl_next.restype = ctypes.c_int
+    lib.kdl_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)]
+    lib.kdl_batch_at.restype = ctypes.c_int
+    lib.kdl_batch_at.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int32)
+    ]
+    lib.kdl_num_windows.restype = ctypes.c_long
+    lib.kdl_num_windows.argtypes = [ctypes.c_void_p]
+    lib.kdl_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _native_lib() is not None
+
+
+def write_shard(path: str, tokens: np.ndarray) -> None:
+    np.asarray(tokens, dtype="<i4").tofile(path)
+
+
+def _affine_params(seed: int, n_windows: int):
+    """Mirror of the C++ seed->(mul, add) derivation (dataloader.cc)."""
+    mask = (1 << 64) - 1
+    a = ((seed * 6364136223846793005 + 1442695040888963407) & mask) | 1
+    a %= n_windows
+    if a == 0:
+        a = 1
+    import math
+
+    while math.gcd(a, n_windows) != 1:
+        nxt = (a + 1) % n_windows
+        a = nxt if nxt else 1
+    add = ((seed * 2862933555777941757 + 3037000493) & mask) % n_windows
+    return a, add
+
+
+class PyTokenLoader:
+    """NumPy reference implementation — identical schedule to the native one."""
+
+    def __init__(self, paths: Sequence[str], batch: int, seq_len: int, seed: int = 0):
+        self.batch, self.seq = int(batch), int(seq_len)
+        self._arrays: List[np.ndarray] = []
+        prefix = [0]
+        for p in paths:
+            arr = np.fromfile(p, dtype="<i4")
+            n_win = arr.size // self.seq
+            if n_win == 0:
+                continue
+            self._arrays.append(arr[: n_win * self.seq].reshape(n_win, self.seq))
+            prefix.append(prefix[-1] + n_win)
+        self.n_windows = prefix[-1]
+        if self.n_windows == 0:
+            raise ValueError(f"no [{seq_len}]-token windows in shards {list(paths)}")
+        self._prefix = np.asarray(prefix[:-1], dtype=np.uint64)
+        self.mul, self.add = _affine_params(seed, self.n_windows)
+        self._next_id = 0
+
+    def _window(self, w: int) -> np.ndarray:
+        shard = int(np.searchsorted(self._prefix, w, side="right")) - 1
+        return self._arrays[shard][w - int(self._prefix[shard])]
+
+    def batch_at(self, batch_id: int) -> np.ndarray:
+        out = np.empty((self.batch, self.seq), np.int32)
+        for j in range(self.batch):
+            w = (self.mul * ((batch_id * self.batch + j) % self.n_windows)
+                 + self.add) % self.n_windows
+            out[j] = self._window(w)
+        return out
+
+    def next(self) -> np.ndarray:
+        out = self.batch_at(self._next_id)
+        self._next_id += 1
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class TokenLoader:
+    """Native loader when available, PyTokenLoader otherwise."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch: int,
+        seq_len: int,
+        seed: int = 0,
+        n_threads: int = 2,  # 0 = no prefetch threads (random-access use)
+        n_slots: int = 0,
+        force_python: bool = False,
+    ):
+        self.batch, self.seq = int(batch), int(seq_len)
+        self._h = None
+        self._n_threads = int(n_threads)
+        self._next_id = 0
+        self._fallback: Optional[PyTokenLoader] = None
+        lib = None if force_python else _native_lib()
+        if lib is not None:
+            c_paths = (ctypes.c_char_p * len(paths))(
+                *[os.fsencode(p) for p in paths]
+            )
+            self._h = lib.kdl_open(
+                c_paths, len(paths), self.batch, self.seq,
+                ctypes.c_uint64(seed), n_threads, n_slots,
+            )
+            self._lib = lib
+        if self._h is None:
+            self._fallback = PyTokenLoader(paths, batch, seq_len, seed)
+
+    @property
+    def is_native(self) -> bool:
+        return self._h is not None
+
+    @property
+    def n_windows(self) -> int:
+        if self._h is not None:
+            return int(self._lib.kdl_num_windows(self._h))
+        return self._fallback.n_windows
+
+    def next(self) -> np.ndarray:
+        if self._h is not None:
+            if self._n_threads == 0:
+                # no producer threads exist: kdl_next would wait forever on
+                # a ring nobody fills — serve sequentially via batch_at
+                out = self.batch_at(self._next_id)
+                self._next_id += 1
+                return out
+            out = np.empty((self.batch, self.seq), np.int32)
+            rc = self._lib.kdl_next(
+                self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            )
+            if rc != 0:
+                raise RuntimeError("loader closed")
+            return out
+        return self._fallback.next()
+
+    def batch_at(self, batch_id: int) -> np.ndarray:
+        if self._h is not None:
+            out = np.empty((self.batch, self.seq), np.int32)
+            self._lib.kdl_batch_at(
+                self._h, ctypes.c_uint64(batch_id),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+            return out
+        return self._fallback.batch_at(batch_id)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.kdl_close(self._h)
+            self._h = None
+        self._fallback = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

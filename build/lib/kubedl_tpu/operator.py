@@ -1,0 +1,350 @@
+"""Operator — the process entrypoint wiring (ref main.go:48-115).
+
+Assembles: object store (L0-equivalent), controller manager, per-workload
+reconcilers (registered via the workload registry, gated like the reference's
+workloadgate), TPU-slice gang admission, the local pod executor, metrics
+registry, and optional storage persistence. Usage:
+
+    op = Operator(OperatorConfig(enable_gang_scheduling=True,
+                                 tpu_slices=["v5e-8", "v5p-32"]))
+    op.register_all()       # every known workload (TF/PyTorch/XGB/XDL/JAX)
+    op.start()
+    job = op.apply(manifest_dict)           # like kubectl apply
+    op.wait_for_condition(job, "Succeeded")
+    op.stop()
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import JobConditionType, has_condition
+from kubedl_tpu.controllers.engine import EngineConfig, JobReconciler
+from kubedl_tpu.core.events import EventRecorder
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.core.store import NotFound, ObjectStore
+from kubedl_tpu.executor.local import LocalPodExecutor
+from kubedl_tpu.gang.interface import GangRegistry
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+from kubedl_tpu.metrics.job_metrics import MetricsRegistry
+from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+from kubedl_tpu.api.validation import validate
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector
+from kubedl_tpu.utils.serde import from_dict
+
+log = logging.getLogger("kubedl_tpu.operator")
+
+
+@dataclass
+class OperatorConfig:
+    # flag parity with ref main.go:54-66 / docs/startup_flags.md
+    max_reconciles: int = 1
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = "tpu-slice"
+    # TPU pool available to the executor, e.g. ["v5e-8", "v5p-32"]
+    tpu_slices: List[str] = field(default_factory=list)
+    # workload gate expression, ref pkg/util/workloadgate: "*", "tf,pytorch", "*,-xdl"
+    workloads: str = "*"
+    cluster_domain: str = ""
+    run_executor: bool = True
+    # persistence flags, ref persist_controller.go:30-74 (--object-storage /
+    # --event-storage + REGION env); backend names resolve via the storage
+    # registry ("sqlite" built in). Empty string disables.
+    object_storage: str = ""
+    event_storage: str = ""
+    storage_db_path: str = ":memory:"
+    region: str = field(default_factory=lambda: os.environ.get("REGION", ""))
+    # HA: single active operator via a lease (ref main.go:56 --enable-leader-
+    # election, default true there; off by default here because embedded/test
+    # operators are single-instance — the CLI `operator` command enables it)
+    enable_leader_election: bool = False
+    leader_lease_path: str = DEFAULT_LEASE_PATH
+    # kube mode: coordination.k8s.io Lease timing (client-go-ish defaults)
+    leader_lease_duration: float = 15.0
+    leader_renew_period: float = 5.0
+    leader_retry_period: float = 2.0
+    # Kubernetes mode: reconcile real Pod/Service objects on a cluster
+    # through the kube-apiserver instead of the in-process store + local
+    # executor (ref main.go:70-75 manager-over-client-go). "in-cluster"
+    # resolves the service-account config; otherwise an apiserver URL.
+    kube_api_url: str = ""
+    kube_namespace: str = "default"
+
+
+class Operator:
+    def __init__(self, config: Optional[OperatorConfig] = None, store=None) -> None:
+        self.config = config or OperatorConfig()
+        if store is not None:
+            self.store = store
+        elif self.config.kube_api_url:
+            from kubedl_tpu.k8s import KubeClient, KubeObjectStore
+
+            url = self.config.kube_api_url
+            client = (
+                KubeClient.resolve() if url == "in-cluster" else KubeClient.resolve(url)
+            )
+            self.store = KubeObjectStore(client, namespace=self.config.kube_namespace)
+        else:
+            self.store = ObjectStore()
+        if self.kube_mode:
+            # the cluster's kubelets run pods; no local executor
+            self.config.run_executor = False
+        self.runtime_metrics = RuntimeMetrics()
+        self.manager = Manager(self.store, runtime_metrics=self.runtime_metrics)
+        self.recorder = EventRecorder(self.store)
+        self.metrics_registry = MetricsRegistry()
+        self.gang_registry = GangRegistry()
+        self.gang_registry.register(TPUSliceAdmitter.with_pool(self.store, self.config.tpu_slices))
+        self._gang = self.gang_registry.get(self.config.gang_scheduler_name)
+        if self.config.tpu_slices and isinstance(self._gang, TPUSliceAdmitter):
+            # BASELINE.md "slice utilization" gauge: /metrics + /debug/vars
+            self.runtime_metrics.register_slice_pool(self._gang.utilization)
+        self.executor: Optional[LocalPodExecutor] = None
+        if self.config.run_executor:
+            scheduler = self._gang if self.config.tpu_slices else None
+            self.executor = LocalPodExecutor(self.store, scheduler=scheduler)
+        self.reconcilers: Dict[str, JobReconciler] = {}
+        self._kind_by_lower: Dict[str, str] = {}
+        self._started = False
+        self._stopping = threading.Event()
+        self.elector = None  # FileLeaseElector | KubeLeaseElector
+        self.node_inventory = None  # kube mode: slice pool from node labels
+        self._podgroup_watch = None  # kube mode + gang: cache-only informer
+        # storage persistence (ref main.go:97-100): backends resolved at
+        # start() so every registered workload gets a persist controller
+        self.object_backend = None
+        self.event_backend = None
+        self._persist_controllers: List = []
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, controller) -> JobReconciler:
+        """Register one workload controller (ref controllers/controllers.go:31-47)."""
+        from kubedl_tpu.codesync import CodeSyncer
+
+        mutators = []
+        if self.kube_mode:
+            from kubedl_tpu.k8s.gke import gke_tpu_mutator
+
+            mutators.append(gke_tpu_mutator)
+        engine = JobReconciler(
+            self.store,
+            controller,
+            recorder=self.recorder,
+            metrics=self.metrics_registry.for_kind(controller.kind),
+            gang_scheduler=self._gang,
+            code_syncer=CodeSyncer(),
+            config=EngineConfig(
+                enable_gang_scheduling=self.config.enable_gang_scheduling,
+                cluster_domain=self.config.cluster_domain,
+                pod_mutators=mutators,
+            ),
+        )
+        controller.engine = engine
+        runner = self.manager.add_controller(
+            controller.controller_name, engine.reconcile, workers=self.config.max_reconciles
+        )
+        engine.setup(runner)
+        self.reconcilers[controller.kind] = engine
+        self._kind_by_lower[controller.kind.lower()] = controller.kind
+        log.info("controller started kind=%s workers=%d",
+                 controller.kind, self.config.max_reconciles)
+        return engine
+
+    @property
+    def kube_mode(self) -> bool:
+        from kubedl_tpu.k8s.store import KubeObjectStore
+
+        return isinstance(self.store, KubeObjectStore)
+
+    def register_all(self) -> None:
+        from kubedl_tpu.controllers.registry import enabled_controllers
+
+        # In kube mode the "auto" gate probes the discovery API for each
+        # CRD, like the reference (ref workload_gate.go:26-107). Discovery
+        # errors propagate (StoreError): better to crash-loop at startup
+        # than come up silently reconciling nothing.
+        discover = self.store.has_kind if self.kube_mode else None
+        controllers = enabled_controllers(self.config.workloads, discover=discover)
+        if discover is not None and not controllers:
+            log.warning(
+                "workload gate %r enabled no controllers (no matching CRDs "
+                "served by the API server)", self.config.workloads,
+            )
+        for controller in controllers:
+            self.register(controller)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, timeout: Optional[float] = None) -> bool:
+        """Start reconciling. With leader election enabled this blocks as a
+        standby until the lease is won (ref main.go:70-75 semantics) or
+        `timeout`/`stop()` interrupts it; returns False if never elected."""
+        if self._started:
+            return True
+        if self.config.enable_leader_election:
+            if self.kube_mode:
+                # apiserver-backed Lease: replicas on different nodes
+                # contend through coordination.k8s.io like the reference
+                # (ref main.go:56,70-75); losing the lease stops the
+                # manager — the reference's process would exit
+                from kubedl_tpu.k8s.leader import KubeLeaseElector
+
+                self.elector = KubeLeaseElector(
+                    self.store.client,
+                    namespace=self.config.kube_namespace,
+                    lease_duration=self.config.leader_lease_duration,
+                    renew_period=self.config.leader_renew_period,
+                    retry_period=self.config.leader_retry_period,
+                    on_lost=self._on_leadership_lost,
+                )
+            else:
+                self.elector = FileLeaseElector(self.config.leader_lease_path)
+            if not self.elector.acquire(timeout=timeout, stop=self._stopping.is_set):
+                return False
+        self._started = True
+        self._setup_persistence()
+        if self.executor is not None:
+            self.executor.start()
+        self.manager.start()
+        if self.kube_mode and self.reconcilers:
+            # informer cache: after sync, reconcile get/list never hits
+            # the apiserver (ref reads from the informer cache, SURVEY
+            # §3.2). Pod/Service pumps only exist when a controller
+            # registered, so with zero controllers there is nothing to
+            # wait for.
+            kinds = sorted({*self.reconcilers, "Pod", "Service"})
+            if self.config.enable_gang_scheduling and self.store.has_kind("PodGroup"):
+                # the gang admitter mirrors PodGroups every reconcile; a
+                # cache-only watch keeps those reads off the apiserver.
+                # Guarded by discovery: without the CRD the pump would
+                # relist a 404 forever and sync would stall startup
+                # (mirror writes already tolerate the missing kind).
+                self._podgroup_watch = self.store.watch(
+                    ["PodGroup"], cache_only=True)
+                kinds.append("PodGroup")
+            if not self.store.wait_for_cache_sync(kinds, timeout=30.0):
+                log.warning("informer cache not synced within 30s; reads stay uncached")
+        if (
+            self.kube_mode
+            and not self.config.tpu_slices
+            and isinstance(self._gang, TPUSliceAdmitter)
+        ):
+            # derive the slice pool from what GKE actually provisioned
+            # (node labels), keeping --tpu-slices as an explicit override
+            from kubedl_tpu.k8s.nodes import NodeInventory
+
+            self.node_inventory = NodeInventory(
+                self.store.client, on_change=self._gang.set_pool
+            )
+            self.node_inventory.start()
+            self.runtime_metrics.register_slice_pool(self._gang.utilization)
+        return True
+
+    def _setup_persistence(self) -> None:
+        if not (self.config.object_storage or self.config.event_storage):
+            return
+        from kubedl_tpu.controllers.persist import setup_persist_controllers
+        from kubedl_tpu.storage import registry as storage_registry
+
+        if self.config.object_storage:
+            self.object_backend = storage_registry.new_object_backend(
+                self.config.object_storage, db_path=self.config.storage_db_path
+            )
+            self.object_backend.initialize()
+        if self.config.event_storage:
+            # share the object backend when both flags name the same backend
+            # and it implements the event role too (sqlite does)
+            if (
+                self.config.event_storage == self.config.object_storage
+                and hasattr(self.object_backend, "save_event")
+            ):
+                self.event_backend = self.object_backend
+            else:
+                self.event_backend = storage_registry.new_event_backend(
+                    self.config.event_storage, db_path=self.config.storage_db_path
+                )
+                self.event_backend.initialize()
+        workload_controllers = {
+            kind: engine.controller for kind, engine in self.reconcilers.items()
+        }
+        self._persist_controllers = setup_persist_controllers(
+            self.manager,
+            self.store,
+            workload_controllers,
+            object_backend=self.object_backend,
+            event_backend=self.event_backend,
+            region=self.config.region,
+        )
+
+    def _on_leadership_lost(self) -> None:
+        log.error("leadership lost — stopping reconcilers (standby takes over)")
+        self.stop()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._podgroup_watch is not None:
+            self._podgroup_watch.stop()
+        if self.node_inventory is not None:
+            self.node_inventory.stop()
+        self.manager.stop()
+        if self.elector is not None:
+            self.elector.release()
+        if self.executor is not None:
+            self.executor.stop()
+        if self.object_backend is not None:
+            self.object_backend.close()
+        if self.event_backend is not None and self.event_backend is not self.object_backend:
+            self.event_backend.close()
+
+    # -- client-ish helpers ---------------------------------------------
+
+    def apply(self, manifest: Dict):
+        """kubectl-apply equivalent: route a manifest dict to its typed job."""
+        kind = manifest.get("kind", "")
+        canonical = self._kind_by_lower.get(kind.lower())
+        if canonical is None:
+            raise ValueError(
+                f"no controller registered for kind {kind!r} "
+                f"(enabled: {sorted(self.reconcilers)})"
+            )
+        engine = self.reconcilers[canonical]
+        job_cls = engine.controller.job_type()
+        job = from_dict(job_cls, manifest)
+        job.kind = canonical
+        # admission: default then validate (the webhook pair the reference
+        # scaffolds but never implements — api/validation.py)
+        engine.controller.set_defaults(job)
+        validate(job, engine.controller)
+        try:
+            existing = self.store.get(canonical, job.metadata.namespace, job.metadata.name)
+            job.metadata.resource_version = existing.metadata.resource_version
+            job.metadata.uid = existing.metadata.uid
+            job.status = existing.status
+            return self.store.update(job)
+        except NotFound:
+            return self.store.create(job)
+
+    def get_job(self, kind: str, namespace: str, name: str):
+        return self.store.get(self._kind_by_lower.get(kind.lower(), kind), namespace, name)
+
+    def wait_for_condition(
+        self, job, condition: str, timeout: float = 30.0, poll: float = 0.02
+    ) -> bool:
+        import time
+
+        ctype = JobConditionType(condition)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                fresh = self.store.get(job.kind, job.metadata.namespace, job.metadata.name)
+            except NotFound:
+                time.sleep(poll)
+                continue
+            if has_condition(fresh.status, ctype):
+                return True
+            time.sleep(poll)
+        return False

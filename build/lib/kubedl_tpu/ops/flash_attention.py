@@ -1,0 +1,623 @@
+"""Flash attention — Pallas TPU kernels (forward + backward).
+
+The hot op of the flagship model (SURVEY.md §7 step 9). Blocked online-softmax
+attention: Q blocks stream against K/V blocks held in VMEM, accumulating in
+f32 while inputs stay bf16 so the QK^T and PV matmuls hit the MXU; the
+backward pass recomputes P from the saved log-sum-exp instead of
+materializing [T, T] attention weights (memory O(T) per block, the property
+ring attention builds on — ops/ring_attention.py).
+
+Layout: [batch*heads, seq, head_dim]. The public entry handles GQA by
+broadcasting KV heads, pads ragged sequence lengths to block multiples, and
+installs a custom VJP wiring the two kernels together.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Swept on v5e (bf16 MXU inputs, causal fwd): at seq 2048, 512/512 hits
+# 53 TF/s vs 47 for 1024/1024 and ~3.5x over 128/128; bigger K/V tiles
+# amortize the online-softmax bookkeeping, but past 512 the f32 score
+# blocks start crowding the 16 MB scoped VMEM (2048-wide blocks OOM it).
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+# Measured crossover on v5e (bf16): the fused kernel loses to plain XLA at
+# short sequences (0.26-0.46x at 256-512, where the [T,T] scores are tiny
+# and per-program overheads dominate) and wins from ~1024 up (2.6-2.8x).
+FLASH_MIN_SEQ = 1024
+# Above this sequence length the default kernel's full-K/V-in-VMEM
+# BlockSpecs crowd the 16 MB scoped VMEM; the forward streams K/V blocks
+# through a 3D grid instead. The backward kernels keep whole-tensor loads,
+# so TRAINING beyond this length belongs to ring attention / context
+# parallelism — the streamed path serves long-context inference prefill.
+STREAM_MIN_SEQ = 8192
+NEG_INF = -1e30
+
+_warned_shapes: set = set()
+
+
+def _warn_unfused_fallback(d: int, block_q: int, block_k: int) -> None:
+    """One warning per shape when caller-supplied block sizes are not
+    128-aligned and the call silently degrades to unfused attention — a
+    masked perf regression otherwise invisible on real TPU. (Head dims are
+    lane-aligned by zero-padding, and short sequences dispatch to the
+    unfused path by measured policy, neither of which warns.)"""
+    key = (d, block_q, block_k)
+    if key in _warned_shapes:
+        return
+    _warned_shapes.add(key)
+    import warnings
+
+    warnings.warn(
+        f"flash_attention: caller-supplied blocks ({block_q},{block_k}) not "
+        f"128-aligned for the TPU MXU; falling back to unfused attention",
+        stacklevel=3,
+    )
+
+
+def _interpret() -> bool:
+    """Pallas TPU kernels run in interpret mode on CPU (tests/virtual mesh)."""
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask):
+    """One K-block update of the online-softmax state (m, l, acc) — the
+    shared numerics of the default and streamed forward kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale
+    s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return m_new, l, acc
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                window, block_q, block_k, seq_len):
+    qb = pl.program_id(1)
+    # Keep q/k/v in their storage dtype (bf16): the MXU runs bf16 x bf16 ->
+    # f32 at full rate, while f32 inputs drop it several-fold. All
+    # accumulation stays f32 via preferred_element_type.
+    q = q_ref[0]  # [block_q, d]
+    head_dim = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        # K blocks strictly above the diagonal contribute nothing.
+        num_kb = jnp.minimum(num_kb, (qb + 1) * block_q // block_k + 1)
+    start_kb = jnp.int32(0)
+    if window is not None:
+        # K blocks entirely below every query's window contribute nothing.
+        start_kb = jnp.maximum(0, (qb * block_q - window + 1) // block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
+        return _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask)
+
+    m, l, acc = jax.lax.fori_loop(start_kb, num_kb, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # lse rides in a [bh, 1, seq] buffer: a (1, 1, block_q) block keeps the
+    # trailing two dims TPU-tileable (second-to-last == array dim 1)
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
+    bh, seq, d = q.shape
+    # dispatch on the TRUE length: lcm padding of mixed block sizes must
+    # not shift the documented threshold
+    if true_len > STREAM_MIN_SEQ:
+        return _fwd_streamed(q, k, v, sm_scale, causal, window, block_q,
+                             block_k, true_len)
+    grid = (bh, pl.cdiv(seq, block_q))
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, seq_len=true_len,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * bh * seq * seq * d * (0.5 if causal else 1.0)),
+            bytes_accessed=q.size * 2 + k.size * 2 + v.size * 2,
+            transcendentals=bh * seq * seq,
+        ),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+                         *, sm_scale, causal, window, block_q, block_k,
+                         seq_len, n_kb):
+    """K-streaming variant: grid (bh, q_blocks, k_blocks); K/V arrive one
+    block per grid step via BlockSpecs (double-buffered by Mosaic), and the
+    online-softmax state lives in VMEM scratch across the kb dimension.
+    VMEM use is O(block) regardless of sequence length."""
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    # A 3D grid cannot skip iterations (the K/V DMA always runs), but the
+    # compute CAN skip grid steps that contribute nothing: fully past the
+    # diagonal (causal) or fully beyond the true sequence. On a causal
+    # prefill that's ~half the MXU work.
+    live = kb * block_k < seq_len
+    if causal:
+        live &= kb * block_k < (qb + 1) * block_q
+    if window is not None:
+        # the whole K block sits below every query's window
+        live &= (kb + 1) * block_k - 1 >= qb * block_q - window + 1
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0]  # [block_q, d] bf16
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
+        m_new, l, acc = _online_softmax_step(
+            q, k, v, m_s[...], l_s[...], acc_s[...], sm_scale, mask
+        )
+        m_s[...] = m_new
+        l_s[...] = l
+        acc_s[...] = acc
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        l = jnp.maximum(l_s[...], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_s[...] + jnp.log(l))[:, 0]
+
+
+def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
+    bh, seq, d = q.shape
+    n_kb = pl.cdiv(seq, block_k)
+    grid = (bh, pl.cdiv(seq, block_q), n_kb)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_streamed_kernel, sm_scale=sm_scale, causal=causal,
+            window=window, block_q=block_q, block_k=block_k,
+            seq_len=true_len, n_kb=n_kb,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, sm_scale, causal, window, block_q, block_k, seq_len):
+    qb = pl.program_id(1)
+    q = q_ref[0]  # bf16 into the MXU; f32 accumulation
+    do = do_ref[0]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kb = jnp.minimum(num_kb, (qb + 1) * block_q // block_k + 1)
+    start_kb = jnp.int32(0)
+    if window is not None:
+        start_kb = jnp.maximum(0, (qb * block_q - window + 1) // block_k)
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = jax.lax.fori_loop(start_kb, num_kb, body, dq0)
+    dq_ref[0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    *, sm_scale, causal, window, block_q, block_k, seq_len):
+    kb = pl.program_id(1)
+    k = k_ref[0]  # bf16 into the MXU; f32 accumulation
+    v = v_ref[0]
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    num_qb = pl.cdiv(seq_len, block_q)
+    start_qb = jnp.int32(0)
+    if causal:
+        # Q blocks strictly before this K block see none of it.
+        start_qb = kb * block_k // block_q
+    if window is not None:
+        # Q blocks whose every query is past this K block's window.
+        num_qb = jnp.minimum(
+            num_qb, ((kb + 1) * block_k - 1 + window) // block_q + 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        mask = (k_pos < seq_len) & (q_pos < seq_len)
+        if causal:
+            mask = mask & (k_pos <= q_pos)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        pb = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, v.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk0, dv0))
+    dk_ref[0] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout):
+    q, k, v, out, lse = res
+    bh, seq, d = q.shape
+    # [bh, 1, seq] to match the lse layout (TPU-tileable blocks)
+    delta = jnp.sum(
+        out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1
+    )[:, None, :]
+
+    kern = dict(sm_scale=sm_scale, causal=causal, window=window,
+                block_q=block_q, block_k=block_k, seq_len=true_len)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kern),
+        grid=(bh, pl.cdiv(seq, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kern),
+        grid=(bh, pl.cdiv(seq, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+def _pad_d(x, dk):
+    pad = dk - x.shape[-1]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
+    out, _ = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
+    return out
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
+    out, lse = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
+    # Residuals store only the true head dim: padded columns are zeros by
+    # construction, so slicing here and re-padding in backward is exact —
+    # and halves attention residual HBM for d=64 models.
+    res = (
+        q[..., :true_d], k[..., :true_d], v[..., :true_d],
+        out[..., :true_d], lse,
+    )
+    return out, res
+
+
+# Bound at import (NOT an alias of the monkeypatchable dispatch knob): the
+# backward kernels load whole-sequence tensors into VMEM and cannot fit
+# beyond this — training longer sequences is context parallelism's job.
+BWD_MAX_SEQ = 8192
+
+
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, true_len, true_d, res, dout):
+    dk_width = dout.shape[-1]
+    q, k, v, out, lse = res
+    if true_len > BWD_MAX_SEQ:
+        raise ValueError(
+            f"flash_attention backward at seq {true_len} exceeds the "
+            f"kernel's whole-sequence VMEM budget (max {BWD_MAX_SEQ}); "
+            f"train long sequences with ring attention over a 'context' "
+            f"mesh axis (ops/ring_attention.py) — the streamed forward "
+            f"serves inference prefill only"
+        )
+    res = (
+        _pad_d(q, dk_width), _pad_d(k, dk_width), _pad_d(v, dk_width),
+        _pad_d(out, dk_width), lse,
+    )
+    return _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _snap_block(block: int) -> int:
+    """Largest divisor of STREAM_MIN_SEQ that is <= block; sub-128 blocks
+    (interpret mode only) pass through untouched."""
+    if block < 128 or STREAM_MIN_SEQ % block == 0:
+        return block
+    p = 128
+    while p * 2 <= min(block, STREAM_MIN_SEQ):
+        p *= 2
+    return p
+
+
+def _pad_seq_to(x, target):
+    pad = target - x.shape[1]
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    window: Optional[int] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    min_seq: Optional[int] = None,
+) -> jax.Array:
+    """Blocked attention over [batch, q_heads, seq, head_dim] tensors.
+
+    GQA: k/v may have fewer heads (q_heads % kv_heads == 0); KV heads are
+    broadcast to the query groups.
+
+    window: sliding-window (Mistral-style) attention — query i attends
+    keys in (i - window, i]. Requires causal=True. Dead K blocks are
+    skipped in both directions, so compute scales with window, not seq.
+
+    min_seq overrides the measured fused-vs-unfused crossover (default
+    FLASH_MIN_SEQ, swept on v5e): pass 0 to prefer the fused kernel at
+    any length — e.g. on a different TPU generation, or when the kernel's
+    O(T)-per-block memory (not its speed) is the point. Sequences shorter
+    than one 128 lane tile cannot tile onto the MXU and always take the
+    unfused path.
+    """
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding window "
+                             "is a causal-attention concept)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    if hq != hkv:
+        if hq % hkv:
+            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+
+    # Below the measured crossover the unfused path is simply faster —
+    # this is dispatch policy, not degradation (no warning). Interpret
+    # mode (CPU tests) keeps exercising the kernel at small shapes.
+    if min_seq is None:
+        min_seq = FLASH_MIN_SEQ
+    # < 128 can never tile onto the MXU regardless of min_seq (silent: it's
+    # a hardware constraint, not a degradation a caller could fix)
+    if not _interpret() and (sq < min_seq or sq < 128):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                                   window=window)
+
+    # Lane-align the head dim by zero-padding to the next multiple of 128
+    # (ViT-class 64, GQA oddballs): zero K columns add nothing to QK^T,
+    # zero V columns produce zero output columns that are sliced off, and
+    # autodiff through pad/slice keeps the VJP exact. At the sequence
+    # lengths that reach here (>= FLASH_MIN_SEQ) the extra MXU work still
+    # beats the unfused path's materialized [T, T] softmax (2.65x at
+    # s=1024 d=64 on v5e).
+    d_pad = (-d) % 128
+    if d_pad:
+        widen = ((0, 0), (0, 0), (0, 0), (0, d_pad))
+        q = jnp.pad(q, widen)
+        k = jnp.pad(k, widen)
+        v = jnp.pad(v, widen)
+    dk = d + d_pad
+
+    # Clamp blocks to the sequence, keeping them lane-aligned (128) so
+    # mid-size sequences stay on the fused kernel (padding fills the rest).
+    if sq >= 128:
+        cap = (sq // 128) * 128
+        block_q = min(block_q, cap)
+        block_k = min(block_k, cap)
+    else:
+        block_q = block_k = max(sq, 1)
+
+    # Mosaic requires MXU-tileable blocks on real TPU: short sequences
+    # (< 128) take the plain-XLA path — at those sizes the fused kernel
+    # has no advantage anyway. CPU interpret mode is exempt.
+    if not _interpret() and (block_q % 128 or block_k % 128):
+        _warn_unfused_fallback(d, block_q, block_k)
+        return attention_reference(
+            q[..., :d], k[..., :d], v[..., :d], causal=causal,
+            sm_scale=sm_scale, window=window,
+        )
+
+    # The whole-sequence kernels (fwd at <= STREAM_MIN_SEQ, bwd always)
+    # budget VMEM for a padded length of at most STREAM_MIN_SEQ. Exotic
+    # block sizes (640, 384, ...) have lcms that can pad PAST that budget
+    # even when the true length is under it; only then snap them down to
+    # divisors of STREAM_MIN_SEQ (all its divisors are pow2 multiples of
+    # 128), which bounds the padded length by the budget again. In-budget
+    # caller choices are preserved exactly.
+    if sq <= STREAM_MIN_SEQ:
+        lcm0 = math.lcm(block_q, block_k)
+        if pl.cdiv(sq, lcm0) * lcm0 > STREAM_MIN_SEQ:
+            block_q = _snap_block(block_q)
+            block_k = _snap_block(block_k)
+
+    # One COMMON padded length divisible by both blocks: padding q and k/v
+    # to different lengths would send the K-block grid out of bounds when
+    # block_q != block_k. The padded tail is masked via seq_len.
+    lcm = math.lcm(block_q, block_k)
+    target = pl.cdiv(sq, lcm) * lcm
+    qf = _pad_seq_to(q.reshape(b * hq, sq, dk), target)
+    kf = _pad_seq_to(k.reshape(b * hq, sq, dk), target)
+    vf = _pad_seq_to(v.reshape(b * hq, sq, dk), target)
+    out = _flash(qf, kf, vf, sm_scale, causal, window, block_q, block_k, sq, d)
+    return out[:, :sq, :d].reshape(b, hq, sq, d)
+
+
+def attention_reference(q, k, v, *, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        window: Optional[int] = None):
+    """Plain-XLA attention for correctness tests (same GQA semantics,
+    incl. the sliding window)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    b, hq, sq, d = q.shape
+    hkv = k.shape[1]
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    if sm_scale is None:
+        sm_scale = 1.0 / (d**0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if causal:
+        mask = np.tril(np.ones((sq, sq), bool))
+        if window is not None:
+            mask &= ~np.tril(np.ones((sq, sq), bool), k=-window)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,234 @@
+"""Device mesh construction + sharding rules — the SPMD backbone.
+
+The reference has no parallelism of its own (SURVEY.md §2.4): it orchestrates
+frameworks that do. Here parallelism is first-class: a JAXJob's spec.mesh
+(workloads/jaxjob.py) names axes and the runtime materializes them as a
+jax.sharding.Mesh over all visible devices — data/fsdp for the batch
+dimension, tensor for MXU-splitting matmuls over ICI, context for
+ring-attention sequence parallelism, expert for MoE.
+
+The recipe (scaling-book style): pick a mesh, annotate shardings with
+NamedSharding/PartitionSpec, let XLA insert the collectives, profile.
+"""
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("data", "fsdp", "stage", "tensor", "context", "expert")
+
+# Batch shards over data+fsdp (fsdp also shards params — ZeRO-3 style).
+BATCH_AXES = ("data", "fsdp")
+
+ENV_MESH = "KUBEDL_MESH"
+# DCN (cross-slice) axes of a multislice job, injected by the operator next
+# to KUBEDL_MESH (which holds the per-slice ICI axes). Present => the
+# program builds a hybrid mesh so collectives on these axes ride DCN and
+# never cut an ICI ring mid-slice.
+ENV_DCN_MESH = "KUBEDL_DCN_MESH"
+
+
+def parse_dcn_mesh_env(value: Optional[str] = None) -> Optional[Dict[str, int]]:
+    """Parse KUBEDL_DCN_MESH ("data=2"). None when unset/empty (single
+    slice); unlike KUBEDL_MESH there is no -1 default — cross-slice axes
+    are always explicit in the JAXJob spec."""
+    value = value if value is not None else os.environ.get(ENV_DCN_MESH, "")
+    if not value:
+        return None
+    axes = {name: 1 for name in AXIS_ORDER}
+    for part in value.split(","):
+        if not part.strip():
+            continue
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in axes:
+            raise ValueError(f"unknown mesh axis {name!r} (known: {AXIS_ORDER})")
+        size = int(size)
+        if size < 1:
+            raise ValueError(f"DCN axis {name!r} must be >=1, got {size}")
+        axes[name] = size
+    return axes
+
+
+def build_mesh_from_env(devices: Optional[Sequence] = None) -> Mesh:
+    """The one mesh entrypoint for workload programs: flat mesh from
+    KUBEDL_MESH, or a hybrid ICIxDCN mesh when the operator injected
+    KUBEDL_DCN_MESH (multislice JAXJob, workloads/jaxjob.py)."""
+    dcn = parse_dcn_mesh_env()
+    if dcn is None:
+        return build_mesh(parse_mesh_env(), devices=devices)
+    ici = parse_mesh_env()
+    if any(v == -1 for v in ici.values()):
+        # -1 fill: resolve against per-slice device count
+        n = len(list(devices if devices is not None else jax.devices()))
+        per_slice, rem = divmod(n, math.prod(dcn.values()))
+        if rem:
+            raise ValueError(
+                f"{n} devices not divisible by DCN axes {dcn}")
+        wild = [k for k, v in ici.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"only one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in ici.values() if v != -1)
+        if per_slice % fixed:
+            raise ValueError(
+                f"{per_slice} per-slice devices not divisible by {fixed}")
+        ici[wild[0]] = per_slice // fixed
+    return build_hybrid_mesh(ici, dcn, devices=devices)
+
+
+def parse_mesh_env(value: Optional[str] = None) -> Dict[str, int]:
+    """Parse "data=2,fsdp=4,tensor=1,..." (the operator-injected KUBEDL_MESH).
+
+    Unset/empty means pure data parallelism over every visible device
+    (data=-1), so programs run out of the box on any chip count."""
+    value = value if value is not None else os.environ.get(ENV_MESH, "")
+    axes = {name: 1 for name in AXIS_ORDER}
+    if not value:
+        axes["data"] = -1
+        return axes
+    for part in value.split(","):
+        if not part.strip():
+            continue
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in axes:
+            raise ValueError(f"unknown mesh axis {name!r} (known: {AXIS_ORDER})")
+        axes[name] = int(size)
+    return axes
+
+
+def build_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` with named axis sizes.
+
+    An axis size of -1 (at most one) absorbs the remaining devices. Axis
+    sizes must multiply to the device count. Device order follows
+    jax.devices(), which JAX already arranges for ICI adjacency on TPU
+    slices; the `context` axis is placed innermost-adjacent by AXIS_ORDER so
+    ring neighbors are one ICI hop apart.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or parse_mesh_env())
+    for name in AXIS_ORDER:
+        axes.setdefault(name, 1)
+
+    wild = [k for k, v in axes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"only one mesh axis may be -1, got {wild}")
+    fixed = math.prod(v for v in axes.values() if v != -1)
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes product {fixed}")
+        axes[wild[0]] = n // fixed
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(
+            f"mesh axes {axes} multiply to {total}, but {n} devices are visible"
+        )
+    shape = tuple(axes[name] for name in AXIS_ORDER)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def build_hybrid_mesh(
+    ici_axes: Dict[str, int],
+    dcn_axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multislice mesh: DCN axes span slices, ICI axes stay inside a slice.
+
+    The standard multislice recipe — e.g. data-parallel across slices over
+    DCN, fsdp/tensor within each slice over ICI:
+        build_hybrid_mesh({"fsdp": 4, "tensor": 4}, {"data": 2})
+    On real multislice TPU this uses the devices' slice topology
+    (mesh_utils.create_hybrid_device_mesh) so collectives on DCN axes never
+    cross ICI rings mid-slice; on single-slice/CPU it degrades to the flat
+    mesh with the per-axis product sizes, keeping tests hermetic.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ici = {n: int(ici_axes.get(n, 1)) for n in AXIS_ORDER}
+    dcn = {n: int(dcn_axes.get(n, 1)) for n in AXIS_ORDER}
+    shape = [ici[n] for n in AXIS_ORDER]
+    dcn_shape = [dcn[n] for n in AXIS_ORDER]
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            shape, dcn_shape, devices=devices, allow_split_physical_axes=True
+        )
+    except (ValueError, AssertionError, AttributeError, KeyError):
+        # no slice topology (CPU sim / single slice): flat reshape
+        total = math.prod(a * b for a, b in zip(shape, dcn_shape))
+        if total != len(devices):
+            raise ValueError(
+                f"hybrid mesh {ici_axes}x{dcn_axes} needs {total} devices, "
+                f"have {len(devices)}"
+            )
+        dev_array = np.array(devices).reshape(
+            [a * b for a, b in zip(shape, dcn_shape)]
+        )
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Logical-dimension -> mesh-axes mapping for model tensors.
+
+    Dimensions used by models/: "batch", "seq", "embed" (d_model), "heads",
+    "kv_heads", "head_dim", "mlp" (ffn hidden), "vocab", "layers", "expert".
+    """
+
+    rules: Dict[str, Tuple[str, ...]] = field(
+        default_factory=lambda: {
+            "batch": BATCH_AXES,
+            "seq": ("context",),
+            "embed": ("fsdp",),
+            "heads": ("tensor",),
+            "kv_heads": ("tensor",),
+            "head_dim": (),
+            "mlp": ("tensor",),
+            "vocab": ("tensor",),
+            "layers": ("stage",),
+            "expert": ("expert",),
+        }
+    )
+
+    def spec(self, *dims: Optional[str]) -> P:
+        """PartitionSpec for a tensor whose dimensions have logical names."""
+        parts = []
+        for d in dims:
+            if d is None:
+                parts.append(None)
+                continue
+            axes = self.rules.get(d, ())
+            if not axes:
+                parts.append(None)
+            elif len(axes) == 1:
+                parts.append(axes[0])
+            else:
+                parts.append(tuple(axes))
+        return P(*parts)
+
+    def sharding(self, mesh: Mesh, *dims: Optional[str]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*dims))
+
+
+def logical_constraint(x, mesh: Mesh, rules: ShardingRules, *dims: Optional[str]):
+    """with_sharding_constraint via logical dimension names."""
+    return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
+
+
+def shard_pytree(tree, mesh: Mesh, spec_tree):
+    """device_put a pytree of arrays with a matching pytree of PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, spec_tree
+    )

@@ -1,0 +1,154 @@
+"""Pipeline parallelism — GPipe schedule over the mesh's "stage" axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY.md §2.4: "Pipeline
+parallelism (PP): absent"); this is the net-new TPU-native implementation the
+JAXJob mesh spec promises. Design is the canonical TPU pipelining recipe, not
+a send/recv translation:
+
+  * layers are stacked on a leading dim and sharded over the "stage" mesh
+    axis, so each stage holds `n_layers / n_stages` layers;
+  * a single `shard_map` runs the classic GPipe loop: at step i, stage 0
+    ingests microbatch i, every stage applies its local layers (a
+    `lax.scan` over the stacked leaf dim), and activations rotate to the
+    next stage with one `ppermute` — a nearest-neighbor ICI hop, the
+    cheapest collective on a TPU torus;
+  * the loop itself is a `lax.scan` over `n_microbatches + n_stages - 1`
+    steps — static control flow, one compiled program, no per-step
+    dispatch;
+  * autodiff flows through scan+ppermute, so `jax.grad` of a pipelined
+    loss is the pipelined backward pass for free.
+
+Composes with data parallelism (batch sharded over data+fsdp, params
+replicated across those axes inside the stage shard_map). Tensor/context/
+expert sharding inside a pipelined layer would need manual collectives in
+shard_map and is intentionally out of scope for the pipelined path — use
+tp/cp/ep on the non-pipelined forward instead.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubedl_tpu.parallel.mesh import BATCH_AXES
+
+
+def stack_layers(layers: Sequence[Any]) -> Any:
+    """[{leaf...}] * L  ->  {leaf: [L, ...]} — the stacked-params layout the
+    pipeline (and `lax.scan` over layers generally) wants."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layers(stacked: Any, n_layers: int) -> list:
+    return [
+        jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n_layers)
+    ]
+
+
+def pipeline_apply(
+    stacked_params: Any,
+    x_microbatches: jax.Array,  # [n_micro, micro_batch, ...feature dims]
+    layer_fn: Callable[[jax.Array, Any], jax.Array],
+    *,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    batch_axes: Tuple[str, ...] = BATCH_AXES,
+    remat: bool = False,
+) -> jax.Array:
+    """Run every microbatch through all pipeline stages; returns activations
+    with the same shape as `x_microbatches`.
+
+    `stacked_params` leaves have leading dim n_layers (divisible by the
+    stage-axis size); `layer_fn(act, layer_params) -> act` applies ONE layer
+    and must be shape-preserving. Microbatch dim 0 is the pipeline's time
+    axis; dim 1 (micro batch) is sharded over `batch_axes`.
+    """
+    n_stages = mesh.shape[stage_axis]
+    n_micro = x_microbatches.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"need >= {n_stages} microbatches to fill a {n_stages}-stage "
+            f"pipeline, got {n_micro}"
+        )
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(
+            f"stacked layer count {n_layers} not divisible by the "
+            f"{stage_axis}-axis size {n_stages}"
+        )
+    x_rank = x_microbatches.ndim
+
+    per_layer = layer_fn
+    if remat:
+        per_layer = jax.checkpoint(per_layer)
+
+    def run_local_layers(act, params_local):
+        def body(a, layer):
+            return per_layer(a, layer), None
+
+        act, _ = jax.lax.scan(body, act, params_local)
+        return act
+
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    n_steps = n_micro + n_stages - 1
+
+    def pipelined(params_local, x_mub):
+        stage = jax.lax.axis_index(stage_axis)
+        out_buf = jnp.zeros_like(x_mub)
+        act = jnp.zeros_like(x_mub[0])
+
+        def step(carry, i):
+            act, out_buf = carry
+            # stage 0 ingests microbatch i (clipped: trailing drain steps
+            # feed garbage that never reaches an output slot)
+            inp = jax.lax.dynamic_index_in_dim(
+                x_mub, jnp.clip(i, 0, n_micro - 1), 0, keepdims=False
+            )
+            act = jnp.where(stage == 0, inp, act)
+            act = run_local_layers(act, params_local)
+            # last stage banks finished microbatch i-(n_stages-1)
+            out_idx = jnp.clip(i - (n_stages - 1), 0, n_micro - 1)
+            cur = jax.lax.dynamic_index_in_dim(out_buf, out_idx, 0, keepdims=False)
+            bank = jnp.where(
+                jnp.logical_and(stage == n_stages - 1, i >= n_stages - 1), act, cur
+            )
+            out_buf = jax.lax.dynamic_update_index_in_dim(out_buf, bank, out_idx, 0)
+            # rotate activations one ICI hop to the next stage
+            act = jax.lax.ppermute(act, stage_axis, perm)
+            return (act, out_buf), None
+
+        (act, out_buf), _ = jax.lax.scan(
+            step, (act, out_buf), jnp.arange(n_steps, dtype=jnp.int32)
+        )
+        # leading singleton picks out this stage's copy; only the last
+        # stage's buffer holds real outputs and the caller slices it.
+        return out_buf[None]
+
+    params_spec = jax.tree_util.tree_map(lambda _: P(stage_axis), stacked_params)
+    x_spec = P(None, batch_axes, *([None] * (x_rank - 2)))
+    out_spec = P(stage_axis, None, batch_axes, *([None] * (x_rank - 2)))
+
+    out = jax.shard_map(
+        pipelined,
+        mesh=mesh,
+        in_specs=(params_spec, x_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )(stacked_params, x_microbatches)
+    return out[-1]
+
+
+def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:
+    """[B, ...] -> [n_micro, B/n_micro, ...]."""
+    if x.shape[0] % n_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {n_microbatches} microbatches"
+        )
+    return x.reshape((n_microbatches, x.shape[0] // n_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[n_micro, mb, ...] -> [n_micro*mb, ...]."""
+    return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])

@@ -1,0 +1,108 @@
+"""Sharded train step factory — pjit + NamedSharding, no hand-rolled
+collectives.
+
+Builds the full SPMD training step for a model: params/opt-state sharded by
+the model's param_specs (fsdp/tensor axes), batch sharded over data+fsdp,
+gradients and updates computed under jit with donated state so XLA reuses
+the buffers in place. Collectives (psum for grads across data, all-gather /
+reduce-scatter for fsdp params) are inserted by XLA from the shardings —
+the scaling-book recipe, not an NCCL translation.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubedl_tpu.parallel.mesh import ShardingRules
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten
+)
+
+
+def make_train_step(
+    loss_fn: Callable,  # (params, batch) -> scalar loss  [or (loss, aux)]
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_spec_tree: Any,
+    batch_spec: P,
+    rules: Optional[ShardingRules] = None,
+    accum_steps: int = 1,
+    has_aux: bool = False,
+) -> Tuple[Callable, Callable]:
+    """Returns (init_state, train_step), both jitted over the mesh.
+
+    init_state(params) -> TrainState with sharded params/opt state.
+    train_step(state, batch) -> (state, metrics) with donated state.
+    accum_steps > 1 accumulates gradients over that many micro-steps
+    before applying the update (optax.MultiSteps) — the HBM-for-batch
+    trade when the global batch doesn't fit.
+    """
+    rules = rules or ShardingRules()
+    if accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accum_steps)
+    param_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_spec_tree
+    )
+    # batch_spec may be one P or a pytree of Ps (e.g. (images, labels));
+    # P subclasses tuple, so guard it as a leaf
+    batch_sharding = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), batch_spec,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    repl = NamedSharding(mesh, P())
+
+    def _init(params):
+        opt_state = tx.init(params)
+        return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    # Optimizer moments have param shapes; with out_shardings unspecified
+    # XLA propagates the params' shardings onto them.
+    init_jit = jax.jit(_init, in_shardings=(param_sharding,))
+
+    def _step(state: TrainState, batch):
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+            aux = {}
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        return (
+            TrainState(params=new_params, opt_state=new_opt, step=state.step + 1),
+            {"loss": loss, "grad_norm": gnorm, **aux},
+        )
+
+    step_jit = jax.jit(
+        _step,
+        in_shardings=(None, batch_sharding),
+        donate_argnums=(0,),
+    )
+
+    def init_state(params):
+        params = jax.device_put(params, param_sharding)
+        return init_jit(params)
+
+    return init_state, step_jit

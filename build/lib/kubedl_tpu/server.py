@@ -1,0 +1,199 @@
+"""HTTP API + metrics server — the operator's network surface.
+
+The reference serves Prometheus metrics on --metrics-addr
+(ref pkg/metrics/monitor.go:27-36) and relies on the k8s API server for
+object CRUD. Standalone, this server provides both:
+
+  GET  /metrics                     Prometheus text exposition
+  GET  /healthz                     liveness
+  GET  /apis/<kind>                 list jobs (JSON)
+  GET  /apis/<kind>/<ns>/<name>     get one job
+  POST /apis/<kind>                 apply a manifest (create-or-update)
+  DELETE /apis/<kind>/<ns>/<name>   delete a job
+  GET  /events/<ns>                 recent events in a namespace
+
+Auth: loopback binds are open; any other bind REQUIRES a bearer token
+(`token=` arg or KUBEDL_API_TOKEN env) — the reference inherits
+kube-apiserver authn/z, so an unauthenticated non-local surface would be
+a regression. /healthz stays unauthenticated for probes.
+"""
+from __future__ import annotations
+
+import hmac
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubedl_tpu.core.store import NotFound
+from kubedl_tpu.utils.serde import to_dict
+
+
+class OperatorHTTPServer:
+    def __init__(
+        self,
+        operator,
+        host: str = "127.0.0.1",
+        port: int = 8443,
+        token: Optional[str] = None,
+    ) -> None:
+        self.operator = operator
+        self.host = host
+        self.port = port
+        self.token = token if token is not None else os.environ.get("KUBEDL_API_TOKEN", "")
+        if not self.token and host not in ("127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                f"refusing to serve the operator API on {host!r} without a "
+                "bearer token (set --api-token or KUBEDL_API_TOKEN)"
+            )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        op = self.operator
+        token = self.token
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _authorized(self) -> bool:
+                if not token or self.path == "/healthz":
+                    return True
+                supplied = self.headers.get("Authorization", "")
+                # compare bytes: str compare_digest requires ASCII and would
+                # raise (not 401) on an exotic header
+                if hmac.compare_digest(
+                    supplied.encode("utf-8", "surrogateescape"),
+                    f"Bearer {token}".encode(),
+                ):
+                    return True
+                self._send(401, '{"error": "unauthorized"}')
+                return False
+
+            def _send(self, code: int, body: str, ctype: str = "application/json"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, code: int, obj):
+                self._send(code, json.dumps(obj, indent=1))
+
+            def do_GET(self):
+                if not self._authorized():
+                    return
+                from urllib.parse import parse_qs, urlsplit
+
+                split = urlsplit(self.path)
+                query = parse_qs(split.query)
+                parts = [p for p in split.path.split("/") if p]
+                if split.path == "/metrics":
+                    body = op.metrics_registry.render()
+                    rm = getattr(op, "runtime_metrics", None)
+                    if rm is not None:
+                        body += rm.render()
+                    self._send(200, body, "text/plain; version=0.0.4")
+                elif split.path == "/debug/vars":
+                    rm = getattr(op, "runtime_metrics", None)
+                    self._json(200, rm.debug_vars() if rm is not None else {})
+                elif split.path == "/healthz":
+                    self._send(200, "ok", "text/plain")
+                elif len(parts) == 3 and parts[0] == "logs":
+                    # kubectl-logs equivalent: /logs/<ns>/<pod>[?container=&tail=]
+                    ex = getattr(op, "executor", None)
+                    if ex is None:
+                        self._json(404, {"error": "no local executor (kube mode: "
+                                                  "use kubectl logs)"})
+                    else:
+                        container = query.get("container", [None])[0]
+                        tail_q = query.get("tail", [None])[0]
+                        try:
+                            tail = int(tail_q) if tail_q is not None else None
+                        except ValueError:
+                            self._json(400, {"error": f"bad tail {tail_q!r}"})
+                            return
+                        text = ex.read_logs(parts[1], parts[2],
+                                            container=container, tail=tail)
+                        if not text:
+                            # distinguish "empty log" from a typo'd name:
+                            # 404 unless the pod exists (live, or left its
+                            # log dir behind after deletion)
+                            try:
+                                op.store.get("Pod", parts[1], parts[2])
+                            except NotFound:
+                                if not os.path.isdir(
+                                    ex._pod_log_dir(parts[1], parts[2])
+                                ):
+                                    self._json(404, {
+                                        "error": f"pod {parts[1]}/{parts[2]} "
+                                                 f"not found"
+                                    })
+                                    return
+                        self._send(200, text, "text/plain")
+                elif len(parts) >= 2 and parts[0] == "apis":
+                    kind = op._kind_by_lower.get(parts[1].lower(), parts[1])
+                    if len(parts) == 2:
+                        objs = op.store.list(kind)
+                        self._json(200, {"kind": f"{kind}List",
+                                         "items": [to_dict(o) for o in objs]})
+                    elif len(parts) == 4:
+                        try:
+                            self._json(200, to_dict(op.store.get(kind, parts[2], parts[3])))
+                        except NotFound as e:
+                            self._json(404, {"error": str(e)})
+                    else:
+                        self._json(400, {"error": "use /apis/<kind>[/<ns>/<name>]"})
+                elif len(parts) == 2 and parts[0] == "events":
+                    evs = op.store.list("Event", namespace=parts[1])
+                    self._json(200, {"items": [to_dict(e) for e in evs]})
+                else:
+                    self._json(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if not self._authorized():
+                    return
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 2 and parts[0] == "apis":
+                    length = int(self.headers.get("Content-Length", "0"))
+                    try:
+                        manifest = json.loads(self.rfile.read(length) or b"{}")
+                        manifest.setdefault("kind", parts[1])
+                        job = op.apply(manifest)
+                        self._json(200, to_dict(job))
+                    except (ValueError, KeyError) as e:
+                        self._json(400, {"error": str(e)})
+                else:
+                    self._json(404, {"error": f"unknown path {self.path}"})
+
+            def do_DELETE(self):
+                if not self._authorized():
+                    return
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 4 and parts[0] == "apis":
+                    kind = op._kind_by_lower.get(parts[1].lower(), parts[1])
+                    try:
+                        op.store.delete(kind, parts[2], parts[3])
+                        self._json(200, {"deleted": f"{parts[2]}/{parts[3]}"})
+                    except NotFound as e:
+                        self._json(404, {"error": str(e)})
+                else:
+                    self._json(404, {"error": f"unknown path {self.path}"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)

@@ -1,0 +1,36 @@
+"""Storage subsystem — pluggable job/pod/event history backends.
+
+Ref pkg/storage/: backend interfaces + registry, DMO row types, converters,
+and a durable SQLite implementation standing in for the reference's
+MySQL (objects) and Aliyun SLS (events) backends.
+"""
+from kubedl_tpu.storage.dmo import DMOEvent, DMOJob, DMOPod, STATUS_STOPPED
+from kubedl_tpu.storage.interface import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+    QueryPagination,
+)
+from kubedl_tpu.storage.registry import (
+    new_event_backend,
+    new_object_backend,
+    register_event_backend,
+    register_object_backend,
+)
+from kubedl_tpu.storage.sqlite_backend import SQLiteBackend
+
+__all__ = [
+    "DMOEvent",
+    "DMOJob",
+    "DMOPod",
+    "STATUS_STOPPED",
+    "EventStorageBackend",
+    "ObjectStorageBackend",
+    "Query",
+    "QueryPagination",
+    "SQLiteBackend",
+    "new_event_backend",
+    "new_object_backend",
+    "register_event_backend",
+    "register_object_backend",
+]

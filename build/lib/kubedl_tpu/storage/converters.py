@@ -1,0 +1,179 @@
+"""Live object -> DMO row converters.
+
+Ref pkg/storage/dmo/converters/{job.go,pod.go,event.go}: compute per-replica
+resource summaries, resolve tenancy, take the *latest* condition as job
+status, capture failure remarks with exit codes, and default timestamps the
+way the reference does (started falls back to creation, finished to now).
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+from kubedl_tpu.api.common import LABEL_REPLICA_TYPE
+from kubedl_tpu.api.pod import Pod, PodPhase
+from kubedl_tpu.storage.dmo import DMOEvent, DMOJob, DMOPod
+from kubedl_tpu.utils.tenancy import get_tenancy
+
+
+class NoDependentOwner(ValueError):
+    """Pod has no controller owner reference (ref converters/pod.go:36)."""
+
+
+class NoReplicaTypeLabel(ValueError):
+    """Pod has no replica-type label (ref converters/pod.go:37)."""
+
+
+def compute_pod_resources(pod_spec) -> Dict[str, Dict[str, float]]:
+    """max(init containers) elementwise-max sum(main containers).
+
+    Ref converters/pod.go computePodResources: init containers run serially
+    so their cost is the max; main containers run together so they sum.
+    """
+
+    def _merge(dst: Dict[str, float], src: Dict[str, float], op) -> None:
+        for k, v in src.items():
+            dst[k] = op(dst.get(k, 0.0), v)
+
+    out: Dict[str, Dict[str, float]] = {"requests": {}, "limits": {}}
+    for field in ("requests", "limits"):
+        summed: Dict[str, float] = {}
+        for c in pod_spec.containers:
+            _merge(summed, getattr(c.resources, field), lambda a, b: a + b)
+        init_max: Dict[str, float] = {}
+        for c in pod_spec.init_containers:
+            _merge(init_max, getattr(c.resources, field), max)
+        _merge(summed, init_max, max)
+        out[field] = summed
+    return out
+
+
+def compute_job_resources(specs) -> Dict[str, Dict]:
+    """{rtype: {"replicas": N, "resources": {...}}} (ref converters/job.go:118-131)."""
+    out: Dict[str, Dict] = {}
+    for rtype, spec in specs.items():
+        rt = rtype.value if hasattr(rtype, "value") else str(rtype)
+        out[rt] = {
+            "replicas": spec.replicas or 0,
+            "resources": compute_pod_resources(spec.template.spec),
+        }
+    return out
+
+
+def convert_pod_to_dmo_pod(pod: Pod, default_container_name: str, region: str = "") -> DMOPod:
+    """Ref converters/pod.go:42-154."""
+    row = DMOPod(
+        name=pod.metadata.name,
+        namespace=pod.metadata.namespace,
+        pod_id=pod.metadata.uid,
+        version=str(pod.metadata.resource_version),
+        gmt_created=pod.metadata.creation_timestamp,
+        deploy_region=region or None,
+    )
+
+    ref = pod.metadata.controller_ref()
+    if ref is None or not ref.uid:
+        raise NoDependentOwner(f"pod {pod.metadata.namespace}/{pod.metadata.name}")
+    row.job_id = ref.uid
+
+    rtype = pod.metadata.labels.get(LABEL_REPLICA_TYPE)
+    if not rtype:
+        raise NoReplicaTypeLabel(f"pod {pod.metadata.namespace}/{pod.metadata.name}")
+    row.replica_type = rtype
+
+    row.resources = json.dumps(compute_pod_resources(pod.spec), sort_keys=True)
+    row.pod_ip = pod.status.node_name or None  # local executor has no pod IPs
+    row.host_ip = pod.status.tpu_slice or None
+    row.status = pod.status.phase.value
+
+    if not pod.spec.containers:
+        return row
+
+    # image of the default container, falling back to containers[0]
+    image = pod.spec.containers[0].image
+    for c in pod.spec.containers[1:]:
+        if c.name == default_container_name:
+            image = c.image
+            break
+    row.image = image
+
+    if not pod.status.container_statuses:
+        return row
+
+    cs = pod.status.container_statuses[0]
+    for candidate in pod.status.container_statuses[1:]:
+        if candidate.name == default_container_name:
+            cs = candidate
+            break
+
+    phase = pod.status.phase
+    if phase == PodPhase.RUNNING:
+        row.gmt_started = pod.status.start_time or pod.metadata.creation_timestamp
+    elif phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+        if cs.terminated is not None:
+            row.gmt_finished = cs.terminated.finished_at
+            if phase == PodPhase.FAILED:
+                row.remark = (
+                    f"Reason: {cs.terminated.reason}\n"
+                    f"ExitCode: {cs.terminated.exit_code}\n"
+                    f"Message: {cs.terminated.message}"
+                )
+        row.gmt_started = pod.status.start_time or pod.metadata.creation_timestamp
+        if not row.gmt_finished:
+            row.gmt_finished = time.time()
+    return row
+
+
+def convert_job_to_dmo_job(job, kind: str, specs, status, region: str = "") -> DMOJob:
+    """Ref converters/job.go:38-95."""
+    row = DMOJob(
+        name=job.metadata.name,
+        namespace=job.metadata.namespace,
+        job_id=job.metadata.uid,
+        version=str(job.metadata.resource_version),
+        kind=kind,
+        gmt_created=job.metadata.creation_timestamp,
+        deploy_region=region or None,
+    )
+
+    try:
+        tn = get_tenancy(job)
+    except ValueError:
+        tn = None
+    if tn is not None:
+        row.tenant = tn.tenant
+        row.owner = tn.user
+        if row.deploy_region is None and tn.region:
+            row.deploy_region = tn.region
+    else:
+        row.tenant = ""
+        row.owner = ""
+
+    row.status = "Created"
+    if status.conditions:
+        last = status.conditions[-1].type
+        row.status = last.value if hasattr(last, "value") else str(last)
+    if status.completion_time:
+        row.gmt_finished = status.completion_time
+
+    row.resources = json.dumps(compute_job_resources(specs), sort_keys=True)
+    return row
+
+
+def convert_event_to_dmo_event(event, region: str = "") -> DMOEvent:
+    """Ref converters/event.go — flatten involved-object fields into the row."""
+    return DMOEvent(
+        name=event.metadata.name,
+        kind=event.involved_object.kind,
+        type=event.type,
+        obj_namespace=event.involved_object.namespace,
+        obj_name=event.involved_object.name,
+        obj_uid=event.involved_object.uid,
+        reason=event.reason,
+        message=event.message,
+        count=event.count,
+        region=region or None,
+        first_timestamp=event.first_timestamp,
+        last_timestamp=event.last_timestamp,
+    )

@@ -1,0 +1,86 @@
+"""DMO (data-model objects) — the rows persisted by storage backends.
+
+Ref pkg/storage/dmo/types.go:28-168: `replica_info` (pods), `job_info`
+(jobs), `event_info` (events), with soft-delete (`deleted`) and
+etcd-presence (`is_in_etcd`) flags so history outlives the live objects.
+Timestamps are float epoch seconds (`gmt_*`), matching the framework-wide
+convention in kubedl_tpu.api.meta.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+# Extra status beyond the condition machine: record was stopped by the
+# persistence layer after the live object vanished mid-flight
+# (ref pkg/storage/backends/objects/mysql/mysql.go:42-43).
+STATUS_STOPPED = "Stopped"
+
+
+@dataclass
+class DMOPod:
+    """One row per replica pod (ref dmo.Pod, table `replica_info`)."""
+
+    id: Optional[int] = None  # autoincrement primary key
+    name: str = ""
+    namespace: str = ""
+    pod_id: str = ""  # pod UID
+    version: str = ""  # resourceVersion at save time
+    status: str = "Unknown"  # PodPhase or Stopped
+    image: str = ""
+    job_id: str = ""  # owning job UID
+    replica_type: str = ""
+    resources: str = ""  # JSON-marshalled ResourceRequirements
+    host_ip: Optional[str] = None
+    pod_ip: Optional[str] = None
+    deploy_region: Optional[str] = None
+    deleted: int = 0
+    is_in_etcd: int = 1
+    remark: Optional[str] = None  # failure reason/exit-code text
+    gmt_created: Optional[float] = None
+    gmt_modified: Optional[float] = None
+    gmt_started: Optional[float] = None
+    gmt_finished: Optional[float] = None
+
+
+@dataclass
+class DMOJob:
+    """One row per job (ref dmo.Job, table `job_info`)."""
+
+    id: Optional[int] = None
+    name: str = ""
+    namespace: str = ""
+    job_id: str = ""  # job UID
+    version: str = ""
+    status: str = "Created"  # latest JobConditionType or Stopped
+    kind: str = ""
+    # JSON: {rtype: {"replicas": N, "resources": {...}}}
+    # (ref converters/job.go computeJobResources)
+    resources: str = ""
+    deploy_region: Optional[str] = None
+    tenant: Optional[str] = None
+    owner: Optional[str] = None
+    deleted: int = 0
+    is_in_etcd: int = 1
+    gmt_created: Optional[float] = None
+    gmt_modified: Optional[float] = None
+    gmt_finished: Optional[float] = None
+
+
+@dataclass
+class DMOEvent:
+    """One row per event occurrence (ref dmo.Event, table `event_info`)."""
+
+    id: Optional[int] = None
+    name: str = ""
+    kind: str = ""  # kind of involved object
+    type: str = ""  # Normal | Warning
+    obj_namespace: str = ""
+    obj_name: str = ""
+    obj_uid: str = ""
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    region: Optional[str] = None
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None

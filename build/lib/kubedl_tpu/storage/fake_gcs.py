@@ -1,0 +1,175 @@
+"""Embedded fake GCS server — wire-level harness for the GCS backend.
+
+Same philosophy as k8s/fake_apiserver.py: serve the actual HTTP JSON API
+(upload with uploadType=media + ifGenerationMatch preconditions, media
+download, prefix list, delete, 404/412 status codes, optional bearer
+auth) so GCSBackend is exercised end-to-end with nothing shared between
+server state and the client under test. State is raw bytes + generation
+counters — the server never imports the DMO types.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+_UPLOAD_RE = re.compile(r"^/upload/storage/v1/b/([^/]+)/o$")
+_OBJECT_RE = re.compile(r"^/storage/v1/b/([^/]+)/o/(.+)$")
+_LIST_RE = re.compile(r"^/storage/v1/b/([^/]+)/o$")
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        # bucket -> object name -> (bytes, generation)
+        self.objects: Dict[str, Dict[str, Tuple[bytes, int]]] = {}
+        self.gen = 0
+
+    def next_gen(self) -> int:
+        self.gen += 1
+        return self.gen
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "FakeGCS/1.0"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    @property
+    def state(self) -> _State:
+        return self.server.state  # type: ignore[attr-defined]
+
+    def _send(self, status: int, body: bytes, ctype: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, message: str) -> None:
+        self._send(status, json.dumps(
+            {"error": {"code": status, "message": message}}).encode())
+
+    def _auth_ok(self) -> bool:
+        token = self.server.token  # type: ignore[attr-defined]
+        if not token or self.headers.get("Authorization") == f"Bearer {token}":
+            return True
+        self._error(401, "Unauthorized")
+        return False
+
+    def _meta(self, bucket: str, name: str, gen: int) -> bytes:
+        return json.dumps({
+            "kind": "storage#object", "bucket": bucket,
+            "name": name, "generation": str(gen),
+        }).encode()
+
+    def do_POST(self) -> None:  # noqa: N802
+        if not self._auth_ok():
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        m = _UPLOAD_RE.match(parsed.path)
+        if not m:
+            return self._error(404, "unknown path")
+        bucket = m.group(1)
+        params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        name = params.get("name", "")
+        if not name:
+            return self._error(400, "name required")
+        length = int(self.headers.get("Content-Length", "0") or "0")
+        body = self.rfile.read(length)
+        st = self.state
+        with st.lock:
+            objects = st.objects.setdefault(bucket, {})
+            cur_gen = objects.get(name, (b"", 0))[1]
+            want = params.get("ifGenerationMatch")
+            if want is not None and int(want) != cur_gen:
+                return self._error(
+                    412, f"generation mismatch: have {cur_gen}, want {want}"
+                )
+            gen = st.next_gen()
+            objects[name] = (body, gen)
+        self._send(200, self._meta(bucket, name, gen))
+
+    def do_GET(self) -> None:  # noqa: N802
+        if not self._auth_ok():
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        st = self.state
+        m = _OBJECT_RE.match(parsed.path)
+        if m:
+            bucket, enc_name = m.groups()
+            name = urllib.parse.unquote(enc_name)
+            with st.lock:
+                entry = st.objects.get(bucket, {}).get(name)
+            if entry is None:
+                return self._error(404, f"object {name} not found")
+            body, gen = entry
+            if params.get("alt") == "media":
+                return self._send(200, body, ctype="application/octet-stream")
+            return self._send(200, self._meta(bucket, name, gen))
+        m = _LIST_RE.match(parsed.path)
+        if m:
+            bucket = m.group(1)
+            prefix = params.get("prefix", "")
+            with st.lock:
+                items = [
+                    {"name": n, "generation": str(g)}
+                    for n, (_, g) in sorted(st.objects.get(bucket, {}).items())
+                    if n.startswith(prefix)
+                ]
+            return self._send(200, json.dumps({"items": items}).encode())
+        self._error(404, "unknown path")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        if not self._auth_ok():
+            return
+        parsed = urllib.parse.urlparse(self.path)
+        m = _OBJECT_RE.match(parsed.path)
+        if not m:
+            return self._error(404, "unknown path")
+        bucket, enc_name = m.groups()
+        name = urllib.parse.unquote(enc_name)
+        st = self.state
+        with st.lock:
+            if st.objects.get(bucket, {}).pop(name, None) is None:
+                return self._error(404, f"object {name} not found")
+        self._send(204, b"")
+
+
+class FakeGCSServer:
+    """`with FakeGCSServer() as srv: GCSBackend(endpoint=srv.url, ...)`."""
+
+    def __init__(self, token: Optional[str] = None) -> None:
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.state = _State()  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeGCSServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fake-gcs", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "FakeGCSServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

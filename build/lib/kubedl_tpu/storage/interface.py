@@ -1,0 +1,94 @@
+"""Storage backend contracts + list query object.
+
+Ref pkg/storage/backends/interface.go:30-73 (ObjectStorageBackend /
+EventStorageBackend) and backends/query.go:25-41 (Query with pagination).
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import List, Optional
+
+from kubedl_tpu.storage.dmo import DMOEvent, DMOJob, DMOPod
+
+
+@dataclass
+class QueryPagination:
+    page_num: int = 1
+    page_size: int = 20
+    count: int = 0  # filled by the backend: total rows matching the query
+
+
+@dataclass
+class Query:
+    job_id: str = ""
+    name: str = ""
+    namespace: str = ""
+    region: str = ""
+    status: str = ""
+    start_time: Optional[float] = None  # gmt_created >= start_time
+    end_time: Optional[float] = None  # gmt_created <= end_time
+    is_del: Optional[int] = None
+    pagination: Optional[QueryPagination] = None
+
+
+class ObjectStorageBackend(abc.ABC):
+    """Write/read pod and job history records (ref interface.go:30-56)."""
+
+    @abc.abstractmethod
+    def initialize(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def save_pod(self, pod, default_container_name: str, region: str = "") -> None: ...
+
+    @abc.abstractmethod
+    def list_pods(self, job_id: str, region: str = "") -> List[DMOPod]: ...
+
+    @abc.abstractmethod
+    def stop_pod(self, namespace: str, name: str, pod_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def save_job(self, job, kind: str, specs, status, region: str = "") -> None: ...
+
+    @abc.abstractmethod
+    def get_job(self, namespace: str, name: str, job_id: str, region: str = "") -> DMOJob: ...
+
+    @abc.abstractmethod
+    def list_jobs(self, query: Query) -> List[DMOJob]: ...
+
+    @abc.abstractmethod
+    def stop_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None: ...
+
+    @abc.abstractmethod
+    def delete_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None: ...
+
+
+class EventStorageBackend(abc.ABC):
+    """Write/read event history records (ref interface.go:58-73)."""
+
+    @abc.abstractmethod
+    def initialize(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def save_event(self, event, region: str = "") -> None: ...
+
+    @abc.abstractmethod
+    def list_events(
+        self,
+        job_namespace: str,
+        job_name: str,
+        from_ts: Optional[float] = None,
+        to_ts: Optional[float] = None,
+    ) -> List[DMOEvent]: ...

@@ -1,0 +1,249 @@
+"""JSONL storage backend — append-only log-structured history.
+
+Second registry backend alongside sqlite (the reference's registry also
+hosts two genuinely different stores: MySQL objects,
+ref pkg/storage/backends/objects/mysql/mysql.go:57-443, and Aliyun SLS
+events, ref events/aliyun_sls/sls_logstore.go:45-279). Design is
+log-structured rather than relational: every mutation appends one JSON
+line `{"t": table, "k": key, "row": {...}}` to the log file; initialize()
+replays the log into an in-memory index (last write wins), so the file
+doubles as a crash-safe durable history and an audit trail, and can be
+shipped to any object store as-is. Queries serve from the index with the
+same semantics the sqlite backend implements: version-gated upserts,
+Stopped close-out for vanished live objects, soft delete, newest-first
+pagination.
+
+`db_path=":memory:"` keeps the log in RAM (tests); anything else is a
+file path, appended with fsync-on-write.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubedl_tpu.storage.converters import (
+    convert_event_to_dmo_event,
+    convert_job_to_dmo_job,
+    convert_pod_to_dmo_pod,
+)
+from kubedl_tpu.storage.dmo import STATUS_STOPPED, DMOEvent, DMOJob, DMOPod
+from kubedl_tpu.storage.interface import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+)
+
+_TERMINAL = ("Succeeded", "Failed", STATUS_STOPPED)
+
+_TABLES = {
+    "replica_info": (DMOPod, ("namespace", "name", "pod_id")),
+    "job_info": (DMOJob, ("namespace", "name", "job_id")),
+    "event_info": (DMOEvent, ("obj_namespace", "name")),
+}
+
+
+class JSONLBackend(ObjectStorageBackend, EventStorageBackend):
+    """Both backend roles over one append-only JSONL file."""
+
+    def __init__(self, db_path: str = ":memory:") -> None:
+        self._path = None if db_path == ":memory:" else db_path
+        self._lock = threading.RLock()
+        self._file = None
+        # table -> key tuple -> row dataclass
+        self._index: Dict[str, Dict[Tuple, object]] = {t: {} for t in _TABLES}
+        self._seq = 0
+        self._initialized = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def initialize(self) -> None:
+        with self._lock:
+            if self._initialized:
+                return
+            if self._path:
+                if os.path.exists(self._path):
+                    with open(self._path) as f:
+                        for line in f:
+                            line = line.strip()
+                            if line:
+                                try:
+                                    self._apply(json.loads(line))
+                                except (json.JSONDecodeError, TypeError, KeyError):
+                                    continue  # torn tail write — skip
+                os.makedirs(os.path.dirname(os.path.abspath(self._path)), exist_ok=True)
+                self._file = open(self._path, "a")
+            self._initialized = True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self._initialized = False
+
+    def name(self) -> str:
+        return "jsonl"
+
+    # -- log machinery ----------------------------------------------------
+
+    def _apply(self, rec: Dict) -> None:
+        table = rec["t"]
+        cls, key_fields = _TABLES[table]
+        names = {f.name for f in dataclasses.fields(cls)}
+        row = cls(**{k: v for k, v in rec["row"].items() if k in names})
+        key = tuple(getattr(row, k) for k in key_fields)
+        self._index[table][key] = row
+        self._seq += 1
+
+    def _commit(self, table: str, row) -> None:
+        cls, key_fields = _TABLES[table]
+        key = tuple(getattr(row, k) for k in key_fields)
+        self._index[table][key] = row
+        self._seq += 1
+        if self._file is not None:
+            rec = {"t": table, "k": list(key), "row": dataclasses.asdict(row)}
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def _get(self, table: str, key: Tuple):
+        return self._index[table].get(key)
+
+    def _upsert(self, table: str, row) -> None:
+        """Version-gated upsert (same rule as sqlite_backend._upsert)."""
+        cls, key_fields = _TABLES[table]
+        key = tuple(getattr(row, k) for k in key_fields)
+        with self._lock:
+            existing = self._get(table, key)
+            if existing is not None:
+                try:
+                    if int(row.version or 0) < int(existing.version or 0):
+                        return  # stale write — keep the newer record
+                except (TypeError, ValueError):
+                    pass
+                row.id = existing.id
+            else:
+                row.id = self._seq + 1
+            row.gmt_modified = time.time()
+            self._commit(table, row)
+
+    def _stop_record(self, table: str, key: Tuple, set_gone_from_etcd: bool) -> None:
+        with self._lock:
+            row = self._get(table, key)
+            if row is None:
+                return
+            row = dataclasses.replace(row)
+            if row.status not in _TERMINAL:
+                row.status = STATUS_STOPPED
+            row.gmt_finished = row.gmt_finished or time.time()
+            row.gmt_modified = time.time()
+            if set_gone_from_etcd:
+                row.is_in_etcd = 0
+            self._commit(table, row)
+
+    # -- pods ------------------------------------------------------------
+
+    def save_pod(self, pod, default_container_name: str, region: str = "") -> None:
+        self._upsert("replica_info", convert_pod_to_dmo_pod(pod, default_container_name, region))
+
+    def list_pods(self, job_id: str, region: str = "") -> List[DMOPod]:
+        with self._lock:
+            rows = [
+                r for r in self._index["replica_info"].values()
+                if r.job_id == job_id and (not region or r.deploy_region == region)
+            ]
+            rows.sort(key=lambda r: (r.replica_type, r.gmt_created or 0, r.name))
+            return [dataclasses.replace(r) for r in rows]
+
+    def stop_pod(self, namespace: str, name: str, pod_id: str) -> None:
+        self._stop_record(
+            "replica_info", (namespace, name, pod_id), set_gone_from_etcd=True
+        )
+
+    # -- jobs ------------------------------------------------------------
+
+    def save_job(self, job, kind: str, specs, status, region: str = "") -> None:
+        self._upsert("job_info", convert_job_to_dmo_job(job, kind, specs, status, region))
+
+    def get_job(self, namespace: str, name: str, job_id: str, region: str = "") -> DMOJob:
+        with self._lock:
+            row = self._get("job_info", (namespace, name, job_id))
+            if row is None or (region and row.deploy_region != region):
+                raise KeyError(f"job {namespace}/{name} ({job_id}) not found")
+            return dataclasses.replace(row)
+
+    def list_jobs(self, query: Query) -> List[DMOJob]:
+        with self._lock:
+            rows = list(self._index["job_info"].values())
+        out = []
+        for r in rows:
+            if query.job_id and r.job_id != query.job_id:
+                continue
+            if query.namespace and r.namespace != query.namespace:
+                continue
+            if query.region and r.deploy_region != query.region:
+                continue
+            if query.status and r.status != query.status:
+                continue
+            if query.name and query.name not in (r.name or ""):
+                continue
+            if query.start_time is not None and (r.gmt_created or 0) < query.start_time:
+                continue
+            if query.end_time is not None and (r.gmt_created or 0) > query.end_time:
+                continue
+            if query.is_del is not None and r.deleted != query.is_del:
+                continue
+            out.append(dataclasses.replace(r))
+        out.sort(key=lambda r: (-(r.gmt_created or 0), -(r.id or 0)))
+        if query.pagination is not None:
+            p = query.pagination
+            p.count = len(out)
+            start = (max(p.page_num, 1) - 1) * p.page_size
+            out = out[start : start + p.page_size]
+        return out
+
+    def stop_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None:
+        self._stop_record("job_info", (namespace, name, job_id), set_gone_from_etcd=False)
+
+    def delete_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None:
+        """Soft delete: the history row survives (ref mysql.go:254-281)."""
+        with self._lock:
+            row = self._get("job_info", (namespace, name, job_id))
+            if row is None:
+                return
+            row = dataclasses.replace(row, deleted=1, is_in_etcd=0, gmt_modified=time.time())
+            self._commit("job_info", row)
+
+    # -- events ----------------------------------------------------------
+
+    def save_event(self, event, region: str = "") -> None:
+        row = convert_event_to_dmo_event(event, region)
+        with self._lock:
+            existing = self._get("event_info", (row.obj_namespace, row.name))
+            if existing is not None:
+                row.id = existing.id
+                row.first_timestamp = existing.first_timestamp
+            else:
+                row.id = self._seq + 1
+            self._commit("event_info", row)
+
+    def list_events(
+        self,
+        job_namespace: str,
+        job_name: str,
+        from_ts: Optional[float] = None,
+        to_ts: Optional[float] = None,
+    ) -> List[DMOEvent]:
+        with self._lock:
+            rows = [
+                r for r in self._index["event_info"].values()
+                if r.obj_namespace == job_namespace and r.obj_name == job_name
+                and (from_ts is None or (r.last_timestamp or 0) >= from_ts)
+                and (to_ts is None or (r.last_timestamp or 0) <= to_ts)
+            ]
+            rows.sort(key=lambda r: r.last_timestamp or 0)
+            return [dataclasses.replace(r) for r in rows]

@@ -1,0 +1,55 @@
+"""Storage backend registry.
+
+Ref pkg/storage/backends/registry/registry.go:27-116 — backends register by
+name at startup (main.go:97) and are looked up by the `--object-storage` /
+`--event-storage` flags. Same here, with `sqlite` registered by default.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from kubedl_tpu.storage.interface import EventStorageBackend, ObjectStorageBackend
+from kubedl_tpu.storage.sqlite_backend import SQLiteBackend
+
+_object_factories: Dict[str, Callable[..., ObjectStorageBackend]] = {}
+_event_factories: Dict[str, Callable[..., EventStorageBackend]] = {}
+
+
+def register_object_backend(name: str, factory: Callable[..., ObjectStorageBackend]) -> None:
+    _object_factories[name] = factory
+
+
+def register_event_backend(name: str, factory: Callable[..., EventStorageBackend]) -> None:
+    _event_factories[name] = factory
+
+
+def new_object_backend(name: str, **kwargs) -> ObjectStorageBackend:
+    if name not in _object_factories:
+        raise KeyError(f"unknown object storage backend {name!r} "
+                       f"(registered: {sorted(_object_factories)})")
+    return _object_factories[name](**kwargs)
+
+
+def new_event_backend(name: str, **kwargs) -> EventStorageBackend:
+    if name not in _event_factories:
+        raise KeyError(f"unknown event storage backend {name!r} "
+                       f"(registered: {sorted(_event_factories)})")
+    return _event_factories[name](**kwargs)
+
+
+def register_default_backends() -> None:
+    """Ref registry.go RegisterStorageBackends called from main.go:97."""
+    from kubedl_tpu.storage.gcs_backend import GCSBackend
+    from kubedl_tpu.storage.jsonl_backend import JSONLBackend
+
+    register_object_backend("sqlite", SQLiteBackend)
+    register_event_backend("sqlite", SQLiteBackend)
+    register_object_backend("jsonl", JSONLBackend)
+    register_event_backend("jsonl", JSONLBackend)
+    # remote backend: GCS JSON API (the reference's registry equally hosts
+    # networked MySQL/SLS backends — mysql.go:57-443, sls_logstore.go:45-279)
+    register_object_backend("gcs", GCSBackend)
+    register_event_backend("gcs", GCSBackend)
+
+
+register_default_backends()

@@ -1,0 +1,313 @@
+"""SQLite storage backend — object + event history.
+
+The reference persists history to MySQL via gorm
+(pkg/storage/backends/objects/mysql/mysql.go:57-443) and events to Aliyun
+SLS (events/aliyun_sls/sls_logstore.go). This framework is standalone, so
+the equivalent durable store is stdlib sqlite3 — same tables
+(`replica_info`, `job_info`, `event_info` — ref dmo/types.go TableName),
+same semantics: version-gated upserts, `Stopped` terminal status for
+records whose live object vanished, soft delete (`deleted`/`is_in_etcd`
+flags), newest-first listing with pagination.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+import time
+from typing import List, Optional
+
+from kubedl_tpu.storage.converters import (
+    convert_event_to_dmo_event,
+    convert_job_to_dmo_job,
+    convert_pod_to_dmo_pod,
+)
+from kubedl_tpu.storage.dmo import STATUS_STOPPED, DMOEvent, DMOJob, DMOPod
+from kubedl_tpu.storage.interface import (
+    EventStorageBackend,
+    ObjectStorageBackend,
+    Query,
+)
+
+_TERMINAL = ("Succeeded", "Failed", STATUS_STOPPED)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS replica_info (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT, namespace TEXT, pod_id TEXT, version TEXT,
+    status TEXT, image TEXT, job_id TEXT, replica_type TEXT,
+    resources TEXT, host_ip TEXT, pod_ip TEXT, deploy_region TEXT,
+    deleted INTEGER DEFAULT 0, is_in_etcd INTEGER DEFAULT 1, remark TEXT,
+    gmt_created REAL, gmt_modified REAL, gmt_started REAL, gmt_finished REAL,
+    UNIQUE(namespace, name, pod_id)
+);
+CREATE TABLE IF NOT EXISTS job_info (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT, namespace TEXT, job_id TEXT, version TEXT,
+    status TEXT, kind TEXT, resources TEXT, deploy_region TEXT,
+    tenant TEXT, owner TEXT,
+    deleted INTEGER DEFAULT 0, is_in_etcd INTEGER DEFAULT 1,
+    gmt_created REAL, gmt_modified REAL, gmt_finished REAL,
+    UNIQUE(namespace, name, job_id)
+);
+CREATE TABLE IF NOT EXISTS event_info (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT, kind TEXT, type TEXT,
+    obj_namespace TEXT, obj_name TEXT, obj_uid TEXT,
+    reason TEXT, message TEXT, count INTEGER DEFAULT 1, region TEXT,
+    first_timestamp REAL, last_timestamp REAL,
+    UNIQUE(obj_namespace, name)
+);
+CREATE INDEX IF NOT EXISTS idx_replica_job ON replica_info(job_id);
+CREATE INDEX IF NOT EXISTS idx_job_created ON job_info(gmt_created);
+CREATE INDEX IF NOT EXISTS idx_event_obj ON event_info(obj_namespace, obj_name);
+"""
+
+
+def _row_to(cls, row: sqlite3.Row):
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: row[k] for k in row.keys() if k in names})
+
+
+def _cols(cls) -> List[str]:
+    return [f.name for f in dataclasses.fields(cls) if f.name != "id"]
+
+
+class SQLiteBackend(ObjectStorageBackend, EventStorageBackend):
+    """Both backend roles over one database file (":memory:" by default)."""
+
+    def __init__(self, db_path: str = ":memory:") -> None:
+        self._db_path = db_path
+        self._lock = threading.RLock()
+        self._conn: Optional[sqlite3.Connection] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def initialize(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                return
+            self._conn = sqlite3.connect(self._db_path, check_same_thread=False)
+            self._conn.row_factory = sqlite3.Row
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def name(self) -> str:
+        return "sqlite"
+
+    def _execute(self, sql: str, params=(), commit: bool = False) -> sqlite3.Cursor:
+        assert self._conn is not None, "backend not initialized"
+        cur = self._conn.execute(sql, params)
+        if commit:
+            self._conn.commit()
+        return cur
+
+    def _upsert(self, table: str, cls, row, key_fields: List[str]) -> None:
+        """Insert, or update when the incoming resourceVersion is newer
+        (ref mysql.go updatePod/updateJob version gate)."""
+        data = dataclasses.asdict(row)
+        data["gmt_modified"] = time.time()
+        cols = [c for c in _cols(cls) if c in data]
+        with self._lock:
+            where = " AND ".join(f"{k}=?" for k in key_fields)
+            cur = self._execute(
+                f"SELECT id, version FROM {table} WHERE {where}",
+                [data[k] for k in key_fields],
+            )
+            existing = cur.fetchone()
+            if existing is None:
+                self._execute(
+                    f"INSERT INTO {table} ({','.join(cols)}) "
+                    f"VALUES ({','.join('?' for _ in cols)})",
+                    [data[c] for c in cols],
+                    commit=True,
+                )
+                return
+            try:
+                if int(data.get("version") or 0) < int(existing["version"] or 0):
+                    return  # stale write — keep the newer record
+            except (TypeError, ValueError):
+                pass
+            sets = ",".join(f"{c}=?" for c in cols)
+            self._execute(
+                f"UPDATE {table} SET {sets} WHERE id=?",
+                [data[c] for c in cols] + [existing["id"]],
+                commit=True,
+            )
+
+    def _stop_record(
+        self, table: str, key_cols: List[str], key_vals, set_gone_from_etcd: bool
+    ) -> None:
+        """Close out a record whose live object vanished: non-terminal status
+        becomes Stopped, gmt_finished is stamped (ref mysql.go StopPod/StopJob)."""
+        with self._lock:
+            where = " AND ".join(f"{c}=?" for c in key_cols)
+            cur = self._execute(
+                f"SELECT id, status, gmt_finished FROM {table} WHERE {where}", key_vals
+            )
+            row = cur.fetchone()
+            if row is None:
+                return
+            status = row["status"]
+            if status not in _TERMINAL:
+                status = STATUS_STOPPED
+            finished = row["gmt_finished"] or time.time()
+            extra = ", is_in_etcd=0" if set_gone_from_etcd else ""
+            self._execute(
+                f"UPDATE {table} SET status=?, gmt_finished=?, gmt_modified=?{extra} "
+                "WHERE id=?",
+                (status, finished, time.time(), row["id"]),
+                commit=True,
+            )
+
+    # -- pods ------------------------------------------------------------
+
+    def save_pod(self, pod, default_container_name: str, region: str = "") -> None:
+        row = convert_pod_to_dmo_pod(pod, default_container_name, region)
+        self._upsert("replica_info", DMOPod, row, ["namespace", "name", "pod_id"])
+
+    def list_pods(self, job_id: str, region: str = "") -> List[DMOPod]:
+        with self._lock:
+            sql = "SELECT * FROM replica_info WHERE job_id=?"
+            params: List = [job_id]
+            if region:
+                sql += " AND deploy_region=?"
+                params.append(region)
+            # stable ordering: replica type then creation then name
+            # (ref mysql.go ListPods orders by gmt_created)
+            sql += " ORDER BY replica_type, gmt_created, name"
+            return [_row_to(DMOPod, r) for r in self._execute(sql, params).fetchall()]
+
+    def stop_pod(self, namespace: str, name: str, pod_id: str) -> None:
+        """Live pod vanished: close out the record (ref mysql.go:121-148)."""
+        self._stop_record(
+            "replica_info",
+            ["namespace", "name", "pod_id"],
+            (namespace, name, pod_id),
+            set_gone_from_etcd=True,
+        )
+
+    # -- jobs ------------------------------------------------------------
+
+    def save_job(self, job, kind: str, specs, status, region: str = "") -> None:
+        row = convert_job_to_dmo_job(job, kind, specs, status, region)
+        self._upsert("job_info", DMOJob, row, ["namespace", "name", "job_id"])
+
+    def get_job(self, namespace: str, name: str, job_id: str, region: str = "") -> DMOJob:
+        with self._lock:
+            sql = "SELECT * FROM job_info WHERE namespace=? AND name=? AND job_id=?"
+            params: List = [namespace, name, job_id]
+            if region:
+                sql += " AND deploy_region=?"
+                params.append(region)
+            row = self._execute(sql, params).fetchone()
+            if row is None:
+                raise KeyError(f"job {namespace}/{name} ({job_id}) not found")
+            return _row_to(DMOJob, row)
+
+    def list_jobs(self, query: Query) -> List[DMOJob]:
+        with self._lock:
+            clauses, params = [], []
+            for col, val in (
+                ("job_id", query.job_id),
+                ("namespace", query.namespace),
+                ("deploy_region", query.region),
+                ("status", query.status),
+            ):
+                if val:
+                    clauses.append(f"{col}=?")
+                    params.append(val)
+            if query.name:
+                clauses.append("name LIKE ?")
+                params.append(f"%{query.name}%")
+            if query.start_time is not None:
+                clauses.append("gmt_created>=?")
+                params.append(query.start_time)
+            if query.end_time is not None:
+                clauses.append("gmt_created<=?")
+                params.append(query.end_time)
+            if query.is_del is not None:
+                clauses.append("deleted=?")
+                params.append(query.is_del)
+            where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+            if query.pagination is not None:
+                cnt = self._execute(
+                    f"SELECT COUNT(*) AS n FROM job_info{where}", params
+                ).fetchone()
+                query.pagination.count = cnt["n"]
+            sql = f"SELECT * FROM job_info{where} ORDER BY gmt_created DESC, id DESC"
+            if query.pagination is not None:
+                p = query.pagination
+                sql += " LIMIT ? OFFSET ?"
+                params = params + [p.page_size, (max(p.page_num, 1) - 1) * p.page_size]
+            return [_row_to(DMOJob, r) for r in self._execute(sql, params).fetchall()]
+
+    def stop_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None:
+        """Ref mysql.go:225-253: non-terminal records become Stopped."""
+        self._stop_record(
+            "job_info",
+            ["namespace", "name", "job_id"],
+            (namespace, name, job_id),
+            set_gone_from_etcd=False,
+        )
+
+    def delete_job(self, namespace: str, name: str, job_id: str, region: str = "") -> None:
+        """Soft delete: the history row survives (ref mysql.go:254-281)."""
+        with self._lock:
+            self._execute(
+                "UPDATE job_info SET deleted=1, is_in_etcd=0, gmt_modified=? "
+                "WHERE namespace=? AND name=? AND job_id=?",
+                (time.time(), namespace, name, job_id),
+                commit=True,
+            )
+
+    # -- events ----------------------------------------------------------
+
+    def save_event(self, event, region: str = "") -> None:
+        row = convert_event_to_dmo_event(event, region)
+        with self._lock:
+            cur = self._execute(
+                "SELECT id FROM event_info WHERE obj_namespace=? AND name=?",
+                (row.obj_namespace, row.name),
+            )
+            existing = cur.fetchone()
+            if existing is None:
+                cols = _cols(DMOEvent)
+                data = dataclasses.asdict(row)
+                self._execute(
+                    f"INSERT INTO event_info ({','.join(cols)}) "
+                    f"VALUES ({','.join('?' for _ in cols)})",
+                    [data[c] for c in cols],
+                    commit=True,
+                )
+            else:
+                self._execute(
+                    "UPDATE event_info SET count=?, last_timestamp=?, message=? WHERE id=?",
+                    (row.count, row.last_timestamp, row.message, existing["id"]),
+                    commit=True,
+                )
+
+    def list_events(
+        self,
+        job_namespace: str,
+        job_name: str,
+        from_ts: Optional[float] = None,
+        to_ts: Optional[float] = None,
+    ) -> List[DMOEvent]:
+        with self._lock:
+            sql = "SELECT * FROM event_info WHERE obj_namespace=? AND obj_name=?"
+            params: List = [job_namespace, job_name]
+            if from_ts is not None:
+                sql += " AND last_timestamp>=?"
+                params.append(from_ts)
+            if to_ts is not None:
+                sql += " AND last_timestamp<=?"
+                params.append(to_ts)
+            sql += " ORDER BY last_timestamp"
+            return [_row_to(DMOEvent, r) for r in self._execute(sql, params).fetchall()]

@@ -1,0 +1,113 @@
+"""Runtime-side coordinator bootstrap — the single rendezvous scheme.
+
+The operator injects KUBEDL_COORDINATOR_ADDRESS / KUBEDL_NUM_PROCESSES /
+KUBEDL_PROCESS_ID (workloads/common.py). Training programs call
+`initialize()` once at startup; it wires jax.distributed so XLA collectives
+ride ICI within a slice and DCN across slices — replacing the reference's
+four per-framework bootstrap paths (TF_CONFIG gRPC ring, torch TCP store,
+Rabit tracker, ZooKeeper; SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger("kubedl_tpu.coordinator")
+
+ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
+ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"
+# Multislice identity (workloads/jaxjob.py, numSlices > 1): which DCN-joined
+# slice this process belongs to. The mesh layout itself comes from
+# KUBEDL_DCN_MESH (parallel/mesh.py); these are for program-level use —
+# logging, per-slice data sharding, profiling labels.
+ENV_NUM_SLICES = "KUBEDL_NUM_SLICES"
+ENV_SLICE_ID = "KUBEDL_SLICE_ID"
+
+
+@dataclass
+class ProcessInfo:
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+    num_slices: int = 1
+    slice_id: int = 0
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
+
+
+def process_info() -> ProcessInfo:
+    return ProcessInfo(
+        coordinator_address=os.environ.get(ENV_COORDINATOR_ADDRESS),
+        num_processes=int(os.environ.get(ENV_NUM_PROCESSES, "1")),
+        process_id=int(os.environ.get(ENV_PROCESS_ID, "0")),
+        num_slices=int(os.environ.get(ENV_NUM_SLICES, "1")),
+        slice_id=int(os.environ.get(ENV_SLICE_ID, "0")),
+    )
+
+
+def _resolve_local(address: str) -> str:
+    """Map service-DNS coordinator addresses to loopback when the headless
+    DNS name doesn't resolve (local executor mode: all processes share one
+    host, so the coordination service is reachable on 127.0.0.1)."""
+    host, _, port = address.partition(":")
+    try:
+        socket.getaddrinfo(host, None)
+        return address
+    except socket.gaierror:
+        return f"127.0.0.1:{port or '8471'}"
+
+
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS=cpu authoritative even when a sitecustomize has
+    already pinned a different platform programmatically (config beats env
+    in JAX). Test/CI pods set the env to get the hermetic virtual-device
+    CPU mesh; without this they would silently dial the real accelerator."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want != "cpu":
+        return
+    import jax
+
+    if (jax.config.jax_platforms or "") == "cpu":
+        return
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+
+
+def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
+    """Idempotently initialize jax.distributed from the injected env."""
+    _honor_platform_env()
+    info = info or process_info()
+    if not info.is_distributed or info.coordinator_address is None:
+        return info
+    import jax
+
+    addr = _resolve_local(info.coordinator_address)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+        log.info(
+            "jax.distributed initialized: %d/%d via %s",
+            info.process_id, info.num_processes, addr,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+    return info

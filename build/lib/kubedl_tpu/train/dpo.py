@@ -1,0 +1,211 @@
+"""DPO post-training workload — preference pairs in, aligned policy out.
+
+JAXJob-deployable CLI over train/preference.py: reads JSONL preference
+data, runs the sharded DPO step (mesh from KUBEDL_MESH like the
+trainer), checkpoints the FULL policy TrainState (so generate/serve
+restore it with the ordinary --checkpoint-path), and logs the implicit
+reward margin + preference accuracy.
+
+Data format — one JSON object per line:
+
+    {"prompt": [ids...], "chosen": [ids...], "rejected": [ids...]}
+
+Pairs are right-padded to --seq-len (prompt + longer continuation must
+fit). The frozen reference is the STARTING policy (base weights from
+--hf-model / --ref-checkpoint-path / fresh init), the standard DPO
+setup; its logprobs are computed once per unique batch and cached.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-dpo")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face base weights (policy AND reference init)")
+    p.add_argument("--ref-checkpoint-path", default="",
+                   help="trainer Orbax dir for the base weights (else fresh "
+                        "init / --hf-model)")
+    p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
+                   help="JSONL preference pairs; synthetic pairs when empty "
+                        "(smoke/bench)")
+    p.add_argument("--steps", type=int, default=int(os.environ.get("KUBEDL_STEPS", 100)))
+    p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
+    p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
+    p.add_argument("--lr", type=float, default=5e-7)
+    p.add_argument("--beta", type=float, default=0.1)
+    p.add_argument("--grad-clip", type=float, default=1.0)
+    p.add_argument("--accum-steps", type=int, default=1)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--checkpoint-interval", type=int, default=200)
+    p.add_argument("--allow-fresh-init", action="store_true",
+                   help="train from random base weights when no "
+                        "--hf-model/--ref-checkpoint-path weights exist "
+                        "(otherwise that's an error — DPO over a random "
+                        "policy is never what a deployed job means)")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def load_pairs(path: str, seq_len: int):
+    """JSONL -> (tokens [n,2,T], prompt_lens [n], seq_lens [n,2]); pairs
+    that cannot fit seq_len are skipped with a count."""
+    import numpy as np
+
+    toks, plens, slens = [], [], []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            prompt = list(rec["prompt"])
+            chosen = prompt + list(rec["chosen"])
+            rejected = prompt + list(rec["rejected"])
+            if (max(len(chosen), len(rejected)) > seq_len
+                    or len(prompt) < 1
+                    or not rec["chosen"] or not rec["rejected"]):
+                # empty continuations make one logprob side hard-zero —
+                # a degenerate gradient, not a preference
+                skipped += 1
+                continue
+            row = np.zeros((2, seq_len), np.int32)
+            row[0, :len(chosen)] = chosen
+            row[1, :len(rejected)] = rejected
+            toks.append(row)
+            plens.append(len(prompt))
+            slens.append([len(chosen), len(rejected)])
+    if not toks:
+        raise ValueError(f"no usable pairs in {path!r} at seq_len {seq_len}")
+    if skipped:
+        print(f"data: skipped {skipped} pairs exceeding --seq-len {seq_len}",
+              flush=True)
+    return (np.stack(toks), np.asarray(plens, np.int32),
+            np.asarray(slens, np.int32))
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
+    from kubedl_tpu.train.preference import make_dpo_step
+
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        base, config = load_hf(args.hf_model)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+        from kubedl_tpu.train.generate import restore_or_init
+
+        base = restore_or_init(
+            config, args.ref_checkpoint_path,
+            allow_fresh_init=(args.allow_fresh_init
+                              or not args.ref_checkpoint_path),
+            seed=args.seed, label="base")
+        if base is None:
+            return 1
+    mesh = build_mesh_from_env()
+    rules = ShardingRules()
+    print(f"mesh: {dict(mesh.shape)} model={args.hf_model or args.model} "
+          f"beta={args.beta}", flush=True)
+
+    tx = optax.adamw(args.lr, weight_decay=0.0)
+    if args.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
+    init_state, ref_fn, step = make_dpo_step(
+        base, config, tx, mesh, rules=rules, beta=args.beta,
+        accum_steps=args.accum_steps,
+    )
+    state = init_state(jax.tree.map(jnp.asarray, base))
+    del base
+
+    # data: whole-set host arrays (preference sets are small relative to
+    # pretraining corpora); batches cycle with a seeded permutation
+    rng = np.random.default_rng(args.seed)
+    if args.data_path:
+        tokens, plens, slens = load_pairs(args.data_path, args.seq_len)
+        print(f"data: {len(tokens)} pairs from {args.data_path}", flush=True)
+    else:
+        n = max(args.batch * 4, 32)
+        tokens = rng.integers(
+            1, config.vocab_size, (n, 2, args.seq_len)).astype(np.int32)
+        plens = rng.integers(1, max(args.seq_len // 4, 2), (n,)).astype(np.int32)
+        slens = rng.integers(
+            args.seq_len // 2, args.seq_len + 1, (n, 2)).astype(np.int32)
+        for i in range(n):  # shared prompt across each pair
+            tokens[i, 1, :plens[i]] = tokens[i, 0, :plens[i]]
+        print(f"data: {n} synthetic pairs (no --data-path)", flush=True)
+
+    mngr = None
+    start_step = 0
+    if args.checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        mngr = ocp.CheckpointManager(
+            args.checkpoint_path,
+            options=ocp.CheckpointManagerOptions(max_to_keep=2, create=True),
+        )
+        latest = mngr.latest_step()
+        if latest is not None:
+            # preemption resume: restore into the SHARDED state and pick
+            # the schedule up where it stopped (trainer.py's pattern)
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+            state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+            start_step = latest
+            print(f"restored policy checkpoint at step {start_step}", flush=True)
+
+    n_pairs = len(tokens)
+    order = rng.permutation(n_pairs)
+    ref_cache = {}
+    import time
+
+    t0 = time.time()
+    for it in range(start_step + 1, args.steps + 1):
+        lo = ((it - 1) * args.batch) % n_pairs
+        idx = np.take(order, range(lo, lo + args.batch), mode="wrap")
+        batch = (jnp.asarray(tokens[idx]), jnp.asarray(plens[idx]),
+                 jnp.asarray(slens[idx]))
+        key = (lo, args.batch)
+        if key not in ref_cache:
+            ref_cache[key] = ref_fn(batch)
+        state, metrics = step(state, (*batch, ref_cache[key]))
+        if it % args.log_every == 0 or it == args.steps:
+            m = {k: float(v) for k, v in metrics.items()}
+            print(f"step {it}: loss={m['loss']:.4f} "
+                  f"margin={m['reward_margin']:.3f} "
+                  f"acc={m['preference_accuracy']:.2f}", flush=True)
+        if mngr is not None and (it % args.checkpoint_interval == 0
+                                 or it == args.steps):
+            import orbax.checkpoint as ocp
+
+            mngr.save(it, args=ocp.args.StandardSave(state))
+    if mngr is not None:
+        mngr.wait_until_finished()
+        print(f"saved policy checkpoint at step {args.steps}", flush=True)
+    print(f"done: {args.steps - start_step} steps in "
+          f"{time.time() - t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,15 +86,6 @@ def parse_args(argv=None):
         p.error("--temperature must be > 0: greedy rollouts make all G "
                 "samples of a group identical, which zeroes every "
                 "group-normalized advantage")
-    if args.group_size < 2:
-        p.error("--group-size must be >= 2: the group mean is the "
-                "baseline, so a single sample always has advantage 0 and "
-                "the policy gradient vanishes")
-    if args.inner_epochs > 1 and args.accum_steps > 1:
-        p.error("--inner-epochs > 1 with --accum-steps > 1: MultiSteps "
-                "defers the param update across micro-steps, so inner "
-                "epochs would recompute identical gradients (params "
-                "unchanged between them) — use one or the other")
     return args
 
 

@@ -90,18 +90,11 @@ def grpo_loss(
     clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
     surrogate = jnp.minimum(ratio * adv, clipped * adv)
     pg_loss = -jnp.sum(surrogate * mask) / n_tok
-    if kl_coef > 0:
-        # k3 KL estimator vs the frozen reference (Schulman): unbiased,
-        # non-negative, low-variance near ref — the standard GRPO penalty
-        delta = ref_logprobs - lp
-        kl = jnp.sum((jnp.exp(delta) - delta - 1.0) * mask) / n_tok
-        loss = pg_loss + kl_coef * kl
-    else:
-        # pure clipped-surrogate GRPO: ref_logprobs is a placeholder
-        # (make_grpo_step never runs the reference) — don't report a
-        # KL computed against it
-        kl = jnp.float32(0.0)
-        loss = pg_loss
+    # k3 KL estimator vs the frozen reference (Schulman): unbiased,
+    # non-negative, low-variance near ref — the standard GRPO penalty
+    delta = ref_logprobs - lp
+    kl = jnp.sum((jnp.exp(delta) - delta - 1.0) * mask) / n_tok
+    loss = pg_loss + kl_coef * kl
     if config.n_experts > 0:
         loss = loss + config.moe_aux_coef * aux
     metrics = {
@@ -154,12 +147,7 @@ def make_grpo_step(
         lambda s: NamedSharding(mesh, s), param_spec_tree,
         is_leaf=lambda x: isinstance(x, P),
     )
-    # kl_coef == 0 (pure clipped-surrogate GRPO) drops the reference
-    # entirely: no second full param copy resident in HBM, no reference
-    # forward per step — ref_logprob_fn degrades to a zeros placeholder
-    # whose values grpo_loss never reads
-    ref_sharded = (jax.device_put(ref_params, param_sharding)
-                   if kl_coef > 0 else None)
+    ref_sharded = jax.device_put(ref_params, param_sharding)
 
     @jax.jit
     def _lp_fn(p, batch):
@@ -174,10 +162,6 @@ def make_grpo_step(
         return _lp_fn(p, batch)
 
     def ref_logprob_fn(batch):
-        if ref_sharded is None:
-            tokens = batch[0]
-            return jnp.zeros((tokens.shape[0], tokens.shape[1] - 1),
-                             jnp.float32)
         return _lp_fn(ref_sharded, batch)[0]
 
     def loss_fn(params, batch):

@@ -1,0 +1,328 @@
+"""HTTP serving workload — the continuous-batching engine as a JAXJob.
+
+Completes the operator's train -> checkpoint -> serve loop: a JAXJob
+runs this module (examples/jax_job_serving.yaml), it restores params
+from the trainer's Orbax checkpoint, and serves generation over a small
+JSON API backed by `models/serving.ServingEngine`:
+
+    POST /generate   {"tokens": [..], "max_new_tokens": 64,
+                      "eos_token": 2?, "prefix_id": 0?} -> {"tokens": [...]}
+                     (with an --hf-model tokenizer, {"text": "..."} works
+                      too and the response adds decoded "text")
+    POST /generate   {"requests": [{...}, ...]}  (batch form; each entry
+                      rides its own engine slot)  -> {"results": [...]}
+    POST /prefix     {"tokens": [...]}  -> {"prefix_id": N}   (shared
+                      system prompts prefill once; see register_prefix)
+    GET  /stats      -> ServingEngine.stats()
+    GET  /metrics    -> Prometheus text format (kubedl_serving_* gauges)
+    GET  /healthz    -> {"ok": true}
+
+One background thread drives `engine.step()` whenever work is pending —
+request handlers only enqueue and wait, so concurrent HTTP clients
+batch onto the same decode ticks (that's the continuous-batching win).
+The reference has no serving stack at all (SURVEY §2.4).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger("kubedl_tpu.serve")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-serve")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face Llama name/dir — overrides --model/"
+                        "--checkpoint-path (models/import_hf.py)")
+    p.add_argument("--allow-fresh-init", action="store_true")
+    p.add_argument("--lora-checkpoint-path", default="",
+                   help="merge the newest adapter checkpoint from a trainer "
+                        "--lora-rank run into the base weights")
+    p.add_argument("--lora-alpha", type=float, default=None)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=1024)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 (models/quant.py)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache with exact scale folding — half the "
+                        "per-token cache read at long contexts")
+    p.add_argument("--max-steps", type=int, default=0,
+                   help="stop after N pump passes, each up to --decode-block "
+                        "device ticks (smoke tests); 0 = forever")
+    p.add_argument("--decode-block", type=int, default=8,
+                   help="max ticks fused per host sync (serving.py "
+                        "step_block): bigger amortizes dispatch/sync "
+                        "overhead, smaller tightens streaming latency; "
+                        "1 = tick per sync")
+    return p.parse_args(argv)
+
+
+class _Service:
+    """Engine + queue pump shared by all HTTP handler threads."""
+
+    def __init__(self, engine, tokenizer=None, decode_block: int = 8) -> None:
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.decode_block = max(int(decode_block), 1)
+        self._lock = threading.Lock()  # engine calls are single-threaded
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self.ticks = 0
+        self._thread = threading.Thread(
+            target=self._pump, name="serve-pump", daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            if not self._work.wait(timeout=0.1):
+                continue
+            with self._lock:
+                if not self.engine.has_pending():
+                    self._work.clear()
+                    continue
+                if self.decode_block > 1:
+                    self.engine.step_block(self.decode_block)
+                else:
+                    self.engine.step()
+                # pump passes, not device ticks: the smoke-mode budget
+                # just needs a monotonic progress counter
+                self.ticks += 1
+
+    def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int],
+               prefix_id: Optional[int] = None):
+        with self._lock:
+            req = self.engine.submit(prompt, max_new_tokens, eos_token,
+                                     prefix_id=prefix_id)
+        self._work.set()
+        return req
+
+    def register_prefix(self, tokens) -> int:
+        # NOT under the service lock: the prefill compile can take tens
+        # of seconds on a real chip and must not freeze the tick pump;
+        # the engine's own prefix lock guards its registry
+        return self.engine.register_prefix(tokens)
+
+    def wait(self, reqs, timeout: float = 300.0) -> bool:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.done for r in reqs):
+                return True
+            self._work.set()
+            time.sleep(0.005)
+        return False
+
+    def cancel(self, reqs) -> None:
+        with self._lock:
+            for r in reqs:
+                self.engine.cancel(r)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — quiet
+        pass
+
+    @property
+    def svc(self) -> _Service:
+        return self.server.svc  # type: ignore[attr-defined]
+
+    def _send(self, status: int, body: dict) -> None:
+        payload = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self) -> None:  # noqa: N802
+        if self.path == "/healthz":
+            return self._send(200, {"ok": True})
+        if self.path == "/stats":
+            stats = self.svc.engine.stats()
+            stats["ticks"] = self.svc.ticks
+            return self._send(200, stats)
+        if self.path == "/metrics":
+            # Prometheus text format, matching the operator's exporter
+            # conventions (docs/metrics.md) so one scrape config covers
+            # operator and serving pods
+            stats = self.svc.engine.stats()
+            stats["ticks"] = self.svc.ticks
+            lines = []
+            for key, val in sorted(stats.items()):
+                if not isinstance(val, (int, float)):
+                    continue
+                name = f"kubedl_serving_{key}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {float(val)}")
+            payload = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path not in ("/generate", "/prefix"):
+            return self._send(404, {"error": f"unknown path {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0") or "0")
+            body = json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, ValueError) as e:
+            return self._send(400, {"error": f"bad JSON: {e}"})
+        if not isinstance(body, dict):
+            return self._send(400, {"error": "body must be a JSON object"})
+        if self.path == "/prefix":
+            try:
+                pid = self.svc.register_prefix(body.get("tokens") or [])
+            except (ValueError, TypeError) as e:
+                return self._send(422, {"error": str(e)})
+            return self._send(200, {"prefix_id": pid})
+        entries = body.get("requests")
+        single = entries is None
+        if single:
+            entries = [body]
+        tok = self.svc.tokenizer
+        reqs = []
+        try:
+            for e in entries:
+                if not isinstance(e, dict):
+                    raise ValueError("each request must be a JSON object")
+                tokens = e.get("tokens")
+                is_text = tokens is None and e.get("text") is not None
+                if is_text:
+                    if tok is None:
+                        raise ValueError(
+                            "text requests need a tokenizer — start the "
+                            "server with --hf-model")
+                    tokens = tok.encode(str(e["text"]))
+                # eos default applies ONLY to text requests (natural stop);
+                # the token-id API keeps exact-length semantics, and an
+                # explicit "eos_token": null opts text requests out too
+                if "eos_token" in e:
+                    eos = e["eos_token"]
+                elif is_text and tok is not None:
+                    eos = tok.eos_token_id
+                else:
+                    eos = None
+                reqs.append(self.svc.submit(
+                    tokens or [],
+                    int(e.get("max_new_tokens") or 32),
+                    eos,
+                    prefix_id=e.get("prefix_id"),
+                ))
+        except (ValueError, TypeError) as e:
+            # partially-submitted batch: release what already went in
+            self.svc.cancel(reqs)
+            return self._send(422, {"error": str(e)})
+        if not self.svc.wait(reqs):
+            # client gets a 504 and is gone; orphaned work must not keep
+            # occupying slots generating tokens nobody reads
+            self.svc.cancel(reqs)
+            return self._send(504, {"error": "generation timed out"})
+        results = []
+        for r in reqs:
+            entry = {"tokens": r.tokens, "request_id": r.request_id}
+            if tok is not None:
+                entry["text"] = tok.decode(r.tokens, skip_special_tokens=True)
+            results.append(entry)
+        self._send(200, results[0] if single else {"results": results})
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.models.serving import ServingEngine
+    from kubedl_tpu.train.generate import restore_or_init
+
+    tokenizer = None
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        params, config = load_hf(args.hf_model)
+        try:
+            import transformers
+
+            tokenizer = transformers.AutoTokenizer.from_pretrained(args.hf_model)
+        except Exception as e:  # noqa: BLE001 — token-id API still works
+            print(f"no tokenizer loaded ({e}); token-id API only", flush=True)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+        params = restore_or_init(
+            config, args.checkpoint_path, args.allow_fresh_init, seed=0)
+        if params is None:
+            return 1
+    if args.lora_checkpoint_path:
+        from kubedl_tpu.models import lora as lora_mod
+
+        params = lora_mod.restore_and_merge(
+            params, args.lora_checkpoint_path, alpha=args.lora_alpha)
+    if args.int8:
+        from kubedl_tpu.models import quant
+
+        params = jax.jit(quant.quantize_params)(params)
+    engine = ServingEngine(
+        params, config, slots=args.slots, max_len=args.max_len,
+        temperature=args.temperature,
+        kv_dtype="int8" if args.kv_int8 else None,
+    )
+    svc = _Service(engine, tokenizer=tokenizer, decode_block=args.decode_block)
+    httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)
+    httpd.daemon_threads = True
+    httpd.svc = svc  # type: ignore[attr-defined]
+    host, port = httpd.server_address[:2]
+    model_name = args.hf_model or args.model
+    print(f"serving {model_name} on http://{host}:{port} "
+          f"(slots={args.slots}, max_len={args.max_len})", flush=True)
+    if args.max_steps:
+        # smoke mode: serve in the background until N ticks happen
+        import time
+
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        while svc.ticks < args.max_steps:
+            time.sleep(0.05)
+        httpd.shutdown()
+        svc.stop()
+        return 0
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

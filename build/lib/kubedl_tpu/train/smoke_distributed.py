@@ -1,0 +1,48 @@
+"""Multi-host rendezvous smoke program — proves the coordinator wiring.
+
+Run as the container command of an N-replica JAXJob: every process calls
+`coordinator.initialize()` (jax.distributed via the injected env), asserts
+the global device view spans all processes, and runs one psum across hosts.
+Exit 0 only if the collective saw every process — the CI stand-in for a
+multi-host TPU slice bootstrap (SURVEY.md §4: multi-node without a cluster).
+"""
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from kubedl_tpu.train import coordinator
+
+    info = coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    expect = n_local * info.num_processes
+    if n_global != expect:
+        print(f"global devices {n_global} != local {n_local} x "
+              f"{info.num_processes} processes", file=sys.stderr)
+        return 1
+
+    # one all-reduce spanning every device on every host
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.ones((n_local,), np.float32), (n_global,)
+    )
+    out = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    total = float(jax.device_get(out))
+    if int(total) != n_global:
+        print(f"psum saw {total}, expected {n_global}", file=sys.stderr)
+        return 1
+    print(f"distributed ok: process {info.process_id}/{info.num_processes} "
+          f"devices {n_local} local / {n_global} global, psum={total}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

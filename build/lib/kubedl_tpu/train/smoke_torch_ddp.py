@@ -1,0 +1,40 @@
+"""Torch DDP rendezvous smoke — run as a PyTorchJob pod program.
+
+Bootstraps torch.distributed from the operator-injected MASTER_ADDR /
+MASTER_PORT / RANK / WORLD_SIZE env (ref pytorchjob_controller.go:180-234
+semantics) over the gloo backend and runs one all_reduce; exits 0 only if
+every rank sees the full sum. CPU-only — the process-level proof that the
+PyTorchJob wiring really rendezvouses, not just that the env JSON looks
+right (SURVEY.md §4 item 8 is exactly that weaker test).
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import sys
+
+
+def main() -> int:
+    import torch
+    import torch.distributed as dist
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    dist.init_process_group(
+        "gloo", init_method="env://", rank=rank, world_size=world,
+        timeout=datetime.timedelta(seconds=60),
+    )
+    t = torch.tensor([float(rank + 1)])
+    dist.all_reduce(t)
+    expect = world * (world + 1) / 2.0
+    dist.destroy_process_group()
+    if abs(t.item() - expect) > 1e-6:
+        print(f"rank {rank}: all_reduce got {t.item()} want {expect}",
+              file=sys.stderr)
+        return 1
+    print(f"rank {rank}/{world}: all_reduce ok ({t.item()})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""Sparse-ads training program — the XDLJob workload, SparseCore-style.
+
+The reference's XDL example (example/xdl/xdl_job_mnist.yaml) runs Alibaba's
+sparse ads framework over PS pods + ZooKeeper. This is its TPU-native
+equivalent (BASELINE.json config 5): a wide-and-deep CTR model whose
+embedding tables are row-sharded over the mesh's table axis
+(models/embedding.py) instead of living on parameter servers — lookups are
+one ICI psum, gradient pushes are local scatter-adds. Dense tower runs in
+bf16 on the MXU. Dataset is synthetic criteo-shaped multi-hot ids (no
+egress in the sandbox); the compute path is the real one.
+
+Usage (as a pod command):
+    python -m kubedl_tpu.train.sparse --steps 100 --batch 4096
+
+Honors KUBEDL_MESH (e.g. "data=2,tensor=4"); default puts every device on
+the table axis — the SparseCore partition layout.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+FEATURE_DEFS = (
+    # (name, vocab, dim, multi_hot, combiner)
+    ("user_id", 200_000, 32, 1, "sum"),
+    ("item_id", 500_000, 32, 1, "sum"),
+    ("item_cate", 10_000, 16, 1, "sum"),
+    ("behavior_seq", 500_000, 32, 20, "mean"),
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=int(os.environ.get("SPARSE_STEPS", 100)))
+    parser.add_argument("--batch", type=int, default=int(os.environ.get("SPARSE_BATCH", 4096)))
+    parser.add_argument("--hidden", type=int, default=512)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    def positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return iv
+
+    parser.add_argument(
+        "--vocab-scale", type=positive_int, default=1,
+        help="divide every feature vocab by this (CI shrinks the synthetic "
+        "criteo tables so CPU compile+adagrad stays inside test budgets)")
+    args = parser.parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    info = coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubedl_tpu.models.embedding import (
+        FeatureSpec,
+        init_tables,
+        lookup_features,
+        table_specs,
+    )
+    from kubedl_tpu.parallel.mesh import (
+        ENV_DCN_MESH,
+        ENV_MESH,
+        build_mesh,
+        build_mesh_from_env,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    if os.environ.get(ENV_MESH) or os.environ.get(ENV_DCN_MESH):
+        mesh = build_mesh_from_env()  # hybrid ICIxDCN when multislice
+    else:
+        # SparseCore layout: whole slice shards the tables
+        mesh = build_mesh({"tensor": n})
+    n_shards = mesh.shape["tensor"]
+
+    features = tuple(
+        FeatureSpec(name, max(vocab // args.vocab_scale, n_shards), dim, mh, comb)
+        for name, vocab, dim, mh, comb in FEATURE_DEFS
+    )
+    emb_dim = sum(f.dim for f in features)
+
+    key = jax.random.PRNGKey(0)
+    k_emb, k_wide, k1, k2, k3 = jax.random.split(key, 5)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tables = init_tables(k_emb, features, n_shards)
+    tables = {
+        name: jax.device_put(t, NamedSharding(mesh, spec))
+        for (name, t), spec in zip(tables.items(), table_specs(features).values())
+    }
+    # wide tower: dim-1 tables over the same shards (classic LR cross features)
+    wide_feats = tuple(FeatureSpec(f.name, f.vocab_size, 1, f.multi_hot, "sum") for f in features)
+    wide = {
+        name + "/wide": jax.device_put(t, NamedSharding(mesh, P("tensor", None)))
+        for name, t in init_tables(k_wide, wide_feats, n_shards).items()
+    }
+
+    repl = NamedSharding(mesh, P())
+    dense = {
+        "w1": jax.device_put(jax.random.normal(k1, (emb_dim, args.hidden), jnp.float32) * 0.02, repl),
+        "b1": jax.device_put(jnp.zeros((args.hidden,)), repl),
+        "w2": jax.device_put(jax.random.normal(k2, (args.hidden, 1), jnp.float32) * 0.02, repl),
+        "b2": jax.device_put(jnp.zeros((1,)), repl),
+    }
+    params = {"tables": tables, "wide": wide, "dense": dense}
+    # adagrad — the classic sparse-feature optimizer (per-coordinate scale)
+    tx = optax.adagrad(args.lr)
+    opt_state = tx.init(params)
+
+    def forward(params, batch_ids):
+        deep = lookup_features(params["tables"], batch_ids, features, mesh)
+        wide_in = {k.replace("/wide", ""): v for k, v in params["wide"].items()}
+        wide_logit = lookup_features(
+            {k: v for k, v in wide_in.items()},
+            batch_ids,
+            tuple(FeatureSpec(f.name, f.vocab_size, 1, f.multi_hot, "sum") for f in features),
+            mesh,
+        ).sum(-1)
+        h = jnp.maximum(
+            deep.astype(jnp.bfloat16) @ params["dense"]["w1"].astype(jnp.bfloat16)
+            + params["dense"]["b1"].astype(jnp.bfloat16), 0)
+        logit = (h @ params["dense"]["w2"].astype(jnp.bfloat16)
+                 + params["dense"]["b2"].astype(jnp.bfloat16))
+        return logit.astype(jnp.float32).squeeze(-1) + wide_logit
+
+    def loss_fn(params, batch_ids, labels):
+        logits = forward(params, batch_ids)
+        return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, batch_ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch_ids, labels)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # synthetic criteo-shaped multi-hot batch, batch-sharded over "data".
+    # Multi-process rule (same as trainer.py's data path): when the batch
+    # dim actually spans processes, each generates ONLY its local rows and
+    # contributes them via make_array_from_process_local_data; when the
+    # batch dim is replicated (the default all-devices-on-"tensor"
+    # SparseCore layout), every process must supply IDENTICAL rows — a
+    # device_put of per-process-different values onto a global sharding
+    # fails jax's cross-process equality check.
+    data_shard = NamedSharding(mesh, P(("data", "fsdp")))
+    data_span = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    split = info.num_processes > 1 and data_span % info.num_processes == 0
+    if split:
+        rng = np.random.default_rng(info.process_id)
+        # each process's local rows must themselves divide over its share
+        # of the data axis, so round local rows to data_span/num_processes
+        per_proc_span = data_span // info.num_processes
+        local_batch = max(
+            max(args.batch, n) // info.num_processes // per_proc_span, 1
+        ) * per_proc_span
+        batch = local_batch * info.num_processes
+    else:
+        rng = np.random.default_rng(0)  # common seed: identical everywhere
+        batch = local_batch = max(args.batch, n)
+
+    def globalize(local, shape):
+        if info.num_processes == 1:
+            return jax.device_put(jnp.asarray(local), data_shard)
+        return jax.make_array_from_process_local_data(data_shard, local, shape)
+
+    batch_ids = {}
+    for f in features:
+        ids = rng.integers(0, f.vocab_size, (local_batch, f.multi_hot), dtype=np.int32)
+        if f.multi_hot > 1:  # ragged bags: pad ~30% of the tail with -1
+            pad = rng.random((local_batch, f.multi_hot)) < 0.3
+            pad[:, 0] = False
+            ids[pad] = -1
+        batch_ids[f.name] = globalize(ids, (batch, f.multi_hot))
+    labels = globalize(
+        rng.integers(0, 2, (local_batch,)).astype(np.float32), (batch,))
+
+    params, opt_state, loss = train_step(params, opt_state, batch_ids, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = train_step(params, opt_state, batch_ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    lookups = batch * sum(f.multi_hot for f in features)
+    print(f"steps={args.steps} batch={batch} loss={float(loss):.4f} "
+          f"step/sec={args.steps / dt:.1f} "
+          f"lookups/sec={args.steps * lookups / dt:.3g} "
+          f"table_shards={n_shards} devices={n}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,417 @@
+"""Trainer — the JAXJob workload runtime (what the operator launches).
+
+Ties the compute path together: coordinator bootstrap from injected env
+(train/coordinator.py) -> mesh from KUBEDL_MESH (parallel/mesh.py) -> Llama
+model (models/llama.py) -> sharded train step (parallel/train_step.py) ->
+Orbax checkpointing with preemption-safe save/resume.
+
+Checkpoint/resume is first-class (SURVEY.md §5 — the reference delegates it
+entirely to training code): SIGTERM (TPU maintenance/preemption surfaces as
+SIGTERM, ref pkg/util/train/train_util.go semantics) triggers a final save
+and exit with the retryable preemption code, so the operator's ExitCode
+policy restarts the pod and the trainer resumes from the latest step.
+
+Usage (as a pod command):
+    python -m kubedl_tpu.train.trainer --model tiny --steps 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-1b", "llama-7b"])
+    p.add_argument("--steps", type=int, default=int(os.environ.get("KUBEDL_STEPS", 100)))
+    p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
+    p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 512)))
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                   default=os.environ.get("KUBEDL_LR_SCHEDULE", "constant"),
+                   help="cosine: warmup then cosine decay to 10%% of --lr "
+                        "over --steps")
+    p.add_argument("--warmup-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_WARMUP_STEPS", 0)),
+                   help="linear LR warmup steps (used by both schedules)")
+    p.add_argument("--grad-clip", type=float,
+                   default=float(os.environ.get("KUBEDL_GRAD_CLIP", 0.0)),
+                   help="clip gradients by global norm (0 = off)")
+    p.add_argument("--eval-every", type=int,
+                   default=int(os.environ.get("KUBEDL_EVAL_EVERY", 0)),
+                   help="evaluate eval-set loss every N steps (0 = off)")
+    p.add_argument("--eval-batches", type=int,
+                   default=int(os.environ.get("KUBEDL_EVAL_BATCHES", 4)),
+                   help="batches per eval pass (a fixed set each time)")
+    p.add_argument("--eval-data-path",
+                   default=os.environ.get("KUBEDL_EVAL_DATA_PATH", ""),
+                   help="separate shards for a TRUE held-out set; without "
+                        "it the eval set is a fixed probe drawn from the "
+                        "training distribution (overlaps training data "
+                        "after ~1 epoch)")
+    p.add_argument("--accum-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_ACCUM_STEPS", 1)),
+                   help="gradient accumulation micro-steps per update")
+    p.add_argument("--log-every", type=int, default=10)
+    # token shards (flat int32 files; native/loader.py). Unset -> synthetic.
+    p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
+                   help="glob of token shard files, e.g. /data/shard-*.bin")
+    p.add_argument("--data-seed", type=int,
+                   default=int(os.environ.get("KUBEDL_DATA_SEED", 0)),
+                   help="shared shuffle seed (same on every process)")
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
+    p.add_argument("--checkpoint-interval",
+                   type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_INTERVAL", 0)))
+    p.add_argument("--checkpoint-keep",
+                   type=int, default=int(os.environ.get("KUBEDL_CHECKPOINT_KEEP", 3)))
+    # JAX profiler / XProf hook (SURVEY.md §5: "TPU side gets JAX
+    # profiler/XProf hooks" — net-new, the reference has no profiling)
+    p.add_argument("--lora-rank", type=int,
+                   default=int(os.environ.get("KUBEDL_LORA_RANK", 0)),
+                   help="train low-rank adapters instead of full weights "
+                        "(models/lora.py); 0 = full fine-tune/pretrain")
+    p.add_argument("--lora-alpha", type=float, default=None,
+                   help="LoRA scale numerator (default: rank, i.e. scale 1)")
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="start from Hugging Face Llama/Mistral weights "
+                        "(models/import_hf.py) — the base for --lora-rank "
+                        "or a full fine-tune")
+    p.add_argument("--remat", choices=["full", "dots", "none"],
+                   default=os.environ.get("KUBEDL_REMAT", ""),
+                   help="override the model's remat: full recompute, "
+                        "matmul-saving 'dots' policy, or none")
+    p.add_argument("--ce-chunks", type=int,
+                   default=int(os.environ.get("KUBEDL_CE_CHUNKS", 0)),
+                   help=">1: chunked cross-entropy (no [b,t,V] logits)")
+    p.add_argument("--profile-dir", default=os.environ.get("KUBEDL_PROFILE_DIR", ""))
+    p.add_argument("--profile-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_PROFILE_STEPS", 5)),
+                   help="trace this many steps after warmup into --profile-dir")
+    args = p.parse_args(argv)
+    # argparse validates `choices` only for command-line values; an env
+    # default (KUBEDL_REMAT=off) would otherwise slip through and silently
+    # mean "full remat" instead of erroring.
+    if args.remat not in ("", "full", "dots", "none"):
+        p.error(f"invalid KUBEDL_REMAT/--remat {args.remat!r} "
+                f"(choose from full, dots, none)")
+    if args.lr_schedule not in ("constant", "cosine"):
+        p.error(f"invalid KUBEDL_LR_SCHEDULE/--lr-schedule "
+                f"{args.lr_schedule!r} (choose from constant, cosine)")
+    return args
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+    from kubedl_tpu.utils.exit_codes import EXIT_TPU_PREEMPTED, EXIT_XLA_COMPILE_ERROR
+
+    info = coordinator.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    import dataclasses
+
+    hf_base = None
+    if args.hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        hf_base, config = load_hf(args.hf_model)
+        print(f"base weights: {args.hf_model} "
+              f"({config.n_layers}L/{config.d_model}d)", flush=True)
+    else:
+        config = llama.LlamaConfig.config_for(args.model)
+
+    if args.remat:
+        config = dataclasses.replace(
+            config,
+            remat=args.remat != "none",
+            remat_policy="dots" if args.remat == "dots" else None,
+        )
+    if args.ce_chunks > 1:
+        config = dataclasses.replace(config, ce_chunks=args.ce_chunks)
+
+    # hybrid ICIxDCN when the operator injected KUBEDL_DCN_MESH (multislice)
+    mesh = build_mesh_from_env()
+    rules = ShardingRules()
+    model_name = args.hf_model or args.model
+    print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
+          f"model={model_name} params≈{config.n_layers}L/{config.d_model}d", flush=True)
+
+    # preemption flag flipped by SIGTERM
+    preempted = {"flag": False}
+
+    def on_sigterm(signum, frame):
+        preempted["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    params = (hf_base if hf_base is not None
+              else llama.init(config, jax.random.PRNGKey(0)))
+
+    def loss(params, batch):
+        return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
+
+    if args.lr_schedule == "cosine":
+        # warmup -> cosine decay to 10% of peak over the run
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=max(args.warmup_steps, 1),
+            decay_steps=max(args.steps, args.warmup_steps + 1),
+            end_value=args.lr * 0.1,
+        )
+    elif args.warmup_steps > 0:
+        lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    else:
+        lr = args.lr
+    tx = optax.adamw(lr, weight_decay=0.01)
+    if args.grad_clip > 0:
+        tx = optax.chain(optax.clip_by_global_norm(args.grad_clip), tx)
+    try:
+        if args.lora_rank > 0:
+            # adapter-only training: gradients + optimizer state cover the
+            # low-rank deltas; the frozen base rides sharded through the
+            # step (models/lora.py)
+            from kubedl_tpu.models import lora as lora_mod
+
+            adapters0, init_state, train_step = lora_mod.make_lora_step(
+                params, config, tx, mesh, rules=rules, rank=args.lora_rank,
+                alpha=args.lora_alpha, accum_steps=args.accum_steps,
+            )
+            state = init_state(adapters0)
+            n_ad = lora_mod.adapter_count(adapters0)
+            print(f"lora: rank {args.lora_rank}, {n_ad} adapter params "
+                  f"({100.0 * n_ad / llama.param_count(params):.2f}% of base)",
+                  flush=True)
+            if args.eval_every:
+                print("note: --eval-every is skipped under --lora-rank "
+                      "(restore with generate/serve --lora-checkpoint-path "
+                      "to evaluate the merged model)", flush=True)
+                args.eval_every = 0
+        else:
+            spec_tree = llama.param_specs(config, rules)
+            init_state, train_step = make_train_step(
+                loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,
+                accum_steps=args.accum_steps,
+            )
+            state = init_state(params)
+        # the sharded copies live on the mesh now; a 7B HF import would
+        # otherwise pin ~14 GB of dead host arrays for the whole run
+        del params
+        hf_base = None
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e) or "XlaRuntimeError" in type(e).__name__:
+            print(f"compile/alloc failure: {e}", file=sys.stderr)
+            return EXIT_XLA_COMPILE_ERROR
+        raise
+
+    # checkpointing (Orbax)
+    mngr = None
+    start_step = 0
+    if args.checkpoint_path:
+        import orbax.checkpoint as ocp
+
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=args.checkpoint_keep, create=True
+        )
+        mngr = ocp.CheckpointManager(args.checkpoint_path, options=options)
+        latest = mngr.latest_step()
+        if latest is not None and os.environ.get("KUBEDL_CHECKPOINT_RESTORE", "1") == "1":
+            # Restore straight into the SHARDED state: the live arrays act
+            # as the abstract target, so each leaf comes back with its
+            # param_specs sharding instead of landing replicated on one
+            # device (mandatory for models that only fit sharded).
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
+            state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
+            start_step = int(state.step)
+            print(f"restored checkpoint at step {start_step}", flush=True)
+
+    # interval saves are ASYNC: orbax's save() blocks only for the
+    # device->host copy (so the next step may donate the state buffers
+    # safely) and streams to disk in background — training overlaps the
+    # write. Only final saves (preemption, end of run) wait for
+    # durability. last-saved is tracked here, not via latest_step(),
+    # which lags while a save is in flight.
+    saved_step = {"v": mngr.latest_step() if mngr else None}
+
+    def save(step, final=False):
+        if mngr is None:
+            return
+        if saved_step["v"] != step:  # else: interval hook already saved it
+            import orbax.checkpoint as ocp
+
+            mngr.save(step, args=ocp.args.StandardSave(state))
+            saved_step["v"] = step
+        if final:
+            mngr.wait_until_finished()
+            print(f"saved final checkpoint at step {step}", flush=True)
+
+    # input pipeline: native mmap+prefetch loader over token shards, or
+    # synthetic batches when no data path is given. All processes share one
+    # seed/permutation and stride it by rank (batch id = step*world + rank),
+    # so the global batch is disjoint across data-parallel processes and a
+    # checkpoint resume at start_step continues the schedule, not replays it.
+    loader = None
+    if args.data_path:
+        import glob as globlib
+
+        from kubedl_tpu.native.loader import TokenLoader
+
+        shard_paths = sorted(globlib.glob(args.data_path))
+        if not shard_paths:
+            print(f"no shards match {args.data_path!r}", file=sys.stderr)
+            return 1
+        loader = TokenLoader(
+            shard_paths, batch=args.batch, seq_len=args.seq_len, seed=args.data_seed,
+            # the trainer only random-accesses batch_at(); prefetch threads
+            # would fill ring slots nobody consumes
+            n_threads=0,
+        )
+        print(f"data: {len(shard_paths)} shards, {loader.n_windows} windows, "
+              f"native={loader.is_native}", flush=True)
+
+    rng = np.random.default_rng(info.process_id)
+    batch_sharding = rules.sharding(mesh, "batch", None)
+    global_batch = args.batch * info.num_processes
+
+    def to_global(local):
+        """Global [world*batch, seq] array from per-process local rows.
+
+        Each process loads ONLY its own rows (rank-strided window ids) and
+        contributes them via make_array_from_process_local_data — jnp.asarray
+        would device-commit locally and cannot reshard onto the other
+        processes' non-addressable devices on a multi-host mesh."""
+        if info.num_processes == 1:
+            return jnp.asarray(local)
+        return jax.make_array_from_process_local_data(
+            batch_sharding, np.asarray(local), (global_batch, args.seq_len)
+        )
+
+    def next_batch(step: int):
+        if loader is not None:
+            local = loader.batch_at(step * info.num_processes + info.process_id)
+        else:
+            local = rng.integers(
+                0, config.vocab_size, (args.batch, args.seq_len), dtype=np.int32
+            )
+        return to_global(local)
+
+    tokens_per_step = global_batch * (args.seq_len - 1)
+
+    # eval: every pass scores the SAME fixed batch set (fresh rng / fixed
+    # ids), so losses are comparable across the run. With
+    # --eval-data-path the set comes from SEPARATE shards — a true
+    # held-out set; otherwise it is a probe drawn from the training
+    # distribution (batch_at wraps modulo the shard windows, so probe
+    # batches overlap training data once a run covers an epoch).
+    eval_fn = jax.jit(loss) if args.eval_every else None
+    eval_loader = None
+    if args.eval_every and args.eval_data_path:
+        import glob as globlib
+
+        from kubedl_tpu.native.loader import TokenLoader
+
+        eval_shards = sorted(globlib.glob(args.eval_data_path))
+        if not eval_shards:
+            print(f"no shards match {args.eval_data_path!r}", file=sys.stderr)
+            return 1
+        eval_loader = TokenLoader(
+            eval_shards, batch=args.batch, seq_len=args.seq_len,
+            seed=args.data_seed, n_threads=0,
+        )
+
+    def eval_pass(step: int) -> None:
+        erng = np.random.default_rng(10**9 + info.process_id)
+        src = eval_loader if eval_loader is not None else loader
+        losses = []
+        for i in range(args.eval_batches):
+            if src is not None:
+                # held-out loader: its own shards, ids from 0. Probe mode
+                # reads a fixed far region of the TRAINING loader — stable
+                # across passes, but not disjoint from training in general
+                base = 0 if eval_loader is not None else 2**20
+                local = src.batch_at(
+                    base + i * info.num_processes + info.process_id)
+            else:
+                local = erng.integers(
+                    0, config.vocab_size, (args.batch, args.seq_len),
+                    dtype=np.int32)
+            losses.append(eval_fn(state.params, to_global(local)))
+        ev = float(np.mean([float(jax.device_get(l)) for l in losses]))
+        tag = "held-out" if eval_loader is not None else "probe"
+        print(f"eval step {step}: loss={ev:.4f} "
+              f"({args.eval_batches} {tag} batches)", flush=True)
+
+    # profiler window: [start+1, start+1+profile_steps) — skips the compile step
+    prof_start = start_step + 1 if args.profile_dir else -1
+    prof_stop = prof_start + args.profile_steps
+    tracing = False
+
+    def stop_trace():
+        nonlocal tracing
+        if tracing:
+            jax.profiler.stop_trace()
+            print(f"profile written to {args.profile_dir}", flush=True)
+            tracing = False
+
+    t_start = time.perf_counter()
+    last_log = t_start
+    for step in range(start_step, args.steps):
+        if step == prof_start:
+            jax.profiler.start_trace(args.profile_dir)
+            tracing = True
+        batch = next_batch(step)
+        state, metrics = train_step(state, batch)
+        if tracing and step + 1 >= prof_stop:
+            jax.block_until_ready(metrics["loss"])
+            stop_trace()
+        if preempted["flag"]:
+            jax.block_until_ready(metrics["loss"])
+            stop_trace()
+            save(step + 1, final=True)
+            print("preempted: checkpoint saved, exiting retryable", flush=True)
+            # A clean interpreter exit would block in jax.distributed's
+            # shutdown barrier (atexit) while peers are still mid-collective
+            # — the exact deadlock slice restart exists to break. The
+            # checkpoint is durable; exit immediately.
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(EXIT_TPU_PREEMPTED)
+        if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
+            jax.block_until_ready(metrics["loss"])
+            save(step + 1)
+        if args.eval_every and (step + 1) % args.eval_every == 0:
+            eval_pass(step + 1)
+        if (step + 1) % args.log_every == 0:
+            loss_v = float(metrics["loss"])
+            now = time.perf_counter()
+            sps = args.log_every / (now - last_log)
+            last_log = now
+            print(f"step {step + 1}: loss={loss_v:.4f} "
+                  f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
+
+    jax.device_get(state.step)  # full sync (remote platforms)
+    stop_trace()
+    total = time.perf_counter() - t_start
+    steps_done = args.steps - start_step
+    print(f"done: {steps_done} steps in {total:.1f}s "
+          f"({steps_done / total:.2f} step/s, "
+          f"{steps_done * tokens_per_step / total:.0f} tok/s)", flush=True)
+    save(args.steps, final=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Exit-code retry classification for RestartPolicy.ExitCode.
+
+Re-derives the reference's table (pkg/util/train/train_util.go:18-53):
+permanent {1,2,126,127,128,139}; retryable {130,137,143} (SIGINT/KILL/TERM —
+transient infra), 138 (=128+SIGUSR1, user-defined retryable).
+
+TPU extension (SURVEY.md §7 "hard parts"): TPU maintenance events and
+preemptions surface as SIGTERM (143) — already retryable — and we add
+explicit codes our runtime uses to signal classified failures upward:
+  EXIT_TPU_PREEMPTED (113): slice preempted/maintenance → retryable
+  EXIT_XLA_COMPILE_ERROR (114): program cannot compile → permanent
+"""
+from __future__ import annotations
+
+EXIT_TPU_PREEMPTED = 113
+EXIT_XLA_COMPILE_ERROR = 114
+
+_PERMANENT = {1, 2, 126, 127, 128, 139, EXIT_XLA_COMPILE_ERROR}
+_RETRYABLE = {130, 137, 143, 138, EXIT_TPU_PREEMPTED}
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    if exit_code in _PERMANENT:
+        return False
+    if exit_code in _RETRYABLE:
+        return True
+    # No guarantee for other codes: treated as permanent, like the reference.
+    return False

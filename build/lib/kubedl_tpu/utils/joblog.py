@@ -1,0 +1,56 @@
+"""Structured per-job/replica/pod loggers (ref pkg/util/logger.go:26-60).
+
+The reference attaches job/replica/pod fields to every reconcile log line
+via logrus.WithFields; the native equivalent is a LoggerAdapter that
+appends `key=value` context to each message, so `grep job=ns/name` slices
+one job's history out of interleaved operator logs.
+
+    jlog = job_logger(log, job)
+    jlog.info("reconciling")            # "reconciling job=default/mnist"
+    plog = job_logger(log, job, rtype="worker", index=2, pod="mnist-worker-2")
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+
+class _ContextAdapter(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        ctx = " ".join(f"{k}={v}" for k, v in self.extra.items() if v is not None)
+        return (f"{msg} {ctx}" if ctx else msg), kwargs
+
+
+def job_logger(
+    base: logging.Logger,
+    job=None,
+    rtype: Optional[str] = None,
+    index: Optional[int] = None,
+    pod: Optional[str] = None,
+    **fields,
+) -> logging.LoggerAdapter:
+    extra = {}
+    if job is not None:
+        extra["kind"] = getattr(job, "kind", None)
+        extra["job"] = f"{job.metadata.namespace}/{job.metadata.name}"
+        if job.metadata.uid:
+            extra["uid"] = job.metadata.uid
+    if rtype is not None:
+        extra["rtype"] = str(rtype).lower()
+    if index is not None:
+        extra["index"] = index
+    if pod is not None:
+        extra["pod"] = pod
+    extra.update(fields)
+    return _ContextAdapter(base, extra)
+
+
+def pod_logger(base: logging.Logger, pod_obj) -> logging.LoggerAdapter:
+    """Context from a Pod object's labels (replica-type/index/job-name)."""
+    labels = pod_obj.metadata.labels
+    return _ContextAdapter(base, {
+        "pod": f"{pod_obj.metadata.namespace}/{pod_obj.metadata.name}",
+        "job": labels.get("job-name"),
+        "rtype": labels.get("replica-type"),
+        "index": labels.get("replica-index"),
+    })

@@ -1,0 +1,138 @@
+"""Generic dataclass <-> plain-dict serialization with camelCase keys.
+
+Gives our API types the same YAML/JSON surface as the reference's CRDs
+(e.g. ref api/tensorflow/v1/types.go marshals `tfReplicaSpecs`,
+`cleanPodPolicy`, ...) without hand-writing a marshaller per type.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Type, TypeVar, Union, get_args, get_origin, get_type_hints
+
+T = TypeVar("T")
+
+_HINT_CACHE: dict = {}
+
+
+def camel(snake: str) -> str:
+    parts = snake.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def _hints(cls) -> dict:
+    if cls not in _HINT_CACHE:
+        _HINT_CACHE[cls] = get_type_hints(cls)
+    return _HINT_CACHE[cls]
+
+
+def to_dict(obj: Any, *, drop_empty: bool = True) -> Any:
+    """Serialize a dataclass tree into plain dicts with camelCase keys."""
+    if obj is None:
+        return None
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            if not f.metadata.get("serialize", True):
+                continue
+            v = to_dict(getattr(obj, f.name), drop_empty=drop_empty)
+            if drop_empty and (v is None or v == "" or v == [] or v == {}):
+                continue
+            out[f.metadata.get("name") or camel(f.name)] = v
+        return out
+    if isinstance(obj, dict):
+        return {str(k.value if isinstance(k, enum.Enum) else k): to_dict(v, drop_empty=drop_empty)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v, drop_empty=drop_empty) for v in obj]
+    return obj
+
+
+def _strip_optional(tp):
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Type[T], data: Any) -> T:
+    """Deserialize plain dicts (camelCase or snake_case keys) into dataclass `cls`."""
+    return _from(cls, data)
+
+
+def _from(tp, data):
+    if data is None:
+        return None
+    tp = _strip_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (elem,) = get_args(tp) or (Any,)
+        return [_from(elem, v) for v in data]
+    if origin is dict:
+        args = get_args(tp)
+        kt, vt = (args if args else (str, Any))
+        return {_from(kt, k): _from(vt, v) for k, v in data.items()}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        hints = _hints(tp)
+        by_key = {}
+        for f in dataclasses.fields(tp):
+            by_key[f.metadata.get("name") or camel(f.name)] = f
+            by_key[f.name] = f
+        kwargs = {}
+        for k, v in data.items():
+            f = by_key.get(k)
+            if f is None:
+                continue  # tolerate unknown fields, like k8s does
+            kwargs[f.name] = _from(hints[f.name], v)
+        return tp(**kwargs)
+    if tp is float and isinstance(data, str):
+        # Two kinds of strings land in float fields: RFC3339 timestamps
+        # (k8s metadata times -> float epoch seconds, see api/meta.py) and
+        # k8s resource quantities ("1", "500m", "1Gi" — YAML authors quote
+        # them routinely, and kubectl emits them quoted).
+        if "T" in data and data.endswith("Z"):
+            import calendar
+            import time as _time
+
+            return float(calendar.timegm(_time.strptime(data, "%Y-%m-%dT%H:%M:%SZ")))
+        return parse_quantity(data)
+    if tp is bool and isinstance(data, str):
+        # bool("false") is True in Python — a quoted flag in a manifest
+        # must not silently invert
+        low = data.strip().lower()
+        if low in ("true", "1", "yes"):
+            return True
+        if low in ("false", "0", "no"):
+            return False
+        raise ValueError(f"invalid boolean string {data!r}")
+    if tp in (int, float, str, bool):
+        return tp(data) if data is not None else None
+    return data
+
+
+# Full k8s resource.Quantity suffix set (shared with k8s/store.py's
+# wire translation — one table, one parser).
+QUANTITY_SUFFIX = {
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+_SUFFIXES_BY_LEN = sorted(QUANTITY_SUFFIX, key=len, reverse=True)
+
+
+def parse_quantity(q) -> float:
+    """k8s resource quantity -> float ("500m" -> 0.5, "1Gi" -> 2**30,
+    "100n" -> 1e-7, "2" -> 2.0); ref resource.Quantity semantics."""
+    if isinstance(q, (int, float)):
+        return float(q)
+    s = str(q).strip()
+    for suf in _SUFFIXES_BY_LEN:
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * QUANTITY_SUFFIX[suf]
+    return float(s)

@@ -1,0 +1,41 @@
+"""Multi-tenancy annotation parsing.
+
+Ref pkg/util/tenancy/tenancy.go:26-43 — jobs may carry a
+`kubedl.io/tenancy` annotation holding JSON `{tenant, user, idc?, region?}`;
+persistence converters record tenant/owner/region from it.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from kubedl_tpu.api.common import ANNOTATION_TENANCY
+
+
+@dataclass
+class Tenancy:
+    tenant: str = ""
+    user: str = ""
+    idc: str = ""
+    region: str = ""
+
+
+def get_tenancy(obj) -> Optional[Tenancy]:
+    """Parse the tenancy annotation off any store object; None if absent.
+
+    Raises ValueError on malformed JSON (ref returns the unmarshal error).
+    """
+    raw = (obj.metadata.annotations or {}).get(ANNOTATION_TENANCY)
+    if raw is None:
+        return None
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed tenancy annotation: {e}") from e
+    return Tenancy(
+        tenant=data.get("tenant", ""),
+        user=data.get("user", ""),
+        idc=data.get("idc", ""),
+        region=data.get("region", ""),
+    )

@@ -1,0 +1,38 @@
+"""Workload enable gate (ref pkg/util/workloadgate/workload_gate.go:26-107).
+
+Expression grammar, same as the reference's --workloads flag /
+WORKLOADS_ENABLE env (env wins): comma-separated names, "*" for all,
+"-name" to subtract. "auto" (reference default) enables everything when
+running standalone (all kinds are compiled in); against a real
+kube-apiserver the registry additionally probes the discovery API for the
+CRD (controllers/registry.enabled_controllers `discover` hook), matching
+the reference's behavior.
+"""
+from __future__ import annotations
+
+import os
+
+ENV_WORKLOADS_ENABLE = "WORKLOADS_ENABLE"
+
+
+def effective_expr(expr: str) -> str:
+    """The expression after the env override (env wins, ref :26-33)."""
+    return os.environ.get(ENV_WORKLOADS_ENABLE) or expr
+
+
+def is_workload_enabled(name: str, expr: str) -> bool:
+    expr = os.environ.get(ENV_WORKLOADS_ENABLE) or expr
+    if expr in ("", "auto"):
+        return True
+    enabled = False
+    for tok in (t.strip() for t in expr.split(",")):
+        if not tok:
+            continue
+        if tok == "*":
+            enabled = True
+        elif tok.startswith("-"):
+            if tok[1:].lower() == name.lower():
+                return False
+        elif tok.lower() == name.lower():
+            enabled = True
+    return enabled

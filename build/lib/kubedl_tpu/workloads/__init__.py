@@ -1,0 +1,1 @@
+from kubedl_tpu.workloads import jaxjob, pytorch, tensorflow, xdl, xgboost  # noqa: F401

@@ -1,0 +1,123 @@
+"""Shared helpers for workload controllers.
+
+The reference wires four different rendezvous schemes (TF_CONFIG JSON, torch
+TCP-store env, Rabit tracker env, ZooKeeper namespaces). TPU-native jobs all
+converge on ONE scheme — the JAX coordination service (SURVEY.md §2.4): the
+reconciler injects coordinator address + process count + process id; XLA
+collectives then ride ICI/DCN. `inject_coordinator_env` is that single
+implementation; per-framework envs are kept for compatibility on top.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from kubedl_tpu.api.common import ReplicaSpec
+from kubedl_tpu.controllers.utils import gen_general_name, get_total_replicas
+
+# ref controllers/tensorflow/tensorflow.go:30-33
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+# Port of the JAX coordination service on worker 0 (the PJRT distributed
+# runtime default).
+COORDINATOR_PORT = 8471
+
+# Port of the Megascale (multislice DCN) coordinator on slice-0 worker-0 —
+# libtpu's default; injected as MEGASCALE_COORDINATOR_ADDRESS next to the
+# coordination-service envs for numSlices > 1 jobs (workloads/jaxjob.py).
+MEGASCALE_PORT = 8080
+
+ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
+ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"
+
+
+def service_dns(job, rt: str, index, namespace: Optional[str] = None) -> str:
+    """Headless-service DNS name for one replica.
+
+    Ref controllers/tensorflow/tensorflow.go:122-136: name-rtype-i.ns.svc
+    plus CUSTOM_CLUSTER_DOMAIN when set.
+    """
+    host = gen_general_name(job.metadata.name, rt, index)
+    svc = f"{host}.{namespace or job.metadata.namespace}.svc"
+    domain = os.environ.get(ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    if domain:
+        svc += f".{domain}"
+    return svc
+
+
+def get_port_from_specs(
+    replica_specs: Dict[str, ReplicaSpec], rtype: str, container_name: str,
+    port_name: str, default: int,
+) -> int:
+    """Named port of the default container for a replica type
+    (ref pkg/job_controller/service.go:221-234)."""
+    spec = replica_specs.get(rtype)
+    if spec is None:
+        return default
+    for c in spec.template.spec.containers:
+        if c.name == container_name:
+            p = c.port_named(port_name)
+            if p:
+                return p
+    return default
+
+
+def add_env(pod_template, env: Dict[str, str]) -> None:
+    """Merge env into every (main) container of a pod template; values the
+    user already set win (parity with the reference appending EnvVars —
+    first occurrence wins in kubelet)."""
+    for c in pod_template.spec.containers:
+        for k, v in env.items():
+            c.env.setdefault(k, v)
+
+
+def global_rank(
+    replica_specs: Dict[str, ReplicaSpec],
+    order: list,
+    coordinator_rtype: str,
+    rtype: str,
+    index: int,
+) -> int:
+    """Globally-unique process id with the coordinator replica pinned to 0.
+
+    jax.distributed requires process 0 to host the coordination service at
+    the advertised address, so the rank ordering puts the coordinator's
+    replica type first, then the remaining types in the controller's
+    reconcile order.
+    """
+    ordered = [coordinator_rtype] + [
+        t for t in order if t != coordinator_rtype and t in replica_specs
+    ]
+    rank = 0
+    for t in ordered:
+        spec = replica_specs.get(t)
+        if spec is None:
+            continue
+        if t == rtype:
+            return rank + int(index)
+        rank += int(spec.replicas or 0)
+    return rank + int(index)
+
+
+def inject_coordinator_env(
+    job, pod_template, rtype: str, index: int,
+    replica_specs: Dict[str, ReplicaSpec],
+    coordinator_rtype: str,
+    order: list,
+) -> None:
+    """The ONE rendezvous scheme for TPU-native workloads: the coordinator
+    replica's index-0 pod hosts the JAX coordination service; every process
+    gets its address, the world size, and a unique process id where id 0 IS
+    the pod at that address."""
+    addr = f"{service_dns(job, coordinator_rtype, 0)}:{COORDINATOR_PORT}"
+    add_env(
+        pod_template,
+        {
+            ENV_COORDINATOR_ADDRESS: addr,
+            ENV_NUM_PROCESSES: str(get_total_replicas(replica_specs)),
+            ENV_PROCESS_ID: str(
+                global_rank(replica_specs, order, coordinator_rtype, rtype, index)
+            ),
+        },
+    )

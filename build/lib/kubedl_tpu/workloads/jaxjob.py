@@ -1,0 +1,221 @@
+"""JAXJob — the flagship first-class TPU workload (net-new).
+
+Added via the reference's documented extension path
+(ref docs/how-to-add-a-custom-workload.md:1-110): a new kind + controller
+registered with the shared engine. Design (SURVEY.md §7 step 4):
+  * replica types: Worker (SPMD ranks; worker-0 hosts the coordination
+    service). No PS, no chief — JAX is single-program multi-data;
+  * spec.mesh declares named axes ("data", "fsdp", "tensor", "context",
+    "expert") the runtime materializes as a jax.sharding.Mesh over the
+    slice (parallel/mesh.py);
+  * spec.checkpoint: Orbax checkpoint dir + save interval — first-class
+    because TPU preemptions make resume mandatory (SURVEY.md §5);
+  * SetClusterSpec injects ONLY the coordination-service env (one rendezvous
+    scheme instead of the reference's four) plus the mesh/checkpoint config;
+  * default restart policy ExitCode: TPU preemptions exit retryable
+    (utils/exit_codes.py), XLA compile errors permanent.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import (
+    LABEL_SLICE_ID,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    slice_group,
+)
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.workloads import common
+
+KIND = "JAXJob"
+API_VERSION = "kubedl-tpu.io/v1alpha1"
+
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+
+_CANONICAL = {"worker": REPLICA_WORKER}
+
+
+@dataclass
+class MeshSpec:
+    """Named mesh axes; sizes multiply to the process*local-device count.
+    A size of -1 means "fill with whatever devices remain" (like a reshape)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+    expert: int = 1
+
+    def axis_dict(self) -> Dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "context": self.context,
+            "expert": self.expert,
+        }
+
+    def encode(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in self.axis_dict().items())
+
+    def encode_sparse(self) -> str:
+        """Only the non-trivial axes — the KUBEDL_DCN_MESH wire form, where
+        unset axes default to 1 (parallel/mesh.py parse_dcn_mesh_env)."""
+        return ",".join(f"{k}={v}" for k, v in self.axis_dict().items() if v != 1)
+
+    def product(self) -> int:
+        p = 1
+        for v in self.axis_dict().values():
+            p *= v
+        return p
+
+
+@dataclass
+class CheckpointSpec:
+    path: str = ""
+    save_interval_steps: int = 0
+    keep: int = 3
+    restore: bool = True
+
+
+@dataclass
+class JAXJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "jaxReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    mesh: Optional[MeshSpec] = None
+    checkpoint: Optional[CheckpointSpec] = None
+    # Multislice: the job spans num_slices TPU slices joined by DCN.
+    # `mesh` stays the per-slice (ICI) axes; `dcn_mesh` declares which
+    # axes span slices (default data=num_slices — the standard recipe:
+    # data parallel over DCN, fsdp/tensor/context inside each slice).
+    # Workers divide evenly into slices by index; the gang admitter
+    # reserves num_slices whole slices atomically or nothing.
+    num_slices: int = 1
+    dcn_mesh: Optional[MeshSpec] = None
+    # Persistent XLA compile cache dir (a mounted volume / GCS path):
+    # after a preemption the restarted slice replays compiles from cache
+    # instead of paying minutes of XLA again. Injected as JAX's native
+    # JAX_COMPILATION_CACHE_DIR (serde camelCases the wire name).
+    compilation_cache_dir: str = ""
+
+
+@dataclass
+class JAXJob(BaseJob):
+    spec: JAXJobSpec = field(default_factory=JAXJobSpec)
+    kind: str = KIND
+
+
+class JAXJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "jax"
+    default_port_name = "jaxjob-port"
+    default_port = common.COORDINATOR_PORT
+
+    replica_key_map = _CANONICAL
+
+    def job_type(self):
+        return JAXJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def set_defaults(self, job) -> None:
+        super().set_defaults(job)
+        if job.spec.run_policy.backoff_limit is None:
+            # preemptions are routine on TPU; retry generously
+            job.spec.run_policy.backoff_limit = 10
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        return RestartPolicy.EXIT_CODE
+
+    def restart_whole_gang(self, job, replicas) -> bool:
+        """Multi-worker SPMD jobs restart as a slice: every rank blocks in
+        jax.distributed.initialize at startup, so a lone restarted worker
+        would hang against peers that are mid-run."""
+        return sum(int(s.replicas or 0) for s in replicas.values()) > 1
+
+    @property
+    def master_types(self) -> List[str]:
+        return []
+
+    def reconcile_orders(self):
+        return [ReplicaType.WORKER]
+
+    def validate_job(self, job) -> List[str]:
+        errs = []
+        ns = int(job.spec.num_slices or 1)
+        workers = int(
+            (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec()).replicas
+            or 0
+        )
+        if ns < 1:
+            errs.append(f"spec.numSlices must be >=1, got {ns}")
+        elif ns > 1:
+            if workers % ns:
+                errs.append(
+                    f"spec.numSlices={ns} must divide the Worker replica "
+                    f"count {workers} (each slice gets an equal worker group)"
+                )
+            if job.spec.dcn_mesh is not None and job.spec.dcn_mesh.product() != ns:
+                errs.append(
+                    f"spec.dcnMesh axes multiply to "
+                    f"{job.spec.dcn_mesh.product()}, must equal "
+                    f"spec.numSlices={ns}"
+                )
+        elif job.spec.dcn_mesh is not None:
+            errs.append("spec.dcnMesh requires spec.numSlices > 1")
+        return errs
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        env = {}
+        if job.spec.mesh is not None:
+            env["KUBEDL_MESH"] = job.spec.mesh.encode()
+        ns = int(job.spec.num_slices or 1)
+        if ns > 1:
+            # Multislice: per-slice worker groups by index; libtpu's
+            # Megascale DCN transport bootstraps from MEGASCALE_* the way
+            # single-slice jobs bootstrap from the coordination service.
+            workers = int(
+                (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec())
+                .replicas or 0
+            )
+            slice_id, _, _ = slice_group(workers, ns, index)
+            dcn = job.spec.dcn_mesh
+            dcn_encoded = dcn.encode_sparse() if dcn is not None else f"data={ns}"
+            env["KUBEDL_NUM_SLICES"] = str(ns)
+            env["KUBEDL_SLICE_ID"] = str(slice_id)
+            env["KUBEDL_DCN_MESH"] = dcn_encoded
+            env["MEGASCALE_NUM_SLICES"] = str(ns)
+            env["MEGASCALE_SLICE_ID"] = str(slice_id)
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                f"{common.service_dns(job, REPLICA_WORKER, 0)}"
+                f":{common.MEGASCALE_PORT}"
+            )
+            pod_template.metadata.labels[LABEL_SLICE_ID] = str(slice_id)
+        ckpt = job.spec.checkpoint
+        if ckpt is not None and ckpt.path:
+            env["KUBEDL_CHECKPOINT_PATH"] = ckpt.path
+            env["KUBEDL_CHECKPOINT_INTERVAL"] = str(ckpt.save_interval_steps)
+            env["KUBEDL_CHECKPOINT_KEEP"] = str(ckpt.keep)
+            env["KUBEDL_CHECKPOINT_RESTORE"] = "1" if ckpt.restore else "0"
+        if job.spec.compilation_cache_dir:
+            # JAX's own min-compile-time default (1s) already skips
+            # sub-second compiles — no need to override it here
+            env["JAX_COMPILATION_CACHE_DIR"] = job.spec.compilation_cache_dir
+        common.add_env(pod_template, env)
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            REPLICA_WORKER, [str(rt.value) for rt in self.reconcile_orders()],
+        )
+
+
+register_workload("jax", JAXJobController)

@@ -1,0 +1,134 @@
+"""PyTorchJob — PyTorch workload controller.
+
+Parity surface (ref api/pytorch/v1 + controllers/pytorch):
+  * replica types Master/Worker (types.go:67-71); container "pytorch", port
+    "pytorchjob-port" 23456; Master defaults ExitCode, Worker OnFailure
+    (constants.go:26-36);
+  * SetClusterSpec injects MASTER_PORT / MASTER_ADDR ("localhost" on the
+    master itself, master-0 service DNS elsewhere) / WORLD_SIZE / RANK
+    (master=0, worker index+1) / PYTHONUNBUFFERED
+    (pytorchjob_controller.go:180-234), erroring on a master with index!=0;
+  * services only for Master — expressed via needs_service_for_replica
+    (the reference hard-codes this in the generic engine, job.go:223-227);
+  * reconcile order Master->Worker; job status driven by Master, and a job
+    without a Master spec is rejected (status.go:63-91).
+
+TPU-native addition: PJRT_DEVICE=TPU plus the shared coordinator env, so
+torch-xla's PJRT runtime rendezvouses over the same coordination service
+instead of a NCCL TCP store (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from kubedl_tpu.api.common import ReplicaSpec, ReplicaType, RestartPolicy, RunPolicy
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.controllers.utils import get_total_replicas
+from kubedl_tpu.workloads import common
+
+KIND = "PyTorchJob"
+API_VERSION = "kubeflow.org/v1"
+
+REPLICA_MASTER = str(ReplicaType.MASTER.value)
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+
+_CANONICAL = {"master": REPLICA_MASTER, "worker": REPLICA_WORKER}
+
+
+@dataclass
+class PyTorchJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "pytorchReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+@dataclass
+class PyTorchJob(BaseJob):
+    spec: PyTorchJobSpec = field(default_factory=PyTorchJobSpec)
+    kind: str = KIND
+
+
+class PyTorchJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "pytorch"
+    default_port_name = "pytorchjob-port"
+    default_port = 23456
+
+    replica_key_map = _CANONICAL
+
+    def job_type(self):
+        return PyTorchJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        # ref constants.go:26-36
+        if rtype == REPLICA_MASTER:
+            return RestartPolicy.EXIT_CODE
+        return RestartPolicy.ON_FAILURE
+
+    @property
+    def master_types(self) -> List[str]:
+        return [REPLICA_MASTER]
+
+    def needs_service_for_replica(self, rtype: str) -> bool:
+        return rtype == REPLICA_MASTER
+
+    def validate_job(self, job) -> List[str]:
+        # admission-time version of the reconcile-time error below
+        if REPLICA_MASTER not in job.spec.replica_specs:
+            return ["spec.pytorchReplicaSpecs: a Master replica spec is required"]
+        return []
+
+    def reconcile_orders(self):
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def update_job_status(self, job, replicas, status, restart) -> None:
+        if REPLICA_MASTER not in replicas:
+            # ref controllers/pytorch/status.go:63-91
+            raise ValueError(
+                f"PyTorchJob {job.metadata.name} must contain a Master replica spec"
+            )
+        super().update_job_status(job, replicas, status, restart)
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        rank = int(index)
+        if rtype == REPLICA_MASTER:
+            if rank != 0:
+                raise ValueError(
+                    "invalid config: there should be only a single master with index=0"
+                )
+            master_addr = "localhost"
+        else:
+            master_addr = common.service_dns(job, REPLICA_MASTER.lower(), 0)
+            rank += 1
+
+        master_port = common.get_port_from_specs(
+            job.spec.replica_specs, REPLICA_MASTER, self.default_container_name,
+            self.default_port_name, self.default_port,
+        )
+        common.add_env(
+            pod_template,
+            {
+                "MASTER_PORT": str(master_port),
+                "MASTER_ADDR": master_addr,
+                "WORLD_SIZE": str(get_total_replicas(job.spec.replica_specs)),
+                "RANK": str(rank),
+                "PYTHONUNBUFFERED": "0",
+                # TPU-native: torch-xla PJRT runtime targets the TPU directly
+                "PJRT_DEVICE": "TPU",
+            },
+        )
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            REPLICA_MASTER, [str(rt.value) for rt in self.reconcile_orders()],
+        )
+
+
+register_workload("pytorch", PyTorchJobController)

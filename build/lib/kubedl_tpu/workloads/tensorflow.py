@@ -1,0 +1,145 @@
+"""TFJob — TensorFlow workload controller.
+
+Parity surface (ref api/tensorflow/v1 + controllers/tensorflow):
+  * replica types PS/Worker/Chief/Master/Evaluator (types.go:70-87);
+  * container "tensorflow", port "tfjob-port" 2222, default restart
+    ExitCode, CleanPodPolicy Running (constants.go:27-33, defaults.go:92-108);
+  * SetClusterSpec builds TF_CONFIG {cluster:{...},task:{type,index},
+    environment:"cloud"} from per-replica headless-service DNS, excluding
+    Evaluator (tensorflow.go:40-142), skipped entirely for non-distributed
+    jobs (tfjob_controller.go:224-245);
+  * reconcile order PS->Master->Chief->Worker->Evaluator (:263-270);
+  * Chief/Master drive success when present, else the worker-0-completed
+    heuristic (status.go:62-177).
+
+TPU-native addition: every pod also gets the shared coordinator-service env
+(workloads/common.py) so `tf.distribute` TPU strategies and JAX-on-TF-images
+bootstrap without TF gRPC server rings.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from kubedl_tpu.api.common import ReplicaSpec, ReplicaType, RestartPolicy, RunPolicy
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.controllers.utils import get_total_replicas
+from kubedl_tpu.workloads import common
+
+KIND = "TFJob"
+API_VERSION = "kubeflow.org/v1"
+
+REPLICA_PS = str(ReplicaType.PS.value)
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+REPLICA_CHIEF = str(ReplicaType.CHIEF.value)
+REPLICA_MASTER = str(ReplicaType.MASTER.value)
+REPLICA_EVALUATOR = str(ReplicaType.EVALUATOR.value)
+
+# canonicalization map for manifest keys (ref defaults.go:92-108 camel-cases
+# "ps"->"PS", "worker"->"Worker", ...)
+_CANONICAL = {
+    "ps": REPLICA_PS,
+    "worker": REPLICA_WORKER,
+    "chief": REPLICA_CHIEF,
+    "master": REPLICA_MASTER,
+    "evaluator": REPLICA_EVALUATOR,
+}
+
+
+@dataclass
+class TFJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "tfReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+@dataclass
+class TFJob(BaseJob):
+    spec: TFJobSpec = field(default_factory=TFJobSpec)
+    kind: str = KIND
+
+
+class TFJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "tensorflow"
+    default_port_name = "tfjob-port"
+    default_port = 2222
+
+    replica_key_map = _CANONICAL
+
+    def job_type(self):
+        return TFJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        return RestartPolicy.EXIT_CODE
+
+    @property
+    def master_types(self) -> List[str]:
+        return [REPLICA_CHIEF, REPLICA_MASTER]
+
+    def use_worker0_completed_heuristic(self) -> bool:
+        return True
+
+    def reconcile_orders(self):
+        return [
+            ReplicaType.PS,
+            ReplicaType.MASTER,
+            ReplicaType.CHIEF,
+            ReplicaType.WORKER,
+            ReplicaType.EVALUATOR,
+        ]
+
+    # -- TF_CONFIG (ref tensorflow.go:40-142) ----------------------------
+
+    def _is_distributed(self, job) -> bool:
+        """Ref tfjob_controller.go:224-245: single-replica jobs skip TF_CONFIG."""
+        specs = job.spec.replica_specs
+        return get_total_replicas(specs) != 1
+
+    def _cluster_spec(self, job) -> Dict[str, List[str]]:
+        cluster: Dict[str, List[str]] = {}
+        for rtype, spec in job.spec.replica_specs.items():
+            if rtype == REPLICA_EVALUATOR:
+                # evaluator is not part of the training cluster
+                continue
+            rt = rtype.lower()
+            port = common.get_port_from_specs(
+                job.spec.replica_specs, rtype, self.default_container_name,
+                self.default_port_name, self.default_port,
+            )
+            cluster[rt] = [
+                f"{common.service_dns(job, rt, i)}:{port}"
+                for i in range(int(spec.replicas or 0))
+            ]
+        return cluster
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        if self._is_distributed(job):
+            tf_config = {
+                "cluster": self._cluster_spec(job),
+                "task": {"type": rtype.lower(), "index": int(index)},
+                "environment": "cloud",
+            }
+            common.add_env(pod_template, {"TF_CONFIG": json.dumps(tf_config)})
+        # TPU-native coordinator wiring: chief/master/worker-0 coordinates
+        # (and is therefore process id 0 — see common.global_rank).
+        coordinator_rt = REPLICA_WORKER
+        for mt in (REPLICA_CHIEF, REPLICA_MASTER):
+            if mt in job.spec.replica_specs:
+                coordinator_rt = mt
+                break
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            coordinator_rt, [str(rt.value) for rt in self.reconcile_orders()],
+        )
+
+
+register_workload("tensorflow", TFJobController)

@@ -1,0 +1,157 @@
+"""XDLJob — XDL (sparse ads) workload controller.
+
+Parity surface (ref api/xdl/v1alpha1 + controllers/xdl):
+  * replica types PS/Worker/Scheduler/ExtendRole (types.go:83-99);
+    container "xdl", port "xdl-port" 2222; default restart Never, backoff
+    limit 20, min-finish 90% (constants.go:24-33, defaults.go:37-52);
+  * spec-level MinFinishWorkerNum / MinFinishWorkerPercentage (wire names
+    minFinishWorkNum / minFinishWorkRate, types.go:38-49) mapped onto the
+    promoted common SuccessPolicy;
+  * SetClusterSpec injects TASK_NAME (=lower rtype) and TASK_INDEX, and
+    suffixes any user-provided ZK_ADDR env with the job UID so each run gets
+    a unique ZooKeeper namespace (xdljob_controller.go:191-218);
+  * reconcile order PS->Scheduler->Worker->ExtendRole (:234-241); no master
+    role; success when succeeded workers reach the min-finish threshold
+    (status.go:123-160).
+
+TPU-native mapping (SURVEY.md §2.4): the PS replica role is kept for API
+compatibility, but sparse-embedding shards belong on SparseCore — pods get
+KUBEDL_SPARSECORE=1 plus the shared coordinator env, and the runtime's
+embedding layer partitions over the mesh instead of parameter servers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubedl_tpu.api.common import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    SuccessPolicy,
+)
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.workloads import common
+
+KIND = "XDLJob"
+API_VERSION = "xdl.kubedl.io/v1alpha1"
+
+REPLICA_PS = str(ReplicaType.PS.value)
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+REPLICA_SCHEDULER = str(ReplicaType.SCHEDULER.value)
+REPLICA_EXTEND_ROLE = str(ReplicaType.EXTEND_ROLE.value)
+
+_CANONICAL = {
+    "ps": REPLICA_PS,
+    "worker": REPLICA_WORKER,
+    "scheduler": REPLICA_SCHEDULER,
+    "extendrole": REPLICA_EXTEND_ROLE,
+}
+
+DEFAULT_MIN_FINISH_RATE = 90  # ref defaults.go:37-52
+DEFAULT_BACKOFF_LIMIT = 20
+
+ENV_ZK_ADDR = "ZK_ADDR"
+
+
+@dataclass
+class XDLJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "xdlReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    # wire names per ref types.go json tags
+    min_finish_worker_num: Optional[int] = field(
+        default=None, metadata={"name": "minFinishWorkNum"}
+    )
+    min_finish_worker_percentage: Optional[int] = field(
+        default=None, metadata={"name": "minFinishWorkRate"}
+    )
+
+
+@dataclass
+class XDLJob(BaseJob):
+    spec: XDLJobSpec = field(default_factory=XDLJobSpec)
+    kind: str = KIND
+
+
+class XDLJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "xdl"
+    default_port_name = "xdl-port"
+    default_port = 2222
+
+    replica_key_map = _CANONICAL
+
+    def job_type(self):
+        return XDLJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def set_defaults(self, job) -> None:
+        super().set_defaults(job)
+        rp = job.spec.run_policy
+        if rp.backoff_limit is None:
+            rp.backoff_limit = DEFAULT_BACKOFF_LIMIT
+        # map spec-level min-finish onto the common success policy
+        if rp.success_policy is None:
+            if (
+                job.spec.min_finish_worker_num is not None
+                or job.spec.min_finish_worker_percentage is not None
+            ):
+                rp.success_policy = SuccessPolicy(
+                    min_finish_worker_num=job.spec.min_finish_worker_num,
+                    min_finish_worker_percentage=job.spec.min_finish_worker_percentage,
+                )
+            else:
+                rp.success_policy = SuccessPolicy(
+                    min_finish_worker_percentage=DEFAULT_MIN_FINISH_RATE
+                )
+
+    def default_restart_policy(self, rtype: str) -> RestartPolicy:
+        return RestartPolicy.NEVER  # ref constants.go:24-33
+
+    @property
+    def master_types(self) -> List[str]:
+        return []  # no master role (ref xdljob_controller.go:245-248)
+
+    def reconcile_orders(self):
+        return [
+            ReplicaType.PS,
+            ReplicaType.SCHEDULER,
+            ReplicaType.WORKER,
+            ReplicaType.EXTEND_ROLE,
+        ]
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        # unique ZooKeeper namespace per run (ref xdljob_controller.go:199-210)
+        for c in pod_template.spec.containers:
+            if ENV_ZK_ADDR in c.env:
+                val = c.env[ENV_ZK_ADDR]
+                sep = "" if val.endswith("/") else "/"
+                c.env[ENV_ZK_ADDR] = f"{val}{sep}{job.metadata.uid}"
+        common.add_env(
+            pod_template,
+            {
+                "TASK_NAME": rtype.lower(),
+                "TASK_INDEX": str(int(index)),
+                # TPU-native: sparse embeddings target SparseCore partitions,
+                # not parameter servers (BASELINE.json config 5)
+                "KUBEDL_SPARSECORE": "1",
+            },
+        )
+        coordinator_rt = (
+            REPLICA_SCHEDULER if REPLICA_SCHEDULER in job.spec.replica_specs else REPLICA_WORKER
+        )
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            coordinator_rt, [str(rt.value) for rt in self.reconcile_orders()],
+        )
+
+
+register_workload("xdl", XDLJobController)

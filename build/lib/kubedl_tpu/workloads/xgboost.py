@@ -1,0 +1,110 @@
+"""XGBoostJob — XGBoost workload controller.
+
+Parity surface (ref api/xgboost/v1alpha1 + controllers/xgboost):
+  * replica types Master/Worker (types.go:78-84); container "xgboostjob",
+    port "xgboostjob-port" 9999; default TTL 100 s, CleanPodPolicy None
+    (constants.go:22-41);
+  * SetPodEnv injects the Rabit-tracker bootstrap MASTER_ADDR (master-0
+    service DNS) / MASTER_PORT / WORLD_SIZE / RANK / PYTHONUNBUFFERED
+    (pod.go:106-152) — kept unchanged: Rabit's allreduce rides the TPU-host
+    CPU network (SURVEY.md §7 step 7);
+  * reconcile order Master->Worker; success when Master completes
+    (job.go:120-147).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from kubedl_tpu.api.common import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+)
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.controllers.base import BaseWorkloadController
+from kubedl_tpu.controllers.registry import register_workload
+from kubedl_tpu.controllers.utils import get_total_replicas
+from kubedl_tpu.workloads import common
+
+KIND = "XGBoostJob"
+API_VERSION = "xgboostjob.kubeflow.org/v1alpha1"
+
+REPLICA_MASTER = str(ReplicaType.MASTER.value)
+REPLICA_WORKER = str(ReplicaType.WORKER.value)
+
+_CANONICAL = {"master": REPLICA_MASTER, "worker": REPLICA_WORKER}
+
+
+@dataclass
+class XGBoostJobSpec:
+    replica_specs: Dict[str, ReplicaSpec] = field(
+        default_factory=dict, metadata={"name": "xgbReplicaSpecs"}
+    )
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+
+
+@dataclass
+class XGBoostJob(BaseJob):
+    spec: XGBoostJobSpec = field(default_factory=XGBoostJobSpec)
+    kind: str = KIND
+
+
+class XGBoostJobController(BaseWorkloadController):
+    kind = KIND
+    api_version = API_VERSION
+    default_container_name = "xgboostjob"
+    default_port_name = "xgboostjob-port"
+    default_port = 9999
+
+    replica_key_map = _CANONICAL
+
+    def job_type(self):
+        return XGBoostJob
+
+    def replica_specs(self, job):
+        return job.spec.replica_specs
+
+    def set_defaults(self, job) -> None:
+        super().set_defaults(job)
+        rp = job.spec.run_policy
+        if rp.ttl_seconds_after_finished is None:
+            rp.ttl_seconds_after_finished = 100  # ref constants.go DefaultTTLseconds
+        if rp.backoff_limit is None:
+            rp.backoff_limit = 3
+
+    def default_clean_pod_policy(self):
+        return CleanPodPolicy.NONE
+
+    @property
+    def master_types(self) -> List[str]:
+        return [REPLICA_MASTER]
+
+    def reconcile_orders(self):
+        return [ReplicaType.MASTER, ReplicaType.WORKER]
+
+    def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:
+        master_addr = common.service_dns(job, REPLICA_MASTER.lower(), 0)
+        master_port = common.get_port_from_specs(
+            job.spec.replica_specs, REPLICA_MASTER, self.default_container_name,
+            self.default_port_name, self.default_port,
+        )
+        common.add_env(
+            pod_template,
+            {
+                "MASTER_PORT": str(master_port),
+                "MASTER_ADDR": master_addr,
+                "WORLD_SIZE": str(get_total_replicas(job.spec.replica_specs)),
+                "RANK": str(int(index)),
+                "PYTHONUNBUFFERED": "0",
+            },
+        )
+        common.inject_coordinator_env(
+            job, pod_template, rtype, index, job.spec.replica_specs,
+            REPLICA_MASTER, [str(rt.value) for rt in self.reconcile_orders()],
+        )
+
+
+register_workload("xgboost", XGBoostJobController)

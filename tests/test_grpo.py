@@ -222,6 +222,35 @@ def test_grpo_cli_reward_plumbing(tmp_path, monkeypatch):
         parse_args(["--reward", "length"])
     with pytest.raises(SystemExit):
         parse_args(["--temperature", "0"])
+    # a single sample per group has advantage 0 by construction; inner
+    # epochs + MultiSteps accumulation would recompute identical grads
+    with pytest.raises(SystemExit):
+        parse_args(["--group-size", "1"])
+    with pytest.raises(SystemExit):
+        parse_args(["--inner-epochs", "2", "--accum-steps", "2"])
+
+
+def test_grpo_kl_zero_drops_reference(model):
+    """kl_coef=0 (pure clipped surrogate): no reference copy in HBM, the
+    ref fn is a zeros placeholder, the reported KL is exactly 0, and the
+    loss equals the pg term alone."""
+    params, config = model
+    mesh = build_mesh({"data": 4, "tensor": 2})
+    init_state, lp_fn, ref_fn, step = make_grpo_step(
+        params, config, optax.adam(1e-3), mesh, kl_coef=0.0,
+        use_old_logprobs=False)
+    tokens, prompt_lens, seq_lens = make_batch(config, seed=7)
+    batch = (tokens, prompt_lens, seq_lens)
+    ref_lp = ref_fn(batch)
+    assert float(jnp.sum(jnp.abs(ref_lp))) == 0.0  # placeholder, no forward
+    state = init_state(jax.tree.map(jnp.copy, params))
+    adv = jnp.asarray(np.random.default_rng(2).normal(
+        size=(tokens.shape[0],)).astype(np.float32))
+    state, metrics = step(state, (*batch, adv, ref_lp))
+    assert float(metrics["kl"]) == 0.0
+    assert float(metrics["loss"]) == pytest.approx(
+        float(metrics["pg_loss"]), rel=1e-6)
+    assert np.isfinite(float(metrics["loss"]))
 
 
 def test_grpo_cli_fresh_init_guard(tmp_path):
