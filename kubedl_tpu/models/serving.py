@@ -54,6 +54,12 @@ class Request:
     max_new_tokens: int
     eos_token: Optional[int] = None
     prefix_id: Optional[int] = None
+    # per-request sampling: temperature None = engine default; 0 = greedy.
+    # top_k 0 = disabled; top_p 1.0 = disabled. Filtering is computed
+    # within the engine's top-`max_top_k` candidates (see _sample).
+    temperature: Optional[float] = None
+    top_k: int = 0
+    top_p: float = 1.0
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
     done: bool = False
@@ -61,6 +67,10 @@ class Request:
 
     submitted_at: float = field(default_factory=time.monotonic)
     finished_at: Optional[float] = None
+
+    @property
+    def needs_filter(self) -> bool:
+        return self.top_k > 0 or self.top_p < 1.0
 
 
 class ServingEngine:
@@ -78,6 +88,7 @@ class ServingEngine:
         max_prefixes: int = 8,
         kv_dtype=None,
         ring: Optional[bool] = None,
+        max_top_k: int = 64,
     ) -> None:
         self.params = params
         self.config = config
@@ -96,6 +107,13 @@ class ServingEngine:
                 f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
                 f"max_len {max_len} — prefill could not fit the scratch cache")
         self.temperature = temperature
+        # per-slot sampling state, device-resident and updated only at
+        # admission — ticks read them as ordinary jit arguments, so
+        # steady-state decode pays no extra host->device transfer
+        self.max_top_k = max_top_k
+        self.samp_temps = jnp.full((slots,), temperature, jnp.float32)
+        self.samp_topk = jnp.zeros((slots,), jnp.int32)
+        self.samp_topp = jnp.ones((slots,), jnp.float32)
         self._key = jax.random.PRNGKey(seed)
         self.kv_dtype = kv_dtype  # None | "int8" (half the cache HBM/read)
         # ring cache (sliding-window models): live K/V buffers hold only
@@ -133,14 +151,19 @@ class ServingEngine:
 
         self._prefill = jax.jit(prefill_fn)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._tick = jax.jit(self._tick_impl, donate_argnums=(1,))
+        # the sampling mode is static: the tick program pays only for
+        # the sampling the active traffic uses (see _sample)
+        self._tick = jax.jit(
+            self._tick_impl, static_argnums=(8,), donate_argnums=(1,))
         # fused multi-tick block (lax.scan): ONE host<->device sync per K
         # tokens instead of per token. Over a remote-tunnel chip the
         # per-tick device_get round trip dominates (~100x the step's
         # compute for a small model); k is static and power-of-2-bounded
         # so at most log2(max) variants compile.
         self._tick_block = jax.jit(
-            self._tick_block_impl, static_argnums=(5,), donate_argnums=(1,))
+            self._tick_block_impl, static_argnums=(5, 9),
+            donate_argnums=(1,))
+        self._sample_jit = jax.jit(self._sample, static_argnums=(5,))
 
         # prefix caching (shared system prompts): prefix K/V computed once
         # into a uniform batch-1 cache; suffixes append via fixed-size
@@ -205,30 +228,80 @@ class ServingEngine:
             active, jnp.ones((1,), jnp.bool_), (slot,))
         return out, cur_tokens, active
 
-    def _tick_impl(self, params, cache, cur_tokens, active, key):
+    def _sample(self, logits, key, temps, top_ks, top_ps, mode):
+        """[slots, V] logits -> [slots] token ids, per-slot params.
+
+        `mode` is STATIC, chosen from what the active requests actually
+        use, so the compiled tick program pays only for the sampling it
+        needs (at most three variants per block size):
+
+        * "greedy" — every active slot has temp 0: pure argmax, no
+          Gumbel work on the hot scan body at all (the default
+          deployment's program, byte-identical math to before).
+        * "plain" — sampling but no top_k/top_p anywhere: one
+          categorical over the full vocab; temp-0 rows take argmax.
+        * "filtered" — someone set top_k/top_p. Built for the MXU-less
+          reality of sampling: ONE O(V) lax.top_k into a fixed
+          [slots, max_top_k] candidate set, then per-slot k-masking and
+          top-p (nucleus) over the already-sorted candidates — an
+          O(max_top_k) cumsum instead of a full-vocab sort per tick.
+          top_p renormalizes within the top-max_top_k candidates; raise
+          max_top_k toward vocab_size if exact full-vocab nucleus
+          sampling matters more than tick latency. Rows that set
+          NEITHER knob still get the full-vocab categorical (selected
+          per row), so a request's distribution never depends on what
+          its co-tenants asked for.
+        """
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if mode == "greedy":
+            return greedy
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        plain = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+        if mode == "plain":
+            return jnp.where(temps > 0, plain, greedy)
+        K = min(self.max_top_k, logits.shape[-1])
+        vals, idx = jax.lax.top_k(scaled, K)  # sorted descending
+        kk = jnp.where(top_ks > 0, jnp.minimum(top_ks, K), K)
+        pos = jnp.arange(K)[None, :]
+        kmask = pos < kk[:, None]
+        probs = jax.nn.softmax(jnp.where(kmask, vals, -jnp.inf), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # nucleus: smallest prefix with mass >= top_p; the first
+        # candidate is always kept (cum - probs == 0 < top_p)
+        keep = (cum - probs) < top_ps[:, None]
+        masked = jnp.where(kmask & keep, vals, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)
+        filtered = jnp.take_along_axis(
+            idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        row_filtered = (top_ks > 0) | (top_ps < 1.0)
+        sampled = jnp.where(row_filtered, filtered, plain)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _tick_impl(self, params, cache, cur_tokens, active, key,
+                   temps, top_ks, top_ps, mode):
         old_lengths = cache["lengths"]
         logits, cache = decode.decode_step(
             params, cur_tokens, cache, self.config)
-        if self.temperature > 0.0:
-            nxt = jax.random.categorical(
-                key, logits / self.temperature, axis=-1).astype(jnp.int32)
-        else:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = self._sample(logits, key, temps, top_ks, top_ps, mode)
         nxt = jnp.where(active, nxt, 0)
         # frozen slots: length must not advance (their stale write at the
         # old position is dead data the next admission overwrites)
         cache["lengths"] = jnp.where(active, cache["lengths"], old_lengths)
         return cache, nxt
 
-    def _tick_block_impl(self, params, cache, cur_tokens, active, key, k):
+    def _tick_block_impl(self, params, cache, cur_tokens, active, key, k,
+                         temps, top_ks, top_ps, mode):
         """k ticks chained on-device; returns the [k, slots] token block.
         Activity can't change mid-block (no admission, no EOS check on the
         device), so tokens past a request's EOS are generated and trimmed
-        host-side — bounded waste the sync savings dwarf."""
+        host-side — bounded waste the sync savings dwarf. Sampling params
+        can't change mid-block either (they only change at admission)."""
 
         def body(carry, subkey):
             cache, cur = carry
-            cache, nxt = self._tick_impl(params, cache, cur, active, subkey)
+            cache, nxt = self._tick_impl(
+                params, cache, cur, active, subkey,
+                temps, top_ks, top_ps, mode)
             return (cache, nxt), nxt
 
         (cache, cur), toks = jax.lax.scan(
@@ -288,8 +361,21 @@ class ServingEngine:
         max_new_tokens: int,
         eos_token: Optional[int] = None,
         prefix_id: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if temperature is not None and temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0 <= top_k <= self.max_top_k:
+            # clamping silently changes the sampling distribution; the
+            # engine's candidate budget is an explicit contract
+            raise ValueError(
+                f"top_k must be in [0, {self.max_top_k}] (engine "
+                f"max_top_k), got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if prompt.size == 0:
             raise ValueError("empty prompt (with a prefix, pass at least "
                              "the first suffix token)")
@@ -308,7 +394,10 @@ class ServingEngine:
                 f"prompt of {prompt.size} tokens exceeds the largest "
                 f"prompt bucket {self.prompt_buckets[-1]}")
         req = Request(self._next_id, prompt, max_new_tokens, eos_token,
-                      prefix_id=prefix_id)
+                      prefix_id=prefix_id,
+                      temperature=(self.temperature if temperature is None
+                                   else float(temperature)),
+                      top_k=int(top_k), top_p=float(top_p))
         self._next_id += 1
         self._queue.append(req)
         return req
@@ -351,16 +440,27 @@ class ServingEngine:
                 logits, row_cache = self._prefill(
                     self.params, jnp.asarray(padded),
                     jnp.asarray([t], jnp.int32))
-            if self.temperature > 0.0:
-                self._key, sub = jax.random.split(self._key)
-                first = jax.random.categorical(
-                    sub, logits[0] / self.temperature).astype(jnp.int32)
+            self._key, sub = jax.random.split(self._key)
+            if req.needs_filter:
+                req_mode = "filtered"
+            elif req.temperature > 0:
+                req_mode = "plain"
             else:
-                first = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+                req_mode = "greedy"
+            first = self._sample_jit(
+                logits, sub, jnp.asarray([req.temperature], jnp.float32),
+                jnp.asarray([req.top_k], jnp.int32),
+                jnp.asarray([req.top_p], jnp.float32),
+                req_mode)[0]
             self.cache, self.cur_tokens, self.active = self._insert(
                 self.cache, row_cache, slot,
                 jnp.asarray([t], jnp.int32), first,
                 self.cur_tokens, self.active)
+            # per-slot sampling state changes only here, so the decode
+            # ticks read device-resident arrays that never retransfer
+            self.samp_temps = self.samp_temps.at[slot].set(req.temperature)
+            self.samp_topk = self.samp_topk.at[slot].set(req.top_k)
+            self.samp_topp = self.samp_topp.at[slot].set(req.top_p)
             self._slot_req[slot] = req
             self._admitted += 1
             req.cache_len = t
@@ -387,6 +487,17 @@ class ServingEngine:
     def has_pending(self) -> bool:
         """True while any request is queued or occupying a slot."""
         return bool(self._queue) or any(r is not None for r in self._slot_req)
+
+    def _sample_mode(self) -> str:
+        """Static tick variant selector from the ACTIVE requests: greedy
+        traffic compiles no sampling work, plain sampling compiles no
+        filtering work (at most three variants per block size)."""
+        reqs = [r for r in self._slot_req if r is not None]
+        if any(r.needs_filter for r in reqs):
+            return "filtered"
+        if any(r.temperature > 0 for r in reqs):
+            return "plain"
+        return "greedy"
 
     def cancel(self, req: Request) -> None:
         """Drop a request: dequeue it if still waiting, or free its slot.
@@ -417,7 +528,9 @@ class ServingEngine:
             return 0
         self._key, sub = jax.random.split(self._key)
         self.cache, nxt = self._tick(
-            self.params, self.cache, self.cur_tokens, self.active, sub)
+            self.params, self.cache, self.cur_tokens, self.active, sub,
+            self.samp_temps, self.samp_topk, self.samp_topp,
+            self._sample_mode())
         self.cur_tokens = nxt
         self._ticks += 1
         emitted = np.asarray(jax.device_get(nxt))
@@ -468,7 +581,9 @@ class ServingEngine:
             return self.step()
         self._key, sub = jax.random.split(self._key)
         self.cache, self.cur_tokens, toks = self._tick_block(
-            self.params, self.cache, self.cur_tokens, self.active, sub, int(k))
+            self.params, self.cache, self.cur_tokens, self.active, sub,
+            int(k), self.samp_temps, self.samp_topk, self.samp_topp,
+            self._sample_mode())
         self._ticks += k
         block = np.asarray(jax.device_get(toks))  # [k, slots]
         for i in range(k):

@@ -102,10 +102,14 @@ class _Service:
                 self.ticks += 1
 
     def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int],
-               prefix_id: Optional[int] = None):
+               prefix_id: Optional[int] = None,
+               temperature: Optional[float] = None,
+               top_k: int = 0, top_p: float = 1.0):
         with self._lock:
             req = self.engine.submit(prompt, max_new_tokens, eos_token,
-                                     prefix_id=prefix_id)
+                                     prefix_id=prefix_id,
+                                     temperature=temperature,
+                                     top_k=top_k, top_p=top_p)
         self._work.set()
         return req
 
@@ -226,11 +230,20 @@ class _Handler(BaseHTTPRequestHandler):
                     eos = tok.eos_token_id
                 else:
                     eos = None
+                temp = e.get("temperature")
+                top_k = e.get("top_k")
+                # explicit None checks: `or` would coerce the INVALID
+                # top_p=0.0 to the default instead of letting the
+                # engine's validation 422 it
+                top_p = e.get("top_p")
                 reqs.append(self.svc.submit(
                     tokens or [],
                     int(e.get("max_new_tokens") or 32),
                     eos,
                     prefix_id=e.get("prefix_id"),
+                    temperature=None if temp is None else float(temp),
+                    top_k=0 if top_k is None else int(top_k),
+                    top_p=1.0 if top_p is None else float(top_p),
                 ))
         except (ValueError, TypeError) as e:
             # partially-submitted batch: release what already went in

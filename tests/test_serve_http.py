@@ -212,3 +212,24 @@ def test_prometheus_metrics_endpoint(server):
     assert float(lines["kubedl_serving_tokens_out"]) >= 2
     assert float(lines["kubedl_serving_slots"]) == 3
     assert "kubedl_serving_slot_utilization" in lines
+
+
+def test_per_request_sampling_over_http(server):
+    """temperature/top_k/top_p ride the wire; top_k=1 with temp>0 is
+    argmax, so it must reproduce the greedy (engine-default) output of
+    the same prompt; invalid params get a 422."""
+    base, config = server
+    prompt = [3, 1, 4, 1, 5]
+    greedy = _post(f"{base}/generate",
+                   {"tokens": prompt, "max_new_tokens": 4})
+    pinned = _post(f"{base}/generate",
+                   {"tokens": prompt, "max_new_tokens": 4,
+                    "temperature": 5.0, "top_k": 1, "top_p": 0.9})
+    assert pinned["tokens"] == greedy["tokens"]
+
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"tokens": prompt, "max_new_tokens": 2, "top_p": 2.0})
+    assert exc.value.code == 422
