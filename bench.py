@@ -263,6 +263,42 @@ def _tpu_child(results_path: str) -> int:
                         "kind_known": known})
     small = bool(os.environ.get("KUBEDL_BENCH_SMALL"))  # CPU smoke shapes
 
+    # -- deadline watchdog: a jax call hung on a wedged tunnel never
+    # returns to the between-milestone budget checks, so without this a
+    # stuck milestone reads as a silent 25-minute hang killed from the
+    # outside with zero evidence of WHERE (the round-3 flash wedge).
+    # The thread names the stuck milestone in the results file, then
+    # self-exits; `current` is the heartbeat the dispatch loop updates.
+    current = ["init"]
+
+    def _mark(name):
+        # heartbeat + artifact record move in lockstep so the watchdog
+        # never blames the wrong milestone
+        current[0] = name
+        _emit(out, "progress", {"milestone": name, "t_left_s": round(left())})
+
+    def _watchdog():
+        # grace must stay comfortably BELOW the parent's KILL_GRACE
+        # (45s) + SIGINT wait: the child's deadline starts after jax
+        # import + dial (tens of seconds on a tunnel), so a grace
+        # above the parent's window would let SIGKILL land before this
+        # record is written — the zero-evidence hang all over again
+        grace = 20.0
+        while True:
+            time.sleep(5)
+            if time.monotonic() > deadline + grace:
+                _emit(out, "watchdog", {
+                    "error": f"milestone {current[0]!r} still running "
+                             f"{grace:.0f}s past the budget — hung jax "
+                             f"call (wedged tunnel?); self-exiting"})
+                try:
+                    out.close()
+                except Exception:  # noqa: BLE001 — exiting anyway
+                    pass
+                os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     # -- 2. flash attention: numeric check + timing on the chip -------------
     def flash_milestone():
         from kubedl_tpu.ops.flash_attention import attention_reference, flash_attention
@@ -573,6 +609,7 @@ def _tpu_child(results_path: str) -> int:
         if left() < min_budget:
             _emit(out, name, {"skipped": f"budget exhausted ({left():.0f}s left)"})
             continue
+        _mark(name)
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - report, keep going
@@ -582,6 +619,7 @@ def _tpu_child(results_path: str) -> int:
     # with whatever budget remains (it needs most of it for first compile).
     try:
         if left() > 120:
+            _mark("llama_150m")
             llama_milestone("tiny" if small else "150m",
                             batch=2 if small else 8, seq=128 if small else 1024,
                             steps=3 if small else 10, key="llama_150m")
@@ -593,6 +631,7 @@ def _tpu_child(results_path: str) -> int:
         if small:
             _emit(out, "llama_1b", {"skipped": "KUBEDL_BENCH_SMALL set"})
         elif left() > 240:
+            _mark("llama_1b")
             llama_milestone("1b", batch=8, seq=1024, steps=10, key="llama_1b")
         else:
             _emit(out, "llama_1b", {"skipped": f"budget exhausted ({left():.0f}s left)",
@@ -603,6 +642,7 @@ def _tpu_child(results_path: str) -> int:
         if small:
             _emit(out, "llama_moe", {"skipped": "KUBEDL_BENCH_SMALL set"})
         elif left() > 180:
+            _mark("llama_moe")
             llama_milestone("moe", batch=8, seq=1024, steps=10, key="llama_moe")
         else:
             _emit(out, "llama_moe", {"skipped": f"budget exhausted ({left():.0f}s left)"})
@@ -664,9 +704,12 @@ def _collect_results(results_path: str):
 
     snapshot = _parse_results(SNAPSHOT_PATH)
     for key, rec in snapshot.items():
-        if key == "done" or live_ok(key):
-            continue
+        if key in ("done", "progress", "watchdog") or live_ok(key):
+            continue  # run-lifecycle records describe THAT run, not this one
         extras[key] = {**rec, "from_snapshot": True}
+    # the LIVE run's "progress" record stays in extras deliberately: its
+    # last-write value names the furthest milestone the child reached,
+    # which is the first diagnostic to read when milestones are missing
     return extras
 
 
@@ -692,7 +735,14 @@ def main() -> int:
 
     # Wait for the TPU child within its budget (+grace), then stop it.
     # SIGINT first: killing an axon client mid-compile can wedge the tunnel.
-    while child.poll() is None and time.monotonic() - t_child0 < TOTAL_TPU_BUDGET + KILL_GRACE:
+    # the child's own deadline clock starts AFTER jax import + tunnel
+    # dial (up to KUBEDL_BENCH_DIAL_BUDGET), and its watchdog self-exits
+    # 20s past that deadline with a record naming the stuck milestone —
+    # so the parent's hard cap must outlast deadline+grace from SPAWN,
+    # or SIGKILL erases the evidence the watchdog exists to write
+    dial_budget = float(os.environ.get("KUBEDL_BENCH_DIAL_BUDGET", "300"))
+    hard_cap = TOTAL_TPU_BUDGET + dial_budget + KILL_GRACE
+    while child.poll() is None and time.monotonic() - t_child0 < hard_cap:
         time.sleep(2)
     timed_out = child.poll() is None
     if timed_out:
