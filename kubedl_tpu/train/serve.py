@@ -119,6 +119,10 @@ class _Service:
         # the engine's own prefix lock guards its registry
         return self.engine.register_prefix(tokens)
 
+    def kick(self) -> None:
+        """Nudge the pump (streaming handlers poll instead of wait())."""
+        self._work.set()
+
     def wait(self, reqs, timeout: float = 300.0) -> bool:
         import time
 
@@ -138,6 +142,40 @@ class _Service:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+class _StreamDecoder:
+    """Incremental detokenization for SSE text deltas.
+
+    Decoding each token prefix from scratch is O(n^2) per stream AND
+    wrong for multi-byte characters (a UTF-8 char split across tokens
+    decodes to U+FFFD until its last byte arrives, and the 'fixed'
+    decode is not a string extension of the broken one). The standard
+    fix: decode over a short sliding window [prefix:read) vs
+    [prefix:], emit the extension only once it no longer ends in a
+    replacement char, and advance the window — O(window) per token,
+    deltas concatenate exactly to the final text (modulo a held-back
+    tail the final event's fresh full decode supplies)."""
+
+    def __init__(self, tok) -> None:
+        self.tok = tok
+        self.toks: list = []
+        self.prefix = 0  # window start
+        self.read = 0    # tokens already reflected in emitted text
+
+    def push(self, token: int) -> str:
+        self.toks.append(token)
+        prev = self.tok.decode(self.toks[self.prefix:self.read],
+                               skip_special_tokens=True)
+        full = self.tok.decode(self.toks[self.prefix:],
+                               skip_special_tokens=True)
+        if full.endswith("�"):
+            return ""  # mid-character: hold until it completes
+        if len(full) > len(prev) and full.startswith(prev):
+            self.prefix = self.read
+            self.read = len(self.toks)
+            return full[len(prev):]
+        return ""
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -187,6 +225,70 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send(404, {"error": f"unknown path {self.path}"})
 
+    def _stream_response(self, req, timeout: float = 300.0) -> None:
+        """Server-sent events: one `data:` line per emitted token as the
+        engine produces it, then a final summary event. Start the server
+        with --decode-block 1 for true per-token latency (larger blocks
+        emit in bursts of up to that many ticks). ANY handler exit
+        before completion — disconnect, socket timeout, deadline —
+        cancels the request so an abandoned stream doesn't keep its
+        slot generating tokens nobody reads."""
+        import time as _time
+
+        tok = self.svc.tokenizer
+        dec = _StreamDecoder(tok) if tok is not None else None
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # no Content-Length: the stream ends at EOF, so this connection
+        # can't be reused — advertise that instead of chunked framing
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        sent = 0
+        deadline = _time.monotonic() + timeout
+        try:
+            while True:
+                done = req.done  # read BEFORE draining: no lost-wakeup
+                toks = list(req.tokens)
+                while sent < len(toks):
+                    event = {"token": toks[sent], "request_id": req.request_id}
+                    if dec is not None:
+                        event["text_delta"] = dec.push(toks[sent])
+                    self.wfile.write(
+                        b"data: " + json.dumps(event).encode() + b"\n\n")
+                    sent += 1
+                self.wfile.flush()
+                if done:
+                    final = {"done": True, "tokens": toks,
+                             "request_id": req.request_id}
+                    if tok is not None:
+                        # fresh full decode: deltas held back for an
+                        # incomplete multi-byte char still land here
+                        final["text"] = tok.decode(
+                            toks, skip_special_tokens=True)
+                    self.wfile.write(
+                        b"data: " + json.dumps(final).encode() + b"\n\n")
+                    self.wfile.flush()
+                    return
+                if _time.monotonic() > deadline:
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"error": "generation timed out",
+                             "request_id": req.request_id}).encode() + b"\n\n")
+                    self.wfile.flush()
+                    return
+                self.svc.kick()
+                _time.sleep(0.005)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # the finally clause frees the slot
+        finally:
+            if not req.done:
+                # every abnormal exit path — disconnect, ETIMEDOUT or
+                # any other OSError from the socket, deadline — must
+                # free the slot for live clients
+                self.svc.cancel([req])
+
     def do_POST(self) -> None:  # noqa: N802
         if self.path not in ("/generate", "/prefix"):
             return self._send(404, {"error": f"unknown path {self.path}"})
@@ -203,10 +305,14 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, TypeError) as e:
                 return self._send(422, {"error": str(e)})
             return self._send(200, {"prefix_id": pid})
+        stream = bool(body.get("stream"))
         entries = body.get("requests")
         single = entries is None
         if single:
             entries = [body]
+        if stream and not single:
+            return self._send(422, {"error": "stream only supports the "
+                                             "single-request form"})
         tok = self.svc.tokenizer
         reqs = []
         try:
@@ -249,6 +355,8 @@ class _Handler(BaseHTTPRequestHandler):
             # partially-submitted batch: release what already went in
             self.svc.cancel(reqs)
             return self._send(422, {"error": str(e)})
+        if stream:
+            return self._stream_response(reqs[0])
         if not self.svc.wait(reqs):
             # client gets a 504 and is gone; orphaned work must not keep
             # occupying slots generating tokens nobody reads

@@ -233,3 +233,58 @@ def test_per_request_sampling_over_http(server):
         _post(f"{base}/generate",
               {"tokens": prompt, "max_new_tokens": 2, "top_p": 2.0})
     assert exc.value.code == 422
+
+
+def test_streaming_sse(server):
+    """stream=true emits one SSE data event per token as generated, then
+    a final summary whose tokens match the non-streaming greedy result;
+    the batch form is rejected."""
+    base, config = server
+    prompt = [2, 7, 1]
+    plain = _post(f"{base}/generate", {"tokens": prompt, "max_new_tokens": 5})
+
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"tokens": prompt, "max_new_tokens": 5,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        for raw in r:
+            raw = raw.strip()
+            if raw.startswith(b"data: "):
+                events.append(json.loads(raw[len(b"data: "):]))
+    assert len(events) == 6  # 5 token events + final
+    assert [e["token"] for e in events[:5]] == plain["tokens"]
+    assert events[-1]["done"] and events[-1]["tokens"] == plain["tokens"]
+
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"stream": True,
+               "requests": [{"tokens": prompt, "max_new_tokens": 2}]})
+    assert exc.value.code == 422
+
+
+def test_stream_decoder_multibyte_and_linear():
+    """A UTF-8 char split across tokens is held back (no U+FFFD ever
+    emitted) and lands whole; deltas concatenate to the full text; the
+    decode window stays O(1) tokens (linear total work)."""
+    from kubedl_tpu.train.serve import _StreamDecoder
+
+    class ByteTok:
+        def __init__(self):
+            self.max_window = 0
+
+        def decode(self, toks, skip_special_tokens=True):
+            self.max_window = max(self.max_window, len(toks))
+            return bytes(toks).decode("utf-8", errors="replace")
+
+    tok = ByteTok()
+    dec = _StreamDecoder(tok)
+    seq = list("ab".encode()) + list("é".encode()) + list("語".encode()) \
+        + list("c".encode()) * 50
+    deltas = [dec.push(t) for t in seq]
+    assert "".join(deltas) == "abé語" + "c" * 50
+    assert all("�" not in d for d in deltas)
+    assert tok.max_window <= 6  # sliding window, not the whole prefix
