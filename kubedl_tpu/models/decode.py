@@ -275,8 +275,8 @@ def decode_step(
         q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
+        q = _rope(q, positions, c.rope_theta, c.rope_scaling)
+        k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         cks = cvs = None
         if int8_kv:
             qk, sk = _quantize_kv(k)
@@ -370,8 +370,8 @@ def decode_block_step(
         q = _mm(h, layer["wq"]).reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
+        q = _rope(q, positions, c.rope_theta, c.rope_scaling)
+        k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         cks = cvs = None
         if int8_kv:
             qk, sk = _quantize_kv(k)
@@ -511,8 +511,8 @@ def prefill(
         q = _mm(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _mm(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = _mm(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        q = _rope(q, positions, c.rope_theta)
-        k = _rope(k, positions, c.rope_theta)
+        q = _rope(q, positions, c.rope_theta, c.rope_scaling)
+        k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         ks.append(k.astype(c.dtype))
         vs.append(v.astype(c.dtype))
         # GQA broadcast happens inside the attention entry points

@@ -25,7 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from kubedl_tpu.models.llama import LlamaConfig
+from kubedl_tpu.models.llama import LlamaConfig, RopeScaling
 
 
 def config_from_hf(hf_config, **overrides) -> LlamaConfig:
@@ -59,14 +59,42 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
             embed_scale=float(hf_config.hidden_size) ** 0.5,
         )
 
-    kw.update(overrides)
-    # refuse configs whose math this stack doesn't implement — importing
-    # them would produce degraded logits with exit 0
+    # rope scaling: llama3 (Llama 3.1+) and linear interpolation map to
+    # the native RopeScaling; others (dynamic/NTK, yarn) are refused —
+    # importing them would produce degraded logits with exit 0
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling and (scaling.get("rope_type") or scaling.get("type")) not in (None, "default"):
-        raise ValueError(
-            f"rope_scaling {scaling!r} not supported (plain RoPE only — "
-            f"Llama 3.1+ 'llama3'/'linear'/'dynamic' scaling isn't implemented)")
+    if scaling:
+        rope_type = scaling.get("rope_type") or scaling.get("type")
+        if rope_type in (None, "default"):
+            pass
+        elif rope_type == "llama3":
+            # all four parameters are required: defaulting a missing
+            # original_max_position_embeddings would rescale at the
+            # wrong wavelength boundaries — degraded logits, exit 0
+            missing = [k for k in ("factor", "low_freq_factor",
+                                   "high_freq_factor",
+                                   "original_max_position_embeddings")
+                       if k not in scaling]
+            if missing:
+                raise ValueError(
+                    f"rope_scaling llama3 is missing {missing} — refusing "
+                    f"to guess frequency boundaries")
+            kw["rope_scaling"] = RopeScaling(
+                kind="llama3",
+                factor=float(scaling["factor"]),
+                low_freq_factor=float(scaling["low_freq_factor"]),
+                high_freq_factor=float(scaling["high_freq_factor"]),
+                original_max_position_embeddings=int(
+                    scaling["original_max_position_embeddings"]),
+            )
+        elif rope_type == "linear":
+            kw["rope_scaling"] = RopeScaling(
+                kind="linear", factor=float(scaling["factor"]))
+        else:
+            raise ValueError(
+                f"rope_scaling {scaling!r} not supported (default, llama3, "
+                f"linear; dynamic/yarn aren't implemented)")
+    kw.update(overrides)
     if getattr(hf_config, "attention_bias", False) or getattr(hf_config, "mlp_bias", False):
         raise ValueError("attention/mlp bias tensors not supported "
                          "(this stack's projections are bias-free)")

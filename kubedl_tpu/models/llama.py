@@ -36,6 +36,20 @@ from kubedl_tpu.parallel.mesh import ShardingRules
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """RoPE frequency rescaling for long-context checkpoints
+    (Llama 3.1's "llama3" scheme or plain "linear" position
+    interpolation) — see _rope_freqs for the math. Frozen so
+    LlamaConfig stays hashable."""
+
+    kind: str  # "llama3" | "linear"
+    factor: float
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     d_model: int = 4096
@@ -45,6 +59,10 @@ class LlamaConfig:
     d_ff: int = 11008
     max_seq_len: int = 4096
     rope_theta: float = 10000.0
+    # None = plain RoPE; RopeScaling for Llama-3.1-style long-context
+    # frequency rescaling (applied identically in training, prefill,
+    # and cached decode — all paths share _rope)
+    rope_scaling: Optional["RopeScaling"] = None
     rms_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -253,11 +271,45 @@ def _act(x, kind: str):
     return jax.nn.silu(x)
 
 
-def _rope(x, positions, theta):
+def _rope_freqs(half: int, theta: float, scaling) -> np.ndarray:
+    """Inverse rotary frequencies, optionally rescaled (trace-time numpy).
+
+    scaling kinds (ref transformers modeling_rope_utils, re-derived):
+      * "linear"  — every frequency divided by `factor` (position
+        interpolation).
+      * "llama3"  — Llama 3.1's frequency-dependent stretch: long
+        wavelengths (past original_max/low_freq_factor) divide by
+        `factor`, short wavelengths (under original_max/
+        high_freq_factor) stay, and the band between interpolates
+        smoothly — long-context positions compress without wrecking
+        the short-range frequencies that encode local order.
+    """
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    if scaling is None:
+        return freqs
+    if scaling.kind == "linear":
+        return (freqs / scaling.factor).astype(np.float32)
+    if scaling.kind != "llama3":
+        raise ValueError(f"unknown rope scaling kind {scaling.kind!r} "
+                         "(linear, llama3)")
+    orig = float(scaling.original_max_position_embeddings)
+    low_wl = orig / scaling.low_freq_factor
+    high_wl = orig / scaling.high_freq_factor
+    wavelen = 2.0 * np.pi / freqs
+    smooth = (orig / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor)
+    scaled = np.where(
+        wavelen > low_wl, freqs / scaling.factor,
+        np.where(wavelen < high_wl, freqs,
+                 (1.0 - smooth) * freqs / scaling.factor + smooth * freqs))
+    return scaled.astype(np.float32)
+
+
+def _rope(x, positions, theta, scaling=None):
     """Rotary embeddings over [b, h, t, d_head]."""
     d = x.shape[-1]
     half = d // 2
-    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    freqs = _rope_freqs(half, theta, scaling)
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]
     cos = jnp.cos(angles)[:, None, :, :]  # [b, 1, t, half]
     sin = jnp.sin(angles)[:, None, :, :]
@@ -274,8 +326,8 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
     q = _mm(h, layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
     k = _mm(h, layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
     v = _mm(h, layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
-    q = _rope(q, positions, config.rope_theta)
-    k = _rope(k, positions, config.rope_theta)
+    q = _rope(q, positions, config.rope_theta, config.rope_scaling)
+    k = _rope(k, positions, config.rope_theta, config.rope_scaling)
     if nq != nkv:
         rep = nq // nkv
         k = jnp.repeat(k, rep, axis=1)

@@ -86,10 +86,10 @@ def test_unsupported_configs_rejected():
         num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
         max_position_embeddings=64, attn_implementation="eager",
     )
+    # llama3/linear scaling is implemented (see the parity tests below);
+    # NTK-style dynamic scaling is not, and must refuse loudly
     scaled = transformers.LlamaConfig(
-        **base, rope_scaling={"rope_type": "llama3", "factor": 8.0,
-                              "low_freq_factor": 1.0, "high_freq_factor": 4.0,
-                              "original_max_position_embeddings": 64})
+        **base, rope_scaling={"rope_type": "yarn", "factor": 8.0})
     with pytest.raises(ValueError, match="rope_scaling"):
         config_from_hf(scaled)
     biased = transformers.LlamaConfig(**base, attention_bias=True)
@@ -242,3 +242,100 @@ def test_gemma_fresh_init_effective_norm_gain_is_one():
     # stored weight 0 -> (w + offset) == 1 at step 0, like HF Gemma
     assert float(jnp.max(jnp.abs(params["layers"][0]["attn_norm"]))) == 0.0
     assert float(jnp.max(jnp.abs(params["final_norm"]))) == 0.0
+
+
+def test_rope_scaling_llama3_logits_parity():
+    """Llama-3.1-style rope scaling: logits must match transformers'
+    reference implementation of the 'llama3' frequency rescale."""
+    hf_config = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    model = transformers.LlamaForCausalLM(hf_config).eval()
+    config = config_from_hf(hf_config, dtype=jnp.float32, use_flash=False)
+    assert config.rope_scaling is not None
+    assert config.rope_scaling.kind == "llama3"
+    params = params_from_state_dict(model.state_dict(), config)
+    rng = np.random.default_rng(5)
+    # positions past original_max/factor boundaries exercise all three
+    # frequency bands
+    tokens = rng.integers(0, config.vocab_size, size=(2, 100))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), config))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+    # cached decode shares the same rope: greedy continuation matches
+    prompt = tokens[:1, :40]
+    with torch.no_grad():
+        hf_gen = model.generate(
+            torch.tensor(prompt), max_new_tokens=5, do_sample=False,
+            pad_token_id=0).numpy()[0, 40:]
+    ours_gen = np.asarray(jax.device_get(decode.generate(
+        params, jnp.asarray(prompt), config, max_new_tokens=5,
+        max_len=45)))[0]
+    np.testing.assert_array_equal(ours_gen, hf_gen)
+
+
+def test_rope_scaling_linear_and_rejections():
+    from kubedl_tpu.models.llama import RopeScaling, _rope_freqs
+
+    base = _rope_freqs(8, 10000.0, None)
+    lin = _rope_freqs(8, 10000.0, RopeScaling(kind="linear", factor=4.0))
+    np.testing.assert_allclose(lin, base / 4.0, rtol=1e-6)
+
+    l3 = _rope_freqs(
+        8, 10000.0, RopeScaling(kind="llama3", factor=8.0,
+                                original_max_position_embeddings=64))
+    # highest frequency (short wavelength) untouched; lowest divided
+    assert l3[0] == pytest.approx(base[0])
+    assert l3[-1] == pytest.approx(base[-1] / 8.0)
+    # monotype guard: unknown kinds refuse loudly
+    with pytest.raises(ValueError, match="unknown rope scaling"):
+        _rope_freqs(8, 10000.0, RopeScaling(kind="yarn", factor=2.0))
+
+    hf_config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=64,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0},
+        attn_implementation="eager",
+    )
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(hf_config)
+
+
+def test_rope_scaling_linear_config_mapping_and_required_keys():
+    """The linear branch maps through config_from_hf; llama3 with
+    missing required keys refuses instead of guessing boundaries."""
+    hf_config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2,
+        max_position_embeddings=64,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        attn_implementation="eager",
+    )
+    config = config_from_hf(hf_config)
+    assert config.rope_scaling is not None
+    assert (config.rope_scaling.kind, config.rope_scaling.factor) == (
+        "linear", 2.0)
+
+    # transformers itself may validate llama3 keys at construction, so
+    # use a duck-typed config (config_from_hf only getattr's) to pin
+    # OUR refusal for hand-edited/partial configs
+    import types
+
+    partial = types.SimpleNamespace(
+        model_type="llama", vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=2, max_position_embeddings=64,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0},
+    )
+    with pytest.raises(ValueError, match="missing"):
+        config_from_hf(partial)
