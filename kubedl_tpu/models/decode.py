@@ -27,6 +27,7 @@ from kubedl_tpu.models.llama import (
     _lm_head,
     _mlp_block,
     _mm,
+    _proj,
     _rope,
     rms_norm,
 )
@@ -272,9 +273,9 @@ def decode_step(
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _mm(h, layer["wq"]).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _mm(h, layer["wk"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _mm(h, layer["wv"]).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q").reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k").reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v").reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         cks = cvs = None
@@ -367,9 +368,9 @@ def decode_block_step(
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _mm(h, layer["wq"]).reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _mm(h, layer["wk"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _mm(h, layer["wv"]).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q").reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k").reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v").reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         cks = cvs = None
@@ -508,9 +509,9 @@ def prefill(
     ks, vs = [], []
     for layer in params["layers"]:
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _mm(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _mm(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _mm(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q").reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         ks.append(k.astype(c.dtype))

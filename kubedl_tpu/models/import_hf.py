@@ -33,9 +33,10 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
     import jax.numpy as jnp
 
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "mistral", "gemma"):
+    if model_type not in ("llama", "mistral", "gemma", "qwen2"):
         raise ValueError(
-            f"unsupported model_type {model_type!r} (llama, mistral, gemma)")
+            f"unsupported model_type {model_type!r} "
+            f"(llama, mistral, gemma, qwen2)")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -58,6 +59,20 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
             norm_offset=1.0,  # HF stores RMSNorm weights as w - 1
             embed_scale=float(hf_config.hidden_size) ** 0.5,
         )
+    if model_type == "qwen2":
+        # Qwen2/2.5: biased q/k/v projections (o_proj and MLP bias-free);
+        # the config always CARRIES a sliding_window value but the model
+        # only applies it when use_sliding_window is set — and then only
+        # to layers above max_window_layers (per-layer windows), which
+        # this stack's single global window cannot express: refuse
+        # rather than window every layer and diverge silently
+        kw["attn_qkv_bias"] = True
+        if getattr(hf_config, "use_sliding_window", False):
+            raise ValueError(
+                "qwen2 use_sliding_window=True applies PER-LAYER windows "
+                "(full attention below max_window_layers) — unimplemented; "
+                "global sliding windows only (Mistral-style)")
+        kw["sliding_window"] = None
 
     # rope scaling: llama3 (Llama 3.1+) and linear interpolation map to
     # the native RopeScaling; others (dynamic/NTK, yarn) are refused —
@@ -129,7 +144,7 @@ def params_from_state_dict(
     layers = []
     for i in range(config.n_layers):
         p = f"model.layers.{i}"
-        layers.append({
+        layer = {
             "attn_norm": jnp.asarray(arr(f"{p}.input_layernorm.weight"),
                                      jnp.float32),
             "wq": cast(arr(f"{p}.self_attn.q_proj.weight", transpose=True)),
@@ -141,7 +156,15 @@ def params_from_state_dict(
             "w1": cast(arr(f"{p}.mlp.gate_proj.weight", transpose=True)),
             "w3": cast(arr(f"{p}.mlp.up_proj.weight", transpose=True)),
             "w2": cast(arr(f"{p}.mlp.down_proj.weight", transpose=True)),
-        })
+        }
+        if config.attn_qkv_bias:  # Qwen2 family
+            layer["bq"] = jnp.asarray(
+                arr(f"{p}.self_attn.q_proj.bias"), jnp.float32)
+            layer["bk"] = jnp.asarray(
+                arr(f"{p}.self_attn.k_proj.bias"), jnp.float32)
+            layer["bv"] = jnp.asarray(
+                arr(f"{p}.self_attn.v_proj.bias"), jnp.float32)
+        layers.append(layer)
     params = {
         "embed": cast(arr("model.embed_tokens.weight")),
         "layers": layers,

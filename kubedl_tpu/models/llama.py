@@ -86,6 +86,9 @@ class LlamaConfig:
     # (i - sliding_window, i]. None = full causal. Applies to prefill,
     # decode, and training; not combined with context parallelism.
     sliding_window: Optional[int] = None
+    # Qwen2-family checkpoints carry biases on the q/k/v projections
+    # (o_proj and the MLP stay bias-free)
+    attn_qkv_bias: bool = False
     tie_embeddings: bool = False
     # >1: compute the training loss over this many vocab chunks instead of
     # materializing [b, t, vocab] f32 logits (a 1 GB HBM round-trip at
@@ -179,6 +182,10 @@ def param_specs(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> D
         "wo": r.spec("heads", "embed"),
         "mlp_norm": r.spec("embed"),
     }
+    if config.attn_qkv_bias:
+        # biases follow their projection's OUTPUT axis sharding
+        layer.update({"bq": r.spec("heads"), "bk": r.spec("heads"),
+                      "bv": r.spec("heads")})
     if config.n_experts > 0:
         layer["moe"] = moe_param_specs(r)
     else:
@@ -220,6 +227,10 @@ def init(config: LlamaConfig, key: jax.Array) -> Dict:
             "wo": dense(ks[3], (nq * hd, d), nq * hd),
             "mlp_norm": norm_init,
         }
+        if config.attn_qkv_bias:
+            layer["bq"] = jnp.zeros((nq * hd,), jnp.float32)
+            layer["bk"] = jnp.zeros((nkv * hd,), jnp.float32)
+            layer["bv"] = jnp.zeros((nkv * hd,), jnp.float32)
         if config.n_experts > 0:
             layer["moe"] = moe_init(ks[4], d, dff, config.n_experts, dtype=dt)
         else:
@@ -319,13 +330,24 @@ def _rope(x, positions, theta, scaling=None):
     ).astype(x.dtype)
 
 
+def _proj(h, layer, name):
+    """Projection through layer['w<name>'], plus the optional QKV bias
+    (Qwen2-family checkpoints: attn_qkv_bias). Biases are stored f32
+    and added in the activation dtype."""
+    out = _mm(h, layer["w" + name])
+    bias = layer.get("b" + name)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
 def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, context_size):
     b, t, d = x.shape
     hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
     h = rms_norm(x, layer["attn_norm"], config.rms_eps, config.norm_offset)
-    q = _mm(h, layer["wq"]).reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
-    k = _mm(h, layer["wk"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
-    v = _mm(h, layer["wv"]).reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    q = _proj(h, layer, "q").reshape(b, t, nq, hd).transpose(0, 2, 1, 3)
+    k = _proj(h, layer, "k").reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
+    v = _proj(h, layer, "v").reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
     q = _rope(q, positions, config.rope_theta, config.rope_scaling)
     k = _rope(k, positions, config.rope_theta, config.rope_scaling)
     if nq != nkv:

@@ -339,3 +339,72 @@ def test_rope_scaling_linear_config_mapping_and_required_keys():
     )
     with pytest.raises(ValueError, match="missing"):
         config_from_hf(partial)
+
+
+# ---------------------------------------------------------------------------
+# Qwen2: Llama layout + biased q/k/v projections
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def qwen2_pair():
+    hf_config = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        use_sliding_window=False, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen2ForCausalLM(hf_config).eval()
+    config = config_from_hf(hf_config, dtype=jnp.float32, use_flash=False)
+    params = params_from_state_dict(model.state_dict(), config)
+    return model, params, config
+
+
+def test_qwen2_config_and_bias_import(qwen2_pair):
+    model, params, config = qwen2_pair
+    assert config.attn_qkv_bias
+    # use_sliding_window=False: the config's carried window must NOT map
+    assert config.sliding_window is None
+    layer = params["layers"][0]
+    assert layer["bq"].shape == (64,) and layer["bk"].shape == (32,)
+    # biases were actually LOADED from the checkpoint, not synthesized
+    hf_bias = model.state_dict()[
+        "model.layers.0.self_attn.q_proj.bias"].numpy()
+    np.testing.assert_allclose(np.asarray(layer["bq"]), hf_bias, rtol=1e-6)
+
+    # per-layer sliding windows (use_sliding_window=True) refuse loudly
+    windowed = transformers.Qwen2Config(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, use_sliding_window=True,
+        sliding_window=16, max_window_layers=1,
+        attn_implementation="eager")
+    with pytest.raises(ValueError, match="PER-LAYER"):
+        config_from_hf(windowed)
+
+
+def test_qwen2_logits_match_transformers(qwen2_pair):
+    model, params, config = qwen2_pair
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, config.vocab_size, size=(2, 14))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), config))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_qwen2_greedy_decode_matches_transformers(qwen2_pair):
+    model, params, config = qwen2_pair
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, config.vocab_size, size=(1, 9))
+    with torch.no_grad():
+        ref = model.generate(
+            torch.tensor(prompt), max_new_tokens=7, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[0, 9:]
+    ours = np.asarray(jax.device_get(decode.generate(
+        params, jnp.asarray(prompt), config, max_new_tokens=7,
+        max_len=16)))[0]
+    np.testing.assert_array_equal(ours, ref)

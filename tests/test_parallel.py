@@ -321,3 +321,30 @@ def test_llama_train_step_with_ulysses_context_parallelism():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 129), 0, cfg.vocab_size)
     state, metrics = train_step(state, tokens)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_llama_qkv_bias_sharded_train_step():
+    """Qwen2-style biased projections: init and param_specs agree on
+    tree structure, and a dp x tp sharded step trains the biases."""
+    mesh = build_mesh({"data": 4, "tensor": 2})
+    rules = ShardingRules()
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False,
+                                 attn_qkv_bias=True)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    spec_tree = llama.param_specs(cfg, rules)
+    jax.tree.map(lambda *_: None, params, spec_tree)  # same structure
+    assert "bq" in params["layers"][0]
+
+    def loss(params, batch):
+        return llama.loss_fn(params, batch, cfg, mesh=mesh, rules=rules)
+
+    init_state, train_step = make_train_step(
+        loss, optax.adamw(1e-2), mesh, spec_tree,
+        rules.spec("batch", None), rules)
+    state = init_state(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg.vocab_size)
+    state, metrics = train_step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    # the bias actually receives gradient (zeros-init but trained)
+    assert float(jnp.sum(jnp.abs(state.params["layers"][0]["bq"]))) > 0.0
