@@ -319,9 +319,40 @@ class _Handler(BaseHTTPRequestHandler):
             for e in entries:
                 if not isinstance(e, dict):
                     raise ValueError("each request must be a JSON object")
+                provided = [k for k in ("tokens", "text", "messages")
+                            if e.get(k) is not None]
+                if len(provided) > 1:
+                    raise ValueError(
+                        "pass exactly one of tokens / text / messages, "
+                        f"got {'+'.join(provided)}")
                 tokens = e.get("tokens")
+                msgs = e.get("messages")
                 is_text = tokens is None and e.get("text") is not None
-                if is_text:
+                if msgs is not None:
+                    # chat form: the tokenizer's own template renders the
+                    # conversation (plus generation prompt) into ids
+                    if tok is None:
+                        raise ValueError(
+                            "messages need a tokenizer — start the "
+                            "server with --hf-model")
+                    if not (isinstance(msgs, list) and msgs and all(
+                            isinstance(m, dict) and "role" in m
+                            and "content" in m for m in msgs)):
+                        raise ValueError(
+                            "messages must be a non-empty list of "
+                            "{role, content} objects")
+                    try:
+                        tokens = tok.apply_chat_template(
+                            msgs, add_generation_prompt=True, tokenize=True)
+                    except Exception as exc:
+                        # jinja TemplateError (e.g. a template's own
+                        # raise_exception on bad role order) is not a
+                        # ValueError — without this rewrap it would skip
+                        # the 422 path AND the partial-batch cancel below
+                        raise ValueError(
+                            f"chat template failed: {exc}") from exc
+                    is_text = True  # natural-stop eos default applies
+                elif is_text:
                     if tok is None:
                         raise ValueError(
                             "text requests need a tokenizer — start the "

@@ -288,3 +288,63 @@ def test_stream_decoder_multibyte_and_linear():
     assert "".join(deltas) == "abé語" + "c" * 50
     assert all("�" not in d for d in deltas)
     assert tok.max_window <= 6  # sliding window, not the whole prefix
+
+
+def test_chat_messages_api(tmp_path):
+    """{"messages": [...]} renders through the tokenizer's chat template
+    into prompt ids; malformed message lists get a 422."""
+    import torch
+    import transformers
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    d = str(tmp_path / "m")
+    hf_config = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, attn_implementation="eager")
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(hf_config).save_pretrained(d)
+    vocab = {"<eos>": 0, "hello": 1, "tpu": 2, "world": 3}
+    vocab.update({f"w{i}": i + 4 for i in range(60)})
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="w0"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, eos_token="<eos>")
+    fast.chat_template = (
+        "{% for m in messages %}{{ m['content'] }} {% endfor %}")
+    fast.save_pretrained(d)
+
+    out, rc = _run_main_and_post(
+        ["--hf-model", d, "--slots", "2", "--max-len", "48",
+         "--max-steps", "2"],
+        18784, {"messages": [{"role": "system", "content": "hello"},
+                             {"role": "user", "content": "tpu world"}],
+                "max_new_tokens": 4})
+    assert out is not None and rc == 0
+    assert len(out["tokens"]) <= 4 and isinstance(out["text"], str)
+
+
+def test_chat_messages_need_tokenizer(server):
+    """messages on a token-only server (no --hf-model) is a 422, as is
+    sending messages alongside tokens."""
+    base, _ = server
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"messages": [{"role": "user", "content": "x"}],
+               "max_new_tokens": 2})
+    assert exc.value.code == 422
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"tokens": [1, 2],
+               "messages": [{"role": "user", "content": "x"}],
+               "max_new_tokens": 2})
+    assert exc.value.code == 422
+
+
+def test_exactly_one_prompt_form(server):
+    """tokens+text together is rejected, not silently resolved."""
+    base, _ = server
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"tokens": [1, 2], "text": "hello", "max_new_tokens": 2})
+    assert exc.value.code == 422
