@@ -60,8 +60,12 @@ class Request:
     temperature: Optional[float] = None
     top_k: int = 0
     top_p: float = 1.0
+    # report per-token logprobs (under the MODEL's distribution —
+    # temperature/filter-independent, OpenAI convention)
+    logprobs: bool = False
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
+    token_logprobs: List[float] = field(default_factory=list)
     done: bool = False
     cache_len: int = 0  # prompt(+prefix) tokens + device ticks consumed
 
@@ -164,6 +168,7 @@ class ServingEngine:
             self._tick_block_impl, static_argnums=(5, 9),
             donate_argnums=(1,))
         self._sample_jit = jax.jit(self._sample, static_argnums=(5,))
+        self._chosen_lp_jit = jax.jit(self._chosen_logprob)
 
         # prefix caching (shared system prompts): prefix K/V computed once
         # into a uniform batch-1 cache; suffixes append via fixed-size
@@ -277,6 +282,15 @@ class ServingEngine:
         sampled = jnp.where(row_filtered, filtered, plain)
         return jnp.where(temps > 0, sampled, greedy)
 
+    def _chosen_logprob(self, logits, chosen):
+        """log p(chosen) under the model's (untempered) distribution —
+        one logsumexp over vocab, noise next to the decode matmuls."""
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        return picked - lse
+
     def _tick_impl(self, params, cache, cur_tokens, active, key,
                    temps, top_ks, top_ps, mode):
         old_lengths = cache["lengths"]
@@ -284,10 +298,11 @@ class ServingEngine:
             params, cur_tokens, cache, self.config)
         nxt = self._sample(logits, key, temps, top_ks, top_ps, mode)
         nxt = jnp.where(active, nxt, 0)
+        lp = self._chosen_logprob(logits, nxt)
         # frozen slots: length must not advance (their stale write at the
         # old position is dead data the next admission overwrites)
         cache["lengths"] = jnp.where(active, cache["lengths"], old_lengths)
-        return cache, nxt
+        return cache, nxt, lp
 
     def _tick_block_impl(self, params, cache, cur_tokens, active, key, k,
                          temps, top_ks, top_ps, mode):
@@ -299,14 +314,14 @@ class ServingEngine:
 
         def body(carry, subkey):
             cache, cur = carry
-            cache, nxt = self._tick_impl(
+            cache, nxt, lp = self._tick_impl(
                 params, cache, cur, active, subkey,
                 temps, top_ks, top_ps, mode)
-            return (cache, nxt), nxt
+            return (cache, nxt), (nxt, lp)
 
-        (cache, cur), toks = jax.lax.scan(
+        (cache, cur), (toks, lps) = jax.lax.scan(
             body, (cache, cur_tokens), jax.random.split(key, k))
-        return cache, cur, toks
+        return cache, cur, toks, lps
 
     # -- public API --------------------------------------------------------
 
@@ -364,6 +379,7 @@ class ServingEngine:
         temperature: Optional[float] = None,
         top_k: int = 0,
         top_p: float = 1.0,
+        logprobs: bool = False,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature is not None and temperature < 0:
@@ -397,7 +413,8 @@ class ServingEngine:
                       prefix_id=prefix_id,
                       temperature=(self.temperature if temperature is None
                                    else float(temperature)),
-                      top_k=int(top_k), top_p=float(top_p))
+                      top_k=int(top_k), top_p=float(top_p),
+                      logprobs=bool(logprobs))
         self._next_id += 1
         self._queue.append(req)
         return req
@@ -452,6 +469,7 @@ class ServingEngine:
                 jnp.asarray([req.top_k], jnp.int32),
                 jnp.asarray([req.top_p], jnp.float32),
                 req_mode)[0]
+            first_lp = self._chosen_lp_jit(logits, first[None])[0]
             self.cache, self.cur_tokens, self.active = self._insert(
                 self.cache, row_cache, slot,
                 jnp.asarray([t], jnp.int32), first,
@@ -464,15 +482,25 @@ class ServingEngine:
             self._slot_req[slot] = req
             self._admitted += 1
             req.cache_len = t
-            wave.append((slot, first))
+            wave.append((slot, first, first_lp))
         if wave:
-            # the prefill-sampled token is each request's first emission
-            firsts = np.asarray(jax.device_get(jnp.stack([f for _, f in wave])))
-            for (slot, _), tok in zip(wave, firsts):
-                self._emit(slot, int(tok))
+            # the prefill-sampled token is each request's first emission;
+            # ONE device_get for the whole wave (tokens + logprobs)
+            firsts, lps = jax.device_get(
+                (jnp.stack([f for _, f, _ in wave]),
+                 jnp.stack([l for _, _, l in wave])))
+            for (slot, _, _), tok, lp in zip(wave, np.asarray(firsts),
+                                             np.asarray(lps)):
+                self._emit(slot, int(tok), float(lp))
 
-    def _emit(self, slot: int, token: int) -> None:
+    def _emit(self, slot: int, token: int, logprob: float = 0.0) -> None:
         req = self._slot_req[slot]
+        # logprob BEFORE token: the SSE handler thread reads both lists
+        # unlocked, gated on the token list's length — appending tokens
+        # first would open a window where a token is visible without its
+        # logprob and the stream drops the field for that index forever
+        if req.logprobs:
+            req.token_logprobs.append(logprob)
         req.tokens.append(token)
         self._tokens_out += 1
         if (
@@ -527,17 +555,17 @@ class ServingEngine:
         if n_active == 0:
             return 0
         self._key, sub = jax.random.split(self._key)
-        self.cache, nxt = self._tick(
+        self.cache, nxt, lp = self._tick(
             self.params, self.cache, self.cur_tokens, self.active, sub,
             self.samp_temps, self.samp_topk, self.samp_topp,
             self._sample_mode())
         self.cur_tokens = nxt
         self._ticks += 1
-        emitted = np.asarray(jax.device_get(nxt))
+        emitted, lps = (np.asarray(a) for a in jax.device_get((nxt, lp)))
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 req.cache_len += 1
-                self._emit(slot, int(emitted[slot]))
+                self._emit(slot, int(emitted[slot]), float(lps[slot]))
         return n_active
 
     def step_block(self, max_block: int = 32) -> int:
@@ -580,17 +608,19 @@ class ServingEngine:
         if k <= 1:
             return self.step()
         self._key, sub = jax.random.split(self._key)
-        self.cache, self.cur_tokens, toks = self._tick_block(
+        self.cache, self.cur_tokens, toks, lps = self._tick_block(
             self.params, self.cache, self.cur_tokens, self.active, sub,
             int(k), self.samp_temps, self.samp_topk, self.samp_topp,
             self._sample_mode())
         self._ticks += k
-        block = np.asarray(jax.device_get(toks))  # [k, slots]
+        block, block_lp = (np.asarray(a)
+                           for a in jax.device_get((toks, lps)))  # [k, slots]
         for i in range(k):
             for slot, req in enumerate(self._slot_req):
                 if req is not None:
                     req.cache_len += 1
-                    self._emit(slot, int(block[i, slot]))
+                    self._emit(slot, int(block[i, slot]),
+                               float(block_lp[i, slot]))
         return len(reqs)
 
     def serve_all(self, prompts, max_new_tokens: int,

@@ -104,12 +104,14 @@ class _Service:
     def submit(self, prompt, max_new_tokens: int, eos_token: Optional[int],
                prefix_id: Optional[int] = None,
                temperature: Optional[float] = None,
-               top_k: int = 0, top_p: float = 1.0):
+               top_k: int = 0, top_p: float = 1.0,
+               logprobs: bool = False):
         with self._lock:
             req = self.engine.submit(prompt, max_new_tokens, eos_token,
                                      prefix_id=prefix_id,
                                      temperature=temperature,
-                                     top_k=top_k, top_p=top_p)
+                                     top_k=top_k, top_p=top_p,
+                                     logprobs=logprobs)
         self._work.set()
         return req
 
@@ -142,6 +144,17 @@ class _Service:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+def _parse_bool(value, field: str) -> bool:
+    """Strict JSON-boolean field: every other sampling param funnels bad
+    input to the 422 path, so `\"logprobs\": 5` (OpenAI's top-N form,
+    unsupported) or \"false\" must not silently coerce to True."""
+    if value is None:
+        return False
+    if isinstance(value, bool):
+        return value
+    raise ValueError(f"{field} must be a JSON boolean, got {value!r}")
 
 
 class _StreamDecoder:
@@ -253,6 +266,8 @@ class _Handler(BaseHTTPRequestHandler):
                 toks = list(req.tokens)
                 while sent < len(toks):
                     event = {"token": toks[sent], "request_id": req.request_id}
+                    if req.logprobs and sent < len(req.token_logprobs):
+                        event["logprob"] = req.token_logprobs[sent]
                     if dec is not None:
                         event["text_delta"] = dec.push(toks[sent])
                     self.wfile.write(
@@ -262,6 +277,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if done:
                     final = {"done": True, "tokens": toks,
                              "request_id": req.request_id}
+                    if req.logprobs:
+                        final["logprobs"] = list(req.token_logprobs)
                     if tok is not None:
                         # fresh full decode: deltas held back for an
                         # incomplete multi-byte char still land here
@@ -305,7 +322,10 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, TypeError) as e:
                 return self._send(422, {"error": str(e)})
             return self._send(200, {"prefix_id": pid})
-        stream = bool(body.get("stream"))
+        try:
+            stream = _parse_bool(body.get("stream"), "stream")
+        except ValueError as e:
+            return self._send(422, {"error": str(e)})
         entries = body.get("requests")
         single = entries is None
         if single:
@@ -381,6 +401,7 @@ class _Handler(BaseHTTPRequestHandler):
                     temperature=None if temp is None else float(temp),
                     top_k=0 if top_k is None else int(top_k),
                     top_p=1.0 if top_p is None else float(top_p),
+                    logprobs=_parse_bool(e.get("logprobs"), "logprobs"),
                 ))
         except (ValueError, TypeError) as e:
             # partially-submitted batch: release what already went in
@@ -396,6 +417,8 @@ class _Handler(BaseHTTPRequestHandler):
         results = []
         for r in reqs:
             entry = {"tokens": r.tokens, "request_id": r.request_id}
+            if r.logprobs:
+                entry["logprobs"] = r.token_logprobs
             if tok is not None:
                 entry["text"] = tok.decode(r.tokens, skip_special_tokens=True)
             results.append(entry)

@@ -348,3 +348,25 @@ def test_exactly_one_prompt_form(server):
         _post(f"{base}/generate",
               {"tokens": [1, 2], "text": "hello", "max_new_tokens": 2})
     assert exc.value.code == 422
+
+
+def test_logprobs_over_http(server):
+    """"logprobs": true returns one logprob per emitted token (finite,
+    <= 0); absent by default."""
+    base, _ = server
+    out = _post(f"{base}/generate",
+                {"tokens": [5, 6, 7], "max_new_tokens": 4,
+                 "logprobs": True})
+    assert len(out["logprobs"]) == 4
+    assert all(isinstance(x, float) and x <= 0.0 for x in out["logprobs"])
+    plain = _post(f"{base}/generate",
+                  {"tokens": [5, 6, 7], "max_new_tokens": 2})
+    assert "logprobs" not in plain
+
+
+def test_logprobs_field_must_be_boolean(server):
+    base, _ = server
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"tokens": [1, 2], "max_new_tokens": 2, "logprobs": 5})
+    assert exc.value.code == 422

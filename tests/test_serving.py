@@ -323,3 +323,32 @@ def test_sampling_param_validation(model):
         eng.submit([1, 2], 4, top_p=1.5)
     with pytest.raises(ValueError, match="temperature"):
         eng.submit([1, 2], 4, temperature=-0.5)
+
+
+def test_logprobs_match_teacher_forced_forward(model):
+    """Reported per-token logprobs must equal log-softmax of a
+    teacher-forced forward over prompt+completion at each position —
+    the engine's incremental KV path reports the model's real
+    distribution, not an approximation."""
+    params, config = model
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, config.vocab_size, size=7).astype(np.int32)
+    eng = ServingEngine(params, config, slots=2, max_len=64)
+    req = eng.submit(prompt, 5, logprobs=True)
+    other = eng.submit(rng.integers(1, config.vocab_size, size=4), 5)
+    while not (req.done and other.done):
+        eng.step_block()
+    assert len(req.token_logprobs) == 5
+    assert not other.token_logprobs  # opt-in only
+
+    from kubedl_tpu.models import llama
+
+    full = np.concatenate([prompt, np.asarray(req.tokens, np.int32)])
+    logits = np.asarray(llama.forward(
+        params, jnp.asarray(full[None, :]), config)).astype(np.float64)
+    logp = logits - np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+        - logits.max(-1, keepdims=True)
+    for i, (t, lp) in enumerate(zip(req.tokens, req.token_logprobs)):
+        pos = len(prompt) - 1 + i  # logits at pos predict token at pos+1
+        assert lp == pytest.approx(float(logp[0, pos, t]), abs=2e-4), i
