@@ -83,6 +83,11 @@ def init_kv_cache(
     if ring:
         if not config.sliding_window:
             raise ValueError("ring=True requires config.sliding_window")
+        if config.layer_windows is not None:
+            # ring buffers are sized by ONE window shared across the
+            # per-layer K/V lists; per-layer windows would need
+            # per-layer buffer shapes and wrap formulas
+            raise ValueError("ring=True is unsupported with layer_windows")
         if max_len < int(config.sliding_window):
             # a buffer below the window would wrap away keys the window
             # mask still expects — silent divergence. A cache this small
@@ -295,7 +300,7 @@ def decode_step(
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
-                              window=c.sliding_window,
+                              window=c.window_for(i),
                               ring_total=(pos + 1) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
@@ -392,7 +397,7 @@ def decode_block_step(
         new_v.append(cv)
         attn = _attend_cached(q, ck, cv, limits, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
-                              window=c.sliding_window,
+                              window=c.window_for(i),
                               ring_total=(pos + T) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
@@ -507,7 +512,7 @@ def prefill(
     if c.embed_scale != 1.0:
         x = x * jnp.asarray(c.embed_scale, c.dtype)
     ks, vs = [], []
-    for layer in params["layers"]:
+    for i, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
         q = _proj(h, layer, "q").reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = _proj(h, layer, "k").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -517,7 +522,7 @@ def prefill(
         ks.append(k.astype(c.dtype))
         vs.append(v.astype(c.dtype))
         # GQA broadcast happens inside the attention entry points
-        attn = _attn(q, k, v, causal=True, window=c.sliding_window)
+        attn = _attn(q, k, v, causal=True, window=c.window_for(i))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
         x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
         x, _ = _mlp_block(x, layer, c)

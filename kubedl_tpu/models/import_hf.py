@@ -63,16 +63,23 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         # Qwen2/2.5: biased q/k/v projections (o_proj and MLP bias-free);
         # the config always CARRIES a sliding_window value but the model
         # only applies it when use_sliding_window is set — and then only
-        # to layers above max_window_layers (per-layer windows), which
-        # this stack's single global window cannot express: refuse
-        # rather than window every layer and diverge silently
+        # to layers at or above max_window_layers, which maps onto
+        # layer_windows (full attention below, windowed above)
         kw["attn_qkv_bias"] = True
-        if getattr(hf_config, "use_sliding_window", False):
-            raise ValueError(
-                "qwen2 use_sliding_window=True applies PER-LAYER windows "
-                "(full attention below max_window_layers) — unimplemented; "
-                "global sliding windows only (Mistral-style)")
         kw["sliding_window"] = None
+        if getattr(hf_config, "use_sliding_window", False):
+            # sliding_window None/0 both mean disabled in HF; and when
+            # max_window_layers covers every layer no layer is actually
+            # windowed — collapse both to plain full attention rather
+            # than shipping an all-None layer_windows tuple that would
+            # spuriously trip uniform-window-only paths (pipelined fwd)
+            w = getattr(hf_config, "sliding_window", None) or None
+            cut = int(getattr(hf_config, "max_window_layers",
+                              hf_config.num_hidden_layers))
+            if w is not None and cut < hf_config.num_hidden_layers:
+                kw["layer_windows"] = tuple(
+                    None if i < cut else int(w)
+                    for i in range(hf_config.num_hidden_layers))
 
     # rope scaling: llama3 (Llama 3.1+) and linear interpolation map to
     # the native RopeScaling; others (dynamic/NTK, yarn) are refused —

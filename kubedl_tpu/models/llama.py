@@ -86,6 +86,13 @@ class LlamaConfig:
     # (i - sliding_window, i]. None = full causal. Applies to prefill,
     # decode, and training; not combined with context parallelism.
     sliding_window: Optional[int] = None
+    # Per-layer windows (Qwen2 use_sliding_window: full attention below
+    # max_window_layers; Gemma-2-style alternating patterns): a tuple of
+    # n_layers entries, each None (full causal) or a window size.
+    # Overrides sliding_window per layer; see window_for(). Unsupported
+    # with ring caches and the pipelined forward (their per-layer
+    # buffers/scan assume one uniform window).
+    layer_windows: Optional[tuple] = None
     # Qwen2-family checkpoints carry biases on the q/k/v projections
     # (o_proj and the MLP stay bias-free)
     attn_qkv_bias: bool = False
@@ -111,6 +118,28 @@ class LlamaConfig:
             # returns uniform garbage with exit 0 — refuse loudly
             raise ValueError(
                 f"sliding_window must be >= 1 or None, got {self.sliding_window}")
+        if self.layer_windows is not None:
+            if len(self.layer_windows) != self.n_layers:
+                raise ValueError(
+                    f"layer_windows has {len(self.layer_windows)} entries "
+                    f"for {self.n_layers} layers")
+            for i, w in enumerate(self.layer_windows):
+                if w is not None and w < 1:
+                    raise ValueError(
+                        f"layer_windows[{i}] must be >= 1 or None, got {w}")
+
+    def window_for(self, i: int) -> Optional[int]:
+        """Layer i's attention window: layer_windows wins, else the
+        global sliding_window, else None (full causal)."""
+        if self.layer_windows is not None:
+            return self.layer_windows[i]
+        return self.sliding_window
+
+    @property
+    def has_windows(self) -> bool:
+        return self.sliding_window is not None or (
+            self.layer_windows is not None
+            and any(w is not None for w in self.layer_windows))
 
     @property
     def head_dim(self) -> int:
@@ -341,7 +370,8 @@ def _proj(h, layer, name):
     return out
 
 
-def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, context_size):
+def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
+                     context_size, window=None):
     b, t, d = x.shape
     hd, nq, nkv = config.head_dim, config.n_heads, config.n_kv_heads
     h = rms_norm(x, layer["attn_norm"], config.rms_eps, config.norm_offset)
@@ -355,7 +385,7 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if context_size > 1:
-        if config.sliding_window is not None:
+        if config.has_windows:
             raise NotImplementedError(
                 "sliding_window + context parallelism is not implemented "
                 "(a windowed ring would skip most hops; use full attention "
@@ -368,13 +398,11 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules, cont
         else:
             attn = ring_attention(q, k, v, mesh=mesh, causal=True)
     elif config.use_flash:
-        attn = flash_attention(q, k, v, causal=True,
-                               window=config.sliding_window)
+        attn = flash_attention(q, k, v, causal=True, window=window)
     else:
         from kubedl_tpu.ops.flash_attention import attention_reference
 
-        attn = attention_reference(q, k, v, causal=True,
-                                   window=config.sliding_window)
+        attn = attention_reference(q, k, v, causal=True, window=window)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
     return x + _mm(attn, layer["wo"]).astype(x.dtype)
 
@@ -426,18 +454,25 @@ def _backbone(
         x = x * jnp.asarray(config.embed_scale, config.dtype)
     x = constrain(x, "batch", "seq", None)
 
-    def layer_fn(carry, layer):
-        x, aux = carry
-        x = _attention_block(x, layer, config, positions, mesh, rules, context_size)
-        x = constrain(x, "batch", "seq", None)
-        x, a = _mlp_block(x, layer, config, mesh, rules)
-        return constrain(x, "batch", "seq", None), aux + a
+    def make_layer_fn(window):
+        # window is trace-time static (it selects the attention mask
+        # program), so it rides a closure, not a traced argument
+        def layer_fn(carry, layer):
+            x, aux = carry
+            x = _attention_block(x, layer, config, positions, mesh, rules,
+                                 context_size, window=window)
+            x = constrain(x, "batch", "seq", None)
+            x, a = _mlp_block(x, layer, config, mesh, rules)
+            return constrain(x, "batch", "seq", None), aux + a
 
-    if config.remat:
-        layer_fn = jax.checkpoint(layer_fn, policy=_remat_policy(config.remat_policy))
+        if config.remat:
+            return jax.checkpoint(
+                layer_fn, policy=_remat_policy(config.remat_policy))
+        return layer_fn
+
     aux = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
-        x, aux = layer_fn((x, aux), layer)
+    for i, layer in enumerate(params["layers"]):
+        x, aux = make_layer_fn(config.window_for(i))((x, aux), layer)
     return x, aux
 
 
@@ -602,6 +637,11 @@ def forward_pipelined(
     (those shardings need manual collectives inside shard_map)."""
     if config.n_experts > 0:
         raise ValueError("pipelined path requires dense FFN (n_experts=0)")
+    if config.layer_windows is not None:
+        # the pipeline scans ONE compiled layer program over stacked
+        # params; a per-layer static mask can't vary inside the scan
+        raise ValueError("pipelined path requires a uniform window "
+                         "(layer_windows unsupported)")
     for ax in ("tensor", "context", "expert"):
         if mesh.shape.get(ax, 1) != 1:
             raise ValueError(f"pipelined mesh must have {ax}=1, got {mesh.shape[ax]}")
@@ -613,7 +653,8 @@ def forward_pipelined(
 
     def layer_fn(a, layer):
         pos = jnp.broadcast_to(positions1, (a.shape[0], t))
-        a = _attention_block(a, layer, config, pos, None, rules, 1)
+        a = _attention_block(a, layer, config, pos, None, rules, 1,
+                             window=config.sliding_window)
         a, _ = _mlp_block(a, layer, config)
         return a
 
