@@ -143,7 +143,7 @@ def _quantize_kv(x):
 
 
 def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
-                   window=None, ring_total=None):
+                   window=None, ring_total=None, softcap=None):
     """q [b,hq,tq,d] vs cache [b,hkv,L,d]; query t in row i attends cache
     positions < its limit. `limits` is [b] (per-row limit, tq == 1) or
     [b, tq] (per-row per-query — the block verify path, where query t
@@ -208,6 +208,8 @@ def _attend_cached(q, ck, cv, limits, n_rep, k_scale=None, v_scale=None,
     if k_scale is not None:
         s = s * k_scale[:, :, None, None, :]
     s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap  # Gemma-2 attn softcapping
     attend = k_pos[:, None, None, None, :] < lim[:, None, None, :, None]
     if window is not None:
         # sliding window: the query at position lim-1 sees keys in
@@ -283,6 +285,8 @@ def decode_step(
         v = _proj(h, layer, "v").reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
+        if c.q_prescale != 1.0:
+            q = q * jnp.asarray(c.q_prescale, q.dtype)
         cks = cvs = None
         if int8_kv:
             qk, sk = _quantize_kv(k)
@@ -301,9 +305,14 @@ def decode_step(
         attn = _attend_cached(q, ck, cv, pos + 1, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
                               window=c.window_for(i),
+                              softcap=c.attn_logit_softcap or None,
                               ring_total=(pos + 1) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
-        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        if "post_attn_norm" in layer:
+            attn_out = rms_norm(attn_out, layer["post_attn_norm"],
+                                c.rms_eps, c.norm_offset)
+        x = x + attn_out
         x, _ = _mlp_block(x, layer, c)
 
     out_cache = {
@@ -378,6 +387,8 @@ def decode_block_step(
         v = _proj(h, layer, "v").reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
+        if c.q_prescale != 1.0:
+            q = q * jnp.asarray(c.q_prescale, q.dtype)
         cks = cvs = None
         if int8_kv:
             qk, sk = _quantize_kv(k)
@@ -398,9 +409,14 @@ def decode_block_step(
         attn = _attend_cached(q, ck, cv, limits, c.n_heads // c.n_kv_heads,
                               k_scale=cks, v_scale=cvs,
                               window=c.window_for(i),
+                              softcap=c.attn_logit_softcap or None,
                               ring_total=(pos + T) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
-        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        if "post_attn_norm" in layer:
+            attn_out = rms_norm(attn_out, layer["post_attn_norm"],
+                                c.rms_eps, c.norm_offset)
+        x = x + attn_out
         x, _ = _mlp_block(x, layer, c)
 
     out_cache = {"k": new_k, "v": new_v, "lengths": pos + T}
@@ -503,10 +519,17 @@ def prefill(
         lengths = jnp.full((b,), t, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
 
-    if c.use_flash:
+    if c.use_flash and not c.attn_logit_softcap:
         from kubedl_tpu.ops.flash_attention import flash_attention as _attn
     else:
-        from kubedl_tpu.ops.flash_attention import attention_reference as _attn
+        # softcapped configs (Gemma-2) take the XLA path — the Pallas
+        # kernel's online softmax doesn't model the tanh transform
+        import functools
+
+        from kubedl_tpu.ops.flash_attention import attention_reference
+
+        _attn = functools.partial(
+            attention_reference, softcap=c.attn_logit_softcap or None)
 
     x = params["embed"][tokens].astype(c.dtype)
     if c.embed_scale != 1.0:
@@ -519,12 +542,18 @@ def prefill(
         v = _proj(h, layer, "v").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
+        if c.q_prescale != 1.0:
+            q = q * jnp.asarray(c.q_prescale, q.dtype)
         ks.append(k.astype(c.dtype))
         vs.append(v.astype(c.dtype))
         # GQA broadcast happens inside the attention entry points
         attn = _attn(q, k, v, causal=True, window=c.window_for(i))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
-        x = x + _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        if "post_attn_norm" in layer:
+            attn_out = rms_norm(attn_out, layer["post_attn_norm"],
+                                c.rms_eps, c.norm_offset)
+        x = x + attn_out
         x, _ = _mlp_block(x, layer, c)
 
     int8_kv = "ks" in cache

@@ -33,10 +33,10 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
     import jax.numpy as jnp
 
     model_type = getattr(hf_config, "model_type", "llama")
-    if model_type not in ("llama", "mistral", "gemma", "qwen2"):
+    if model_type not in ("llama", "mistral", "gemma", "gemma2", "qwen2"):
         raise ValueError(
             f"unsupported model_type {model_type!r} "
-            f"(llama, mistral, gemma, qwen2)")
+            f"(llama, mistral, gemma, gemma2, qwen2)")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         d_model=hf_config.hidden_size,
@@ -53,12 +53,38 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
         sliding_window=(getattr(hf_config, "sliding_window", None) or None),
         dtype=jnp.bfloat16,
     )
-    if model_type == "gemma":
+    if model_type in ("gemma", "gemma2"):
         kw.update(
             act="gelu_tanh",
             norm_offset=1.0,  # HF stores RMSNorm weights as w - 1
             embed_scale=float(hf_config.hidden_size) ** 0.5,
         )
+    if model_type == "gemma2":
+        # Gemma-2: sandwich norms, attn/final logit softcapping, scores
+        # scaled by query_pre_attn_scalar**-0.5, head_dim decoupled from
+        # d_model/n_heads, and alternating local/global attention
+        kw.update(
+            post_block_norms=True,
+            attn_logit_softcap=float(
+                getattr(hf_config, "attn_logit_softcapping", 0.0) or 0.0),
+            final_logit_softcap=float(
+                getattr(hf_config, "final_logit_softcapping", 0.0) or 0.0),
+            query_pre_attn_scalar=float(hf_config.query_pre_attn_scalar),
+            head_dim_override=int(hf_config.head_dim),
+        )
+        kw["sliding_window"] = None
+        w = getattr(hf_config, "sliding_window", None) or None
+        if w is not None:
+            layer_types = getattr(hf_config, "layer_types", None)
+            if layer_types is not None:
+                wins = tuple(int(w) if lt == "sliding_attention" else None
+                             for lt in layer_types)
+            else:
+                # older transformers: sliding on even layers
+                wins = tuple(int(w) if i % 2 == 0 else None
+                             for i in range(hf_config.num_hidden_layers))
+            if any(x is not None for x in wins):
+                kw["layer_windows"] = wins
     if model_type == "qwen2":
         # Qwen2/2.5: biased q/k/v projections (o_proj and MLP bias-free);
         # the config always CARRIES a sliding_window value but the model
@@ -158,8 +184,10 @@ def params_from_state_dict(
             "wk": cast(arr(f"{p}.self_attn.k_proj.weight", transpose=True)),
             "wv": cast(arr(f"{p}.self_attn.v_proj.weight", transpose=True)),
             "wo": cast(arr(f"{p}.self_attn.o_proj.weight", transpose=True)),
-            "mlp_norm": jnp.asarray(arr(f"{p}.post_attention_layernorm.weight"),
-                                    jnp.float32),
+            # Gemma-2 reuses this HF name for its attention OUTPUT norm;
+            # its pre-MLP norm loads below from pre_feedforward_layernorm
+            "mlp_norm": (None if config.post_block_norms else jnp.asarray(
+                arr(f"{p}.post_attention_layernorm.weight"), jnp.float32)),
             "w1": cast(arr(f"{p}.mlp.gate_proj.weight", transpose=True)),
             "w3": cast(arr(f"{p}.mlp.up_proj.weight", transpose=True)),
             "w2": cast(arr(f"{p}.mlp.down_proj.weight", transpose=True)),
@@ -171,6 +199,16 @@ def params_from_state_dict(
                 arr(f"{p}.self_attn.k_proj.bias"), jnp.float32)
             layer["bv"] = jnp.asarray(
                 arr(f"{p}.self_attn.v_proj.bias"), jnp.float32)
+        if config.post_block_norms:  # Gemma-2 sandwich norms: HF's
+            # "post_attention_layernorm" is the attention OUTPUT norm
+            # here (not the pre-MLP norm, which is
+            # "pre_feedforward_layernorm")
+            layer["mlp_norm"] = jnp.asarray(
+                arr(f"{p}.pre_feedforward_layernorm.weight"), jnp.float32)
+            layer["post_attn_norm"] = jnp.asarray(
+                arr(f"{p}.post_attention_layernorm.weight"), jnp.float32)
+            layer["post_mlp_norm"] = jnp.asarray(
+                arr(f"{p}.post_feedforward_layernorm.weight"), jnp.float32)
         layers.append(layer)
     params = {
         "embed": cast(arr("model.embed_tokens.weight")),

@@ -82,6 +82,21 @@ class LlamaConfig:
     act: str = "silu"  # "silu" | "gelu_tanh"
     norm_offset: float = 0.0  # rms_norm multiplies by (weight + offset)
     embed_scale: float = 1.0
+    # Gemma-2 family knobs:
+    # head_dim decoupled from d_model/n_heads (None = derived)
+    head_dim_override: Optional[int] = None
+    # sandwich norms: extra RMSNorm on the attention and FFN OUTPUTS
+    # before their residual adds (post_attn_norm / post_mlp_norm params)
+    post_block_norms: bool = False
+    # logit softcapping: x -> cap * tanh(x / cap); 0 = off. The
+    # attention cap forces the XLA attention path (the Pallas flash
+    # kernel's online-softmax VJP doesn't model the tanh transform).
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    # attention scores scale by query_pre_attn_scalar**-0.5 instead of
+    # head_dim**-0.5 (None = standard); applied by pre-scaling q so the
+    # attention kernels keep their 1/sqrt(head_dim) convention
+    query_pre_attn_scalar: Optional[float] = None
     # Mistral-style sliding-window attention: query i attends keys in
     # (i - sliding_window, i]. None = full causal. Applies to prefill,
     # decode, and training; not combined with context parallelism.
@@ -143,7 +158,15 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
+
+    @property
+    def q_prescale(self) -> float:
+        """Multiplier applied to q after RoPE so the kernels' built-in
+        1/sqrt(head_dim) nets out to 1/sqrt(query_pre_attn_scalar)."""
+        if self.query_pre_attn_scalar is None:
+            return 1.0
+        return (self.head_dim / self.query_pre_attn_scalar) ** 0.5
 
     @staticmethod
     def llama_7b() -> "LlamaConfig":
@@ -215,6 +238,9 @@ def param_specs(config: LlamaConfig, rules: Optional[ShardingRules] = None) -> D
         # biases follow their projection's OUTPUT axis sharding
         layer.update({"bq": r.spec("heads"), "bk": r.spec("heads"),
                       "bv": r.spec("heads")})
+    if config.post_block_norms:
+        layer.update({"post_attn_norm": r.spec("embed"),
+                      "post_mlp_norm": r.spec("embed")})
     if config.n_experts > 0:
         layer["moe"] = moe_param_specs(r)
     else:
@@ -260,6 +286,9 @@ def init(config: LlamaConfig, key: jax.Array) -> Dict:
             layer["bq"] = jnp.zeros((nq * hd,), jnp.float32)
             layer["bk"] = jnp.zeros((nkv * hd,), jnp.float32)
             layer["bv"] = jnp.zeros((nkv * hd,), jnp.float32)
+        if config.post_block_norms:
+            layer["post_attn_norm"] = norm_init
+            layer["post_mlp_norm"] = norm_init
         if config.n_experts > 0:
             layer["moe"] = moe_init(ks[4], d, dff, config.n_experts, dtype=dt)
         else:
@@ -301,6 +330,12 @@ def rms_norm(x, weight, eps, offset: float = 0.0):
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     w = weight + offset if offset else weight
     return (xf * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
+def softcap(x, cap: float):
+    """Gemma-2 logit softcapping: cap * tanh(x / cap) — a smooth clamp
+    keeping scores/logits in (-cap, cap)."""
+    return jnp.tanh(x / cap) * cap
 
 
 def _act(x, kind: str):
@@ -380,6 +415,8 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
     v = _proj(h, layer, "v").reshape(b, t, nkv, hd).transpose(0, 2, 1, 3)
     q = _rope(q, positions, config.rope_theta, config.rope_scaling)
     k = _rope(k, positions, config.rope_theta, config.rope_scaling)
+    if config.q_prescale != 1.0:
+        q = q * jnp.asarray(config.q_prescale, q.dtype)
     if nq != nkv:
         rep = nq // nkv
         k = jnp.repeat(k, rep, axis=1)
@@ -390,6 +427,11 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
                 "sliding_window + context parallelism is not implemented "
                 "(a windowed ring would skip most hops; use full attention "
                 "on the context mesh or a single-shard windowed model)")
+        if config.attn_logit_softcap:
+            raise NotImplementedError(
+                "attn_logit_softcap + context parallelism is not "
+                "implemented (the ring/all-to-all paths run uncapped "
+                "online softmax)")
         if config.context_parallel == "ulysses":
             from kubedl_tpu.ops.ulysses import ulysses_attention
 
@@ -397,14 +439,21 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
                 q, k, v, mesh=mesh, causal=True, use_flash=config.use_flash)
         else:
             attn = ring_attention(q, k, v, mesh=mesh, causal=True)
-    elif config.use_flash:
+    elif config.use_flash and not config.attn_logit_softcap:
         attn = flash_attention(q, k, v, causal=True, window=window)
     else:
+        # softcapped configs (Gemma-2) take the XLA path: the Pallas
+        # kernel's online-softmax VJP doesn't model the tanh transform
         from kubedl_tpu.ops.flash_attention import attention_reference
 
-        attn = attention_reference(q, k, v, causal=True, window=window)
+        attn = attention_reference(q, k, v, causal=True, window=window,
+                                   softcap=config.attn_logit_softcap or None)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, t, nq * hd)
-    return x + _mm(attn, layer["wo"]).astype(x.dtype)
+    out = _mm(attn, layer["wo"]).astype(x.dtype)
+    if "post_attn_norm" in layer:
+        out = rms_norm(out, layer["post_attn_norm"], config.rms_eps,
+                       config.norm_offset)
+    return x + out
 
 
 def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
@@ -415,10 +464,17 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
             h, layer["moe"], top_k=config.expert_top_k,
             capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
         )
-        return x + y.astype(x.dtype), aux
-    gate = _act(_mm(h, layer["w1"]).astype(jnp.float32), config.act).astype(h.dtype)
-    up = _mm(h, layer["w3"])
-    return x + (_mm(gate * up, layer["w2"])).astype(x.dtype), jnp.zeros((), jnp.float32)
+        y = y.astype(x.dtype)
+    else:
+        gate = _act(_mm(h, layer["w1"]).astype(jnp.float32),
+                    config.act).astype(h.dtype)
+        up = _mm(h, layer["w3"])
+        y = _mm(gate * up, layer["w2"]).astype(x.dtype)
+        aux = jnp.zeros((), jnp.float32)
+    if "post_mlp_norm" in layer:
+        y = rms_norm(y, layer["post_mlp_norm"], config.rms_eps,
+                     config.norm_offset)
+    return x + y, aux
 
 
 def _constrainer(mesh, rules):
@@ -507,7 +563,10 @@ def _head_matrix(params, config: LlamaConfig):
 def _lm_head(x, params, config: LlamaConfig) -> jax.Array:
     """Final norm + (tied or separate) LM head -> f32 logits."""
     x = rms_norm(x, params["final_norm"], config.rms_eps, config.norm_offset)
-    return _mm(x, _head_matrix(params, config)).astype(jnp.float32)
+    logits = _mm(x, _head_matrix(params, config)).astype(jnp.float32)
+    if config.final_logit_softcap:
+        logits = softcap(logits, config.final_logit_softcap)
+    return logits
 
 
 def _next_token_ce(logits, targets):
@@ -536,6 +595,10 @@ def _next_token_ce_chunked(x, params, config: LlamaConfig, targets, n_chunks: in
     @jax.checkpoint
     def chunk_stats(h_c, off):
         logits = (xn @ h_c).astype(jnp.float32)  # [b, t, cs]
+        if config.final_logit_softcap:
+            # softcap is elementwise, so capping per chunk == capping the
+            # full logits — the chunked loss must match _lm_head's math
+            logits = softcap(logits, config.final_logit_softcap)
         m = jnp.max(logits, axis=-1)
         l = jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
         in_chunk = (targets >= off) & (targets < off + cs)

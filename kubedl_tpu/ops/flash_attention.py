@@ -601,9 +601,11 @@ def flash_attention(
 
 def attention_reference(q, k, v, *, causal: bool = True,
                         sm_scale: Optional[float] = None,
-                        window: Optional[int] = None):
-    """Plain-XLA attention for correctness tests (same GQA semantics,
-    incl. the sliding window)."""
+                        window: Optional[int] = None,
+                        softcap: Optional[float] = None):
+    """Plain-XLA attention for correctness tests and softcapped configs
+    (same GQA semantics, incl. the sliding window; softcap applies
+    Gemma-2's cap*tanh(s/cap) to the scaled scores before masking)."""
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     b, hq, sq, d = q.shape
@@ -614,6 +616,8 @@ def attention_reference(q, k, v, *, causal: bool = True,
     if sm_scale is None:
         sm_scale = 1.0 / (d**0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
+    if softcap is not None:
+        s = jnp.tanh(s / softcap) * softcap
     if causal:
         mask = np.tril(np.ones((sq, sq), bool))
         if window is not None:

@@ -63,6 +63,11 @@ def _target_logprobs_chunked(x, params, config, targets):
             "ntd,dv->ntv", x, head[:, lo:hi].astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
+        if config.final_logit_softcap:
+            # elementwise cap per chunk == capping the full logits; the
+            # policy/reference logprobs must match the distribution the
+            # decode stack (capped _lm_head) actually samples from
+            logits = llama.softcap(logits, config.final_logit_softcap)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[..., None]), axis=-1)

@@ -478,3 +478,71 @@ def test_qwen2_disabled_window_spellings_collapse_to_full():
     ):
         cfg = config_from_hf(types.SimpleNamespace(**base, **extra))
         assert cfg.layer_windows is None and cfg.sliding_window is None, extra
+
+
+# ---------------------------------------------------------------------------
+# Gemma-2: sandwich norms, logit softcapping, query_pre_attn_scalar,
+# decoupled head_dim, alternating local/global attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gemma2_pair():
+    hf_config = transformers.Gemma2Config(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24,  # deliberately != hidden/heads = 16
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        query_pre_attn_scalar=32.0, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, sliding_window=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = transformers.Gemma2ForCausalLM(hf_config).eval()
+    config = config_from_hf(hf_config, dtype=jnp.float32)
+    params = params_from_state_dict(model.state_dict(), config)
+    return model, params, config
+
+
+def test_gemma2_config_mapping(gemma2_pair):
+    _, params, config = gemma2_pair
+    assert config.post_block_norms and config.head_dim == 24
+    assert config.attn_logit_softcap == 50.0
+    assert config.final_logit_softcap == 30.0
+    assert config.query_pre_attn_scalar == 32.0
+    assert config.q_prescale == pytest.approx((24 / 32.0) ** 0.5)
+    # alternating local/global windows came from layer_types
+    assert config.layer_windows is not None
+    assert any(w is not None for w in config.layer_windows)
+    assert any(w is None for w in config.layer_windows)
+    layer = params["layers"][0]
+    assert "post_attn_norm" in layer and "post_mlp_norm" in layer
+
+
+def test_gemma2_logits_match_transformers(gemma2_pair):
+    model, params, config = gemma2_pair
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, config.vocab_size, size=(2, 24))  # 24 >> 8
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), config))
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_gemma2_greedy_decode_matches_teacher_forced(gemma2_pair):
+    """Cached decode shares the softcap/prescale/sandwich-norm math:
+    greedy continuation equals argmax over the full forward each step
+    (the model's definition; see the Qwen2 note on HF generate)."""
+    model, params, config = gemma2_pair
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, config.vocab_size, size=(1, 14))
+    toks = prompt.copy()
+    with torch.no_grad():
+        for _ in range(6):
+            step_logits = model(torch.tensor(toks)).logits.numpy()
+            toks = np.concatenate(
+                [toks, [[int(np.argmax(step_logits[0, -1]))]]], axis=1)
+    ours = np.asarray(jax.device_get(decode.generate(
+        params, jnp.asarray(prompt), config, max_new_tokens=6,
+        max_len=20)))[0]
+    np.testing.assert_array_equal(ours, toks[0, 14:])

@@ -210,3 +210,33 @@ def test_dpo_cli_resume_and_guards(tmp_path, monkeypatch):
               "--checkpoint-path", ckpt, "--checkpoint-interval", "2"]
     assert dpo.main(common + ["--steps", "2"]) == 0
     assert dpo.main(common + ["--steps", "4"]) == 0  # resumes at step 2
+
+
+def test_chunked_logprobs_softcap_parity():
+    """final_logit_softcap (Gemma-2) must flow through the chunked
+    logprob path: chunked == full log-softmax on a capped config, and
+    both differ from the uncapped math."""
+    import dataclasses
+
+    config = llama.LlamaConfig.tiny(
+        dtype=jnp.float32, use_flash=False, final_logit_softcap=5.0)
+    params = llama.init(config, jax.random.PRNGKey(2))
+    tokens, prompt_lens, seq_lens = make_batch(config, seed=6)
+    flat, pl, sl = tokens[:, 0], prompt_lens, seq_lens[:, 0]
+    full = sequence_logprobs(params, flat, pl, sl, config)
+    chunked_cfg = dataclasses.replace(config, ce_chunks=4)
+    chunked = sequence_logprobs(params, flat, pl, sl, chunked_cfg)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(full),
+                               rtol=2e-5, atol=2e-5)
+    uncapped = sequence_logprobs(
+        params, flat, pl, sl,
+        dataclasses.replace(config, final_logit_softcap=0.0))
+    assert np.abs(np.asarray(uncapped) - np.asarray(full)).max() > 1e-3
+
+    # the chunked TRAINING loss sees the cap too
+    batch = jnp.asarray(tokens[:, 0])
+    full_loss = llama.loss_fn(params, batch, config)
+    chunk_loss = llama.loss_fn(params, batch, chunked_cfg)
+    f = full_loss[0] if isinstance(full_loss, tuple) else full_loss
+    c = chunk_loss[0] if isinstance(chunk_loss, tuple) else chunk_loss
+    np.testing.assert_allclose(float(c), float(f), rtol=2e-5)
