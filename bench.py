@@ -484,7 +484,9 @@ def _tpu_child(results_path: str) -> int:
     # -- 4e. continuous-batching serving: mixed prompt lengths streaming
     # through a fixed slot pool (models/serving.py) — the sustained-load
     # number a serving deployment actually sees -------------------------
-    def serving_milestone():
+    def _serving_setup():
+        """Shared engine + mixed-length traffic so the greedy baseline
+        ("serving") and the sampled variant stay comparable."""
         from kubedl_tpu.models import llama
         from kubedl_tpu.models.serving import ServingEngine
 
@@ -498,6 +500,10 @@ def _tpu_child(results_path: str) -> int:
         lens = [5, 9] if small else [33, 150, 80, 250, 61, 190, 40, 120]
         prompts = [rng.integers(1, config.vocab_size, size=n).astype(np.int32)
                    for n in lens for _ in range(2)]
+        return eng, prompts, slots, new
+
+    def serving_milestone():
+        eng, prompts, slots, new = _serving_setup()
         # warm up with the SAME traffic shape so the timed run pays zero
         # compilation: every prefill bucket AND every fused tick-block
         # size the admission pattern produces (serving.py step_block)
@@ -510,6 +516,88 @@ def _tpu_child(results_path: str) -> int:
             "serving_tokens_per_sec": round(n_tok / dt, 0),
             "requests": len(prompts), "slots": slots,
             "new_tokens_per_req": new,
+        })
+
+    # -- 4f. serving under per-request sampling: the same mixed traffic
+    # with temperature/top-k/top-p on half the requests times the
+    # "filtered" static tick variant (one O(V) lax.top_k + O(max_top_k)
+    # nucleus cumsum per tick) against the greedy baseline above --------
+    def serving_sampled_milestone():
+        eng, prompts, slots, new = _serving_setup()
+
+        def run():
+            reqs = []
+            for j, p in enumerate(prompts):
+                kw = ({"temperature": 0.8, "top_k": 40, "top_p": 0.95}
+                      if j % 2 else {})
+                reqs.append(eng.submit(p, new, **kw))
+            while not all(r.done for r in reqs):
+                eng.step_block()
+
+        run()  # warm: every bucket + both tick variants
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        _emit(out, "serving_sampled", {
+            "serving_sampled_tokens_per_sec": round(len(prompts) * new / dt, 0),
+            "requests": len(prompts), "slots": slots,
+            "sampled_fraction": 0.5, "new_tokens_per_req": new,
+        })
+
+    # -- 4g. GRPO iteration: G rollouts/prompt through the decode stack +
+    # the clipped-surrogate update — the RL post-training path's on-chip
+    # cost per generated token (train/rl.py, train/grpo.py) -------------
+    def grpo_milestone():
+        import optax
+
+        from kubedl_tpu.models import decode as dec, llama
+        from kubedl_tpu.parallel.mesh import build_mesh
+        from kubedl_tpu.train.rl import group_advantages, make_grpo_step
+
+        config = (llama.LlamaConfig.tiny(dtype=jnp.bfloat16) if small
+                  else llama.LlamaConfig.bench_150m(
+                      max_seq_len=512, remat=False))
+        params = llama.init(config, jax.random.PRNGKey(0))
+        mesh = build_mesh({"data": len(jax.devices())})
+        B, G, P, K = (1, 2, 8, 8) if small else (2, 8, 64, 64)
+        init_state, _, ref_fn, step = make_grpo_step(
+            params, config, optax.adamw(1e-6), mesh,
+            kl_coef=0.04, use_old_logprobs=False)
+        state = init_state(jax.tree.map(jnp.asarray, params))
+        rng = np.random.default_rng(0)
+        prompts = np.repeat(
+            rng.integers(1, config.vocab_size, (B, P)).astype(np.int32),
+            G, axis=0)
+        plens = np.full(B * G, P, np.int32)
+        roll = jax.jit(lambda p, toks, key: dec.generate(
+            p, toks, config, K, temperature=1.0, key=key))
+
+        def one_iter(key, st):
+            comp = np.asarray(jax.device_get(
+                roll(st.params, jnp.asarray(prompts), key)))
+            rewards = (comp == 5).mean(axis=1).astype(np.float32)
+            full = np.concatenate([prompts, comp], axis=1)
+            adv = np.asarray(group_advantages(
+                jnp.asarray(rewards.reshape(B, G)))).reshape(-1)
+            batch = (jnp.asarray(full), jnp.asarray(plens),
+                     jnp.asarray(np.full(B * G, P + K, np.int32)))
+            ref_lp = ref_fn(batch)
+            st, metrics = step(st, (*batch, jnp.asarray(adv), ref_lp))
+            jax.device_get(metrics["loss"])
+            return st
+
+        key = jax.random.PRNGKey(0)
+        state = one_iter(key, state)  # compile rollout + ref + update
+        iters = 2 if small else 4
+        t0 = time.perf_counter()
+        for it in range(iters):
+            state = one_iter(jax.random.fold_in(key, it + 1), state)
+        dt = time.perf_counter() - t0
+        toks = iters * B * G * K
+        _emit(out, "grpo", {
+            "grpo_tokens_per_sec": round(toks / dt, 0),
+            "grpo_iter_s": round(dt / iters, 3),
+            "batch": B, "group": G, "prompt_len": P, "new_tokens": K,
         })
 
     def decode_int8_milestone():
@@ -604,6 +692,8 @@ def _tpu_child(results_path: str) -> int:
         ("decode_int8", decode_int8_milestone, 120),
         ("decode_long", decode_long_milestone, 150),
         ("serving", serving_milestone, 150),
+        ("serving_sampled", serving_sampled_milestone, 120),
+        ("grpo", grpo_milestone, 150),
     ]
     for name, fn, min_budget in milestones:
         if left() < min_budget:
