@@ -234,6 +234,8 @@ def decode_step(
     token: jax.Array,  # [b] int32
     cache: Dict,
     config: LlamaConfig,
+    lora: Optional[Dict] = None,       # stacked adapters (llama._proj)
+    adapter_ids: Optional[jax.Array] = None,  # [b] int32, 0 = base
 ) -> Tuple[jax.Array, Dict]:
     """One decode step: returns (logits [b, vocab], updated cache).
 
@@ -248,7 +250,9 @@ def decode_step(
     pos = cache["lengths"]  # [b], or scalar in uniform mode
     int8_kv = "ks" in cache
     if pos.ndim == 0:
-        logits, cache = decode_block_step(params, token[:, None], cache, config)
+        logits, cache = decode_block_step(
+            params, token[:, None], cache, config,
+            lora=lora, adapter_ids=adapter_ids)
         return logits[:, 0], cache
     max_cap = cache["k"][0].shape[2]
     ring = "ring" in cache
@@ -279,10 +283,11 @@ def decode_step(
         x = x * jnp.asarray(c.embed_scale, c.dtype)
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
+        llayer = None if lora is None else lora["layers"][i]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _proj(h, layer, "q").reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _proj(h, layer, "k").reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _proj(h, layer, "v").reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q", llayer, adapter_ids).reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k", llayer, adapter_ids).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v", llayer, adapter_ids).reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         if c.q_prescale != 1.0:
@@ -308,12 +313,13 @@ def decode_step(
                               softcap=c.attn_logit_softcap or None,
                               ring_total=(pos + 1) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, 1, c.n_heads * c.head_dim)
-        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _proj(attn.astype(c.dtype), layer, "o",
+                         llayer, adapter_ids).astype(c.dtype)
         if "post_attn_norm" in layer:
             attn_out = rms_norm(attn_out, layer["post_attn_norm"],
                                 c.rms_eps, c.norm_offset)
         x = x + attn_out
-        x, _ = _mlp_block(x, layer, c)
+        x, _ = _mlp_block(x, layer, c, lora=llayer, adapter_ids=adapter_ids)
 
     out_cache = {
         "k": new_k,
@@ -336,6 +342,8 @@ def decode_block_step(
     cache: Dict,
     config: LlamaConfig,
     return_hidden: bool = False,
+    lora: Optional[Dict] = None,
+    adapter_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict]:
     """Chunked decode: T tokens forward through the cache in ONE dispatch.
 
@@ -381,10 +389,11 @@ def decode_block_step(
         x = x * jnp.asarray(c.embed_scale, c.dtype)
     new_k, new_v, new_ks, new_vs = [], [], [], []
     for i, layer in enumerate(params["layers"]):
+        llayer = None if lora is None else lora["layers"][i]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _proj(h, layer, "q").reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _proj(h, layer, "k").reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _proj(h, layer, "v").reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q", llayer, adapter_ids).reshape(b, T, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k", llayer, adapter_ids).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v", llayer, adapter_ids).reshape(b, T, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         if c.q_prescale != 1.0:
@@ -412,12 +421,13 @@ def decode_block_step(
                               softcap=c.attn_logit_softcap or None,
                               ring_total=(pos + T) if ring else None)
         attn = attn.transpose(0, 2, 1, 3).reshape(b, T, c.n_heads * c.head_dim)
-        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _proj(attn.astype(c.dtype), layer, "o",
+                         llayer, adapter_ids).astype(c.dtype)
         if "post_attn_norm" in layer:
             attn_out = rms_norm(attn_out, layer["post_attn_norm"],
                                 c.rms_eps, c.norm_offset)
         x = x + attn_out
-        x, _ = _mlp_block(x, layer, c)
+        x, _ = _mlp_block(x, layer, c, lora=llayer, adapter_ids=adapter_ids)
 
     out_cache = {"k": new_k, "v": new_v, "lengths": pos + T}
     if int8_kv:
@@ -498,6 +508,8 @@ def prefill(
     cache: Dict,
     config: LlamaConfig,
     lengths: Optional[jax.Array] = None,  # [b] unpadded lengths; default t
+    lora: Optional[Dict] = None,
+    adapter_ids: Optional[jax.Array] = None,
 ):
     """One full-sequence forward over the prompt, writing all K/V at once.
 
@@ -536,10 +548,11 @@ def prefill(
         x = x * jnp.asarray(c.embed_scale, c.dtype)
     ks, vs = [], []
     for i, layer in enumerate(params["layers"]):
+        llayer = None if lora is None else lora["layers"][i]
         h = rms_norm(x, layer["attn_norm"], c.rms_eps, c.norm_offset)
-        q = _proj(h, layer, "q").reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = _proj(h, layer, "k").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = _proj(h, layer, "v").reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _proj(h, layer, "q", llayer, adapter_ids).reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = _proj(h, layer, "k", llayer, adapter_ids).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = _proj(h, layer, "v", llayer, adapter_ids).reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         q = _rope(q, positions, c.rope_theta, c.rope_scaling)
         k = _rope(k, positions, c.rope_theta, c.rope_scaling)
         if c.q_prescale != 1.0:
@@ -549,12 +562,13 @@ def prefill(
         # GQA broadcast happens inside the attention entry points
         attn = _attn(q, k, v, causal=True, window=c.window_for(i))
         attn = attn.transpose(0, 2, 1, 3).reshape(b, t, c.n_heads * c.head_dim)
-        attn_out = _mm(attn.astype(c.dtype), layer["wo"]).astype(c.dtype)
+        attn_out = _proj(attn.astype(c.dtype), layer, "o",
+                         llayer, adapter_ids).astype(c.dtype)
         if "post_attn_norm" in layer:
             attn_out = rms_norm(attn_out, layer["post_attn_norm"],
                                 c.rms_eps, c.norm_offset)
         x = x + attn_out
-        x, _ = _mlp_block(x, layer, c)
+        x, _ = _mlp_block(x, layer, c, lora=llayer, adapter_ids=adapter_ids)
 
     int8_kv = "ks" in cache
     if int8_kv:

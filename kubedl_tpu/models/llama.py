@@ -394,14 +394,30 @@ def _rope(x, positions, theta, scaling=None):
     ).astype(x.dtype)
 
 
-def _proj(h, layer, name):
+def _proj(h, layer, name, lora=None, adapter_ids=None):
     """Projection through layer['w<name>'], plus the optional QKV bias
     (Qwen2-family checkpoints: attn_qkv_bias). Biases are stored f32
-    and added in the activation dtype."""
-    out = _mm(h, layer["w" + name])
+    and added in the activation dtype.
+
+    Multi-adapter serving (models/serving.py register_adapter): `lora`
+    is this layer's stacked adapters {name: {"a": [N, in, r],
+    "b": [N, r, out]}} with row 0 all-zero (the base model) and the
+    alpha/r scale folded into b; `adapter_ids` [b] selects each row's
+    adapter. The rank-r delta is two small einsums on top of the main
+    matmul — per-request adapters without per-request weight copies."""
+    wkey = "w" + name
+    out = _mm(h, layer[wkey])
     bias = layer.get("b" + name)
     if bias is not None:
         out = out + bias.astype(out.dtype)
+    if lora is not None and wkey in lora:
+        a = jnp.take(lora[wkey]["a"], adapter_ids, axis=0).astype(h.dtype)
+        bm = jnp.take(lora[wkey]["b"], adapter_ids, axis=0).astype(h.dtype)
+        delta = jnp.einsum("btd,bdr->btr", h, a,
+                           preferred_element_type=jnp.float32)
+        out = out + jnp.einsum("btr,bro->bto", delta.astype(h.dtype), bm,
+                               preferred_element_type=jnp.float32
+                               ).astype(out.dtype)
     return out
 
 
@@ -456,8 +472,11 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
     return x + out
 
 
-def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
-    """Dense or MoE FFN; returns (out, aux_loss)."""
+def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None,
+               lora=None, adapter_ids=None):
+    """Dense or MoE FFN; returns (out, aux_loss). lora/adapter_ids:
+    per-row serving adapters on w1/w3/w2 (see _proj); MoE layers carry
+    no dense projections for adapters to target."""
     h = rms_norm(x, layer["mlp_norm"], config.rms_eps, config.norm_offset)
     if "moe" in layer:
         y, aux = moe_mlp(
@@ -466,10 +485,10 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None):
         )
         y = y.astype(x.dtype)
     else:
-        gate = _act(_mm(h, layer["w1"]).astype(jnp.float32),
-                    config.act).astype(h.dtype)
-        up = _mm(h, layer["w3"])
-        y = _mm(gate * up, layer["w2"]).astype(x.dtype)
+        gate = _act(_proj(h, layer, "1", lora, adapter_ids)
+                    .astype(jnp.float32), config.act).astype(h.dtype)
+        up = _proj(h, layer, "3", lora, adapter_ids)
+        y = _proj(gate * up, layer, "2", lora, adapter_ids).astype(x.dtype)
         aux = jnp.zeros((), jnp.float32)
     if "post_mlp_norm" in layer:
         y = rms_norm(y, layer["post_mlp_norm"], config.rms_eps,

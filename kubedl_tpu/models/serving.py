@@ -63,6 +63,8 @@ class Request:
     # report per-token logprobs (under the MODEL's distribution —
     # temperature/filter-independent, OpenAI convention)
     logprobs: bool = False
+    # LoRA adapter id from engine.register_adapter (0 = base model)
+    adapter_id: int = 0
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
     token_logprobs: List[float] = field(default_factory=list)
@@ -93,6 +95,7 @@ class ServingEngine:
         kv_dtype=None,
         ring: Optional[bool] = None,
         max_top_k: int = 64,
+        max_adapters: int = 8,
     ) -> None:
         self.params = params
         self.config = config
@@ -118,6 +121,14 @@ class ServingEngine:
         self.samp_temps = jnp.full((slots,), temperature, jnp.float32)
         self.samp_topk = jnp.zeros((slots,), jnp.int32)
         self.samp_topp = jnp.ones((slots,), jnp.float32)
+        # multi-adapter serving: stacked LoRA deltas selected PER SLOT
+        # inside the shared tick (llama._proj) — adapter 0 is the base
+        # model (all-zero row). None until the first register_adapter.
+        self.max_adapters = max_adapters
+        self.lora = None
+        self._adapter_rows: list = []  # host copies for stack rebuilds
+        self._adapter_meta = None  # (rank, per-layer target tuple)
+        self.slot_adapter = jnp.zeros((slots,), jnp.int32)
         self._key = jax.random.PRNGKey(seed)
         self.kv_dtype = kv_dtype  # None | "int8" (half the cache HBM/read)
         # ring cache (sliding-window models): live K/V buffers hold only
@@ -147,11 +158,12 @@ class ServingEngine:
         # executable as constants (duplicating them in device memory).
         # One jitted prefill covers every bucket: jit retraces per padded
         # prompt shape, i.e. exactly once per bucket.
-        def prefill_fn(params, prompt, length):
+        def prefill_fn(params, prompt, length, lora, adapter_ids):
             scratch = decode.init_kv_cache(self.config, 1, self.max_len,
                                            kv_dtype=kv_dtype)
             return decode.prefill(
-                params, prompt, scratch, self.config, lengths=length)
+                params, prompt, scratch, self.config, lengths=length,
+                lora=lora, adapter_ids=adapter_ids)
 
         self._prefill = jax.jit(prefill_fn)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
@@ -292,10 +304,11 @@ class ServingEngine:
         return picked - lse
 
     def _tick_impl(self, params, cache, cur_tokens, active, key,
-                   temps, top_ks, top_ps, mode):
+                   temps, top_ks, top_ps, mode, lora, adapter_ids):
         old_lengths = cache["lengths"]
         logits, cache = decode.decode_step(
-            params, cur_tokens, cache, self.config)
+            params, cur_tokens, cache, self.config,
+            lora=lora, adapter_ids=adapter_ids)
         nxt = self._sample(logits, key, temps, top_ks, top_ps, mode)
         nxt = jnp.where(active, nxt, 0)
         lp = self._chosen_logprob(logits, nxt)
@@ -305,7 +318,7 @@ class ServingEngine:
         return cache, nxt, lp
 
     def _tick_block_impl(self, params, cache, cur_tokens, active, key, k,
-                         temps, top_ks, top_ps, mode):
+                         temps, top_ks, top_ps, mode, lora, adapter_ids):
         """k ticks chained on-device; returns the [k, slots] token block.
         Activity can't change mid-block (no admission, no EOS check on the
         device), so tokens past a request's EOS are generated and trimmed
@@ -316,7 +329,7 @@ class ServingEngine:
             cache, cur = carry
             cache, nxt, lp = self._tick_impl(
                 params, cache, cur, active, subkey,
-                temps, top_ks, top_ps, mode)
+                temps, top_ks, top_ps, mode, lora, adapter_ids)
             return (cache, nxt), (nxt, lp)
 
         (cache, cur), (toks, lps) = jax.lax.scan(
@@ -326,6 +339,84 @@ class ServingEngine:
     # -- public API --------------------------------------------------------
 
     _SUFFIX_CHUNK = 16  # block size for prefix-append prefill
+
+    def register_adapter(self, adapters: Dict, alpha=None) -> int:
+        """Register a LoRA adapter tree (models/lora.py lora_init layout:
+        {"layers": [{name: {"a": [in, r], "b": [r, out]}}]}) for
+        per-request selection; returns its id (0 is always the base
+        model). The alpha/r scale folds into b, and every adapter joins
+        per-target stacked arrays ([N+1, ...], zero row 0) that ride the
+        shared tick — per-request adapters with no per-request weights.
+
+        All registered adapters must share rank and target set (the
+        stacks are rectangular). Registration rebuilds the stacks, so
+        the next tick recompiles once per registry size; register
+        adapters before opening traffic, not per request."""
+        layers = adapters["layers"]
+        if len(layers) != len(self.params["layers"]):
+            raise ValueError(
+                f"adapter has {len(layers)} layers, model has "
+                f"{len(self.params['layers'])}")
+        meta = tuple(tuple(sorted(entry)) for entry in layers)
+        ranks = {ab["a"].shape[1] for entry in layers
+                 for ab in entry.values()}
+        if len(ranks) != 1:
+            raise ValueError(f"mixed ranks within adapter: {sorted(ranks)}")
+        rank = ranks.pop()
+        # dimension check against THIS model's weights: a wrong-width
+        # checkpoint would otherwise 200 here and blow up later inside
+        # the serve pump's prefill, killing decoding for every client
+        for li, entry in enumerate(layers):
+            for name, ab in entry.items():
+                w = self.params["layers"][li].get(name)
+                if w is None:
+                    raise ValueError(
+                        f"adapter targets {name!r} but layer {li} has no "
+                        f"such projection")
+                if (ab["a"].shape[0], ab["b"].shape[1]) != (w.shape[0],
+                                                            w.shape[1]):
+                    raise ValueError(
+                        f"adapter {name!r} at layer {li} is "
+                        f"{ab['a'].shape[0]}x{ab['b'].shape[1]}, model "
+                        f"weight is {w.shape[0]}x{w.shape[1]} — wrong "
+                        f"checkpoint/model pairing")
+        if self._adapter_meta is not None and self._adapter_meta != (rank, meta):
+            raise ValueError(
+                "adapter rank/targets differ from already-registered "
+                "adapters — stacks must be rectangular (serve mixed "
+                "shapes from separate engines)")
+        if len(self._adapter_rows) >= self.max_adapters:
+            raise ValueError(
+                f"adapter registry full ({self.max_adapters})")
+        scale = (float(alpha) if alpha is not None else float(rank)) / rank
+        row = [{name: {"a": np.asarray(ab["a"], np.float32),
+                       "b": np.asarray(ab["b"], np.float32) * scale}
+                for name, ab in entry.items()}
+               for entry in layers]
+        # build the new stacks FULLY before committing any state, so a
+        # failure leaves registry and device stacks consistent. Stacks
+        # are stored in the model dtype: _proj's cast then no-ops and
+        # the per-tick gather reads half the bytes vs f32.
+        rows = self._adapter_rows + [row]
+        stacked = []
+        for li, entry in enumerate(layers):
+            out = {}
+            for name in entry:
+                a0 = np.zeros_like(rows[0][li][name]["a"])
+                b0 = np.zeros_like(rows[0][li][name]["b"])
+                out[name] = {
+                    "a": jnp.asarray(np.stack(
+                        [a0] + [r[li][name]["a"] for r in rows])
+                    ).astype(self.config.dtype),
+                    "b": jnp.asarray(np.stack(
+                        [b0] + [r[li][name]["b"] for r in rows])
+                    ).astype(self.config.dtype),
+                }
+            stacked.append(out)
+        self._adapter_rows = rows
+        self._adapter_meta = (rank, meta)
+        self.lora = {"layers": stacked}
+        return len(self._adapter_rows)
 
     def register_prefix(self, tokens) -> int:
         """Precompute K/V for a shared prompt prefix (system prompt).
@@ -380,6 +471,7 @@ class ServingEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         logprobs: bool = False,
+        adapter_id: int = 0,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature is not None and temperature < 0:
@@ -392,6 +484,15 @@ class ServingEngine:
                 f"max_top_k), got {top_k}")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if not 0 <= adapter_id <= len(self._adapter_rows):
+            raise ValueError(
+                f"unknown adapter_id {adapter_id} "
+                f"({len(self._adapter_rows)} registered; 0 = base)")
+        if adapter_id and prefix_id is not None:
+            # a shared prefix's K/V was computed with BASE projections;
+            # reusing it under an adapter would silently mix models
+            raise ValueError("adapter_id cannot combine with prefix_id "
+                             "(prefix K/V is base-model state)")
         if prompt.size == 0:
             raise ValueError("empty prompt (with a prefix, pass at least "
                              "the first suffix token)")
@@ -414,7 +515,7 @@ class ServingEngine:
                       temperature=(self.temperature if temperature is None
                                    else float(temperature)),
                       top_k=int(top_k), top_p=float(top_p),
-                      logprobs=bool(logprobs))
+                      logprobs=bool(logprobs), adapter_id=int(adapter_id))
         self._next_id += 1
         self._queue.append(req)
         return req
@@ -456,7 +557,8 @@ class ServingEngine:
                 padded[0, :t] = req.prompt
                 logits, row_cache = self._prefill(
                     self.params, jnp.asarray(padded),
-                    jnp.asarray([t], jnp.int32))
+                    jnp.asarray([t], jnp.int32), self.lora,
+                    jnp.asarray([req.adapter_id], jnp.int32))
             self._key, sub = jax.random.split(self._key)
             if req.needs_filter:
                 req_mode = "filtered"
@@ -479,6 +581,7 @@ class ServingEngine:
             self.samp_temps = self.samp_temps.at[slot].set(req.temperature)
             self.samp_topk = self.samp_topk.at[slot].set(req.top_k)
             self.samp_topp = self.samp_topp.at[slot].set(req.top_p)
+            self.slot_adapter = self.slot_adapter.at[slot].set(req.adapter_id)
             self._slot_req[slot] = req
             self._admitted += 1
             req.cache_len = t
@@ -558,7 +661,7 @@ class ServingEngine:
         self.cache, nxt, lp = self._tick(
             self.params, self.cache, self.cur_tokens, self.active, sub,
             self.samp_temps, self.samp_topk, self.samp_topp,
-            self._sample_mode())
+            self._sample_mode(), self.lora, self.slot_adapter)
         self.cur_tokens = nxt
         self._ticks += 1
         emitted, lps = (np.asarray(a) for a in jax.device_get((nxt, lp)))
@@ -611,7 +714,7 @@ class ServingEngine:
         self.cache, self.cur_tokens, toks, lps = self._tick_block(
             self.params, self.cache, self.cur_tokens, self.active, sub,
             int(k), self.samp_temps, self.samp_topk, self.samp_topp,
-            self._sample_mode())
+            self._sample_mode(), self.lora, self.slot_adapter)
         self._ticks += k
         block, block_lp = (np.asarray(a)
                            for a in jax.device_get((toks, lps)))  # [k, slots]

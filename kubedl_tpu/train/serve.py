@@ -93,10 +93,23 @@ class _Service:
                 if not self.engine.has_pending():
                     self._work.clear()
                     continue
-                if self.decode_block > 1:
-                    self.engine.step_block(self.decode_block)
-                else:
-                    self.engine.step()
+                try:
+                    if self.decode_block > 1:
+                        self.engine.step_block(self.decode_block)
+                    else:
+                        self.engine.step()
+                except Exception as e:  # noqa: BLE001
+                    # a step that throws (bad state, OOM, device error)
+                    # must not kill the pump thread silently: waiting
+                    # clients would hang until their timeouts while
+                    # submits keep returning 200. Fail the in-flight
+                    # work loudly and keep serving.
+                    print(f"serve pump: engine step failed: "
+                          f"{type(e).__name__}: {e}", flush=True)
+                    for req in list(self.engine._queue) + [
+                            r for r in self.engine._slot_req
+                            if r is not None]:
+                        self.engine.cancel(req)
                 # pump passes, not device ticks: the smoke-mode budget
                 # just needs a monotonic progress counter
                 self.ticks += 1
@@ -105,15 +118,30 @@ class _Service:
                prefix_id: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
-               logprobs: bool = False):
+               logprobs: bool = False, adapter_id: int = 0):
         with self._lock:
             req = self.engine.submit(prompt, max_new_tokens, eos_token,
                                      prefix_id=prefix_id,
                                      temperature=temperature,
                                      top_k=top_k, top_p=top_p,
-                                     logprobs=logprobs)
+                                     logprobs=logprobs,
+                                     adapter_id=adapter_id)
         self._work.set()
         return req
+
+    def register_adapter(self, checkpoint_path: str, alpha=None) -> int:
+        """Load a trainer --lora-rank adapter checkpoint and register it
+        for per-request selection. The disk restore runs OUTSIDE the
+        service lock (it can take seconds); only the registry swap —
+        which retraces the next tick — holds it."""
+        from kubedl_tpu.train.generate import restore_params
+
+        adapters = restore_params(checkpoint_path, label="lora adapters")
+        if adapters is None:
+            raise ValueError(
+                f"no adapter checkpoint under {checkpoint_path!r}")
+        with self._lock:
+            return self.engine.register_adapter(adapters, alpha=alpha)
 
     def register_prefix(self, tokens) -> int:
         # NOT under the service lock: the prefill compile can take tens
@@ -307,7 +335,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.svc.cancel([req])
 
     def do_POST(self) -> None:  # noqa: N802
-        if self.path not in ("/generate", "/prefix"):
+        if self.path not in ("/generate", "/prefix", "/adapter"):
             return self._send(404, {"error": f"unknown path {self.path}"})
         try:
             length = int(self.headers.get("Content-Length", "0") or "0")
@@ -322,6 +350,15 @@ class _Handler(BaseHTTPRequestHandler):
             except (ValueError, TypeError) as e:
                 return self._send(422, {"error": str(e)})
             return self._send(200, {"prefix_id": pid})
+        if self.path == "/adapter":
+            alpha = body.get("alpha")
+            try:
+                aid = self.svc.register_adapter(
+                    str(body.get("checkpoint_path") or ""),
+                    alpha=None if alpha is None else float(alpha))
+            except (ValueError, TypeError) as e:
+                return self._send(422, {"error": str(e)})
+            return self._send(200, {"adapter_id": aid})
         try:
             stream = _parse_bool(body.get("stream"), "stream")
         except ValueError as e:
@@ -402,6 +439,7 @@ class _Handler(BaseHTTPRequestHandler):
                     top_k=0 if top_k is None else int(top_k),
                     top_p=1.0 if top_p is None else float(top_p),
                     logprobs=_parse_bool(e.get("logprobs"), "logprobs"),
+                    adapter_id=int(e.get("adapter_id") or 0),
                 ))
         except (ValueError, TypeError) as e:
             # partially-submitted batch: release what already went in

@@ -370,3 +370,68 @@ def test_logprobs_field_must_be_boolean(server):
         _post(f"{base}/generate",
               {"tokens": [1, 2], "max_new_tokens": 2, "logprobs": 5})
     assert exc.value.code == 422
+
+
+def test_multi_lora_over_http(tmp_path):
+    """POST /adapter registers a LoRA checkpoint; per-request adapter_id
+    selects it; base traffic (id 0) is untouched; bad ids 422."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from kubedl_tpu.models import llama, lora
+    from kubedl_tpu.models.serving import ServingEngine
+    from kubedl_tpu.train.serve import _Handler, _Service
+    from http.server import ThreadingHTTPServer
+
+    config = llama.LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    ad = lora.lora_init(jax.random.PRNGKey(1), params, rank=4,
+                        targets=("wq", "wv"))
+    ad = jax.tree.map(
+        lambda x: jnp.asarray(
+            np.random.default_rng(5).normal(size=x.shape) * 0.1, jnp.float32),
+        ad)
+    ckpt = str(tmp_path / "adapters")
+    mngr = ocp.CheckpointManager(
+        ckpt, options=ocp.CheckpointManagerOptions(create=True))
+    mngr.save(1, args=ocp.args.StandardSave({"params": ad}))
+    mngr.wait_until_finished()
+
+    engine = ServingEngine(params, config, slots=2, max_len=64)
+    svc = _Service(engine)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    httpd.daemon_threads = True
+    httpd.svc = svc
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        out = _post(f"{base}/adapter", {"checkpoint_path": ckpt})
+        aid = out["adapter_id"]
+        assert aid == 1
+        prompt = [3, 1, 4, 1, 5]
+        plain = _post(f"{base}/generate",
+                      {"tokens": prompt, "max_new_tokens": 4})
+        adapted = _post(f"{base}/generate",
+                        {"tokens": prompt, "max_new_tokens": 4,
+                         "adapter_id": aid})
+        merged = lora.merge(params, ad)
+        from kubedl_tpu.models import decode as dec
+
+        ref = [int(t) for t in np.asarray(jax.device_get(dec.generate(
+            merged, jnp.asarray(prompt, jnp.int32)[None, :], config,
+            max_new_tokens=4, max_len=9)))[0]]
+        assert adapted["tokens"] == ref
+        assert plain["tokens"] != adapted["tokens"]
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{base}/generate",
+                  {"tokens": prompt, "max_new_tokens": 2, "adapter_id": 9})
+        assert exc.value.code == 422
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"{base}/adapter", {"checkpoint_path": str(tmp_path / "x")})
+        assert exc.value.code == 422
+    finally:
+        httpd.shutdown()
+        svc.stop()
