@@ -1,0 +1,170 @@
+"""Evaluation workload — checkpoint in, perplexity out.
+
+Completes the train -> eval -> serve triad as a standalone JAXJob
+program: restores params exactly like generate/serve (trainer Orbax
+checkpoint, HF import, or LoRA merge), runs the SHARDED forward
+(mesh from KUBEDL_MESH) over token shards with the same native
+mmap+prefetch loader the trainer uses, and prints one JSON line —
+token-level NLL and perplexity — the number a training run is judged
+by. Unlike the trainer's interleaved --eval-every probes, this scores
+a full deterministic pass (batch i = loader.batch_at(i)), so two
+checkpoints are comparable bit-for-bit.
+
+The reference operator has no evaluation (or any model) code; this is
+another workload program its JAXJob equivalent deploys (ref parity
+anchor: the pod-command slot in /root/reference/controllers/).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("kubedl-evaluate")
+    p.add_argument("--model", default=os.environ.get("KUBEDL_MODEL", "tiny"),
+                   choices=["tiny", "bench-150m", "bench-1b", "llama-7b"])
+    p.add_argument("--hf-model", default=os.environ.get("KUBEDL_HF_MODEL", ""),
+                   help="Hugging Face weights — overrides --model/--checkpoint-path")
+    p.add_argument("--checkpoint-path",
+                   default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""),
+                   help="trainer Orbax dir; newest step's params are used")
+    p.add_argument("--lora-checkpoint-path", default="",
+                   help="merge the newest adapter checkpoint into the base "
+                        "weights before scoring (models/lora.py)")
+    p.add_argument("--lora-alpha", type=float, default=None)
+    p.add_argument("--allow-fresh-init", action="store_true",
+                   help="score random weights when no checkpoint exists "
+                        "(smoke only — otherwise that's an error)")
+    p.add_argument("--data-path", default=os.environ.get("KUBEDL_DATA_PATH", ""),
+                   help="glob of token shard files (trainer format); "
+                        "synthetic tokens when empty (smoke only)")
+    p.add_argument("--batch", type=int, default=int(os.environ.get("KUBEDL_BATCH", 8)))
+    p.add_argument("--seq-len", type=int, default=int(os.environ.get("KUBEDL_SEQ_LEN", 1024)))
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="cap scored batches (0 = the full pass)")
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    from kubedl_tpu.train import coordinator
+
+    coordinator.initialize()
+
+    import glob as globlib
+    import math
+    import time
+
+    import jax
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env, shard_pytree
+    from kubedl_tpu.train.generate import resolve_params
+
+    params, config = resolve_params(
+        args.model, args.hf_model, args.checkpoint_path,
+        args.allow_fresh_init, lora_checkpoint_path=args.lora_checkpoint_path,
+        lora_alpha=args.lora_alpha, seed=args.seed, label="eval")
+    if params is None:
+        return 1
+
+    mesh = build_mesh_from_env()
+    rules = ShardingRules()
+    params = shard_pytree(params, mesh, llama.param_specs(config, rules))
+    n_proc = jax.process_count()
+    rank = jax.process_index()
+    print(f"mesh: {dict(mesh.shape)} model={args.hf_model or args.model} "
+          f"seq={args.seq_len} processes={n_proc}", flush=True)
+
+    eval_step = jax.jit(
+        lambda p, batch: llama.loss_fn(p, batch, config, mesh=mesh,
+                                       rules=rules))
+
+    # each process loads its OWN args.batch rows; the global batch is
+    # n_proc * batch, assembled like the trainer's multi-host pipeline
+    global_batch = args.batch * n_proc
+    if args.data_path:
+        from kubedl_tpu.native.loader import TokenLoader
+
+        shard_paths = sorted(globlib.glob(args.data_path))
+        if not shard_paths:
+            print(f"no shards match {args.data_path!r}", file=sys.stderr)
+            return 1
+        loader = TokenLoader(shard_paths, batch=args.batch,
+                             seq_len=args.seq_len, seed=args.seed,
+                             n_threads=0)  # random access = deterministic
+        if loader.n_windows < global_batch:
+            # batch_at wraps window ids modulo n_windows: short sets
+            # would score some windows twice and bias the mean
+            print(f"only {loader.n_windows} windows for a global batch "
+                  f"of {global_batch} — shrink --batch", file=sys.stderr)
+            return 1
+        n_batches = loader.n_windows // global_batch
+        dropped = loader.n_windows - n_batches * global_batch
+        if dropped:
+            print(f"note: dropping {dropped} remainder windows "
+                  f"(static batch shapes)", flush=True)
+        # rank-strided ids: process r scores batches r, r+P, r+2P, ...
+        get = lambda i: loader.batch_at(i * n_proc + rank)  # noqa: E731
+        print(f"data: {len(shard_paths)} shards, {loader.n_windows} "
+              f"windows -> {n_batches} global batches", flush=True)
+    else:
+        rng = np.random.default_rng(args.seed + rank)
+        fixed = rng.integers(1, config.vocab_size,
+                             (8, args.batch, args.seq_len)).astype(np.int32)
+        n_batches = len(fixed)
+        get = lambda i: fixed[i]  # noqa: E731
+        print(f"data: {n_batches} synthetic batches (no --data-path)",
+              flush=True)
+    if args.max_batches:
+        n_batches = min(n_batches, args.max_batches)
+
+    batch_sharding = rules.sharding(mesh, "batch", None)
+
+    def to_global(local):
+        # a plain device_put of host-local rows cannot reshard onto
+        # other processes' non-addressable devices on multi-host meshes
+        if n_proc == 1:
+            return jax.device_put(np.asarray(local), batch_sharding)
+        return jax.make_array_from_process_local_data(
+            batch_sharding, np.asarray(local),
+            (global_batch, args.seq_len))
+
+    total_nll = 0.0
+    t0 = None
+    for i in range(n_batches):
+        # loss_fn is mean next-token CE over (seq_len - 1) positions
+        total_nll += float(jax.device_get(eval_step(params, to_global(get(i)))))
+        if t0 is None:
+            t0 = time.time()  # steady-state clock: exclude batch 0's compile
+        if args.log_every and ((i + 1) % args.log_every == 0
+                               or i + 1 == n_batches):
+            mean = total_nll / (i + 1)
+            print(f"batch {i + 1}/{n_batches}: nll={mean:.4f} "
+                  f"ppl={math.exp(min(mean, 30.0)):.2f}", flush=True)
+    mean_nll = total_nll / n_batches
+    tokens = n_batches * global_batch * (args.seq_len - 1)
+    dt = max(time.time() - (t0 or time.time()), 1e-9)
+    print(json.dumps({
+        "metric": "eval_perplexity",
+        "perplexity": round(math.exp(min(mean_nll, 30.0)), 4),
+        "nll": round(mean_nll, 6),
+        "tokens": tokens,
+        # steady-state rate: the first batch (jit compile) starts the
+        # clock but isn't counted in it
+        "tokens_per_sec": round(
+            (tokens - global_batch * (args.seq_len - 1)) / dt
+            if n_batches > 1 else 0.0, 0),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,34 @@ def restore_params(path, label="params"):
     return jax.tree.map(jnp.asarray, tree)
 
 
+def resolve_params(model, hf_model, checkpoint_path, allow_fresh_init,
+                   lora_checkpoint_path="", lora_alpha=None, seed=0,
+                   label="target"):
+    """The shared weight-resolution cascade of the generate/serve/
+    evaluate entrypoints: --hf-model beats --model/--checkpoint-path,
+    then an optional LoRA merge. Returns (params, config), or
+    (None, None) when a required checkpoint is missing (the error is
+    already printed)."""
+    if hf_model:
+        from kubedl_tpu.models.import_hf import load_hf
+
+        params, config = load_hf(hf_model)
+    else:
+        from kubedl_tpu.models import llama
+
+        config = llama.LlamaConfig.config_for(model)
+        params = restore_or_init(config, checkpoint_path, allow_fresh_init,
+                                 seed=seed, label=label)
+        if params is None:
+            return None, None
+    if lora_checkpoint_path:
+        from kubedl_tpu.models.lora import restore_and_merge
+
+        params = restore_and_merge(params, lora_checkpoint_path,
+                                   alpha=lora_alpha)
+    return params, config
+
+
 def restore_or_init(config, checkpoint_path, allow_fresh_init, seed=0,
                     label="target"):
     """Checkpoint params, fresh init, or None (error already printed) —
@@ -129,22 +157,12 @@ def main(argv=None) -> int:
 
     from kubedl_tpu.models import decode, llama
 
-    if args.hf_model:
-        from kubedl_tpu.models.import_hf import load_hf
-
-        params, config = load_hf(args.hf_model)
-    else:
-        config = llama.LlamaConfig.config_for(args.model)
-
-        params = restore_or_init(
-            config, args.checkpoint_path, args.allow_fresh_init, seed=args.seed)
-        if params is None:
-            return 1
-    if args.lora_checkpoint_path:
-        from kubedl_tpu.models import lora as lora_mod
-
-        params = lora_mod.restore_and_merge(
-            params, args.lora_checkpoint_path, alpha=args.lora_alpha)
+    params, config = resolve_params(
+        args.model, args.hf_model, args.checkpoint_path,
+        args.allow_fresh_init, lora_checkpoint_path=args.lora_checkpoint_path,
+        lora_alpha=args.lora_alpha, seed=args.seed)
+    if params is None:
+        return 1
 
     if args.int8:
         from kubedl_tpu.models import quant

@@ -472,32 +472,23 @@ def main(argv=None) -> int:
 
     import jax
 
-    from kubedl_tpu.models import llama
     from kubedl_tpu.models.serving import ServingEngine
-    from kubedl_tpu.train.generate import restore_or_init
+    from kubedl_tpu.train.generate import resolve_params
 
+    params, config = resolve_params(
+        args.model, args.hf_model, args.checkpoint_path,
+        args.allow_fresh_init, lora_checkpoint_path=args.lora_checkpoint_path,
+        lora_alpha=args.lora_alpha)
+    if params is None:
+        return 1
     tokenizer = None
     if args.hf_model:
-        from kubedl_tpu.models.import_hf import load_hf
-
-        params, config = load_hf(args.hf_model)
         try:
             import transformers
 
             tokenizer = transformers.AutoTokenizer.from_pretrained(args.hf_model)
         except Exception as e:  # noqa: BLE001 — token-id API still works
             print(f"no tokenizer loaded ({e}); token-id API only", flush=True)
-    else:
-        config = llama.LlamaConfig.config_for(args.model)
-        params = restore_or_init(
-            config, args.checkpoint_path, args.allow_fresh_init, seed=0)
-        if params is None:
-            return 1
-    if args.lora_checkpoint_path:
-        from kubedl_tpu.models import lora as lora_mod
-
-        params = lora_mod.restore_and_merge(
-            params, args.lora_checkpoint_path, alpha=args.lora_alpha)
     if args.int8:
         from kubedl_tpu.models import quant
 

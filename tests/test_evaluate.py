@@ -1,0 +1,92 @@
+"""Standalone evaluation workload (train/evaluate.py): deterministic
+full-pass perplexity, shard loading, checkpoint restore."""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.train import evaluate
+
+
+def test_synthetic_smoke_and_determinism(capsys, monkeypatch):
+    monkeypatch.setenv("KUBEDL_MESH", "data=4,tensor=2")
+    args = ["--model", "tiny", "--batch", "4", "--seq-len", "32",
+            "--allow-fresh-init", "--log-every", "0"]
+    assert evaluate.main(args) == 0
+    out1 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # random weights over vocab 256: ppl near the uniform 256
+    assert 100 < out1["perplexity"] < 600
+    assert out1["tokens"] == 8 * 4 * 31
+    assert evaluate.main(args) == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["nll"] == out1["nll"]  # full pass is deterministic
+
+
+def test_shards_and_trained_checkpoint_scores_better(tmp_path, capsys,
+                                                     monkeypatch):
+    """Eval over real token shards; a briefly-trained checkpoint must
+    score lower NLL on its training distribution than fresh init."""
+    import optax
+    import orbax.checkpoint as ocp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+    from kubedl_tpu.parallel.train_step import make_train_step
+
+    monkeypatch.setenv("KUBEDL_MESH", "data=4,tensor=2")
+    rng = np.random.default_rng(0)
+    # highly structured tokens so a few steps measurably help
+    stream = np.tile(np.arange(1, 17, dtype=np.int32), 600)
+    shard = tmp_path / "shard-0.bin"
+    stream.tofile(shard)
+
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    mesh = build_mesh({"data": 4, "tensor": 2})
+    rules = ShardingRules()
+
+    def loss(p, batch):
+        return llama.loss_fn(p, batch, config, mesh=mesh, rules=rules)
+
+    init_state, step = make_train_step(
+        loss, optax.adam(1e-2), mesh, llama.param_specs(config, rules),
+        rules.spec("batch", None), rules)
+    state = init_state(params)
+    for _ in range(30):
+        toks = np.lib.stride_tricks.sliding_window_view(stream, 33)[
+            rng.integers(0, len(stream) - 33, 4)]
+        state, _ = step(state, jnp.asarray(toks))
+    ckpt = str(tmp_path / "ckpt")
+    mngr = ocp.CheckpointManager(
+        ckpt, options=ocp.CheckpointManagerOptions(create=True))
+    mngr.save(30, args=ocp.args.StandardSave({"params": state.params}))
+    mngr.wait_until_finished()
+
+    common = ["--model", "tiny", "--batch", "4", "--seq-len", "33",
+              "--data-path", str(tmp_path / "shard-*.bin"),
+              "--max-batches", "6", "--log-every", "0"]
+    assert evaluate.main(common + ["--allow-fresh-init"]) == 0
+    fresh = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert evaluate.main(common + ["--checkpoint-path", ckpt]) == 0
+    trained = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert trained["nll"] < fresh["nll"] - 0.5
+    assert trained["perplexity"] < fresh["perplexity"]
+
+
+def test_missing_shards_and_checkpoint_fail_loudly(tmp_path):
+    assert evaluate.main(
+        ["--model", "tiny", "--allow-fresh-init",
+         "--data-path", str(tmp_path / "none-*.bin")]) == 1
+    assert evaluate.main(
+        ["--model", "tiny",
+         "--checkpoint-path", str(tmp_path / "nope")]) == 1
+    # fewer windows than one batch would wrap (double-score) — refuse
+    tiny_shard = tmp_path / "small-0.bin"
+    np.arange(1, 40, dtype=np.int32).tofile(tiny_shard)  # ~6 windows @33
+    assert evaluate.main(
+        ["--model", "tiny", "--allow-fresh-init", "--batch", "64",
+         "--seq-len", "33", "--data-path", str(tmp_path / "small-*.bin")]
+    ) == 1
