@@ -544,6 +544,33 @@ def _tpu_child(results_path: str) -> int:
             "sampled_fraction": 0.5, "new_tokens_per_req": new,
         })
 
+    # -- 4f2. multi-LoRA serving: half the traffic routed through a
+    # registered adapter (per-slot rank-r deltas gathered inside the
+    # fused tick) — the per-request-adapter overhead vs the greedy
+    # baseline above ---------------------------------------------------
+    def serving_lora_milestone():
+        from kubedl_tpu.models import lora
+
+        eng, prompts, slots, new = _serving_setup()
+        ad = lora.lora_init(jax.random.PRNGKey(1), eng.params, rank=8)
+        aid = eng.register_adapter(ad)
+
+        def run():
+            reqs = [eng.submit(p, new, adapter_id=aid if j % 2 else 0)
+                    for j, p in enumerate(prompts)]
+            while not all(r.done for r in reqs):
+                eng.step_block()
+
+        run()  # warm: buckets + the lora tick variant
+        t0 = time.perf_counter()
+        run()
+        dt = time.perf_counter() - t0
+        _emit(out, "serving_lora", {
+            "serving_lora_tokens_per_sec": round(len(prompts) * new / dt, 0),
+            "requests": len(prompts), "slots": slots,
+            "adapter_fraction": 0.5, "rank": 8,
+        })
+
     # -- 4g. GRPO iteration: G rollouts/prompt through the decode stack +
     # the clipped-surrogate update — the RL post-training path's on-chip
     # cost per generated token (train/rl.py, train/grpo.py) -------------
@@ -693,6 +720,7 @@ def _tpu_child(results_path: str) -> int:
         ("decode_long", decode_long_milestone, 150),
         ("serving", serving_milestone, 150),
         ("serving_sampled", serving_sampled_milestone, 120),
+        ("serving_lora", serving_lora_milestone, 120),
         ("grpo", grpo_milestone, 150),
     ]
     for name, fn, min_budget in milestones:
