@@ -28,6 +28,7 @@ import argparse
 import json
 import logging
 import os
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -49,6 +50,14 @@ def parse_args(argv=None):
                    help="merge the newest adapter checkpoint from a trainer "
                         "--lora-rank run into the base weights")
     p.add_argument("--lora-alpha", type=float, default=None)
+    p.add_argument("--adapter", action="append", default=[],
+                   metavar="CKPT[:ALPHA]",
+                   help="register a LoRA adapter checkpoint at startup "
+                        "for per-request selection (repeatable; ids are "
+                        "assigned in order starting at 1). Unlike "
+                        "--lora-checkpoint-path (which MERGES one adapter "
+                        "into the weights), these serve side-by-side with "
+                        "the base model")
     p.add_argument("--bind", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
     p.add_argument("--slots", type=int, default=8)
@@ -499,6 +508,22 @@ def main(argv=None) -> int:
         kv_dtype="int8" if args.kv_int8 else None,
     )
     svc = _Service(engine, tokenizer=tokenizer, decode_block=args.decode_block)
+    for spec in args.adapter:
+        # CKPT[:ALPHA] — registration failures at startup are fatal: a
+        # deployment that silently dropped an adapter would 422 every
+        # request that names it
+        path, _, alpha_s = spec.rpartition(":")
+        if path and alpha_s.replace(".", "", 1).isdigit():
+            alpha = float(alpha_s)
+        else:
+            path, alpha = spec, None
+        try:
+            aid = svc.register_adapter(path, alpha=alpha)
+        except ValueError as e:
+            print(f"error: --adapter {spec!r}: {e}", file=sys.stderr)
+            svc.stop()
+            return 1
+        print(f"adapter {aid}: {path} (alpha={alpha})", flush=True)
     httpd = ThreadingHTTPServer((args.bind, args.port), _Handler)
     httpd.daemon_threads = True
     httpd.svc = svc  # type: ignore[attr-defined]

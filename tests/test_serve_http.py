@@ -435,3 +435,40 @@ def test_multi_lora_over_http(tmp_path):
     finally:
         httpd.shutdown()
         svc.stop()
+
+
+def test_startup_adapter_flag(tmp_path):
+    """--adapter CKPT[:ALPHA] registers adapters before the server
+    opens; a bad path is a fatal startup error, not a silent drop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from kubedl_tpu.models import llama, lora
+    from kubedl_tpu.train import serve
+
+    config = llama.LlamaConfig.tiny(use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    ad = lora.lora_init(jax.random.PRNGKey(1), params, rank=4,
+                        targets=("wq",))
+    ad = jax.tree.map(
+        lambda x: jnp.asarray(
+            np.random.default_rng(3).normal(size=x.shape) * 0.1,
+            jnp.float32), ad)
+    ckpt = str(tmp_path / "ad")
+    m = ocp.CheckpointManager(
+        ckpt, options=ocp.CheckpointManagerOptions(create=True))
+    m.save(1, args=ocp.args.StandardSave({"params": ad}))
+    m.wait_until_finished()
+
+    out, rc = _run_main_and_post(
+        ["--model", "tiny", "--slots", "2", "--max-len", "32",
+         "--adapter", f"{ckpt}:8", "--max-steps", "2"],
+        18786, {"tokens": [1, 2], "max_new_tokens": 3, "adapter_id": 1})
+    assert rc == 0 and out is not None and len(out["tokens"]) == 3
+
+    assert serve.main(
+        ["--model", "tiny", "--slots", "2", "--max-len", "32",
+         "--adapter", str(tmp_path / "missing"),
+         "--bind", "127.0.0.1", "--port", "18787"]) == 1
