@@ -10,6 +10,9 @@ Data format — one JSON object per line:
 
     {"prompt": [ids...], "chosen": [ids...], "rejected": [ids...]}
 
+With --hf-model the fields may also be raw strings, encoded by the
+checkpoint's own tokenizer.
+
 Pairs are right-padded to --seq-len (prompt + longer continuation must
 fit). The frozen reference is the STARTING policy (base weights from
 --hf-model / --ref-checkpoint-path / fresh init), the standard DPO
@@ -55,10 +58,13 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def load_pairs(path: str, seq_len: int):
+def load_pairs(path: str, seq_len: int, tokenizer=None):
     """JSONL -> (tokens [n,2,T], prompt_lens [n], seq_lens [n,2]); pairs
-    that cannot fit seq_len are skipped with a count."""
+    that cannot fit seq_len are skipped with a count. Fields may be id
+    lists or (with a tokenizer) raw strings."""
     import numpy as np
+
+    from kubedl_tpu.train.generate import encode_field
 
     toks, plens, slens = [], [], []
     skipped = 0
@@ -68,12 +74,15 @@ def load_pairs(path: str, seq_len: int):
             if not line:
                 continue
             rec = json.loads(line)
-            prompt = list(rec["prompt"])
-            chosen = prompt + list(rec["chosen"])
-            rejected = prompt + list(rec["rejected"])
+            prompt = encode_field(rec["prompt"], tokenizer, "prompt")
+            chosen = prompt + encode_field(
+                rec["chosen"], tokenizer, "chosen", continuation=True)
+            rejected = prompt + encode_field(
+                rec["rejected"], tokenizer, "rejected", continuation=True)
             if (max(len(chosen), len(rejected)) > seq_len
                     or len(prompt) < 1
-                    or not rec["chosen"] or not rec["rejected"]):
+                    or len(chosen) == len(prompt)
+                    or len(rejected) == len(prompt)):
                 # empty continuations make one logprob side hard-zero —
                 # a degenerate gradient, not a preference
                 skipped += 1
@@ -109,10 +118,14 @@ def main(argv=None) -> int:
     from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
     from kubedl_tpu.train.preference import make_dpo_step
 
+    tokenizer = None
     if args.hf_model:
         from kubedl_tpu.models.import_hf import load_hf
 
         base, config = load_hf(args.hf_model)
+        from kubedl_tpu.train.generate import load_tokenizer
+
+        tokenizer = load_tokenizer(args.hf_model)
     else:
         config = llama.LlamaConfig.config_for(args.model)
         from kubedl_tpu.train.generate import restore_or_init
@@ -143,7 +156,8 @@ def main(argv=None) -> int:
     # pretraining corpora); batches cycle with a seeded permutation
     rng = np.random.default_rng(args.seed)
     if args.data_path:
-        tokens, plens, slens = load_pairs(args.data_path, args.seq_len)
+        tokens, plens, slens = load_pairs(args.data_path, args.seq_len,
+                                          tokenizer=tokenizer)
         print(f"data: {len(tokens)} pairs from {args.data_path}", flush=True)
     else:
         n = max(args.batch * 4, 32)

@@ -88,6 +88,37 @@ def restore_params(path, label="params"):
     return jax.tree.map(jnp.asarray, tree)
 
 
+def load_tokenizer(hf_model: str):
+    """AutoTokenizer for an --hf-model, or None with a warning (the
+    id-list APIs still work) — the one tokenizer-loading block shared by
+    serve/dpo/grpo."""
+    if not hf_model:
+        return None
+    try:
+        import transformers
+
+        return transformers.AutoTokenizer.from_pretrained(hf_model)
+    except Exception as e:  # noqa: BLE001 — id-list data still works
+        print(f"no tokenizer loaded ({e}); id-list data only", flush=True)
+        return None
+
+
+def encode_field(value, tokenizer, field: str, continuation: bool = False):
+    """JSONL field -> token ids: id lists pass through; strings encode
+    via the tokenizer. Prompts encode with the tokenizer's special
+    tokens (matching how serve.py encodes request text, so the trained
+    prompt distribution is the served one); continuations (chosen/
+    rejected/completions) never get BOS/EOS spliced mid-sequence."""
+    if isinstance(value, str):
+        if tokenizer is None:
+            raise ValueError(
+                f"{field!r} is text but no tokenizer is available — "
+                f"pass --hf-model, or pre-tokenize to id lists")
+        return list(tokenizer.encode(value,
+                                     add_special_tokens=not continuation))
+    return [int(t) for t in value]
+
+
 def resolve_params(model, hf_model, checkpoint_path, allow_fresh_init,
                    lora_checkpoint_path="", lora_alpha=None, seed=0,
                    label="target"):

@@ -12,6 +12,9 @@ Data format — one JSON object per line:
 
     {"prompt": [ids...]}
 
+With --hf-model the prompt may also be a raw string, encoded by the
+checkpoint's own tokenizer.
+
 Rewards (pick one):
   --reward token-match   fraction of completion tokens == --reward-token
                          (trivially learnable; smoke/CI default)
@@ -98,20 +101,24 @@ def parse_args(argv=None):
     return args
 
 
-def load_prompts(path: str, limit_len: int):
+def load_prompts(path: str, limit_len: int, tokenizer=None):
     """JSONL -> list of id-lists; prompts longer than limit_len are
-    skipped with a count."""
+    skipped with a count. Prompts may be id lists or (with a tokenizer
+    from --hf-model) raw strings."""
+    from kubedl_tpu.train.generate import encode_field
+
     prompts, skipped = [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
-            ids = json.loads(line)["prompt"]
+            ids = encode_field(json.loads(line)["prompt"], tokenizer,
+                               "prompt")
             if not ids or len(ids) > limit_len:
                 skipped += 1
                 continue
-            prompts.append([int(t) for t in ids])
+            prompts.append(ids)
     if skipped:
         print(f"data: skipped {skipped} prompts over {limit_len} tokens",
               flush=True)
@@ -160,10 +167,14 @@ def main(argv=None) -> int:
     from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh_from_env
     from kubedl_tpu.train.rl import group_advantages, make_grpo_step
 
+    tokenizer = None
     if args.hf_model:
         from kubedl_tpu.models.import_hf import load_hf
 
         base, config = load_hf(args.hf_model)
+        from kubedl_tpu.train.generate import load_tokenizer
+
+        tokenizer = load_tokenizer(args.hf_model)
     else:
         config = llama.LlamaConfig.config_for(args.model)
         from kubedl_tpu.train.generate import restore_or_init
@@ -198,7 +209,8 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
     max_prompt = config.max_seq_len - args.max_new_tokens
     if args.data_path:
-        prompts = load_prompts(args.data_path, max_prompt)
+        prompts = load_prompts(args.data_path, max_prompt,
+                                tokenizer=tokenizer)
         print(f"data: {len(prompts)} prompts from {args.data_path}", flush=True)
     else:
         n = max(args.prompts_per_step * 4, 16)

@@ -490,14 +490,9 @@ def main(argv=None) -> int:
         lora_alpha=args.lora_alpha)
     if params is None:
         return 1
-    tokenizer = None
-    if args.hf_model:
-        try:
-            import transformers
+    from kubedl_tpu.train.generate import load_tokenizer
 
-            tokenizer = transformers.AutoTokenizer.from_pretrained(args.hf_model)
-        except Exception as e:  # noqa: BLE001 — token-id API still works
-            print(f"no tokenizer loaded ({e}); token-id API only", flush=True)
+    tokenizer = load_tokenizer(args.hf_model)
     if args.int8:
         from kubedl_tpu.models import quant
 

@@ -262,3 +262,39 @@ def test_grpo_cli_fresh_init_guard(tmp_path):
         "--ref-checkpoint-path", str(tmp_path / "nope"),
     ])
     assert rc == 1
+
+
+def test_text_data_via_tokenizer(tmp_path):
+    """JSONL fields may be raw strings when a tokenizer is available;
+    without one they refuse loudly (no silent ord() garbage)."""
+    import json as _json
+
+    transformers = pytest.importorskip("transformers")
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    from kubedl_tpu.train.dpo import load_pairs
+    from kubedl_tpu.train.grpo import load_prompts
+
+    vocab = {"<unk>": 0, "hello": 1, "tpu": 2, "world": 3, "yes": 4, "no": 5}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = transformers.PreTrainedTokenizerFast(tokenizer_object=tok,
+                                                unk_token="<unk>")
+
+    prompts = tmp_path / "p.jsonl"
+    prompts.write_text(
+        _json.dumps({"prompt": "hello tpu"}) + "\n"
+        + _json.dumps({"prompt": [3, 2]}) + "\n")  # ids mix fine
+    out = load_prompts(str(prompts), 16, tokenizer=fast)
+    assert out == [[1, 2], [3, 2]]
+    with pytest.raises(ValueError, match="tokenizer"):
+        load_prompts(str(prompts), 16)
+
+    pairs = tmp_path / "d.jsonl"
+    pairs.write_text(_json.dumps(
+        {"prompt": "hello", "chosen": "yes tpu", "rejected": "no"}) + "\n")
+    toks, plens, slens = load_pairs(str(pairs), 8, tokenizer=fast)
+    assert plens.tolist() == [1] and slens.tolist() == [[3, 2]]
+    assert toks[0, 0, :3].tolist() == [1, 4, 2]
+    assert toks[0, 1, :2].tolist() == [1, 5]
