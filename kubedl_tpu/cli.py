@@ -226,19 +226,80 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_describe(args) -> int:
+    """kubectl-describe-style view of one job: metadata, replica specs,
+    the condition machine's history, replica statuses, and the job's
+    events — the triage view `get` (one JSON blob) doesn't give."""
+    obj = _client_request(
+        args, "GET", f"/apis/{args.kind}/{args.namespace}/{args.name}")
+    if obj is None:
+        return 1
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    print(f"Name:      {meta.get('name', '')}")
+    print(f"Namespace: {meta.get('namespace', '')}")
+    print(f"Kind:      {obj.get('kind', args.kind)}")
+    print(f"Created:   {meta.get('creationTimestamp', '')}")
+    print(f"Status:    {_job_phase(status)}")
+    replica_key = next((k for k in spec if k.endswith("ReplicaSpecs")), None)
+    if replica_key:
+        print("Replicas:")
+        for rtype, rspec in sorted((spec.get(replica_key) or {}).items()):
+            rstat = (status.get("replicaStatuses") or {}).get(rtype) or {}
+            print(f"  {rtype}: {rspec.get('replicas', 1)} desired | "
+                  f"{rstat.get('active', 0)} active, "
+                  f"{rstat.get('succeeded', 0)} succeeded, "
+                  f"{rstat.get('failed', 0)} failed "
+                  f"(restart {rspec.get('restartPolicy', '')})")
+    conds = status.get("conditions") or []
+    if conds:
+        print("Conditions:")
+        rows = [("TYPE", "STATUS", "REASON", "LAST TRANSITION", "MESSAGE")]
+        for c in conds:
+            rows.append((c.get("type", ""), c.get("status", ""),
+                         c.get("reason", ""),
+                         c.get("lastTransitionTime", ""),
+                         c.get("message", "")))
+        _print_table(rows)
+    listing = _client_request(args, "GET", f"/events/{args.namespace}")
+    if listing is not None:
+        kind = obj.get("kind") or args.kind
+        rows = _event_rows(listing, only_kind=kind, only_name=args.name,
+                           with_object=False)
+        if len(rows) > 1:
+            print("Events:")
+            _print_table(rows)
+    return 0
+
+
+def _event_rows(listing, only_kind=None, only_name=None, with_object=True):
+    """Shared event-table builder for `events` (all objects) and
+    `describe` (one object: kind AND name must match — a same-named
+    object of another kind must not pollute the triage view)."""
+    header = (("TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")
+              if with_object else ("TYPE", "REASON", "COUNT", "MESSAGE"))
+    rows = [header]
+    for e in listing.get("items", []):
+        inv = e.get("involvedObject") or e.get("involved_object") or {}
+        if only_name is not None and inv.get("name") != only_name:
+            continue
+        if (only_kind is not None
+                and (inv.get("kind") or "").lower() != only_kind.lower()):
+            continue
+        row = [e.get("type", ""), e.get("reason", "")]
+        if with_object:
+            row.append(f"{inv.get('kind', '')}/{inv.get('name', '')}")
+        row += [e.get("count", 1), e.get("message", "")]
+        rows.append(tuple(row))
+    return rows
+
+
 def cmd_events(args) -> int:
     listing = _client_request(args, "GET", f"/events/{args.namespace}")
     if listing is None:
         return 1
-    rows = [("TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE")]
-    for e in listing.get("items", []):
-        inv = e.get("involvedObject") or e.get("involved_object") or {}
-        rows.append((
-            e.get("type", ""), e.get("reason", ""),
-            f"{inv.get('kind', '')}/{inv.get('name', '')}",
-            e.get("count", 1), e.get("message", ""),
-        ))
-    _print_table(rows)
+    _print_table(_event_rows(listing))
     return 0
 
 
@@ -492,6 +553,12 @@ def main(argv=None) -> int:
     p_logs.add_argument("-c", "--container", default="")
     p_logs.add_argument("--tail", type=int, default=None)
     p_logs.set_defaults(fn=cmd_logs)
+
+    p_desc = client_parser(
+        "describe", "conditions, replica statuses, and events for one job")
+    p_desc.add_argument("kind")
+    p_desc.add_argument("name")
+    p_desc.set_defaults(fn=cmd_describe)
 
     p_ev = client_parser("events", "list events in a namespace")
     p_ev.set_defaults(fn=cmd_events)

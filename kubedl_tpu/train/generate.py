@@ -113,7 +113,9 @@ def encode_field(value, tokenizer, field: str, continuation: bool = False):
         if tokenizer is None:
             raise ValueError(
                 f"{field!r} is text but no tokenizer is available — "
-                f"pass --hf-model, or pre-tokenize to id lists")
+                f"pass --hf-model (and check the 'no tokenizer loaded' "
+                f"warning if you already did), or pre-tokenize to id "
+                f"lists")
         return list(tokenizer.encode(value,
                                      add_special_tokens=not continuation))
     return [int(t) for t in value]

@@ -211,3 +211,26 @@ def test_get_watch_reports_deletion(server, tmp_path, capsys, monkeypatch):
     assert cli_main(["get", "jaxjob", "--server", url, "-w"]) == 0
     out = capsys.readouterr().out
     assert "Deleted" in out, out
+
+
+def test_describe_shows_conditions_replicas_events(server, tmp_path, capsys):
+    op, url = server
+    path = _manifest_file(tmp_path, name="desc-job")
+    assert cli_main(["apply", "--server", url, "-f", path]) == 0
+    job = op.get_job("JAXJob", "default", "desc-job")
+    assert op.wait_for_condition(job, "Succeeded", timeout=60)
+    capsys.readouterr()
+
+    assert cli_main(["describe", "jaxjob", "desc-job", "--server", url]) == 0
+    out = capsys.readouterr().out
+    assert "Name:      desc-job" in out
+    assert "Status:    Succeeded" in out
+    # replica spec + tallied statuses
+    assert "Worker: 1 desired" in out and "1 succeeded" in out
+    # the condition machine's history, not just the phase
+    assert "Conditions:" in out and "Created" in out and "Succeeded" in out
+    # only THIS job's events
+    assert "Events:" in out and "SuccessfulCreatePod" in out
+
+    # unknown job is a plain error, not a traceback
+    assert cli_main(["describe", "jaxjob", "nope", "--server", url]) == 1
