@@ -746,4 +746,6 @@ class ServingEngine:
             "tokens_out": self._tokens_out,
             "tokens_per_sec": self._tokens_out / wall,
             "slot_utilization": busy / self.slots,
+            "adapters_registered": len(self._adapter_rows),
+            "prefixes_registered": len(self._prefixes),
         }
