@@ -1,6 +1,6 @@
 # Targets mirror the reference's Makefile:15-56 (test/manifests/install/
 # deploy/docker-build) for a Python operator.
-IMG ?= kubedl-tpu/operator:v0.1.0
+IMG ?= kubedl-tpu/operator:v0.2.0
 PY ?= python
 
 .PHONY: test

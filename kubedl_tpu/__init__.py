@@ -30,4 +30,4 @@ Layout (mirrors the reference's layer map, SURVEY.md §1, re-designed TPU-first)
   utils/        serde, exit codes, logging
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
