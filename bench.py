@@ -822,9 +822,22 @@ def _collect_results(results_path: str):
 
     snapshot = _parse_results(SNAPSHOT_PATH)
     for key, rec in snapshot.items():
-        if key in ("done", "progress", "watchdog") or live_ok(key):
-            continue  # run-lifecycle records describe THAT run, not this one
-        extras[key] = {**rec, "from_snapshot": True}
+        # run-lifecycle records describe THAT run, not this one — in
+        # particular a live probe FAILURE (wedged dial) must stay
+        # visible, not be papered over by the snapshot's happy dial
+        if key in ("done", "progress", "watchdog", "probe") or live_ok(key):
+            continue
+        merged = {**rec, "from_snapshot": True}
+        live_rec = extras.get(key)
+        # a milestone that FAILED live still backfills, but carries the
+        # live failure alongside — the diagnostic must not vanish under
+        # the snapshot's happy numbers
+        if isinstance(live_rec, dict):
+            if "error" in live_rec:
+                merged["live_error"] = live_rec["error"]
+            elif "skipped" in live_rec:
+                merged["live_skipped"] = live_rec["skipped"]
+        extras[key] = merged
     # the LIVE run's "progress" record stays in extras deliberately: its
     # last-write value names the furthest milestone the child reached,
     # which is the first diagnostic to read when milestones are missing
