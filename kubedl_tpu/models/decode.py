@@ -531,13 +531,14 @@ def prefill(
         lengths = jnp.full((b,), t, jnp.int32)
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
 
-    if c.use_flash and not c.attn_logit_softcap:
-        from kubedl_tpu.ops.flash_attention import flash_attention as _attn
-    else:
-        # softcapped configs (Gemma-2) take the XLA path — the Pallas
-        # kernel's online softmax doesn't model the tanh transform
-        import functools
+    import functools
 
+    if c.use_flash:
+        from kubedl_tpu.ops.flash_attention import flash_attention
+
+        _attn = functools.partial(
+            flash_attention, softcap=c.attn_logit_softcap or None)
+    else:
         from kubedl_tpu.ops.flash_attention import attention_reference
 
         _attn = functools.partial(

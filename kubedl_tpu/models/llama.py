@@ -88,9 +88,10 @@ class LlamaConfig:
     # sandwich norms: extra RMSNorm on the attention and FFN OUTPUTS
     # before their residual adds (post_attn_norm / post_mlp_norm params)
     post_block_norms: bool = False
-    # logit softcapping: x -> cap * tanh(x / cap); 0 = off. The
-    # attention cap forces the XLA attention path (the Pallas flash
-    # kernel's online-softmax VJP doesn't model the tanh transform).
+    # logit softcapping: x -> cap * tanh(x / cap); 0 = off. The Pallas
+    # flash kernel applies the attention cap natively (forward and VJP);
+    # context parallelism still refuses it (uncapped online softmax in
+    # the ring/all-to-all paths).
     attn_logit_softcap: float = 0.0
     final_logit_softcap: float = 0.0
     # attention scores scale by query_pre_attn_scalar**-0.5 instead of
@@ -455,11 +456,10 @@ def _attention_block(x, layer, config: LlamaConfig, positions, mesh, rules,
                 q, k, v, mesh=mesh, causal=True, use_flash=config.use_flash)
         else:
             attn = ring_attention(q, k, v, mesh=mesh, causal=True)
-    elif config.use_flash and not config.attn_logit_softcap:
-        attn = flash_attention(q, k, v, causal=True, window=window)
+    elif config.use_flash:
+        attn = flash_attention(q, k, v, causal=True, window=window,
+                               softcap=config.attn_logit_softcap or None)
     else:
-        # softcapped configs (Gemma-2) take the XLA path: the Pallas
-        # kernel's online-softmax VJP doesn't model the tanh transform
         from kubedl_tpu.ops.flash_attention import attention_reference
 
         attn = attention_reference(q, k, v, causal=True, window=window,

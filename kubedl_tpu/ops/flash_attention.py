@@ -73,12 +73,23 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask):
+def _softcap_scores(s, cap):
+    """cap * tanh(s / cap) — Gemma-2 logit softcapping, the ONE place
+    the transform lives. Backward sites derive its gradient from the
+    CAPPED value: d/ds = 1 - tanh(s/cap)^2 = 1 - (capped/cap)^2."""
+    return jnp.tanh(s / cap) * cap
+
+
+def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask, softcap=None):
     """One K-block update of the online-softmax state (m, l, acc) — the
-    shared numerics of the default and streamed forward kernels."""
+    shared numerics of the default and streamed forward kernels.
+    softcap (Gemma-2): cap*tanh(s/cap) on the scaled scores, applied
+    before masking, exactly as in attention_reference."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
+    if softcap is not None:
+        s = _softcap_scores(s, softcap)
     s = jnp.where(mask, s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -92,7 +103,7 @@ def _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
-                window, block_q, block_k, seq_len):
+                window, block_q, block_k, seq_len, softcap):
     qb = pl.program_id(1)
     # Keep q/k/v in their storage dtype (bf16): the MXU runs bf16 x bf16 ->
     # f32 at full rate, while f32 inputs drop it several-fold. All
@@ -125,7 +136,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
             mask = mask & (k_pos <= q_pos)
         if window is not None:
             mask = mask & (k_pos > q_pos - window)
-        return _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask)
+        return _online_softmax_step(q, k, v, m, l, acc, sm_scale, mask,
+                                    softcap)
 
     m, l, acc = jax.lax.fori_loop(start_kb, num_kb, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
@@ -135,18 +147,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
-def _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
+def _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len,
+         softcap=None):
     bh, seq, d = q.shape
     # dispatch on the TRUE length: lcm padding of mixed block sizes must
     # not shift the documented threshold
     if true_len > STREAM_MIN_SEQ:
         return _fwd_streamed(q, k, v, sm_scale, causal, window, block_q,
-                             block_k, true_len)
+                             block_k, true_len, softcap=softcap)
     grid = (bh, pl.cdiv(seq, block_q))
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, seq_len=true_len,
+            softcap=softcap,
         ),
         grid=grid,
         in_specs=[
@@ -174,7 +188,7 @@ def _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
 
 def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
                          *, sm_scale, causal, window, block_q, block_k,
-                         seq_len, n_kb):
+                         seq_len, n_kb, softcap):
     """K-streaming variant: grid (bh, q_blocks, k_blocks); K/V arrive one
     block per grid step via BlockSpecs (double-buffered by Mosaic), and the
     online-softmax state lives in VMEM scratch across the kb dimension.
@@ -216,7 +230,7 @@ def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         if window is not None:
             mask = mask & (k_pos > q_pos - window)
         m_new, l, acc = _online_softmax_step(
-            q, k, v, m_s[...], l_s[...], acc_s[...], sm_scale, mask
+            q, k, v, m_s[...], l_s[...], acc_s[...], sm_scale, mask, softcap
         )
         m_s[...] = m_new
         l_s[...] = l
@@ -229,7 +243,8 @@ def _fwd_streamed_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         lse_ref[0, 0] = (m_s[...] + jnp.log(l))[:, 0]
 
 
-def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k, true_len):
+def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k,
+                  true_len, softcap=None):
     bh, seq, d = q.shape
     n_kb = pl.cdiv(seq, block_k)
     grid = (bh, pl.cdiv(seq, block_q), n_kb)
@@ -237,7 +252,7 @@ def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
         functools.partial(
             _fwd_streamed_kernel, sm_scale=sm_scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k,
-            seq_len=true_len, n_kb=n_kb,
+            seq_len=true_len, n_kb=n_kb, softcap=softcap,
         ),
         grid=grid,
         in_specs=[
@@ -272,7 +287,8 @@ def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, sm_scale, causal, window, block_q, block_k, seq_len):
+                   *, sm_scale, causal, window, block_q, block_k, seq_len,
+                   softcap):
     qb = pl.program_id(1)
     q = q_ref[0]  # bf16 into the MXU; f32 accumulation
     do = do_ref[0]
@@ -292,6 +308,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if softcap is not None:
+            s = _softcap_scores(s, softcap)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
@@ -302,6 +320,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
+        if softcap is not None:
+            # d/dx[cap*tanh(x/cap)] = 1 - tanh(x/cap)^2 = 1 - (s/cap)^2
+            ds = ds * (1.0 - (s / softcap) ** 2)
         return dq + jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -311,7 +332,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                    *, sm_scale, causal, window, block_q, block_k, seq_len):
+                    *, sm_scale, causal, window, block_q, block_k, seq_len,
+                    softcap):
     kb = pl.program_id(1)
     k = k_ref[0]  # bf16 into the MXU; f32 accumulation
     v = v_ref[0]
@@ -335,6 +357,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * sm_scale
+        if softcap is not None:
+            s = _softcap_scores(s, softcap)
         q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         mask = (k_pos < seq_len) & (q_pos < seq_len)
         if causal:
@@ -348,6 +372,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
+        if softcap is not None:
+            # d/dx[cap*tanh(x/cap)] = 1 - tanh(x/cap)^2 = 1 - (s/cap)^2
+            ds = ds * (1.0 - (s / softcap) ** 2)
         dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
@@ -359,7 +386,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout):
+def _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout,
+         softcap=None):
     q, k, v, out, lse = res
     bh, seq, d = q.shape
     # [bh, 1, seq] to match the lse layout (TPU-tileable blocks)
@@ -368,7 +396,8 @@ def _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout):
     )[:, None, :]
 
     kern = dict(sm_scale=sm_scale, causal=causal, window=window,
-                block_q=block_q, block_k=block_k, seq_len=true_len)
+                block_q=block_q, block_k=block_k, seq_len=true_len,
+                softcap=softcap)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **kern),
         grid=(bh, pl.cdiv(seq, block_q)),
@@ -421,14 +450,18 @@ def _pad_d(x, dk):
     return x
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
-    out, _ = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, sm_scale, causal, window, block_q, block_k, true_len,
+           true_d, softcap):
+    out, _ = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                  true_len, softcap=softcap)
     return out
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, true_d):
-    out, lse = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len)
+def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len,
+               true_d, softcap):
+    out, lse = _fwd(q, k, v, sm_scale, causal, window, block_q, block_k,
+                    true_len, softcap=softcap)
     # Residuals store only the true head dim: padded columns are zeros by
     # construction, so slicing here and re-padding in backward is exact —
     # and halves attention residual HBM for d=64 models.
@@ -445,7 +478,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, window, block_q, block_k, true_len, tr
 BWD_MAX_SEQ = 8192
 
 
-def _flash_bwd(sm_scale, causal, window, block_q, block_k, true_len, true_d, res, dout):
+def _flash_bwd(sm_scale, causal, window, block_q, block_k, true_len, true_d,
+               softcap, res, dout):
     dk_width = dout.shape[-1]
     q, k, v, out, lse = res
     if true_len > BWD_MAX_SEQ:
@@ -460,7 +494,8 @@ def _flash_bwd(sm_scale, causal, window, block_q, block_k, true_len, true_d, res
         _pad_d(q, dk_width), _pad_d(k, dk_width), _pad_d(v, dk_width),
         _pad_d(out, dk_width), lse,
     )
-    return _bwd(sm_scale, causal, window, block_q, block_k, true_len, res, dout)
+    return _bwd(sm_scale, causal, window, block_q, block_k, true_len, res,
+                dout, softcap=softcap)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -495,6 +530,7 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     min_seq: Optional[int] = None,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Blocked attention over [batch, q_heads, seq, head_dim] tensors.
 
@@ -504,6 +540,10 @@ def flash_attention(
     window: sliding-window (Mistral-style) attention — query i attends
     keys in (i - window, i]. Requires causal=True. Dead K blocks are
     skipped in both directions, so compute scales with window, not seq.
+
+    softcap (Gemma-2): cap*tanh(s/cap) on the scaled scores before
+    masking, applied inside the kernel (forward AND the custom VJP —
+    the backward multiplies dS by 1 - (s_capped/cap)^2).
 
     min_seq overrides the measured fused-vs-unfused crossover (default
     FLASH_MIN_SEQ, swept on v5e): pass 0 to prefer the fused kernel at
@@ -520,6 +560,8 @@ def flash_attention(
                              "is a causal-attention concept)")
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
+    if softcap is not None and softcap <= 0:
+        raise ValueError(f"softcap must be > 0 or None, got {softcap}")
     if hq != hkv:
         if hq % hkv:
             raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
@@ -538,7 +580,7 @@ def flash_attention(
     # a hardware constraint, not a degradation a caller could fix)
     if not _interpret() and (sq < min_seq or sq < 128):
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                                   window=window)
+                                   window=window, softcap=softcap)
 
     # Lane-align the head dim by zero-padding to the next multiple of 128
     # (ViT-class 64, GQA oddballs): zero K columns add nothing to QK^T,
@@ -571,7 +613,7 @@ def flash_attention(
         _warn_unfused_fallback(d, block_q, block_k)
         return attention_reference(
             q[..., :d], k[..., :d], v[..., :d], causal=causal,
-            sm_scale=sm_scale, window=window,
+            sm_scale=sm_scale, window=window, softcap=softcap,
         )
 
     # The whole-sequence kernels (fwd at <= STREAM_MIN_SEQ, bwd always)
@@ -595,7 +637,8 @@ def flash_attention(
     qf = _pad_seq_to(q.reshape(b * hq, sq, dk), target)
     kf = _pad_seq_to(k.reshape(b * hq, sq, dk), target)
     vf = _pad_seq_to(v.reshape(b * hq, sq, dk), target)
-    out = _flash(qf, kf, vf, sm_scale, causal, window, block_q, block_k, sq, d)
+    out = _flash(qf, kf, vf, sm_scale, causal, window, block_q, block_k,
+                 sq, d, softcap)
     return out[:, :sq, :d].reshape(b, hq, sq, d)
 
 
@@ -617,7 +660,7 @@ def attention_reference(q, k, v, *, causal: bool = True,
         sm_scale = 1.0 / (d**0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * sm_scale
     if softcap is not None:
-        s = jnp.tanh(s / softcap) * softcap
+        s = _softcap_scores(s, softcap)
     if causal:
         mask = np.tril(np.ones((sq, sq), bool))
         if window is not None:

@@ -546,3 +546,17 @@ def test_gemma2_greedy_decode_matches_teacher_forced(gemma2_pair):
         params, jnp.asarray(prompt), config, max_new_tokens=6,
         max_len=20)))[0]
     np.testing.assert_array_equal(ours, toks[0, 14:])
+
+
+def test_gemma2_flash_kernel_matches_xla_path(gemma2_pair):
+    """The Pallas kernel's native softcap: a Gemma-2 forward with
+    use_flash=True matches the XLA reference path."""
+    import dataclasses
+
+    _, params, config = gemma2_pair
+    flash_cfg = dataclasses.replace(config, use_flash=True)
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, config.vocab_size, size=(2, 24))
+    ref = np.asarray(llama.forward(params, jnp.asarray(tokens), config))
+    out = np.asarray(llama.forward(params, jnp.asarray(tokens), flash_cfg))
+    np.testing.assert_allclose(out, ref, atol=3e-4, rtol=3e-3)
