@@ -26,7 +26,6 @@ from kubedl_tpu.models.llama import (
     LlamaConfig,
     _lm_head,
     _mlp_block,
-    _mm,
     _proj,
     _rope,
     rms_norm,
