@@ -28,6 +28,19 @@ def ref_generate(params, config, prompt, n):
     return [int(t) for t in np.asarray(jax.device_get(toks))[0]]
 
 
+def ref_logprobs(params, config, prompt, tokens):
+    """Teacher-forced per-token logprobs of `tokens` continuing `prompt`
+    under the given weights — numerically careful log-softmax in f64."""
+    full = np.concatenate([np.asarray(prompt, np.int32),
+                           np.asarray(tokens, np.int32)])
+    logits = np.asarray(llama.forward(
+        params, jnp.asarray(full[None, :]), config)).astype(np.float64)
+    logp = logits - np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True))         - logits.max(-1, keepdims=True)
+    start = len(prompt) - 1
+    return [float(logp[0, start + i, t]) for i, t in enumerate(tokens)]
+
+
 def test_bucket_selection():
     assert _bucket(3, [16, 32]) == 16
     assert _bucket(16, [16, 32]) == 16
@@ -341,17 +354,9 @@ def test_logprobs_match_teacher_forced_forward(model):
     assert len(req.token_logprobs) == 5
     assert not other.token_logprobs  # opt-in only
 
-    from kubedl_tpu.models import llama
-
-    full = np.concatenate([prompt, np.asarray(req.tokens, np.int32)])
-    logits = np.asarray(llama.forward(
-        params, jnp.asarray(full[None, :]), config)).astype(np.float64)
-    logp = logits - np.log(np.exp(
-        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
-        - logits.max(-1, keepdims=True)
-    for i, (t, lp) in enumerate(zip(req.tokens, req.token_logprobs)):
-        pos = len(prompt) - 1 + i  # logits at pos predict token at pos+1
-        assert lp == pytest.approx(float(logp[0, pos, t]), abs=2e-4), i
+    ref_lp = ref_logprobs(params, config, prompt, req.tokens)
+    for i, (lp, want) in enumerate(zip(req.token_logprobs, ref_lp)):
+        assert lp == pytest.approx(want, abs=2e-4), i
 
 
 def test_multi_lora_per_request_parity(model):
@@ -447,3 +452,46 @@ def test_lora_dimension_validation(model):
     assert eng.register_adapter(good) == 1  # registry still clean
     # stacks live in the model dtype (per-tick gather bandwidth)
     assert eng.lora["layers"][0]["wq"]["a"].dtype == config.dtype
+
+
+def test_adapters_sampling_logprobs_compose(model):
+    """The session's serving features interact in one batch: a greedy
+    base request with logprobs, a top_k=1 adapter request (deterministic
+    despite temp>0), and a nucleus-sampled base request — slot state
+    stays per-request across all three axes."""
+    from kubedl_tpu.models import lora
+
+    params, config = model
+    rng = np.random.default_rng(31)
+    ad = jax.tree.map(
+        lambda x: jnp.asarray(rng.normal(size=x.shape) * 0.05, jnp.float32),
+        lora.lora_init(jax.random.PRNGKey(3), params, rank=4,
+                       targets=("wq", "w2")))
+    eng = ServingEngine(params, config, slots=3, max_len=64)
+    aid = eng.register_adapter(ad)
+
+    p1 = rng.integers(1, config.vocab_size, size=6).astype(np.int32)
+    p2 = rng.integers(1, config.vocab_size, size=9).astype(np.int32)
+    p3 = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
+    r1 = eng.submit(p1, 5, logprobs=True)                     # greedy base
+    r2 = eng.submit(p2, 5, adapter_id=aid, temperature=3.0,
+                    top_k=1, logprobs=True)                   # pinned adapter
+    r3 = eng.submit(p3, 5, temperature=1.0, top_p=0.9)        # sampled base
+    while not (r1.done and r2.done and r3.done):
+        eng.step_block()
+
+    assert r1.tokens == ref_generate(params, config, p1, 5)
+    merged = lora.merge(params, ad)
+    assert r2.tokens == ref_generate(merged, config, p2, 5)
+    # logprobs: r1's match the BASE model's teacher-forced forward,
+    # r2's match the ADAPTER model's — per-slot weights all the way
+    # through to the reported distribution
+    for lp, want in zip(r1.token_logprobs,
+                        ref_logprobs(params, config, p1, r1.tokens)):
+        assert lp == pytest.approx(want, abs=2e-4)
+    for lp, want in zip(r2.token_logprobs,
+                        ref_logprobs(merged, config, p2, r2.tokens)):
+        assert lp == pytest.approx(want, abs=2e-4)
+    assert len(r2.token_logprobs) == 5
+    assert not r3.token_logprobs  # logprobs stay opt-in per request
+    assert len(r3.tokens) == 5
