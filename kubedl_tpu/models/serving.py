@@ -65,6 +65,11 @@ class Request:
     logprobs: bool = False
     # LoRA adapter id from engine.register_adapter (0 = base model)
     adapter_id: int = 0
+    # multi-token stop sequences (OpenAI "stop"): generation ends when
+    # the tail of the output matches any of them; the matched sequence
+    # is trimmed from the result (eos_token handles the single-token
+    # natural stop)
+    stop_sequences: tuple = ()
     # filled by the engine
     tokens: List[int] = field(default_factory=list)
     token_logprobs: List[float] = field(default_factory=list)
@@ -472,6 +477,7 @@ class ServingEngine:
         top_p: float = 1.0,
         logprobs: bool = False,
         adapter_id: int = 0,
+        stop: Optional[list] = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if temperature is not None and temperature < 0:
@@ -493,6 +499,17 @@ class ServingEngine:
             # reusing it under an adapter would silently mix models
             raise ValueError("adapter_id cannot combine with prefix_id "
                              "(prefix K/V is base-model state)")
+        stop_seqs = []
+        for s in (stop or []):
+            ids = [int(t) for t in s]
+            if not ids:
+                raise ValueError("empty stop sequence")
+            if len(ids) > 16:
+                raise ValueError(
+                    f"stop sequence of {len(ids)} tokens (max 16)")
+            stop_seqs.append(tuple(ids))
+        if len(stop_seqs) > 4:
+            raise ValueError(f"{len(stop_seqs)} stop sequences (max 4)")
         if prompt.size == 0:
             raise ValueError("empty prompt (with a prefix, pass at least "
                              "the first suffix token)")
@@ -515,7 +532,8 @@ class ServingEngine:
                       temperature=(self.temperature if temperature is None
                                    else float(temperature)),
                       top_k=int(top_k), top_p=float(top_p),
-                      logprobs=bool(logprobs), adapter_id=int(adapter_id))
+                      logprobs=bool(logprobs), adapter_id=int(adapter_id),
+                      stop_sequences=tuple(stop_seqs))
         self._next_id += 1
         self._queue.append(req)
         return req
@@ -606,8 +624,20 @@ class ServingEngine:
             req.token_logprobs.append(logprob)
         req.tokens.append(token)
         self._tokens_out += 1
+        hit_stop = False
+        for seq in req.stop_sequences:
+            n = len(seq)
+            if len(req.tokens) >= n and tuple(req.tokens[-n:]) == seq:
+                # OpenAI convention: the matched stop sequence is
+                # excluded from the result
+                del req.tokens[-n:]
+                if req.logprobs:
+                    del req.token_logprobs[-n:]
+                hit_stop = True
+                break
         if (
-            len(req.tokens) >= req.max_new_tokens
+            hit_stop
+            or len(req.tokens) >= req.max_new_tokens
             or (req.eos_token is not None and token == req.eos_token)
         ):
             req.done = True
@@ -689,8 +719,8 @@ class ServingEngine:
             return 0
         k = min(r.max_new_tokens - len(r.tokens) for r in reqs)
         k = min(k, max_block)
-        if any(r.eos_token is not None for r in reqs):
-            k = min(k, 8)  # post-EOS ticks are pure waste; stay short
+        if any(r.eos_token is not None or r.stop_sequences for r in reqs):
+            k = min(k, 8)  # post-EOS/stop ticks are pure waste; stay short
         elif self._queue:
             # a slot freed mid-block can't admit; bound the wait without
             # giving back the sync savings

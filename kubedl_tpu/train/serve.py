@@ -127,14 +127,14 @@ class _Service:
                prefix_id: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: int = 0, top_p: float = 1.0,
-               logprobs: bool = False, adapter_id: int = 0):
+               logprobs: bool = False, adapter_id: int = 0, stop=None):
         with self._lock:
             req = self.engine.submit(prompt, max_new_tokens, eos_token,
                                      prefix_id=prefix_id,
                                      temperature=temperature,
                                      top_k=top_k, top_p=top_p,
                                      logprobs=logprobs,
-                                     adapter_id=adapter_id)
+                                     adapter_id=adapter_id, stop=stop)
         self._work.set()
         return req
 
@@ -181,6 +181,37 @@ class _Service:
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+def _parse_stop(value, tok):
+    """"stop" field -> list of token-id sequences. Accepts one string, a
+    list of strings (tokenizer required; encoded without special
+    tokens), or a list of id-lists — the OpenAI surface adapted to the
+    token-id API.
+
+    String stops are encoded ONCE and matched at token level: a
+    tokenizer that merges context differently (leading-space variants)
+    can produce output text containing the string without the token
+    tail ever matching. Pass token-id lists for exact control."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = [value]
+    if not isinstance(value, list):
+        raise ValueError("stop must be a string or a list")
+    out = []
+    for s in value:
+        if isinstance(s, str):
+            if tok is None:
+                raise ValueError("string stop sequences need a tokenizer "
+                                 "— start the server with --hf-model, or "
+                                 "pass token-id lists")
+            out.append(tok.encode(s, add_special_tokens=False))
+        elif isinstance(s, list):
+            out.append([int(t) for t in s])
+        else:
+            raise ValueError("each stop entry must be a string or id list")
+    return out
 
 
 def _parse_bool(value, field: str) -> bool:
@@ -296,15 +327,24 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.close_connection = True
         sent = 0
+        # stop sequences trim the token tail when they match, so (a) any
+        # token still within the longest stop's reach is HELD BACK until
+        # the request finishes (else the stream would leak a partial
+        # match the final result excludes), and (b) that same margin
+        # keeps `sent` out of the region _emit may delete, preserving
+        # the unlocked reader's safety
+        margin = max((len(s) for s in req.stop_sequences), default=0)
         deadline = _time.monotonic() + timeout
         try:
             while True:
                 done = req.done  # read BEFORE draining: no lost-wakeup
                 toks = list(req.tokens)
-                while sent < len(toks):
+                lps = list(req.token_logprobs)
+                limit = len(toks) if done else max(len(toks) - margin, 0)
+                while sent < limit:
                     event = {"token": toks[sent], "request_id": req.request_id}
-                    if req.logprobs and sent < len(req.token_logprobs):
-                        event["logprob"] = req.token_logprobs[sent]
+                    if req.logprobs and sent < len(lps):
+                        event["logprob"] = lps[sent]
                     if dec is not None:
                         event["text_delta"] = dec.push(toks[sent])
                     self.wfile.write(
@@ -449,6 +489,7 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=1.0 if top_p is None else float(top_p),
                     logprobs=_parse_bool(e.get("logprobs"), "logprobs"),
                     adapter_id=int(e.get("adapter_id") or 0),
+                    stop=_parse_stop(e.get("stop"), tok),
                 ))
         except (ValueError, TypeError) as e:
             # partially-submitted batch: release what already went in

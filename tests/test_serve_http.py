@@ -472,3 +472,43 @@ def test_startup_adapter_flag(tmp_path):
         ["--model", "tiny", "--slots", "2", "--max-len", "32",
          "--adapter", str(tmp_path / "missing"),
          "--bind", "127.0.0.1", "--port", "18787"]) == 1
+
+
+def test_stop_sequences_over_http(server):
+    """"stop" rides the wire as id lists; string stops without a
+    tokenizer are a 422."""
+    base, _ = server
+    full = _post(f"{base}/generate",
+                 {"tokens": [1, 2, 3], "max_new_tokens": 8})
+    stop = full["tokens"][2:4]
+    out = _post(f"{base}/generate",
+                {"tokens": [1, 2, 3], "max_new_tokens": 8, "stop": [stop]})
+    assert out["tokens"] == full["tokens"][:2]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(f"{base}/generate",
+              {"tokens": [1, 2], "max_new_tokens": 2, "stop": "world"})
+    assert exc.value.code == 422
+
+
+def test_streaming_with_stop_never_leaks_partial_match(server):
+    """SSE + stop: streamed per-token events exclude anything the final
+    result trims — the concatenated stream equals the final tokens."""
+    base, _ = server
+    full = _post(f"{base}/generate",
+                 {"tokens": [2, 7, 1], "max_new_tokens": 8})
+    stop = full["tokens"][3:5]
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"tokens": [2, 7, 1], "max_new_tokens": 8,
+                         "stop": [stop], "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for raw in r:
+            raw = raw.strip()
+            if raw.startswith(b"data: "):
+                events.append(json.loads(raw[len(b"data: "):]))
+    final = events[-1]
+    assert final["done"] and final["tokens"] == full["tokens"][:3]
+    streamed = [e["token"] for e in events[:-1]]
+    assert streamed == final["tokens"]  # no leaked stop-prefix tokens

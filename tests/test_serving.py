@@ -495,3 +495,29 @@ def test_adapters_sampling_logprobs_compose(model):
     assert len(r2.token_logprobs) == 5
     assert not r3.token_logprobs  # logprobs stay opt-in per request
     assert len(r3.tokens) == 5
+
+
+def test_stop_sequences(model):
+    """Multi-token stop sequences end generation early with the matched
+    tail trimmed (OpenAI convention), logprobs trimmed in lockstep, and
+    non-matching requests unaffected."""
+    params, config = model
+    prompt = np.arange(1, 7, dtype=np.int32)
+    full = ref_generate(params, config, prompt, 10)
+    stop = full[3:5]  # tokens 3-4 of the greedy continuation
+    eng = ServingEngine(params, config, slots=2, max_len=64)
+    r1 = eng.submit(prompt, 10, stop=[stop], logprobs=True)
+    r2 = eng.submit(prompt, 10)  # same prompt, no stop
+    while not (r1.done and r2.done):
+        eng.step_block()
+    assert r1.tokens == full[:3]  # matched stop excluded
+    assert len(r1.token_logprobs) == 3  # trimmed in lockstep
+    assert r2.tokens == full
+    assert eng.stats()["slots_busy"] == 0
+
+    with pytest.raises(ValueError, match="empty stop"):
+        eng.submit(prompt, 4, stop=[[]])
+    with pytest.raises(ValueError, match="max 16"):
+        eng.submit(prompt, 4, stop=[list(range(20))])
+    with pytest.raises(ValueError, match="max 4"):
+        eng.submit(prompt, 4, stop=[[1]] * 5)
