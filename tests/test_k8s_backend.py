@@ -498,7 +498,10 @@ def test_cache_resyncs_after_watch_stop(srv):
         assert kstore.cache.synced("Pod")
     finally:
         w.stop()
-    deadline = time.monotonic() + 30
+    # 90 s: under a fully loaded box (parallel full-suite runs) the watch
+    # thread can be starved long past the earlier 30 s before it observes
+    # the stop and marks the cache unsynced
+    deadline = time.monotonic() + 90
     while kstore.cache.synced("Pod") and time.monotonic() < deadline:
         time.sleep(0.02)
     # stale cache must not serve reads once its feeder is gone
