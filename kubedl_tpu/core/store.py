@@ -80,12 +80,26 @@ def write_status(store, obj):
 
 
 class ObjectStore:
-    def __init__(self) -> None:
+    def __init__(self, gc: bool = True) -> None:
         self._lock = threading.RLock()
         # kind -> "ns/name" -> object
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._rv = 0
         self._watchers: List["Watch"] = []
+        # -- garbage collection (ref job_controller.go:114-126: the engine
+        # sets Controller+BlockOwnerDeletion ownerRefs and the reference
+        # relies on KUBERNETES' GC to cascade-delete pods/services when a
+        # job is deleted mid-run; standalone, the store must provide the
+        # same semantics or deleting a Running job orphans live processes)
+        self._gc_enabled = gc
+        self._uids: set = set()
+        # refcount of each uid appearing in some object's ownerReferences —
+        # lets delete() skip waking the sweeper for objects nothing owns
+        # (e.g. the unboundedly accumulating Event bucket)
+        self._ref_uids: Dict[str, int] = {}
+        self._gc_wake = threading.Event()
+        self._gc_stop = False
+        self._gc_thread: Optional[threading.Thread] = None
 
     # -- helpers ---------------------------------------------------------
 
@@ -101,6 +115,86 @@ class ObjectStore:
         ev = WatchEvent(type=etype, kind=kind, obj=obj)
         for w in list(self._watchers):
             w._offer(ev)
+
+    # -- garbage collection ----------------------------------------------
+
+    def _gc_signal(self) -> None:
+        """Wake (lazily starting) the GC sweeper. Called with the lock held
+        whenever an owner uid disappears or an object arrives already
+        pointing at a missing owner (the create-after-delete race kube's
+        GC graph absorbs)."""
+        if not self._gc_enabled or self._gc_stop:
+            return
+        if self._gc_thread is None:
+            self._gc_thread = threading.Thread(
+                target=self._gc_loop, name="store-gc", daemon=True
+            )
+            self._gc_thread.start()
+        self._gc_wake.set()
+
+    def _track_refs(self, obj, sign: int) -> None:
+        """Caller holds the lock; sign is +1 (refs appear) or -1 (vanish)."""
+        for r in obj.metadata.owner_references:
+            if not r.uid:
+                continue
+            n = self._ref_uids.get(r.uid, 0) + sign
+            if n > 0:
+                self._ref_uids[r.uid] = n
+            else:
+                self._ref_uids.pop(r.uid, None)
+
+    def _gc_loop(self) -> None:
+        while not self._gc_stop:
+            self._gc_wake.wait()
+            self._gc_wake.clear()
+            if self._gc_stop:
+                return
+            try:
+                self._gc_sweep()
+            except Exception:  # noqa: BLE001 — one bad object must not
+                pass  # permanently kill cascade deletion for the store
+
+    def close(self) -> None:
+        """Stop the GC sweeper thread (if one ever started)."""
+        self._gc_stop = True
+        self._gc_wake.set()
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=2.0)
+
+    def _gc_orphans(self) -> List[Any]:
+        """Objects whose owners are all gone (kube GC semantics: a
+        dependent survives while ANY ownerRef still resolves; refs with
+        empty uids never count as live owners but also never trigger
+        collection alone — matching the apiserver's requirement that
+        ownerReferences carry uids)."""
+        out = []
+        for bucket in self._objects.values():
+            for obj in bucket.values():
+                refs = [r for r in obj.metadata.owner_references if r.uid]
+                if refs and all(r.uid not in self._uids for r in refs):
+                    out.append(obj)
+        return out
+
+    def _gc_sweep(self) -> None:
+        while True:
+            # scan AND delete under one lock hold: a victim list released
+            # to the outside can go stale (a same-named, correctly-owned
+            # object re-created in the window would be killed — kube's GC
+            # guards this with UID preconditions)
+            with self._lock:
+                victims = self._gc_orphans()
+                for obj in victims:
+                    bucket = self._objects.get(obj.kind, {})
+                    key = self._key(obj)
+                    if bucket.get(key) is not obj:
+                        continue  # re-created meanwhile; leave it alone
+                    bucket.pop(key)
+                    obj.metadata.deletion_timestamp = now()
+                    self._uids.discard(obj.metadata.uid)
+                    self._track_refs(obj, -1)
+                    self._emit(DELETED, obj.kind, copy.deepcopy(obj))
+            if not victims:
+                return
 
     # -- CRUD ------------------------------------------------------------
 
@@ -121,6 +215,13 @@ class ObjectStore:
             obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now()
             obj.metadata.resource_version = self._next_rv()
             bucket[key] = obj
+            self._uids.add(obj.metadata.uid)
+            self._track_refs(obj, +1)
+            refs = [r for r in obj.metadata.owner_references if r.uid]
+            if refs and all(r.uid not in self._uids for r in refs):
+                # born orphaned (owner deleted between the creator's read
+                # and this create) — the sweep must collect it
+                self._gc_signal()
             out = copy.deepcopy(obj)
             self._emit(ADDED, kind, copy.deepcopy(obj))
             return out
@@ -164,7 +265,13 @@ class ObjectStore:
             obj.metadata.resource_version = self._next_rv()
             if _has_status_subresource(cur) and hasattr(cur, "status"):
                 obj.status = copy.deepcopy(cur.status)
+            self._track_refs(cur, -1)  # ownerRefs may change (orphan release)
+            self._track_refs(obj, +1)
             bucket[key] = obj
+            refs = [r for r in obj.metadata.owner_references if r.uid]
+            if refs and all(r.uid not in self._uids for r in refs):
+                # adopted onto an already-dead owner — wake the sweeper
+                self._gc_signal()
             out = copy.deepcopy(obj)
             self._emit(MODIFIED, kind, copy.deepcopy(obj))
             return out
@@ -197,6 +304,12 @@ class ObjectStore:
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
             obj.metadata.deletion_timestamp = now()
+            self._uids.discard(obj.metadata.uid)
+            self._track_refs(obj, -1)
+            if obj.metadata.uid in self._ref_uids:
+                # only owners wake the sweeper — deleting unowned leaves
+                # (Events, solo pods) costs no full-store scan
+                self._gc_signal()
             self._emit(DELETED, kind, copy.deepcopy(obj))
             return obj
 

@@ -31,7 +31,11 @@ class GangScheduler(abc.ABC):
     def get_gang(self, namespace: str, name: str): ...
 
     @abc.abstractmethod
-    def delete_gang(self, job) -> None: ...
+    def delete_gang(self, job, expected_kind: str = "") -> None:
+        """Release the job's gang. When `expected_kind` is set, the
+        implementation must skip the release if the recorded gang belongs
+        to a different job kind (gang keys are ns/name, so deletion paths
+        can race a same-named job of another kind)."""
 
 
 class GangRegistry:

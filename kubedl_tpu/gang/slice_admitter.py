@@ -84,6 +84,10 @@ class _GangState:
     total_member: int = 0  # total replicas (min_member can be lower)
     priority: int = 0
     seq: int = 0  # admission order for FIFO tie-break
+    # owning job kind: gang keys are ns/name (reference parity — kube-batch
+    # PodGroups are named after the job), so deletion paths must verify the
+    # kind to avoid releasing a same-named other-kind job's gang
+    kind: str = ""
 
     @property
     def slice_name(self) -> Optional[str]:
@@ -217,6 +221,7 @@ class TPUSliceAdmitter(GangScheduler):
                     requested_slice=requested_slice,
                     num_slices=num_slices, total_member=total,
                     priority=priority, seq=self._seq,
+                    kind=getattr(job, "kind", "") or "",
                 )
                 self._gangs[key] = state
             self._reserve_waiting()
@@ -233,10 +238,20 @@ class TPUSliceAdmitter(GangScheduler):
         with self._lock:
             return self._gangs.get(f"{namespace}/{name}")
 
-    def delete_gang(self, job) -> None:
+    def delete_gang(self, job, expected_kind: str = "") -> None:
+        """Release the job's gang. `expected_kind` (when set) makes the
+        pop conditional UNDER THE LOCK: gang keys are ns/name (reference
+        parity — kube-batch PodGroups are named after the job), so a
+        deletion path racing a same-named job of another kind must not
+        release the live record a check-then-act outside the lock could."""
         key = f"{job.metadata.namespace}/{job.metadata.name}"
         with self._lock:
-            state = self._gangs.pop(key, None)
+            state = self._gangs.get(key)
+            if state is not None and expected_kind and state.kind not in (
+                "", expected_kind
+            ):
+                return  # another kind's live gang took the key — not ours
+            self._gangs.pop(key, None)
             if state:
                 for sname in state.slice_names:
                     info = self._slices.get(sname)

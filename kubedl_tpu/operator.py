@@ -77,6 +77,7 @@ class OperatorConfig:
 class Operator:
     def __init__(self, config: Optional[OperatorConfig] = None, store=None) -> None:
         self.config = config or OperatorConfig()
+        self._owns_store = store is None  # covers BOTH internal branches
         if store is not None:
             self.store = store
         elif self.config.kube_api_url:
@@ -299,6 +300,13 @@ class Operator:
             self.object_backend.close()
         if self.event_backend is not None and self.event_backend is not self.object_backend:
             self.event_backend.close()
+        if self._owns_store:
+            # ObjectStore.close() stops the GC sweeper; KubeObjectStore
+            # exposes stop() for its informer/watch threads
+            stopper = getattr(self.store, "close", None) or getattr(
+                self.store, "stop", None)
+            if stopper is not None:
+                stopper()
 
     # -- client-ish helpers ---------------------------------------------
 
