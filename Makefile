@@ -27,6 +27,14 @@ uninstall:
 deploy: install
 	kubectl apply -f config/manager/all_in_one.yaml
 
+.PHONY: webhook-certs
+webhook-certs:
+	bash hack/webhook_certs.sh
+
+.PHONY: deploy-webhook
+deploy-webhook:
+	kubectl apply -f config/webhook/webhook.yaml
+
 .PHONY: docker-build
 docker-build:
 	docker build -t $(IMG) .

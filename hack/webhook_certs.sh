@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Self-signed TLS for the admission webhook — the no-cert-manager path
+# (the reference only ships cert-manager kustomize scaffolding:
+# ref config/certmanager/). Two modes:
+#
+#   hack/webhook_certs.sh --out DIR
+#       generate ca.crt/tls.crt/tls.key into DIR and stop (local tests).
+#
+#   hack/webhook_certs.sh
+#       generate certs, create/update the kubedl-tpu-webhook-tls secret
+#       in kubedl-tpu-system, and patch the caBundle into both webhook
+#       configurations — after `make deploy-webhook` the /mutate and
+#       /validate endpoints work on a vanilla cluster.
+set -euo pipefail
+
+NAMESPACE="${NAMESPACE:-kubedl-tpu-system}"
+SERVICE="${SERVICE:-kubedl-tpu-webhook}"
+OUT=""
+CLUSTER=1
+if [[ "${1:-}" == "--out" ]]; then
+  OUT="$2"
+  CLUSTER=0
+fi
+OUT="${OUT:-$(mktemp -d)}"
+mkdir -p "$OUT"
+
+CN="${SERVICE}.${NAMESPACE}.svc"
+
+openssl req -x509 -newkey rsa:2048 -nodes -days 3650 \
+  -keyout "$OUT/ca.key" -out "$OUT/ca.crt" \
+  -subj "/CN=kubedl-tpu-webhook-ca" >/dev/null 2>&1
+
+openssl req -newkey rsa:2048 -nodes \
+  -keyout "$OUT/tls.key" -out "$OUT/tls.csr" \
+  -subj "/CN=${CN}" >/dev/null 2>&1
+
+cat > "$OUT/ext.cnf" <<EOF
+subjectAltName = DNS:${SERVICE}.${NAMESPACE}.svc, DNS:${SERVICE}.${NAMESPACE}.svc.cluster.local, DNS:localhost, IP:127.0.0.1
+EOF
+
+openssl x509 -req -in "$OUT/tls.csr" -CA "$OUT/ca.crt" -CAkey "$OUT/ca.key" \
+  -CAcreateserial -days 3650 -out "$OUT/tls.crt" \
+  -extfile "$OUT/ext.cnf" >/dev/null 2>&1
+
+echo "certs written to $OUT"
+if [[ "$CLUSTER" == "0" ]]; then
+  exit 0
+fi
+
+kubectl -n "$NAMESPACE" create secret tls kubedl-tpu-webhook-tls \
+  --cert="$OUT/tls.crt" --key="$OUT/tls.key" \
+  --dry-run=client -o yaml | kubectl apply -f -
+
+CA_BUNDLE="$(base64 -w0 < "$OUT/ca.crt" 2>/dev/null || base64 < "$OUT/ca.crt" | tr -d '\n')"
+for CFG in mutatingwebhookconfiguration/kubedl-tpu-mutating \
+           validatingwebhookconfiguration/kubedl-tpu-validating; do
+  kubectl patch "$CFG" --type=json -p \
+    "[{\"op\": \"add\", \"path\": \"/webhooks/0/clientConfig/caBundle\", \"value\": \"${CA_BUNDLE}\"}]"
+done
+echo "secret kubedl-tpu-webhook-tls + caBundle patched in ${NAMESPACE}"

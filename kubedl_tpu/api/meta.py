@@ -44,6 +44,10 @@ class ObjectMeta:
     namespace: str = "default"
     uid: str = ""
     resource_version: int = 0
+    # increments only when desired state (spec) changes — status writes
+    # and label/annotation churn leave it alone, so controllers can cheaply
+    # detect "spec changed since I last looked" (k8s ObjectMeta.Generation)
+    generation: int = 0
     creation_timestamp: Optional[float] = None
     deletion_timestamp: Optional[float] = None
     labels: Dict[str, str] = field(default_factory=dict)

@@ -213,6 +213,7 @@ class ObjectStore:
             if not obj.metadata.uid:
                 obj.metadata.uid = new_uid()
             obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now()
+            obj.metadata.generation = 1
             obj.metadata.resource_version = self._next_rv()
             bucket[key] = obj
             self._uids.add(obj.metadata.uid)
@@ -265,6 +266,11 @@ class ObjectStore:
             obj.metadata.resource_version = self._next_rv()
             if _has_status_subresource(cur) and hasattr(cur, "status"):
                 obj.status = copy.deepcopy(cur.status)
+            # generation moves only with desired state (spec), never with
+            # metadata churn or (subresource-stripped) status writes
+            old_gen = cur.metadata.generation or 1
+            spec_changed = getattr(obj, "spec", None) != getattr(cur, "spec", None)
+            obj.metadata.generation = old_gen + 1 if spec_changed else old_gen
             self._track_refs(cur, -1)  # ownerRefs may change (orphan release)
             self._track_refs(obj, +1)
             bucket[key] = obj

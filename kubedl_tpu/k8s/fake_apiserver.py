@@ -46,6 +46,10 @@ class _State:
         self.objects: Dict[Tuple[str, str], Dict[Tuple[str, str], Dict]] = {}
         # registered resources: (gv, plural) -> kind
         self.resources: Dict[Tuple[str, str], str] = {}
+        # (gv, plural) -> openAPIV3 structural schema; writes are PRUNED
+        # against it like a real apiserver (unknown spec fields dropped
+        # unless x-kubernetes-preserve-unknown-fields)
+        self.schemas: Dict[Tuple[str, str], Dict] = {}
         # resources serving a /status subresource: main-path writes have
         # their status silently dropped, like a real apiserver with
         # `subresources: status: {}` in the CRD
@@ -327,6 +331,11 @@ class _Handler(BaseHTTPRequestHandler):
         # apiserver owns the main path, status owners write /status later
         if (gv, plural) in st.status_subresources:
             obj.pop("status", None)
+        schema = st.schemas.get((gv, plural))
+        if schema is not None:
+            from kubedl_tpu.utils.schema import prune
+
+            prune(obj, schema)
         meta = obj.setdefault("metadata", {})
         meta["namespace"] = ns
         name = meta.get("name", "")
@@ -341,6 +350,7 @@ class _Handler(BaseHTTPRequestHandler):
             st.uid += 1
             meta.setdefault("uid", f"fake-uid-{st.uid}")
             meta.setdefault("creationTimestamp", time.time())
+            meta["generation"] = 1
             meta["resourceVersion"] = st.next_rv()
             bucket[(ns, name)] = obj
             st.uids.add(meta["uid"])
@@ -366,6 +376,12 @@ class _Handler(BaseHTTPRequestHandler):
         if sub and not has_status:
             return self._error(404, f"{plural} has no status subresource", "NotFound")
         obj = self._read_body() or {}
+        if not sub:
+            schema = st.schemas.get((gv, plural))
+            if schema is not None:
+                from kubedl_tpu.utils.schema import prune
+
+                prune(obj, schema)
         meta = obj.setdefault("metadata", {})
         meta["namespace"] = ns
         meta["name"] = name
@@ -384,6 +400,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             if sub:
                 # /status PUT: only the status (and nothing else) changes
+                # — and metadata.generation never moves for status writes
                 new = json.loads(json.dumps(cur))
                 if "status" in obj:
                     new["status"] = obj["status"]
@@ -401,6 +418,16 @@ class _Handler(BaseHTTPRequestHandler):
                         obj["status"] = cur["status"]
                     else:
                         obj.pop("status", None)
+                # metadata.generation increments iff the DESIRED state
+                # (anything outside metadata/status) changed — label or
+                # annotation churn must not look like a new spec
+                old_gen = int(cur["metadata"].get("generation", 1) or 1)
+                desired = {k: v for k, v in obj.items()
+                           if k not in ("metadata", "status")}
+                cur_desired = {k: v for k, v in cur.items()
+                               if k not in ("metadata", "status")}
+                meta["generation"] = (
+                    old_gen + 1 if desired != cur_desired else old_gen)
             obj["metadata"]["resourceVersion"] = st.next_rv()
             st.track_refs(cur, -1)  # ownerRefs may change (orphan release)
             st.track_refs(obj, +1)
@@ -471,6 +498,7 @@ class FakeApiServer:
         kind: str,
         status_subresource: bool = False,
         namespaced: bool = True,
+        schema: Optional[Dict] = None,
     ) -> None:
         state: _State = self._httpd.state  # type: ignore[attr-defined]
         with state.lock:
@@ -479,15 +507,26 @@ class FakeApiServer:
                 state.status_subresources.add((gv, plural))
             if not namespaced:
                 state.cluster_resources.add((gv, plural))
+            if schema is not None:
+                state.schemas[(gv, plural)] = schema
 
     def register_workload_crds(self) -> None:
         from kubedl_tpu.k8s.resources import register_workload_kinds, registered_kinds
+        from kubedl_tpu.utils.schema import schema_for_job
 
         register_workload_kinds()
         for kind, info in registered_kinds().items():
+            # CRDs (non-core groups) get the structural schema generated
+            # from their typed API class, so writes are pruned like on a
+            # real cluster. Core v1 kinds (Pod/Service/Event) stay
+            # unpruned: our typed classes model a SUBSET of core v1, and
+            # a real apiserver admits the full surface there.
+            is_crd = "/" in info.api_version
             self.register_resource(
                 info.api_version, info.plural, kind,
                 status_subresource=info.status_subresource,
+                schema=schema_for_job(info.cls)
+                if (is_crd and info.cls) else None,
             )
 
     def start(self) -> "FakeApiServer":

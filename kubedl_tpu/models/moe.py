@@ -132,6 +132,71 @@ def _top_k_gating(
     )
 
 
+def _dropless_mlp(
+    hf: jax.Array,  # [S, d]
+    params: Dict,
+    experts: jax.Array,  # [k, S] i32 expert choice per token
+    weights: jax.Array,  # [k, S] f32 combine weights
+    e: int,
+) -> jax.Array:
+    """Dropless dispatch via the grouped matmul kernel (ops/gmm.py):
+    sort the k*S (token, choice) rows by expert, pad each expert's run
+    to the row-tile, run the three FFN matmuls as gmm — compute scales
+    with the TOKENS ROUTED (k*S + E*tile rows), not with a capacity
+    bound, and nothing is ever dropped."""
+    from kubedl_tpu.ops.gmm import TILE_M, gmm
+
+    s, d = hf.shape
+    k = experts.shape[0]
+    ks = k * s
+    ef = experts.reshape(ks)  # flat id f = choice*S + token
+    order = jnp.argsort(ef)  # stable: equal experts keep flat order
+    sorted_expert = ef[order]
+    ones = jnp.ones((ks,), jnp.int32)
+    group_sizes = jnp.zeros((e,), jnp.int32).at[ef].add(ones)
+    pad_sizes = ((group_sizes + TILE_M - 1) // TILE_M) * TILE_M
+    pad_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pad_sizes)[:-1]])
+    grp_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]])
+    # destination row (padded layout) of the p-th sorted entry
+    pos_in_group = jnp.arange(ks, dtype=jnp.int32) - grp_offsets[sorted_expert]
+    dest = pad_offsets[sorted_expert] + pos_in_group  # [ks]
+    m_pad = ks + e * TILE_M  # static worst case; tail tiles are zeros
+    x = jnp.zeros((m_pad, d), hf.dtype).at[dest].set(hf[order % s])
+    # expert of each row-tile: tiles past the real rows clamp to the
+    # last expert and multiply zeros — bounded, harmless
+    tile_starts = jnp.arange(m_pad // TILE_M, dtype=jnp.int32) * TILE_M
+    tile_expert = jnp.clip(
+        jnp.searchsorted(jnp.cumsum(pad_sizes), tile_starts, side="right"),
+        0, e - 1).astype(jnp.int32)
+
+    w1, w3, w2 = params["w1"], params["w3"], params["w2"]
+    if isinstance(w1, dict):
+        # int8 experts: fold the per-expert output scale via a row gather
+        row_scale1 = w1["s"][tile_expert].repeat(TILE_M, axis=0)
+        row_scale3 = w3["s"][tile_expert].repeat(TILE_M, axis=0)
+        row_scale2 = w2["s"][tile_expert].repeat(TILE_M, axis=0)
+        gate = jax.nn.silu(
+            (gmm(x, w1["q"].astype(x.dtype), tile_expert)
+             * row_scale1.astype(x.dtype)).astype(jnp.float32)).astype(hf.dtype)
+        up = gmm(x, w3["q"].astype(x.dtype), tile_expert) * row_scale3.astype(x.dtype)
+        rows = gmm(gate * up, w2["q"].astype(x.dtype), tile_expert) \
+            * row_scale2.astype(x.dtype)
+    else:
+        gate = jax.nn.silu(
+            gmm(x, w1, tile_expert).astype(jnp.float32)).astype(hf.dtype)
+        up = gmm(x, w3, tile_expert)
+        rows = gmm(gate * up, w2, tile_expert)
+    # combine: flat id f sits at padded row pos_of_flat[f]
+    pos_of_flat = jnp.zeros((ks,), jnp.int32).at[order].set(dest)
+    y = jnp.zeros((s, d), hf.dtype)
+    for kk in range(k):
+        rows_k = rows[pos_of_flat[kk * s:(kk + 1) * s]]
+        y = y + weights[kk][:, None].astype(hf.dtype) * rows_k
+    return y
+
+
 def moe_mlp(
     h: jax.Array,  # [b, t, d] normed hidden states
     params: Dict,
@@ -140,14 +205,26 @@ def moe_mlp(
     capacity_factor: float = 1.25,
     mesh: Optional[Mesh] = None,
     rules: Optional[ShardingRules] = None,
+    dropless: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (output [b,t,d], aux_load_balance_loss scalar)."""
+    """Returns (output [b,t,d], aux_load_balance_loss scalar).
+
+    dropless=None (auto): use the grouped-matmul kernel whenever experts
+    are NOT sharded over a multi-device expert axis — it processes only
+    the routed tokens (no capacity padding, no drops), lifting the
+    capacity_factor MFU ceiling. The capacity/scatter path remains the
+    expert-parallel (multi-chip) route: its static [E, C, d] buffer is
+    what XLA turns into the token all-to-all.
+    """
     rules = rules or ShardingRules()
     b, t, d = h.shape
     s = b * t
     w1 = params["w1"]
     e = (w1["q"] if isinstance(w1, dict) else w1).shape[0]
     c = expert_capacity(s, e, top_k, capacity_factor)
+    if dropless is None:
+        expert_axis = getattr(rules, "expert", "expert")
+        dropless = mesh is None or dict(mesh.shape).get(expert_axis, 1) <= 1
 
     def constrain(x, *dims):
         if mesh is None:
@@ -156,6 +233,12 @@ def moe_mlp(
 
     hf = h.reshape(s, d)
     gate_logits = hf.astype(jnp.float32) @ params["router"]
+    if dropless:
+        experts, _, gates, _, aux = _top_k_gating(gate_logits, top_k, s + 1)
+        # capacity s+1 == unlimited: every choice keeps, so `gates`
+        # arrives renormalized over all k choices — true dropless
+        y = _dropless_mlp(hf, params, experts, gates, e)
+        return y.reshape(b, t, d), aux
     experts, slots, weights, keeps, aux = _top_k_gating(gate_logits, top_k, c)
 
     def emm(x, w, eq):

@@ -1,0 +1,203 @@
+"""Grouped matrix multiply — the dropless-MoE expert FFN kernel.
+
+`gmm(lhs, rhs, tile_expert)` computes, for every row-tile i of `lhs`,
+`lhs[i] @ rhs[tile_expert[i]]` — i.e. a matmul whose weight matrix
+changes per row-group. This is the TPU-native alternative to both of
+the classic MoE dispatch shapes:
+
+  * GShard's dense one-hot einsums burn S*E*C*d FLOPs per dispatch —
+    measured equal to the expert FFN compute itself (models/moe.py);
+  * capacity-slot gather/scatter (models/moe.py today) is
+    bandwidth-cheap but still RUNS the expert matmuls over every
+    capacity slot: at capacity_factor 1.25 that is a hard 1/1.25
+    ceiling on MFU (the committed 0.474 at dense 0.60 is exactly that
+    ceiling).
+
+Here tokens are sorted by expert and padded per group to the row-tile
+size, so the expert matmuls touch `top_k*S + E*tile_m` rows — a few
+percent of tile rounding instead of 25% capacity padding, and NO
+dropped tokens.
+
+Mechanics (ref: the megablox `gmm` pattern from public JAX —
+SNIPPETS.md has no counterpart; built from the pallas guide):
+  * caller guarantees every row-tile belongs to exactly ONE group and
+    passes `tile_expert[num_m_tiles]`; the scalar-prefetch grid spec
+    lets the rhs BlockSpec index_map select the expert's weight block
+    per tile before the kernel body runs;
+  * grid (m_tiles, n_tiles, k_tiles), k innermost sequential; f32
+    accumulator scratch, cast on the last k step;
+  * backward: dlhs is the same gmm against rhs^T (per expert);
+    drhs is `tgmm` — grid (k, n, m) with m innermost sequential,
+    accumulating row-tiles into the owning expert's [K, N] block
+    (zeroed on the group's first tile).
+
+Like ops/flash_attention.py, kernels run in interpret mode off-TPU so
+CPU tests exercise the real kernel logic.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_M = 128
+_TILE_N = 256
+_TILE_K = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick(dim: int, pref: int) -> int:
+    """Largest tile <= pref that divides dim (dims here are model sizes —
+    multiples of 128 in practice; fall back to the dim itself)."""
+    for t in (pref, 512, 256, 128):
+        if t <= pref and dim % t == 0:
+            return t
+    return dim
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        lhs_ref[...], rhs_ref[0],
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def _gmm_raw(lhs, rhs, tile_expert):
+    m, k = lhs.shape
+    _, _, n = rhs.shape
+    tm = TILE_M
+    tk = _pick(k, _TILE_K)
+    tn = _pick(n, _TILE_N)
+    nk = k // tk
+    grid = (m // tm, n // tn, nk)
+    return pl.pallas_call(
+        functools.partial(_gmm_kernel, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda i, j, kk, te: (i, kk)),
+                pl.BlockSpec((1, tk, tn), lambda i, j, kk, te: (te[i], kk, j)),
+            ],
+            out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk, te: (i, j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n, bytes_accessed=0, transcendentals=0),
+        interpret=_interpret(),
+    )(tile_expert, lhs, rhs)
+
+
+# -- transposed (weight-gradient) --------------------------------------------
+
+
+def _tgmm_kernel(te_ref, first_ref, lhs_ref, dout_ref, out_ref):
+    mm = pl.program_id(2)
+
+    @pl.when(first_ref[mm] == 1)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += jnp.dot(
+        lhs_ref[...].T, dout_ref[...],
+        preferred_element_type=jnp.float32,
+    )[None]
+
+
+def _tgmm_raw(lhs, dout, tile_expert, first_tile, n_experts):
+    """drhs[e] = sum over e's row-tiles of lhs_tile^T @ dout_tile.
+    Experts with no tiles keep whatever was in their block — callers
+    mask them to zero (cheap jnp.where on group counts)."""
+    m, k = lhs.shape
+    _, n = dout.shape
+    tm = TILE_M
+    tk = _pick(k, _TILE_K)
+    tn = _pick(n, _TILE_N)
+    grid = (k // tk, n // tn, m // tm)
+    return pl.pallas_call(
+        _tgmm_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk), lambda kk, j, i, te, fi: (i, kk)),
+                pl.BlockSpec((tm, tn), lambda kk, j, i, te, fi: (i, j)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, tk, tn), lambda kk, j, i, te, fi: (te[i], kk, j)),
+            scratch_shapes=[],
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_experts, k, n), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n, bytes_accessed=0, transcendentals=0),
+        interpret=_interpret(),
+    )(tile_expert, first_tile, lhs, dout)
+
+
+# -- public op with VJP ------------------------------------------------------
+
+
+@jax.custom_vjp
+def gmm(lhs, rhs, tile_expert):
+    """[M, K] x [E, K, N] -> [M, N], weight chosen per row-tile.
+
+    `tile_expert[i]` names the expert for row-tile i (rows sorted and
+    per-group padded to TILE_M by the caller — see moe.py's dropless
+    dispatch). Padding rows are zeros; they multiply into zeros and are
+    never gathered back.
+    """
+    return _gmm_raw(lhs, rhs, tile_expert)
+
+
+def _gmm_fwd(lhs, rhs, tile_expert):
+    return _gmm_raw(lhs, rhs, tile_expert), (lhs, rhs, tile_expert)
+
+
+def _gmm_bwd(res, dout):
+    lhs, rhs, tile_expert = res
+    dlhs = _gmm_raw(dout, jnp.swapaxes(rhs, 1, 2), tile_expert)
+    first = _first_tile_flags(tile_expert)
+    drhs = _tgmm_raw(lhs, dout, tile_expert, first, rhs.shape[0])
+    # experts that own no tiles were never written — mask their garbage
+    owned = jnp.zeros((rhs.shape[0],), jnp.int32).at[tile_expert].add(
+        1, mode="drop")
+    drhs = jnp.where((owned > 0)[:, None, None], drhs, 0.0)
+    dte = np.zeros(tile_expert.shape, jax.dtypes.float0)
+    return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), dte
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def _first_tile_flags(tile_expert):
+    """1 where a tile starts a new expert run (m-order), else 0."""
+    prev = jnp.concatenate(
+        [jnp.full((1,), -1, tile_expert.dtype), tile_expert[:-1]])
+    return (tile_expert != prev).astype(jnp.int32)

@@ -1,0 +1,158 @@
+"""openAPIV3 structural schemas generated from the typed API dataclasses.
+
+The reference generates CRD schemas with controller-gen from Go struct
+markers (ref Makefile:33-38, config/crd/bases/kubeflow.org_tfjobs.yaml);
+here the dataclasses ARE the API, so the schema comes from their type
+hints via the same naming rules serde uses on the wire. The schemas feed
+two consumers: hack/gen_manifests.py (the CRD YAMLs a real cluster
+applies) and the fake apiserver's structural pruning (unknown spec fields
+are dropped exactly like a real apiserver with a structural schema —
+SURVEY.md §4's envtest-substitute duty).
+
+Wire-divergence overrides (k8s/store.py:40-44): Container.env is a plain
+dict internally but a k8s EnvVar LIST on the wire (valueFrom entries must
+survive), env_raw never appears on the wire, and resource quantities may
+be strings ("500m") or numbers — those fields get permissive schemas.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Union, get_args, get_origin, get_type_hints
+
+from kubedl_tpu.utils.serde import camel
+
+_PRESERVE = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def _strip_optional(tp):
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _field_override(cls, fname: str):
+    from kubedl_tpu.api.pod import Container, ResourceRequirements
+
+    if cls is Container:
+        if fname == "env":
+            # wire form: k8s EnvVar list; valueFrom-style entries must
+            # not be pruned away (k8s/store.py _pod_spec_from_wire keeps
+            # them in envRaw for round-trips)
+            return {
+                "type": "array",
+                "items": {"type": "object",
+                          "x-kubernetes-preserve-unknown-fields": True},
+            }
+        if fname == "env_raw":
+            return ...  # internal only — never on the wire; omit
+    if cls is ResourceRequirements and fname in ("requests", "limits"):
+        # quantities are strings ("500m"/"1Gi") on the wire, floats
+        # internally — admit both
+        return {"type": "object", "additionalProperties": True}
+    return None
+
+
+def schema_for_type(tp, _stack=()) -> dict:
+    """Recursive dataclass/typing -> openAPIV3 schema node."""
+    tp = _strip_optional(tp)
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        args = get_args(tp)
+        if not args:
+            return {"type": "array",
+                    "items": {"x-kubernetes-preserve-unknown-fields": True,
+                              "type": "object"}}
+        return {"type": "array", "items": schema_for_type(args[0], _stack)}
+    if origin is dict:
+        args = get_args(tp)
+        if not args or args[1] is Any:
+            return dict(_PRESERVE)
+        return {"type": "object",
+                "additionalProperties": schema_for_type(args[1], _stack)}
+    if tp is Any or tp is dict:
+        return dict(_PRESERVE)
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return {"type": "string"}
+    if tp is bool:
+        return {"type": "boolean"}
+    if tp is int:
+        return {"type": "integer"}
+    if tp is float:
+        return {"type": "number"}
+    if tp is str:
+        return {"type": "string"}
+    if dataclasses.is_dataclass(tp):
+        if tp in _stack:  # recursive type — stop expanding, admit anything
+            return dict(_PRESERVE)
+        props = {}
+        hints = get_type_hints(tp)
+        for f in dataclasses.fields(tp):
+            if not f.metadata.get("serialize", True):
+                continue
+            override = _field_override(tp, f.name)
+            if override is ...:
+                continue
+            wire_name = f.metadata.get("name") or camel(f.name)
+            props[wire_name] = (
+                override if override is not None
+                else schema_for_type(hints[f.name], _stack + (tp,))
+            )
+        return {"type": "object", "properties": props}
+    # unknown python type — don't invent constraints
+    return dict(_PRESERVE)
+
+
+def schema_for_job(job_cls) -> dict:
+    """Top-level CRD openAPIV3Schema for a typed job class: spec and
+    status from the dataclass; apiVersion/kind/metadata are the
+    apiserver's own (never pruned)."""
+    hints = get_type_hints(job_cls)
+    props = {
+        name: schema_for_type(hints[name])
+        for name in ("spec", "status") if name in hints
+    }
+    return {"type": "object", "properties": props}
+
+
+def prune(obj, schema):
+    """Drop fields a structural schema doesn't admit — the real
+    apiserver's pruning pass (structural schemas prune by default unless
+    x-kubernetes-preserve-unknown-fields). Mutates and returns `obj`.
+    At the document root, apiVersion/kind/metadata always survive."""
+    return _prune_node(obj, schema, root=True)
+
+
+_ROOT_KEEP = ("apiVersion", "kind", "metadata")
+
+
+def _prune_node(obj, schema, root=False):
+    if not isinstance(schema, dict) or schema is None:
+        return obj
+    if schema.get("x-kubernetes-preserve-unknown-fields"):
+        return obj
+    stype = schema.get("type")
+    if stype == "object" and isinstance(obj, dict):
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        if props is not None:
+            for k in list(obj):
+                if root and k in _ROOT_KEEP:
+                    continue
+                if k in props:
+                    obj[k] = _prune_node(obj[k], props[k])
+                elif isinstance(addl, dict):
+                    obj[k] = _prune_node(obj[k], addl)
+                elif not addl:
+                    del obj[k]
+        elif isinstance(addl, dict):
+            for k in list(obj):
+                obj[k] = _prune_node(obj[k], addl)
+        return obj
+    if stype == "array" and isinstance(obj, list):
+        items = schema.get("items")
+        if isinstance(items, dict):
+            return [_prune_node(v, items) for v in obj]
+    return obj

@@ -1,0 +1,135 @@
+"""Real-apiserver behaviors the fake must reproduce, or the suite
+certifies away whole classes of production bugs (SURVEY.md §4
+"Implication for the rebuild", VERDICT r3 next #5): structural-schema
+pruning of unknown spec fields and metadata.generation increments.
+Cascade GC coverage lives in tests/test_cascade_gc.py.
+"""
+import copy
+
+from kubedl_tpu.api.job import BaseJob
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.k8s.client import KubeClient
+from kubedl_tpu.k8s.fake_apiserver import FakeApiServer
+
+JOBS = "/apis/kubedl-tpu.io/v1alpha1/namespaces/default/jaxjobs"
+
+
+def _srv():
+    srv = FakeApiServer()
+    srv.register_workload_crds()
+    return srv
+
+
+def test_post_prunes_unknown_spec_fields():
+    with _srv() as srv:
+        client = KubeClient(srv.url)
+        client.request("POST", JOBS, body={
+            "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+            "metadata": {"name": "pruned"},
+            "spec": {
+                "numSlices": 2,
+                "bogusKnob": "nope",  # not in the structural schema
+                "jaxReplicaSpecs": {"Worker": {
+                    "replicas": 1,
+                    "surpriseField": True,  # nested unknown
+                    "template": {"spec": {"containers": [{
+                        "name": "jax",
+                        "madeUp": 1,  # unknown on Container
+                    }]}},
+                }},
+            },
+        })
+        got = client.request("GET", f"{JOBS}/pruned")
+        spec = got["spec"]
+        assert spec["numSlices"] == 2
+        assert "bogusKnob" not in spec
+        worker = spec["jaxReplicaSpecs"]["Worker"]
+        assert worker["replicas"] == 1
+        assert "surpriseField" not in worker
+        container = worker["template"]["spec"]["containers"][0]
+        assert container["name"] == "jax"
+        assert "madeUp" not in container
+
+
+def test_pruning_preserves_wire_divergent_fields():
+    """Container env on the wire is a k8s EnvVar LIST (valueFrom entries
+    included) and resource quantities may be strings — the schema's
+    wire-divergence overrides must admit them."""
+    with _srv() as srv:
+        client = KubeClient(srv.url)
+        client.request("POST", JOBS, body={
+            "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+            "metadata": {"name": "wirey"},
+            "spec": {"jaxReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [{
+                    "name": "jax",
+                    "env": [
+                        {"name": "PLAIN", "value": "v"},
+                        {"name": "SECRET", "valueFrom": {
+                            "secretKeyRef": {"name": "s", "key": "k"}}},
+                    ],
+                    "resources": {"limits": {"google.com/tpu": "4",
+                                             "memory": "1Gi"}},
+                }]}},
+            }}},
+        })
+        got = client.request("GET", f"{JOBS}/wirey")
+        c = got["spec"]["jaxReplicaSpecs"]["Worker"]["template"]["spec"]["containers"][0]
+        assert c["env"][1]["valueFrom"]["secretKeyRef"]["key"] == "k"
+        assert c["resources"]["limits"]["memory"] == "1Gi"
+
+
+def test_generation_tracks_spec_changes_only():
+    with _srv() as srv:
+        client = KubeClient(srv.url)
+        job = client.request("POST", JOBS, body={
+            "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+            "metadata": {"name": "gen"},
+            "spec": {"numSlices": 1},
+        })
+        assert job["metadata"]["generation"] == 1
+
+        # label-only churn: generation must NOT move
+        labeled = copy.deepcopy(job)
+        labeled["metadata"]["labels"] = {"team": "x"}
+        job = client.request("PUT", f"{JOBS}/gen", body=labeled)
+        assert job["metadata"]["generation"] == 1
+
+        # spec change: generation increments
+        changed = copy.deepcopy(job)
+        changed["spec"]["numSlices"] = 2
+        job = client.request("PUT", f"{JOBS}/gen", body=changed)
+        assert job["metadata"]["generation"] == 2
+
+        # status write: generation frozen
+        status = copy.deepcopy(job)
+        status["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
+        client.request("PUT", f"{JOBS}/gen/status", body=status)
+        got = client.request("GET", f"{JOBS}/gen")
+        assert got["metadata"]["generation"] == 2
+        assert got["status"]["conditions"][0]["type"] == "Created"
+
+
+def test_native_store_generation_parity():
+    store = ObjectStore()
+    job = store.create(BaseJob(
+        metadata=ObjectMeta(name="g", namespace="default"), kind="TestJob"))
+    assert job.metadata.generation == 1
+
+    # metadata-only churn
+    job.metadata.labels["team"] = "y"
+    job = store.update(job)
+    assert job.metadata.generation == 1
+
+    # spec change
+    job.spec.replica_specs = {}
+    job.spec.run_policy.backoff_limit = 7
+    job = store.update(job)
+    assert job.metadata.generation == 2
+
+    # status write (subresource) never bumps
+    job.status.conditions = []
+    job = store.update_status(job)
+    assert job.metadata.generation == 2

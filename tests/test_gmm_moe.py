@@ -1,0 +1,130 @@
+"""Grouped matmul kernel (ops/gmm.py) + dropless MoE dispatch.
+
+Reference semantics for gmm is the per-tile dense matmul; for the
+dropless path it is the per-token dense computation
+y = sum_k w_k * FFN_{e_k}(x) with NO tokens dropped. CPU runs the real
+kernels in interpret mode (same discipline as tests/test_flash_attention.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedl_tpu.models.moe import moe_init, moe_mlp
+from kubedl_tpu.ops.gmm import TILE_M, gmm
+
+
+def _mk_grouped(key, m_tiles, k, n, e, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    lhs = jax.random.normal(k1, (m_tiles * TILE_M, k), dtype)
+    rhs = jax.random.normal(k2, (e, k, n), dtype)
+    te = jnp.sort(jax.random.randint(k3, (m_tiles,), 0, e)).astype(jnp.int32)
+    return lhs, rhs, te
+
+
+def _ref_gmm(lhs, rhs, te):
+    out = []
+    for i in range(te.shape[0]):
+        tile = lhs[i * TILE_M:(i + 1) * TILE_M]
+        out.append(tile @ rhs[int(te[i])])
+    return jnp.concatenate(out, axis=0)
+
+
+def test_gmm_matches_dense_reference():
+    lhs, rhs, te = _mk_grouped(jax.random.PRNGKey(0), 6, 256, 256, 3)
+    got = gmm(lhs, rhs, te)
+    want = _ref_gmm(lhs, rhs, te)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gmm_gradients_match_reference():
+    lhs, rhs, te = _mk_grouped(jax.random.PRNGKey(1), 4, 256, 128, 3)
+
+    def f(a, b):
+        return jnp.sum(gmm(a, b, te) ** 2)
+
+    def f_ref(a, b):
+        return jnp.sum(_ref_gmm(a, b, te) ** 2)
+
+    ga, gb = jax.grad(f, argnums=(0, 1))(lhs, rhs)
+    ra, rb = jax.grad(f_ref, argnums=(0, 1))(lhs, rhs)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gmm_grad_zero_for_unrouted_expert():
+    lhs, rhs, _ = _mk_grouped(jax.random.PRNGKey(2), 4, 256, 128, 4)
+    te = jnp.asarray([0, 0, 2, 2], jnp.int32)  # experts 1 and 3 idle
+
+    def f(b):
+        return jnp.sum(gmm(lhs, b, te) ** 2)
+
+    gb = jax.grad(f)(rhs)
+    assert float(jnp.abs(gb[1]).max()) == 0.0
+    assert float(jnp.abs(gb[3]).max()) == 0.0
+    assert float(jnp.abs(gb[0]).max()) > 0.0
+
+
+def _ref_moe(hf, params, top_k):
+    """Per-token dense reference: every token through its top-k experts,
+    weights renormalized over the k choices — dropless semantics."""
+    probs = jax.nn.softmax(hf.astype(jnp.float32) @ params["router"], axis=-1)
+    s = hf.shape[0]
+    remaining = probs
+    experts, gates = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        oh = jax.nn.one_hot(idx, probs.shape[-1], dtype=jnp.float32)
+        experts.append(idx)
+        gates.append(jnp.sum(probs * oh, axis=-1))
+        remaining = remaining * (1.0 - oh)
+    w = jnp.stack(gates)
+    w = w / jnp.maximum(jnp.sum(w, axis=0, keepdims=True), 1e-9)
+
+    def ffn(x, eidx):
+        w1, w3, w2 = (params[n][eidx] for n in ("w1", "w3", "w2"))
+        gate = jax.nn.silu((x @ w1).astype(jnp.float32)).astype(x.dtype)
+        return (gate * (x @ w3)) @ w2
+
+    y = jnp.zeros_like(hf)
+    for t in range(s):
+        for k in range(top_k):
+            y = y.at[t].add(
+                w[k, t].astype(hf.dtype) * ffn(hf[t][None], int(experts[k][t]))[0])
+    return y
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_dropless_moe_matches_per_token_reference(top_k):
+    d, ff, e = 128, 256, 4
+    params = moe_init(jax.random.PRNGKey(3), d, ff, e, dtype=jnp.float32)
+    h = jax.random.normal(jax.random.PRNGKey(4), (2, 16, d), jnp.float32)
+    y, aux = moe_mlp(h, params, top_k=top_k, dropless=True)
+    want = _ref_moe(h.reshape(-1, d), params, top_k)
+    np.testing.assert_allclose(
+        np.asarray(y.reshape(-1, d)), np.asarray(want), rtol=2e-4, atol=2e-4)
+    assert np.isfinite(float(aux))
+
+
+def test_dropless_moe_trains_end_to_end():
+    """Forward+backward through a 2-layer MoE llama on the auto
+    (dropless) path: finite loss, finite grads."""
+    from kubedl_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    cfg = llama.LlamaConfig(**{**cfg.__dict__, "n_experts": 4,
+                               "expert_top_k": 2})
+    params = llama.init(cfg, jax.random.PRNGKey(5))
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 33), 0,
+                                cfg.vocab_size)
+
+    def loss(p):
+        return llama.loss_fn(p, tokens, cfg)
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)

@@ -490,20 +490,13 @@ def test_cache_resyncs_after_watch_stop(srv):
     kstore = KubeObjectStore(KubeClient(srv.url))
     w = kstore.watch(["Pod"])
     try:
-        # generous deadlines: the watch thread competes with whatever else
-        # the CI box is running (full-suite + bench runs flaked at 5 s)
-        deadline = time.monotonic() + 30
-        while not kstore.cache.synced("Pod") and time.monotonic() < deadline:
-            time.sleep(0.02)
-        assert kstore.cache.synced("Pod")
+        assert kstore.wait_for_cache_sync(["Pod"], timeout=30)
     finally:
         w.stop()
-    # 90 s: under a fully loaded box (parallel full-suite runs) the watch
-    # thread can be starved long past the earlier 30 s before it observes
-    # the stop and marks the cache unsynced
-    deadline = time.monotonic() + 90
-    while kstore.cache.synced("Pod") and time.monotonic() < deadline:
-        time.sleep(0.02)
+    # event-driven (no sleep-deadline tuning): join blocks until the pump
+    # thread's finally has run, which marks the cache unsynced — however
+    # loaded the box is, this either completes or fails loudly
+    assert w.join(timeout=60), "watch pump failed to exit after stop()"
     # stale cache must not serve reads once its feeder is gone
     assert not kstore.cache.synced("Pod")
 
