@@ -127,6 +127,9 @@ class LlamaConfig:
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # None = auto (gmm off-mesh, capacity path on a mesh); True forces the
+    # dropless gmm route, False forces capacity/scatter (models/moe.py)
+    moe_dropless: Optional[bool] = None
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
@@ -482,6 +485,7 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None,
         y, aux = moe_mlp(
             h, layer["moe"], top_k=config.expert_top_k,
             capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
+            dropless=config.moe_dropless,
         )
         y = y.astype(x.dtype)
     else:

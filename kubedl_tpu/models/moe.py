@@ -162,7 +162,11 @@ def _dropless_mlp(
     # destination row (padded layout) of the p-th sorted entry
     pos_in_group = jnp.arange(ks, dtype=jnp.int32) - grp_offsets[sorted_expert]
     dest = pad_offsets[sorted_expert] + pos_in_group  # [ks]
-    m_pad = ks + e * TILE_M  # static worst case; tail tiles are zeros
+    # static worst case, rounded to a whole number of row-tiles: the
+    # per-group padded runs sum to <= round_up(ks) + e*TILE_M and the gmm
+    # grid (m_pad // TILE_M) must cover every row — a ragged tail would
+    # silently never be written (and int8 row-scales are built per tile)
+    m_pad = (ks + TILE_M - 1) // TILE_M * TILE_M + e * TILE_M
     x = jnp.zeros((m_pad, d), hf.dtype).at[dest].set(hf[order % s])
     # expert of each row-tile: tiles past the real rows clamp to the
     # last expert and multiply zeros — bounded, harmless
@@ -209,12 +213,13 @@ def moe_mlp(
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (output [b,t,d], aux_load_balance_loss scalar).
 
-    dropless=None (auto): use the grouped-matmul kernel whenever experts
-    are NOT sharded over a multi-device expert axis — it processes only
-    the routed tokens (no capacity padding, no drops), lifting the
-    capacity_factor MFU ceiling. The capacity/scatter path remains the
-    expert-parallel (multi-chip) route: its static [E, C, d] buffer is
-    what XLA turns into the token all-to-all.
+    dropless=None (auto): use the grouped-matmul kernel only when there
+    is no multi-device mesh — it processes exactly the routed tokens (no
+    capacity padding, no drops), lifting the capacity_factor MFU
+    ceiling. Under ANY multi-device mesh the auto default is the
+    capacity/scatter path (its static [E, C, d] buffer is what XLA turns
+    into the token all-to-all); pass dropless=True explicitly (e.g. via
+    LlamaConfig.moe_dropless) to force the gmm path on a mesh.
     """
     rules = rules or ShardingRules()
     b, t, d = h.shape
@@ -223,8 +228,13 @@ def moe_mlp(
     e = (w1["q"] if isinstance(w1, dict) else w1).shape[0]
     c = expert_capacity(s, e, top_k, capacity_factor)
     if dropless is None:
-        expert_axis = getattr(rules, "expert", "expert")
-        dropless = mesh is None or dict(mesh.shape).get(expert_axis, 1) <= 1
+        # auto only where the gmm path is validated: no mesh (or a
+        # 1-device one). Under ANY multi-device mesh the pallas_call
+        # cannot be auto-partitioned by XLA — the sort/scatter + gmm
+        # would force full replication of activations — so multi-device
+        # meshes default to the capacity/scatter path; dropless=True
+        # forces the gmm route regardless.
+        dropless = mesh is None or mesh.size <= 1
 
     def constrain(x, *dims):
         if mesh is None:

@@ -53,6 +53,19 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _check_tiled(m: int, tile_expert, name: str) -> None:
+    if m % TILE_M:
+        raise ValueError(
+            f"{name} lhs rows ({m}) must be a multiple of TILE_M ({TILE_M}); "
+            "the grid covers m // TILE_M tiles and a ragged tail would "
+            "silently never be computed")
+    if tile_expert.shape[0] != m // TILE_M:
+        raise ValueError(
+            f"{name} tile_expert has {tile_expert.shape[0]} entries for "
+            f"{m // TILE_M} row-tiles; an out-of-range te[i] gather clamps "
+            "and would silently reuse the last expert's weights")
+
+
 def _pick(dim: int, pref: int) -> int:
     """Largest tile <= pref that divides dim (dims here are model sizes —
     multiples of 128 in practice; fall back to the dim itself)."""
@@ -85,6 +98,7 @@ def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk):
 def _gmm_raw(lhs, rhs, tile_expert):
     m, k = lhs.shape
     _, _, n = rhs.shape
+    _check_tiled(m, tile_expert, "gmm")
     tm = TILE_M
     tk = _pick(k, _TILE_K)
     tn = _pick(n, _TILE_N)
@@ -134,6 +148,7 @@ def _tgmm_raw(lhs, dout, tile_expert, first_tile, n_experts):
     mask them to zero (cheap jnp.where on group counts)."""
     m, k = lhs.shape
     _, n = dout.shape
+    _check_tiled(m, tile_expert, "tgmm")
     tm = TILE_M
     tk = _pick(k, _TILE_K)
     tn = _pick(n, _TILE_N)

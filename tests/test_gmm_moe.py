@@ -128,3 +128,33 @@ def test_dropless_moe_trains_end_to_end():
     assert np.isfinite(float(val))
     flat = jax.tree_util.tree_leaves(grads)
     assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+
+def test_gmm_rejects_ragged_rows():
+    """A non-TILE_M-multiple row count must fail loudly: the grid covers
+    m // TILE_M tiles, so a ragged tail would silently never be computed
+    (the round-4 regression's failure mode)."""
+    lhs = jnp.zeros((TILE_M + 5, 256), jnp.float32)
+    rhs = jnp.zeros((2, 256, 128), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of TILE_M"):
+        gmm(lhs, rhs, jnp.zeros((1,), jnp.int32))
+
+
+def test_dropless_moe_int8_non_tile_token_count():
+    """int8 experts through the dropless path with k*S NOT a multiple of
+    TILE_M — the round-4 regression: m_pad was not tile-aligned, so the
+    per-tile int8 row scales ((m_pad//TILE_M)*TILE_M rows) mismatched the
+    gmm output (m_pad rows) and all quantized MoE inference crashed."""
+    from kubedl_tpu.models import quant
+
+    d, ff, e = 128, 256, 4
+    params = moe_init(jax.random.PRNGKey(7), d, ff, e, dtype=jnp.float32)
+    # S = 21, ks = 42 for top_k=2: not a multiple of 128
+    h = jax.random.normal(jax.random.PRNGKey(8), (3, 7, d), jnp.float32)
+    qparams = dict(params)
+    for n in ("w1", "w3", "w2"):
+        qparams[n] = quant.quantize_stack(params[n])
+    y_fp, _ = moe_mlp(h, params, top_k=2, dropless=True)
+    y_q, _ = moe_mlp(h, qparams, top_k=2, dropless=True)
+    rel = float(jnp.linalg.norm(y_q - y_fp) / jnp.linalg.norm(y_fp))
+    assert rel < 0.05, rel
