@@ -27,6 +27,7 @@ their residual path passes through — standard Switch behavior.
 """
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -81,13 +82,18 @@ def _top_k_gating(
     gate_logits: jax.Array,  # [S, E] f32
     top_k: int,
     capacity: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+           Tuple[jax.Array, jax.Array]]:
     """Routing as INDICES instead of one-hot planes.
 
     Returns (experts [k,S] i32, slots [k,S] i32, weights [k,S] f32,
-    keep [k,S] bool, aux_loss scalar): for each token and each of its k
+    keep [k,S] bool, (me, ce)): for each token and each of its k
     choices, which expert, which capacity slot inside that expert, the
-    renormalized combine weight, and whether the slot fit under capacity.
+    renormalized combine weight, and whether the slot fit under
+    capacity. (me, ce) are the per-expert mean routing prob and mean
+    top-1 assignment — the factors of the GShard load-balance loss
+    aux = E * sum(me * ce), returned unfused so the expert-parallel
+    path can pmean them to global means before combining.
     """
     s, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits, axis=-1)
@@ -103,10 +109,9 @@ def _top_k_gating(
         gates.append(jnp.sum(probs * onehot, axis=-1))
         remaining = remaining * (1.0 - onehot)
 
-    # load-balance aux: E * mean(prob) . mean(top-1 assignment)
+    # load-balance aux factors: mean(prob), mean(top-1 assignment)
     me = jnp.mean(probs, axis=0)
     ce = jnp.mean(masks[0], axis=0)
-    aux_loss = e * jnp.sum(me * ce)
 
     # per-expert slot assignment in token order, k=0 choices first
     slots, keeps = [], []
@@ -128,46 +133,49 @@ def _top_k_gating(
         jnp.stack(slots),
         weights,
         jnp.stack(keeps),
-        aux_loss,
+        (me, ce),
     )
 
 
-def _dropless_mlp(
-    hf: jax.Array,  # [S, d]
+def _gmm_ffn(
+    src: jax.Array,  # [n_src, d] source rows to gather from
+    src_rows: jax.Array,  # [M] i32 row of `src` backing each routed entry
+    eid: jax.Array,  # [M] i32 expert per entry, in [0, e]; e = empty sentinel
     params: Dict,
-    experts: jax.Array,  # [k, S] i32 expert choice per token
-    weights: jax.Array,  # [k, S] f32 combine weights
     e: int,
 ) -> jax.Array:
-    """Dropless dispatch via the grouped matmul kernel (ops/gmm.py):
-    sort the k*S (token, choice) rows by expert, pad each expert's run
-    to the row-tile, run the three FFN matmuls as gmm — compute scales
-    with the TOKENS ROUTED (k*S + E*tile rows), not with a capacity
-    bound, and nothing is ever dropped."""
+    """Route M rows through their experts' SwiGLU FFN via the grouped
+    matmul kernel (ops/gmm.py): sort entries by expert, pad each
+    expert's run to the row-tile, run the three FFN matmuls as gmm.
+    Returns [M, d] outputs aligned to the input entries; sentinel
+    entries (eid == e) come back as zero rows."""
     from kubedl_tpu.ops.gmm import TILE_M, gmm
 
-    s, d = hf.shape
-    k = experts.shape[0]
-    ks = k * s
-    ef = experts.reshape(ks)  # flat id f = choice*S + token
-    order = jnp.argsort(ef)  # stable: equal experts keep flat order
-    sorted_expert = ef[order]
-    ones = jnp.ones((ks,), jnp.int32)
-    group_sizes = jnp.zeros((e,), jnp.int32).at[ef].add(ones)
+    m = eid.shape[0]
+    d = src.shape[1]
+    order = jnp.argsort(eid)  # stable: equal experts keep entry order
+    sorted_eid = eid[order]
+    ones = jnp.ones((m,), jnp.int32)
+    group_sizes = jnp.zeros((e,), jnp.int32).at[eid].add(ones, mode="drop")
     pad_sizes = ((group_sizes + TILE_M - 1) // TILE_M) * TILE_M
     pad_offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(pad_sizes)[:-1]])
     grp_offsets = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]])
-    # destination row (padded layout) of the p-th sorted entry
-    pos_in_group = jnp.arange(ks, dtype=jnp.int32) - grp_offsets[sorted_expert]
-    dest = pad_offsets[sorted_expert] + pos_in_group  # [ks]
+    # destination row (padded layout) of the p-th sorted entry; sentinel
+    # entries sort last and are routed to the out-of-range row m_pad
+    # (dropped by the scatter, gathered back as the zero row)
+    real_eid = jnp.clip(sorted_eid, 0, e - 1)
+    pos_in_group = jnp.arange(m, dtype=jnp.int32) - grp_offsets[real_eid]
     # static worst case, rounded to a whole number of row-tiles: the
-    # per-group padded runs sum to <= round_up(ks) + e*TILE_M and the gmm
+    # per-group padded runs sum to <= round_up(m) + e*TILE_M and the gmm
     # grid (m_pad // TILE_M) must cover every row — a ragged tail would
     # silently never be written (and int8 row-scales are built per tile)
-    m_pad = (ks + TILE_M - 1) // TILE_M * TILE_M + e * TILE_M
-    x = jnp.zeros((m_pad, d), hf.dtype).at[dest].set(hf[order % s])
+    m_pad = (m + TILE_M - 1) // TILE_M * TILE_M + e * TILE_M
+    dest = jnp.where(sorted_eid < e,
+                     pad_offsets[real_eid] + pos_in_group, m_pad)  # [M]
+    x = jnp.zeros((m_pad, d), src.dtype).at[dest].set(
+        src[src_rows[order]], mode="drop")
     # expert of each row-tile: tiles past the real rows clamp to the
     # last expert and multiply zeros — bounded, harmless
     tile_starts = jnp.arange(m_pad // TILE_M, dtype=jnp.int32) * TILE_M
@@ -183,22 +191,200 @@ def _dropless_mlp(
         row_scale2 = w2["s"][tile_expert].repeat(TILE_M, axis=0)
         gate = jax.nn.silu(
             (gmm(x, w1["q"].astype(x.dtype), tile_expert)
-             * row_scale1.astype(x.dtype)).astype(jnp.float32)).astype(hf.dtype)
+             * row_scale1.astype(x.dtype)).astype(jnp.float32)).astype(x.dtype)
         up = gmm(x, w3["q"].astype(x.dtype), tile_expert) * row_scale3.astype(x.dtype)
         rows = gmm(gate * up, w2["q"].astype(x.dtype), tile_expert) \
             * row_scale2.astype(x.dtype)
     else:
         gate = jax.nn.silu(
-            gmm(x, w1, tile_expert).astype(jnp.float32)).astype(hf.dtype)
+            gmm(x, w1, tile_expert).astype(jnp.float32)).astype(x.dtype)
         up = gmm(x, w3, tile_expert)
         rows = gmm(gate * up, w2, tile_expert)
-    # combine: flat id f sits at padded row pos_of_flat[f]
-    pos_of_flat = jnp.zeros((ks,), jnp.int32).at[order].set(dest)
+    # entry p's output sits at padded row dest[p]; sentinel dest == m_pad
+    # gathers the appended zero row
+    pos_of_entry = jnp.zeros((m,), jnp.int32).at[order].set(dest)
+    rows = jnp.concatenate([rows, jnp.zeros((1, d), rows.dtype)], axis=0)
+    return rows[pos_of_entry]
+
+
+def _dropless_mlp(
+    hf: jax.Array,  # [S, d]
+    params: Dict,
+    experts: jax.Array,  # [k, S] i32 expert choice per token
+    weights: jax.Array,  # [k, S] f32 combine weights
+    e: int,
+) -> jax.Array:
+    """Single-shard dropless dispatch: compute scales with the TOKENS
+    ROUTED (k*S + E*tile rows), not with a capacity bound, and nothing
+    is ever dropped."""
+    s, d = hf.shape
+    k = experts.shape[0]
+    ks = k * s
+    ef = experts.reshape(ks)  # flat id f = choice*S + token
+    src_rows = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
+    rows = _gmm_ffn(hf, src_rows, ef, params, e)  # [ks, d]
     y = jnp.zeros((s, d), hf.dtype)
     for kk in range(k):
-        rows_k = rows[pos_of_flat[kk * s:(kk + 1) * s]]
-        y = y + weights[kk][:, None].astype(hf.dtype) * rows_k
+        y = y + weights[kk][:, None].astype(hf.dtype) * rows[kk * s:(kk + 1) * s]
     return y
+
+
+def _dropless_shard_fn(
+    hf_loc: jax.Array,  # [S_loc, d] this device's token rows
+    params: Dict,  # expert blocks: w* leading dim = e_loc local experts
+    *,
+    top_k: int,
+    e: int,
+    e_loc: int,
+    n_e: int,
+    quota: int,
+    expert_axis: str,
+    token_axes: Tuple[str, ...],
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-device body of the expert-parallel dropless route (runs under
+    shard_map). Tokens are sharded over `token_axes` (batch axes + the
+    expert axis — every device owns a token block AND an expert block);
+    expert weights are blocked over `expert_axis`.
+
+    Dispatch: sort this device's k*S_loc (token, choice) entries by
+    expert — runs destined to the same expert shard are contiguous —
+    and pack each destination shard's run into a `quota`-row slot of a
+    [n_e, quota, d] buffer. One all_to_all over the expert axis lands
+    every entry on the shard that owns its expert; a local _gmm_ffn
+    computes exactly the received rows (plus tile padding); the reverse
+    all_to_all returns outputs to each entry's home device for the
+    weighted combine. Entries past a destination's quota are dropped
+    (weight renormalized over surviving choices) — drops happen at
+    SHARD granularity (e_loc experts pooled), far coarser than the
+    capacity path's per-expert slots, and vanish for quota factor >= 1
+    under a balanced router."""
+    s_loc, d = hf_loc.shape
+    k = top_k
+    ks = k * s_loc
+    gate_logits = hf_loc.astype(jnp.float32) @ params["router"]
+    experts, _, gates, _, (me, ce) = _top_k_gating(gate_logits, k, s_loc + 1)
+    # load-balance loss over GLOBAL means: every token axis partitions
+    # the token set, so pmean over all of them is the global mean
+    me = jax.lax.pmean(me, token_axes)
+    ce = jax.lax.pmean(ce, token_axes)
+    aux = e * jnp.sum(me * ce)
+
+    ef = experts.reshape(ks)  # flat entry f = choice*S_loc + token
+    src_rows = jnp.tile(jnp.arange(s_loc, dtype=jnp.int32), k)
+    dest_shard = ef // e_loc  # owning expert shard per entry
+    order = jnp.argsort(ef)  # stable; groups by expert => also by shard
+    sorted_ef = ef[order]
+    sorted_dest = sorted_ef // e_loc
+    shard_counts = jnp.zeros((n_e,), jnp.int32).at[dest_shard].add(
+        jnp.ones((ks,), jnp.int32))
+    shard_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(shard_counts)[:-1]])
+    pos = jnp.arange(ks, dtype=jnp.int32) - shard_offsets[sorted_dest]
+    kept_sorted = pos < quota  # entries past the shard quota drop
+    slot = jnp.where(kept_sorted, sorted_dest * quota + pos, n_e * quota)
+    send_x = jnp.zeros((n_e * quota, d), hf_loc.dtype).at[slot].set(
+        hf_loc[src_rows[order]], mode="drop")
+    # expert id per slot; e = empty-slot sentinel
+    send_eid = jnp.full((n_e * quota,), e, jnp.int32).at[slot].set(
+        sorted_ef, mode="drop")
+
+    recv_x = jax.lax.all_to_all(
+        send_x.reshape(n_e, quota, d), expert_axis, 0, 0)
+    recv_eid = jax.lax.all_to_all(
+        send_eid.reshape(n_e, quota), expert_axis, 0, 0)
+    ei = jax.lax.axis_index(expert_axis)
+    flat_eid = recv_eid.reshape(n_e * quota)
+    local_eid = jnp.where(flat_eid < e, flat_eid - ei * e_loc, e_loc)
+    rows = recv_x.reshape(n_e * quota, d)
+    y_rows = _gmm_ffn(
+        rows, jnp.arange(n_e * quota, dtype=jnp.int32), local_eid,
+        params, e_loc)
+    back = jax.lax.all_to_all(
+        y_rows.reshape(n_e, quota, d), expert_axis, 0, 0)
+
+    # combine at home: entry f's reply sits at slot_of_entry[f]; dropped
+    # entries point at the appended zero row
+    slot_of_entry = jnp.zeros((ks,), jnp.int32).at[order].set(slot)
+    kept = jnp.zeros((ks,), bool).at[order].set(kept_sorted).reshape(k, s_loc)
+    weights = gates * kept
+    weights = weights / jnp.maximum(
+        jnp.sum(weights, axis=0, keepdims=True), 1e-9)
+    back_flat = jnp.concatenate(
+        [back.reshape(n_e * quota, d), jnp.zeros((1, d), y_rows.dtype)], axis=0)
+    y = jnp.zeros((s_loc, d), hf_loc.dtype)
+    for kk in range(k):
+        rows_k = back_flat[slot_of_entry[kk * s_loc:(kk + 1) * s_loc]]
+        y = y + weights[kk][:, None].astype(hf_loc.dtype) * rows_k
+    return y, aux
+
+
+def _dropless_mlp_sharded(
+    hf: jax.Array,  # [S, d] global token rows
+    params: Dict,
+    *,
+    top_k: int,
+    quota_factor: float,
+    mesh: Mesh,
+    rules: ShardingRules,
+    e: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel dropless MoE: shard_map over the mesh with tokens
+    sharded over (batch axes x expert axis) and expert weights blocked
+    over the expert axis. Communication is two all_to_alls over ICI;
+    compute per chip is proportional to the quota (~ routed tokens /
+    n_shards * quota_factor), not to a per-expert capacity."""
+    from jax.sharding import PartitionSpec as P
+
+    from kubedl_tpu.ops.gmm import TILE_M
+
+    s, d = hf.shape
+    batch_axes = tuple(rules.rules.get("batch", ("data", "fsdp")))
+    expert_axes = tuple(rules.rules.get("expert", ("expert",)))
+    if len(expert_axes) != 1:
+        raise ValueError(
+            f"dropless expert parallelism needs exactly one expert mesh "
+            f"axis, got {expert_axes}")
+    expert_axis = expert_axes[0]
+    token_axes = batch_axes + (expert_axis,)
+    shape = dict(mesh.shape)
+    n_e = shape.get(expert_axis, 1)
+    n_tok = int(np.prod([shape.get(a, 1) for a in token_axes]))
+    if e % n_e:
+        raise ValueError(
+            f"{e} experts not divisible by expert axis {expert_axis}={n_e}")
+    if s % n_tok:
+        raise ValueError(
+            f"dropless dispatch shards {s} tokens over "
+            f"{dict((a, shape.get(a, 1)) for a in token_axes)} = {n_tok} "
+            f"ways; pad batch*seq to a multiple")
+    e_loc = e // n_e
+    s_loc = s // n_tok
+    ks_loc = top_k * s_loc
+    quota = int(np.ceil(ks_loc * quota_factor / n_e / TILE_M)) * TILE_M
+
+    def wspec(w):
+        if isinstance(w, dict):
+            return {"q": P(expert_axis, None, None), "s": P(expert_axis, None)}
+        return P(expert_axis, None, None)
+
+    in_specs = (
+        P(token_axes, None),
+        {
+            "router": P(None, None),
+            "w1": wspec(params["w1"]),
+            "w3": wspec(params["w3"]),
+            "w2": wspec(params["w2"]),
+        },
+    )
+    fn = functools.partial(
+        _dropless_shard_fn, top_k=top_k, e=e, e_loc=e_loc, n_e=n_e,
+        quota=quota, expert_axis=expert_axis, token_axes=token_axes)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P(token_axes, None), P()),
+        check_vma=False,
+    )(hf, {k: params[k] for k in ("router", "w1", "w3", "w2")})
 
 
 def moe_mlp(
@@ -218,8 +404,12 @@ def moe_mlp(
     capacity padding, no drops), lifting the capacity_factor MFU
     ceiling. Under ANY multi-device mesh the auto default is the
     capacity/scatter path (its static [E, C, d] buffer is what XLA turns
-    into the token all-to-all); pass dropless=True explicitly (e.g. via
-    LlamaConfig.moe_dropless) to force the gmm path on a mesh.
+    into the token all-to-all). dropless=True (e.g. via
+    LlamaConfig.moe_dropless) forces the gmm route: single-shard
+    _dropless_mlp off-mesh, or the shard_map expert-parallel dispatch
+    (_dropless_mlp_sharded — explicit all_to_all over the expert axis,
+    per-shard gmm) on a mesh; there capacity_factor bounds the per-shard
+    all-to-all quota instead of a per-expert slot count.
     """
     rules = rules or ShardingRules()
     b, t, d = h.shape
@@ -242,14 +432,22 @@ def moe_mlp(
         return jax.lax.with_sharding_constraint(x, rules.sharding(mesh, *dims))
 
     hf = h.reshape(s, d)
+    if dropless and mesh is not None and mesh.size > 1:
+        # expert-parallel dropless: shard_map + all_to_all dispatch; the
+        # router runs per-device inside the shard body
+        y, aux = _dropless_mlp_sharded(
+            hf, params, top_k=top_k, quota_factor=capacity_factor,
+            mesh=mesh, rules=rules, e=e)
+        return y.reshape(b, t, d), aux
     gate_logits = hf.astype(jnp.float32) @ params["router"]
     if dropless:
-        experts, _, gates, _, aux = _top_k_gating(gate_logits, top_k, s + 1)
+        experts, _, gates, _, (me, ce) = _top_k_gating(gate_logits, top_k, s + 1)
         # capacity s+1 == unlimited: every choice keeps, so `gates`
         # arrives renormalized over all k choices — true dropless
         y = _dropless_mlp(hf, params, experts, gates, e)
-        return y.reshape(b, t, d), aux
-    experts, slots, weights, keeps, aux = _top_k_gating(gate_logits, top_k, c)
+        return y.reshape(b, t, d), e * jnp.sum(me * ce)
+    experts, slots, weights, keeps, (me, ce) = _top_k_gating(gate_logits, top_k, c)
+    aux = e * jnp.sum(me * ce)
 
     def emm(x, w, eq):
         """Batched expert matmul; int8 stacks ({q, s}, models/quant.py)
