@@ -28,6 +28,17 @@ def new_uid() -> str:
     return str(uuid.uuid4())
 
 
+# The apiserver-owned finalizer a Foreground delete installs: the object
+# stays (deletionTimestamp set) until the GC has removed every dependent
+# with blockOwnerDeletion, then the finalizer is stripped and the object
+# goes away (k8s metav1.FinalizerDeleteDependents).
+FOREGROUND_FINALIZER = "foregroundDeletion"
+DELETE_BACKGROUND = "Background"
+DELETE_FOREGROUND = "Foreground"
+DELETE_ORPHAN = "Orphan"
+PROPAGATION_POLICIES = (DELETE_BACKGROUND, DELETE_FOREGROUND, DELETE_ORPHAN)
+
+
 @dataclass
 class OwnerReference:
     api_version: str = ""
@@ -53,6 +64,10 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     owner_references: List[OwnerReference] = field(default_factory=list)
+    # while non-empty, a delete only MARKS the object (deletionTimestamp)
+    # — it is removed when the last finalizer is stripped by whoever
+    # registered it (k8s ObjectMeta.Finalizers)
+    finalizers: List[str] = field(default_factory=list)
 
     def controller_ref(self) -> Optional[OwnerReference]:
         for ref in self.owner_references:

@@ -16,7 +16,15 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
-from kubedl_tpu.api.meta import new_uid, now
+from kubedl_tpu.api.meta import (
+    DELETE_BACKGROUND,
+    DELETE_FOREGROUND,
+    DELETE_ORPHAN,
+    FOREGROUND_FINALIZER,
+    PROPAGATION_POLICIES,
+    new_uid,
+    now,
+)
 
 
 class StoreError(Exception):
@@ -51,6 +59,20 @@ def match_labels(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> 
     if not selector:
         return True
     return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _desired_state(obj) -> Dict[str, Any]:
+    """Top-level fields outside metadata/status — the generation-bump
+    comparison set (mirrors the fake apiserver's PUT handler)."""
+    import dataclasses
+
+    if dataclasses.is_dataclass(obj):
+        return {
+            f.name: getattr(obj, f.name)
+            for f in dataclasses.fields(obj)
+            if f.name not in ("metadata", "status")
+        }
+    return {"spec": getattr(obj, "spec", None)}
 
 
 def _has_status_subresource(obj) -> bool:
@@ -175,6 +197,114 @@ class ObjectStore:
                     out.append(obj)
         return out
 
+    def _remove_locked(self, obj) -> None:
+        """Physically remove a STORED object (caller holds the lock):
+        emit DELETED, drop its uid, wake the sweeper if anything owned it."""
+        bucket = self._objects.get(obj.kind, {})
+        key = self._key(obj)
+        if bucket.get(key) is not obj:
+            return  # re-created meanwhile; leave it alone
+        bucket.pop(key)
+        if obj.metadata.deletion_timestamp is None:
+            obj.metadata.deletion_timestamp = now()
+        self._uids.discard(obj.metadata.uid)
+        self._track_refs(obj, -1)
+        # re-sweep when an owner vanishes (dependents to reap) OR a
+        # dependent vanishes (a foreground-deleting owner may unblock)
+        if obj.metadata.uid in self._ref_uids or obj.metadata.owner_references:
+            self._gc_signal()
+        self._emit(DELETED, obj.kind, copy.deepcopy(obj))
+
+    def _mark_deleting_locked(self, obj) -> None:
+        """Finalizer-blocked delete: the STORED object stays, with
+        deletionTimestamp set, until its last finalizer is stripped."""
+        if obj.metadata.deletion_timestamp is None:
+            obj.metadata.deletion_timestamp = now()
+            obj.metadata.resource_version = self._next_rv()
+            self._emit(MODIFIED, obj.kind, copy.deepcopy(obj))
+        self._gc_signal()
+
+    def _orphan_dependents_locked(self, uid: str) -> None:
+        """propagationPolicy=Orphan: release dependents by stripping the
+        deleted owner's refs so the GC never collects them."""
+        for bucket in self._objects.values():
+            for obj in list(bucket.values()):
+                refs = obj.metadata.owner_references
+                keep = [r for r in refs if r.uid != uid]
+                if len(keep) == len(refs):
+                    continue
+                self._track_refs(obj, -1)
+                obj.metadata.owner_references = keep
+                self._track_refs(obj, +1)
+                obj.metadata.resource_version = self._next_rv()
+                self._emit(MODIFIED, obj.kind, copy.deepcopy(obj))
+                kept = [r for r in keep if r.uid]
+                if kept and all(r.uid not in self._uids for r in kept):
+                    # the surviving refs all point at dead owners: the
+                    # strip just made this an orphan the sweeper must
+                    # collect (nothing else will signal for it)
+                    self._gc_signal()
+
+    def _sweep_orphans_locked(self) -> bool:
+        acted = False
+        for obj in self._gc_orphans():
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    self._mark_deleting_locked(obj)
+                    acted = True
+            else:
+                self._remove_locked(obj)
+                acted = True
+        return acted
+
+    def _sweep_foreground_locked(self) -> bool:
+        """Foreground deletion: an owner marked deleting with the
+        foregroundDeletion finalizer waits until the GC has removed every
+        dependent whose ownerRef sets blockOwnerDeletion, then loses the
+        finalizer (and the object, unless other finalizers remain)."""
+        acted = False
+        owners = [
+            o
+            for bucket in self._objects.values()
+            for o in list(bucket.values())
+            if o.metadata.deletion_timestamp is not None
+            and FOREGROUND_FINALIZER in o.metadata.finalizers
+        ]
+        for owner in owners:
+            uid = owner.metadata.uid
+            blocked = False
+            for bucket in list(self._objects.values()):
+                for dep in list(bucket.values()):
+                    refs = [r for r in dep.metadata.owner_references if r.uid == uid]
+                    if not refs:
+                        continue
+                    # kube GC: a dependent with ANOTHER live owner is not
+                    # deleted by this owner's foreground pass (and does
+                    # not block it) — it survives until all owners die
+                    if any(r.uid != uid and r.uid in self._uids
+                           for r in dep.metadata.owner_references):
+                        continue
+                    if dep.metadata.finalizers:
+                        if dep.metadata.deletion_timestamp is None:
+                            self._mark_deleting_locked(dep)
+                            acted = True
+                        if any(r.block_owner_deletion for r in refs):
+                            blocked = True
+                    else:
+                        self._remove_locked(dep)
+                        acted = True
+            if not blocked:
+                owner.metadata.finalizers = [
+                    f for f in owner.metadata.finalizers if f != FOREGROUND_FINALIZER
+                ]
+                if owner.metadata.finalizers:
+                    owner.metadata.resource_version = self._next_rv()
+                    self._emit(MODIFIED, owner.kind, copy.deepcopy(owner))
+                else:
+                    self._remove_locked(owner)
+                acted = True
+        return acted
+
     def _gc_sweep(self) -> None:
         while True:
             # scan AND delete under one lock hold: a victim list released
@@ -182,18 +312,9 @@ class ObjectStore:
             # object re-created in the window would be killed — kube's GC
             # guards this with UID preconditions)
             with self._lock:
-                victims = self._gc_orphans()
-                for obj in victims:
-                    bucket = self._objects.get(obj.kind, {})
-                    key = self._key(obj)
-                    if bucket.get(key) is not obj:
-                        continue  # re-created meanwhile; leave it alone
-                    bucket.pop(key)
-                    obj.metadata.deletion_timestamp = now()
-                    self._uids.discard(obj.metadata.uid)
-                    self._track_refs(obj, -1)
-                    self._emit(DELETED, obj.kind, copy.deepcopy(obj))
-            if not victims:
+                acted = self._sweep_orphans_locked()
+                acted |= self._sweep_foreground_locked()
+            if not acted:
                 return
 
     # -- CRUD ------------------------------------------------------------
@@ -212,6 +333,7 @@ class ObjectStore:
                 raise AlreadyExists(f"{kind} {key} already exists")
             if not obj.metadata.uid:
                 obj.metadata.uid = new_uid()
+            obj.metadata.deletion_timestamp = None  # apiserver-owned
             obj.metadata.creation_timestamp = obj.metadata.creation_timestamp or now()
             obj.metadata.generation = 1
             obj.metadata.resource_version = self._next_rv()
@@ -266,11 +388,23 @@ class ObjectStore:
             obj.metadata.resource_version = self._next_rv()
             if _has_status_subresource(cur) and hasattr(cur, "status"):
                 obj.status = copy.deepcopy(cur.status)
-            # generation moves only with desired state (spec), never with
-            # metadata churn or (subresource-stripped) status writes
+            # deletionTimestamp is apiserver-owned: clients can neither
+            # set nor clear it, and once deleting, no NEW finalizers may
+            # be added (kube's ValidateObjectMetaUpdate rule)
+            obj.metadata.deletion_timestamp = cur.metadata.deletion_timestamp
+            if cur.metadata.deletion_timestamp is not None:
+                added = set(obj.metadata.finalizers) - set(cur.metadata.finalizers)
+                if added:
+                    raise StoreError(
+                        f"{kind} {key}: no new finalizers can be added if "
+                        f"the object is being deleted (tried {sorted(added)})")
+            # generation moves only with desired state — ANY top-level
+            # field outside metadata/status (matching the fake apiserver,
+            # k8s/fake_apiserver.py PUT: kinds whose desired state lives
+            # outside .spec must behave the same on both backends)
             old_gen = cur.metadata.generation or 1
-            spec_changed = getattr(obj, "spec", None) != getattr(cur, "spec", None)
-            obj.metadata.generation = old_gen + 1 if spec_changed else old_gen
+            obj.metadata.generation = (
+                old_gen + 1 if _desired_state(obj) != _desired_state(cur) else old_gen)
             self._track_refs(cur, -1)  # ownerRefs may change (orphan release)
             self._track_refs(obj, +1)
             bucket[key] = obj
@@ -280,6 +414,9 @@ class ObjectStore:
                 self._gc_signal()
             out = copy.deepcopy(obj)
             self._emit(MODIFIED, kind, copy.deepcopy(obj))
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                # last finalizer stripped — the pending delete completes
+                self._remove_locked(obj)
             return out
 
     def update_status(self, obj):
@@ -302,21 +439,41 @@ class ObjectStore:
             self._emit(MODIFIED, kind, copy.deepcopy(new))
             return out
 
-    def delete(self, kind: str, namespace: str, name: str):
+    def delete(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        propagation: str = DELETE_BACKGROUND,
+    ):
+        """Delete with kube deletionPropagation semantics.
+
+        Background (default): remove now; the GC reaps dependents async.
+        Foreground: install the foregroundDeletion finalizer — the object
+        stays (deletionTimestamp set) until the GC has removed every
+        blockOwnerDeletion dependent. Orphan: strip this owner's refs
+        from dependents first, so they survive. Any object with
+        finalizers is only MARKED; it is removed when the last finalizer
+        is stripped via update()."""
+        if propagation not in PROPAGATION_POLICIES:
+            raise StoreError(
+                f"unknown propagationPolicy {propagation!r} "
+                f"(want one of {PROPAGATION_POLICIES})")
         with self._lock:
             bucket = self._objects.get(kind, {})
             key = f"{namespace}/{name}"
-            obj = bucket.pop(key, None)
+            obj = bucket.get(key)
             if obj is None:
                 raise NotFound(f"{kind} {key} not found")
-            obj.metadata.deletion_timestamp = now()
-            self._uids.discard(obj.metadata.uid)
-            self._track_refs(obj, -1)
-            if obj.metadata.uid in self._ref_uids:
-                # only owners wake the sweeper — deleting unowned leaves
-                # (Events, solo pods) costs no full-store scan
-                self._gc_signal()
-            self._emit(DELETED, kind, copy.deepcopy(obj))
+            if propagation == DELETE_ORPHAN:
+                self._orphan_dependents_locked(obj.metadata.uid)
+            elif propagation == DELETE_FOREGROUND:
+                if FOREGROUND_FINALIZER not in obj.metadata.finalizers:
+                    obj.metadata.finalizers.append(FOREGROUND_FINALIZER)
+            if obj.metadata.finalizers:
+                self._mark_deleting_locked(obj)
+                return copy.deepcopy(obj)
+            self._remove_locked(obj)
             return obj
 
     def list(

