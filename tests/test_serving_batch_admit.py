@@ -44,7 +44,9 @@ def test_batched_admission_matches_serial_greedy_tokens():
 
     batched = ServingEngine(params, cfg, slots=4, max_len=256)
     outs_batched = batched.serve_all(prompts, max_new_tokens=12)
-    assert batched.stats()["prefill_batches"] == 1
+    # buckets {16,32,64} cluster into one dispatch (4x span), 128 gets
+    # its own — 2 dispatches for the wave, not 4 serial prefills
+    assert batched.stats()["prefill_batches"] <= 2
 
     trickled = ServingEngine(params, cfg, slots=4, max_len=256)
     reqs = []
