@@ -7,6 +7,13 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# The FULL suite, slow lane included — run before every snapshot commit
+# and quote the tail in the commit message (VERDICT r4 directive 1).
+.PHONY: presubmit
+presubmit:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+	$(PY) -m pytest tests/ -q -m slow
+
 .PHONY: bench
 bench:
 	$(PY) bench.py

@@ -105,4 +105,11 @@ def make_train_step(
         params = jax.device_put(params, param_sharding)
         return init_jit(params)
 
+    # AOT access (fit checks, ahead-of-time compiles): the inner jit
+    # accepts abstract params and its compiled output_shardings give the
+    # full TrainState sharding tree — eval_shape alone drops shardings,
+    # so an AOT lower of step_jit with plain ShapeDtypeStructs would
+    # silently measure a REPLICATED state (tests/test_aot_fit.py)
+    init_state.jit = init_jit
+
     return init_state, step_jit

@@ -231,6 +231,7 @@ def test_generate_allow_fresh_init_round_trip(tmp_path):
     assert "done: generated" in p.stdout
 
 
+@pytest.mark.slow
 def test_trainer_lr_schedule_resumes_from_checkpoint(tmp_path):
     """Cosine schedule + warmup + grad clipping through the real trainer,
     including an Orbax save -> resume cycle (the chained optimizer's
@@ -258,6 +259,7 @@ def test_trainer_lr_schedule_resumes_from_checkpoint(tmp_path):
     assert "resumed" in p.stdout or "restored" in p.stdout, p.stdout
 
 
+@pytest.mark.slow
 def test_trainer_eval_pass_reports_held_out_loss(tmp_path):
     """--eval-every through the real trainer with a TRUE held-out set
     (--eval-data-path, separate shards). The eval set is fixed: a rerun

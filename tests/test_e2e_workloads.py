@@ -5,6 +5,9 @@ import os
 import sys
 
 import pytest
+
+# heavy multi-process e2e: slow lane (make presubmit)
+pytestmark = pytest.mark.slow
 import yaml
 
 from kubedl_tpu.operator import Operator, OperatorConfig

@@ -127,6 +127,7 @@ def test_lookup_features_concat(mesh):
         naive_pooled(tables["b"], batch_ids["b"], combiner="mean"), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_sparse_train_program_runs(capsys):
     """The XDLJob workload program end to end on the virtual mesh."""
     from kubedl_tpu.train import sparse

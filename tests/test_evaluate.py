@@ -25,6 +25,7 @@ def test_synthetic_smoke_and_determinism(capsys, monkeypatch):
     assert out2["nll"] == out1["nll"]  # full pass is deterministic
 
 
+@pytest.mark.slow
 def test_shards_and_trained_checkpoint_scores_better(tmp_path, capsys,
                                                      monkeypatch):
     """Eval over real token shards; a briefly-trained checkpoint must

@@ -109,6 +109,7 @@ def test_dropless_moe_matches_per_token_reference(top_k):
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow
 def test_dropless_moe_trains_end_to_end():
     """Forward+backward through a 2-layer MoE llama on the auto
     (dropless) path: finite loss, finite grads."""

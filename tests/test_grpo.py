@@ -116,6 +116,7 @@ def test_grpo_kl_penalty_positive_and_grows(model):
     assert 0.0 < float(near["kl"]) < float(far["kl"])
 
 
+@pytest.mark.slow
 def test_grpo_training_raises_reward_on_mesh(model):
     """End-to-end on a dp x tp mesh: reward 'fraction of completion
     tokens == target token', fresh rollouts each iteration. A few GRPO
@@ -165,6 +166,7 @@ def test_grpo_training_raises_reward_on_mesh(model):
     assert float(metrics["kl"]) >= 0.0
 
 
+@pytest.mark.slow
 def test_grpo_cli_with_jsonl_and_checkpoint(tmp_path, monkeypatch):
     """The GRPO workload CLI: JSONL prompts in, trained full-params
     checkpoint out, restorable by the plain generate --checkpoint-path."""

@@ -212,6 +212,7 @@ def test_unknown_model_type_rejected():
         config_from_hf(cfg)
 
 
+@pytest.mark.slow
 def test_gemma_chunked_ce_matches_full(gemma_pair):
     """ce_chunks and the DPO chunked logprobs must apply the (1+w) final
     norm like the unchunked head — pinned on a real Gemma import."""
@@ -244,6 +245,7 @@ def test_gemma_fresh_init_effective_norm_gain_is_one():
     assert float(jnp.max(jnp.abs(params["final_norm"]))) == 0.0
 
 
+@pytest.mark.slow
 def test_rope_scaling_llama3_logits_parity():
     """Llama-3.1-style rope scaling: logits must match transformers'
     reference implementation of the 'llama3' frequency rescale."""
@@ -387,6 +389,7 @@ def test_qwen2_config_and_bias_import(qwen2_pair):
     assert wcfg.sliding_window is None
 
 
+@pytest.mark.slow
 def test_qwen2_per_layer_windows_logits_parity():
     """use_sliding_window Qwen2: sequences longer than the window must
     match HF's eager reference, which windows only the layers at/above
@@ -529,6 +532,7 @@ def test_gemma2_logits_match_transformers(gemma2_pair):
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
 
 
+@pytest.mark.slow
 def test_gemma2_greedy_decode_matches_teacher_forced(gemma2_pair):
     """Cached decode shares the softcap/prescale/sandwich-norm math:
     greedy continuation equals argmax over the full forward each step

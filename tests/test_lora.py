@@ -115,6 +115,7 @@ def test_trainer_cli_lora_mode(monkeypatch):
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_lora_checkpoint_roundtrip_to_generate(tmp_path, monkeypatch):
     """trainer --lora-rank writes adapter-only checkpoints; generate
     --lora-checkpoint-path merges them into the base and decodes — the

@@ -8,6 +8,9 @@ import sys
 
 import pytest
 
+# heavy multi-process e2e: slow lane (make presubmit)
+pytestmark = pytest.mark.slow
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from kubedl_tpu.operator import Operator, OperatorConfig

@@ -12,6 +12,10 @@ import sys
 
 from kubedl_tpu.operator import Operator, OperatorConfig
 from kubedl_tpu.workloads.jaxjob import JAXJobController
+import pytest
+
+# heavy multi-process e2e: slow lane (make presubmit)
+pytestmark = pytest.mark.slow
 
 
 def test_multislice_job_trains_to_success(tmp_path):

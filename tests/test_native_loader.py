@@ -83,6 +83,7 @@ def test_rejects_empty_shard_set(tmp_path):
         PyTokenLoader([p], batch=1, seq_len=128)
 
 
+@pytest.mark.slow
 def test_trainer_runs_on_sharded_data(tmp_path, capsys, monkeypatch):
     from kubedl_tpu.train import trainer
 

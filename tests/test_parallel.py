@@ -51,6 +51,7 @@ def test_ring_attention_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients():
     mesh = build_mesh({"context": 4, "data": 2})
     b, h, t, d = 2, 2, 128, 32
@@ -131,6 +132,7 @@ def test_llama_sharded_train_step_dp_fsdp_tp():
     assert emb_shard.spec == rules.spec("vocab", "embed")
 
 
+@pytest.mark.slow
 def test_llama_train_step_with_context_parallelism():
     mesh = build_mesh({"data": 2, "context": 4})
     rules = ShardingRules()
@@ -151,6 +153,7 @@ def test_llama_train_step_with_context_parallelism():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_grad_accumulation_matches_big_batch():
     """accum_steps=2 on half batches must equal one step on the full batch."""
     import optax
@@ -189,6 +192,7 @@ def test_grad_accumulation_matches_big_batch():
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_full_loss_and_grads():
     """ce_chunks must be a pure optimization: same loss, same gradients."""
     import dataclasses
@@ -271,6 +275,7 @@ def test_ulysses_attention_matches_reference(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_ulysses_attention_gradients():
     from kubedl_tpu.ops.ulysses import ulysses_attention
 
@@ -304,6 +309,7 @@ def test_ulysses_rejects_indivisible_heads():
         ulysses_attention(q, q, q, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_llama_train_step_with_ulysses_context_parallelism():
     mesh = build_mesh({"data": 2, "context": 4})
     rules = ShardingRules()
@@ -323,6 +329,7 @@ def test_llama_train_step_with_ulysses_context_parallelism():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_llama_qkv_bias_sharded_train_step():
     """Qwen2-style biased projections: init and param_specs agree on
     tree structure, and a dp x tp sharded step trains the biases."""

@@ -55,6 +55,7 @@ def test_pipelined_forward_matches_sequential(remat):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipelined_loss_and_grads_match():
     config = tiny(n_layers=4, remat=False)
     mesh = build_mesh({"stage": 4, "data": 2})

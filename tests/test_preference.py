@@ -68,6 +68,7 @@ def test_dpo_zero_margin_at_reference(model):
     np.testing.assert_allclose(float(metrics["reward_margin"]), 0.0, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_dpo_training_grows_margin_on_mesh(model):
     """A few sharded DPO steps must push the reward margin positive and
     the loss below log(2), with chosen logprob rising relative to
@@ -109,6 +110,7 @@ def test_chunked_logprobs_match_full(model):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_dpo_moe_keeps_router_aux(model):
     """On a MoE config the DPO loss must include the router balance term
     (nonzero gradient to the router even at the zero-margin fixed point)."""
@@ -137,6 +139,7 @@ def test_dpo_moe_keeps_router_aux(model):
     assert gate_norm > 0.0
 
 
+@pytest.mark.slow
 def test_dpo_cli_with_jsonl_and_checkpoint(tmp_path, monkeypatch):
     """The DPO workload CLI: JSONL pairs in, trained full-params
     checkpoint out, restorable by the plain generate --checkpoint-path."""
@@ -182,6 +185,7 @@ def test_load_pairs_validation(tmp_path):
         load_pairs(str(bad), seq_len=16)
 
 
+@pytest.mark.slow
 def test_dpo_cli_resume_and_guards(tmp_path, monkeypatch):
     monkeypatch.setenv("KUBEDL_MESH", "data=4,tensor=2")
     from kubedl_tpu.train import dpo

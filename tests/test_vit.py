@@ -6,6 +6,7 @@ import numpy as np
 from kubedl_tpu.models import vit
 from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
 from kubedl_tpu.parallel.train_step import make_train_step
+import pytest
 
 
 def _config():
@@ -33,6 +34,7 @@ def test_forward_shape_and_determinism():
     )
 
 
+@pytest.mark.slow
 def test_sharded_training_loss_decreases():
     import optax
 
