@@ -240,6 +240,7 @@ def _dropless_shard_fn(
     quota: int,
     expert_axis: str,
     token_axes: Tuple[str, ...],
+    tensor_axes: Tuple[str, ...] = (),
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-device body of the expert-parallel dropless route (runs under
     shard_map). Tokens are sharded over `token_axes` (batch axes + the
@@ -299,6 +300,14 @@ def _dropless_shard_fn(
     y_rows = _gmm_ffn(
         rows, jnp.arange(n_e * quota, dtype=jnp.int32), local_eid,
         params, e_loc)
+    if tensor_axes:
+        # tensor-parallel experts: w1/w3 are column-blocked and w2
+        # row-blocked over the tensor axis (classic TP MLP), so each
+        # shard's _gmm_ffn output is a partial sum over its ff block —
+        # tokens are replicated across the tensor axis, so one psum
+        # completes the FFN (int8 per-output-column scales distribute
+        # over the sum)
+        y_rows = jax.lax.psum(y_rows, tensor_axes)
     back = jax.lax.all_to_all(
         y_rows.reshape(n_e, quota, d), expert_axis, 0, 0)
 
@@ -362,10 +371,34 @@ def _dropless_mlp_sharded(
     ks_loc = top_k * s_loc
     quota = int(np.ceil(ks_loc * quota_factor / n_e / TILE_M)) * TILE_M
 
-    def wspec(w):
+    # tensor parallelism composes: the ff (mlp) dim blocks over the
+    # tensor axes (w1/w3 columns, w2 rows) and the shard body psums the
+    # partial FFN outputs — TP's usual MLP split, inside the EP dispatch
+    mlp_axes = tuple(a for a in rules.rules.get("mlp", ("tensor",))
+                     if shape.get(a, 1) > 1)
+    mlp_spec = mlp_axes if len(mlp_axes) > 1 else (
+        mlp_axes[0] if mlp_axes else None)
+    if set(mlp_axes) & set(token_axes):
+        # tokens must be REPLICATED over the mlp/tensor axes (the psum
+        # completing the FFN assumes every tensor shard saw the same
+        # tokens) — overlapping rules would sum different token blocks
+        raise ValueError(
+            f"mlp axes {mlp_axes} overlap token axes {token_axes}; "
+            f"dropless EP x TP needs disjoint mesh axes")
+    w1 = params["w1"]
+    ff = (w1["q"] if isinstance(w1, dict) else w1).shape[-1]
+    n_t = int(np.prod([shape.get(a, 1) for a in mlp_axes])) if mlp_axes else 1
+    if ff % max(n_t, 1):
+        raise ValueError(
+            f"d_ff {ff} not divisible by tensor axes "
+            f"{dict((a, shape.get(a, 1)) for a in mlp_axes)}")
+
+    def wspec(w, transpose=False):
+        ein, eout = (mlp_spec, None) if transpose else (None, mlp_spec)
         if isinstance(w, dict):
-            return {"q": P(expert_axis, None, None), "s": P(expert_axis, None)}
-        return P(expert_axis, None, None)
+            return {"q": P(expert_axis, ein, eout),
+                    "s": P(expert_axis, eout)}
+        return P(expert_axis, ein, eout)
 
     in_specs = (
         P(token_axes, None),
@@ -373,12 +406,13 @@ def _dropless_mlp_sharded(
             "router": P(None, None),
             "w1": wspec(params["w1"]),
             "w3": wspec(params["w3"]),
-            "w2": wspec(params["w2"]),
+            "w2": wspec(params["w2"], transpose=True),
         },
     )
     fn = functools.partial(
         _dropless_shard_fn, top_k=top_k, e=e, e_loc=e_loc, n_e=n_e,
-        quota=quota, expert_axis=expert_axis, token_axes=token_axes)
+        quota=quota, expert_axis=expert_axis, token_axes=token_axes,
+        tensor_axes=mlp_axes)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=in_specs,

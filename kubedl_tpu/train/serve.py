@@ -354,6 +354,10 @@ class _Handler(BaseHTTPRequestHandler):
                 if done:
                     final = {"done": True, "tokens": toks,
                              "request_id": req.request_id}
+                    if req.error:
+                        # engine-side failure (e.g. poisoned prefill):
+                        # done with empty tokens and the reason attached
+                        final["error"] = req.error
                     if req.logprobs:
                         final["logprobs"] = list(req.token_logprobs)
                     if tok is not None:
@@ -505,6 +509,11 @@ class _Handler(BaseHTTPRequestHandler):
         results = []
         for r in reqs:
             entry = {"tokens": r.tokens, "request_id": r.request_id}
+            if r.error:
+                # engine-side failure (e.g. poisoned prefill batch): the
+                # request is done with empty tokens; say why instead of
+                # returning a silent empty completion
+                entry["error"] = r.error
             if r.logprobs:
                 entry["logprobs"] = r.token_logprobs
             if tok is not None:

@@ -413,6 +413,7 @@ def test_chunked_prefill_appends_to_existing_cache():
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sampled_speculative_preserves_target_distribution():
     """Rejection-sampled speculative decoding must sample from the TARGET
     distribution regardless of the draft. Small vocab + enumeration: the

@@ -209,6 +209,7 @@ def test_trainer_memory_knobs_run_end_to_end():
     assert "done: 4 steps" in p.stdout, p.stdout
 
 
+@pytest.mark.slow
 def test_generate_allow_fresh_init_round_trip(tmp_path):
     """--allow-fresh-init serves random weights with an explicit opt-in;
     without it an empty checkpoint dir is a hard error."""

@@ -182,6 +182,7 @@ def test_gemma_config_mapping(gemma_pair):
     assert config.tie_embeddings
 
 
+@pytest.mark.slow
 def test_gemma_logits_match_transformers(gemma_pair):
     model, params, config = gemma_pair
     rng = np.random.default_rng(7)
@@ -192,6 +193,7 @@ def test_gemma_logits_match_transformers(gemma_pair):
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
 
 
+@pytest.mark.slow
 def test_gemma_greedy_decode_matches_transformers(gemma_pair):
     model, params, config = gemma_pair
     rng = np.random.default_rng(8)
@@ -522,6 +524,7 @@ def test_gemma2_config_mapping(gemma2_pair):
     assert "post_attn_norm" in layer and "post_mlp_norm" in layer
 
 
+@pytest.mark.slow
 def test_gemma2_logits_match_transformers(gemma2_pair):
     model, params, config = gemma2_pair
     rng = np.random.default_rng(11)

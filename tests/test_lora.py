@@ -102,6 +102,7 @@ def test_mismatch_and_bad_targets_rejected(model):
         lora.merge(params, short)
 
 
+@pytest.mark.slow
 def test_trainer_cli_lora_mode(monkeypatch):
     """kubedl_tpu.train.trainer --lora-rank runs the adapter-only path
     end to end (JAXJob-deployable LoRA fine-tuning)."""

@@ -512,3 +512,39 @@ def test_streaming_with_stop_never_leaks_partial_match(server):
     assert final["done"] and final["tokens"] == full["tokens"][:3]
     streamed = [e["token"] for e in events[:-1]]
     assert streamed == final["tokens"]  # no leaked stop-prefix tokens
+
+
+def test_engine_failure_surfaces_error_in_response():
+    """A poisoned prefill fails the request engine-side; the HTTP
+    response must carry .error instead of a silent empty completion."""
+    import jax
+
+    from http.server import ThreadingHTTPServer
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.models.serving import ServingEngine
+    from kubedl_tpu.train.serve import _Handler, _Service
+
+    config = llama.LlamaConfig.tiny(use_flash=False)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    engine = ServingEngine(params, config, slots=2, max_len=64)
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic prefill failure")
+
+    engine._prefill = boom
+    svc = _Service(engine)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    httpd.daemon_threads = True
+    httpd.svc = svc
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        out = _post(f"{url}/generate",
+                    {"tokens": [1, 2, 3], "max_new_tokens": 4})
+        assert out["tokens"] == []
+        assert "synthetic prefill failure" in out.get("error", "")
+    finally:
+        httpd.shutdown()
+        svc.stop()
