@@ -710,19 +710,21 @@ def stack_params(params: Dict) -> Dict:
     return out
 
 
-def forward_pipelined(
+def forward_pipelined_and_aux(
     params: Dict,  # stacked layout (stack_params)
     tokens: jax.Array,
     config: LlamaConfig,
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     n_microbatches: int = 4,
-) -> jax.Array:
-    """GPipe forward over the mesh's "stage" axis. Composes with data
-    parallelism; tensor/context/expert must be size 1 on a pipelined mesh
-    (those shardings need manual collectives inside shard_map)."""
-    if config.n_experts > 0:
-        raise ValueError("pipelined path requires dense FFN (n_experts=0)")
+) -> Tuple[jax.Array, jax.Array]:
+    """GPipe forward over the mesh's "stage" axis; returns (logits,
+    summed MoE aux loss — 0 when dense). Composes with data parallelism
+    AND MoE (experts replicated per stage: _mlp_block runs the local
+    dropless gmm route inside the stage body, aux accumulated per valid
+    microbatch window — parallel/pipeline.py); tensor/context/expert
+    must be size 1 on a pipelined mesh (those shardings need manual
+    collectives inside shard_map)."""
     if config.layer_windows is not None:
         # the pipeline scans ONE compiled layer program over stacked
         # params; a per-layer static mask can't vary inside the scan
@@ -741,21 +743,35 @@ def forward_pipelined(
         pos = jnp.broadcast_to(positions1, (a.shape[0], t))
         a = _attention_block(a, layer, config, pos, None, rules, 1,
                              window=config.sliding_window)
-        a, _ = _mlp_block(a, layer, config)
-        return a
+        a, aux = _mlp_block(a, layer, config)
+        return a, aux
 
     x = pipeline.microbatch(x, n_microbatches)
-    y = pipeline.pipeline_apply(
-        params["layers"], x, layer_fn, mesh=mesh, remat=config.remat
+    y, aux = pipeline.pipeline_apply(
+        params["layers"], x, layer_fn, mesh=mesh, remat=config.remat,
+        with_aux=True,
     )
     x = pipeline.unmicrobatch(y)
-    return _lm_head(x, params, config)
+    return _lm_head(x, params, config), aux
+
+
+def forward_pipelined(
+    params: Dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    n_microbatches: int = 4,
+) -> jax.Array:
+    return forward_pipelined_and_aux(
+        params, tokens, config, mesh, rules=rules,
+        n_microbatches=n_microbatches)[0]
 
 
 def loss_fn_pp(
     params, tokens, config: LlamaConfig, mesh: Mesh, rules=None, n_microbatches: int = 4
 ):
-    logits = forward_pipelined(
+    logits, aux = forward_pipelined_and_aux(
         params, tokens[:, :-1], config, mesh, rules=rules, n_microbatches=n_microbatches
     )
-    return _next_token_ce(logits, tokens[:, 1:])
+    return _next_token_ce(logits, tokens[:, 1:]) + config.moe_aux_coef * aux

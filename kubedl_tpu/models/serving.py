@@ -566,8 +566,17 @@ class ServingEngine:
             raise ValueError(
                 f"prefix {prefix_len} + prompt {prompt.size} + "
                 f"{max_new_tokens} new tokens exceeds max_len {self.max_len}")
-        if prefix_id is None and prompt.size > self.prompt_buckets[-1]:
-            # reject at submission, not when _admit pops it mid-flight
+        # mirrors _use_chunked (monotone: anything past the largest
+        # bucket is chunk-eligible, so longer never rejects while
+        # shorter admits)
+        chunk_eligible = (self.prefill_chunk > 0 and not self.ring
+                          and (prompt.size > self.prefill_chunk
+                               or prompt.size > self.prompt_buckets[-1]))
+        if (prefix_id is None and prompt.size > self.prompt_buckets[-1]
+                and not chunk_eligible):
+            # reject at submission, not when _admit pops it mid-flight;
+            # the chunked path needs no bucket (its block steps are
+            # bucket-free), so it lifts this cap — max_len still bounds
             raise ValueError(
                 f"prompt of {prompt.size} tokens exceeds the largest "
                 f"prompt bucket {self.prompt_buckets[-1]}")
@@ -700,13 +709,17 @@ class ServingEngine:
         req.cache_len = cache_len
 
     def _use_chunked(self, req: Request) -> bool:
-        """Route to the chunked prefill path: long plain prompts only.
-        Ring caches can't honor block appends (a block can wrap over its
-        own in-flight positions — same restriction as prefix caching)."""
+        """Route to the chunked prefill path: prompts too long for a
+        chunk OR for the largest wave bucket (monotone in length — the
+        block steps handle a partial final chunk, so anything the wave
+        can't take, chunking can). Ring caches can't honor block appends
+        (a block can wrap over its own in-flight positions — same
+        restriction as prefix caching)."""
         return (
             self.prefill_chunk > 0
             and not self.ring
-            and len(req.prompt) > self.prefill_chunk
+            and (len(req.prompt) > self.prefill_chunk
+                 or len(req.prompt) > self.prompt_buckets[-1])
         )
 
     def _advance_chunk(self) -> None:

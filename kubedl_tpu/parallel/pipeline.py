@@ -56,14 +56,24 @@ def pipeline_apply(
     stage_axis: str = "stage",
     batch_axes: Tuple[str, ...] = BATCH_AXES,
     remat: bool = False,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Any:
     """Run every microbatch through all pipeline stages; returns activations
-    with the same shape as `x_microbatches`.
+    with the same shape as `x_microbatches` (or an (activations, aux)
+    tuple with with_aux=True — see below).
 
     `stacked_params` leaves have leading dim n_layers (divisible by the
     stage-axis size); `layer_fn(act, layer_params) -> act` applies ONE layer
     and must be shape-preserving. Microbatch dim 0 is the pipeline's time
     axis; dim 1 (micro batch) is sharded over `batch_axes`.
+
+    with_aux=True: `layer_fn` returns (act, aux_scalar) — e.g. the MoE
+    load-balance loss — and the call returns (activations, aux_total).
+    Contributions are gated to each stage's VALID window (the GPipe
+    fill/drain steps feed clipped garbage that must not count), summed
+    over this stage's layers and steps, psummed across stages, and
+    averaged over microbatches — the microbatch-mean approximation of
+    the full-batch aux every per-shard MoE implementation uses.
     """
     n_stages = mesh.shape[stage_axis]
     n_micro = x_microbatches.shape[0]
@@ -81,15 +91,21 @@ def pipeline_apply(
     x_rank = x_microbatches.ndim
 
     per_layer = layer_fn
+    if not with_aux:
+        def per_layer(a, layer):  # noqa: F811 — uniform (act, aux) shape
+            return layer_fn(a, layer), jnp.zeros((), jnp.float32)
     if remat:
         per_layer = jax.checkpoint(per_layer)
 
     def run_local_layers(act, params_local):
-        def body(a, layer):
-            return per_layer(a, layer), None
+        def body(carry, layer):
+            a, aux = carry
+            a, da = per_layer(a, layer)
+            return (a, aux + da), None
 
-        act, _ = jax.lax.scan(body, act, params_local)
-        return act
+        (act, aux), _ = jax.lax.scan(
+            body, (act, jnp.zeros((), jnp.float32)), params_local)
+        return act, aux
 
     perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
     n_steps = n_micro + n_stages - 1
@@ -100,14 +116,18 @@ def pipeline_apply(
         act = jnp.zeros_like(x_mub[0])
 
         def step(carry, i):
-            act, out_buf = carry
+            act, out_buf, aux_acc = carry
             # stage 0 ingests microbatch i (clipped: trailing drain steps
             # feed garbage that never reaches an output slot)
             inp = jax.lax.dynamic_index_in_dim(
                 x_mub, jnp.clip(i, 0, n_micro - 1), 0, keepdims=False
             )
             act = jnp.where(stage == 0, inp, act)
-            act = run_local_layers(act, params_local)
+            act, aux = run_local_layers(act, params_local)
+            # stage s does REAL work on microbatch i-s; fill/drain steps
+            # process clipped garbage whose aux must not count
+            valid = jnp.logical_and(i - stage >= 0, i - stage < n_micro)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             # last stage banks finished microbatch i-(n_stages-1)
             out_idx = jnp.clip(i - (n_stages - 1), 0, n_micro - 1)
             cur = jax.lax.dynamic_index_in_dim(out_buf, out_idx, 0, keepdims=False)
@@ -117,26 +137,34 @@ def pipeline_apply(
             out_buf = jax.lax.dynamic_update_index_in_dim(out_buf, bank, out_idx, 0)
             # rotate activations one ICI hop to the next stage
             act = jax.lax.ppermute(act, stage_axis, perm)
-            return (act, out_buf), None
+            return (act, out_buf, aux_acc), None
 
-        (act, out_buf), _ = jax.lax.scan(
-            step, (act, out_buf), jnp.arange(n_steps, dtype=jnp.int32)
+        (act, out_buf, aux_acc), _ = jax.lax.scan(
+            step, (act, out_buf, jnp.zeros((), jnp.float32)),
+            jnp.arange(n_steps, dtype=jnp.int32)
         )
+        # every stage contributes its own layers' aux; mean over
+        # microbatches approximates the full-batch value, pmean over the
+        # batch axes makes it a true global (replicated) scalar
+        aux_total = jax.lax.psum(aux_acc, stage_axis) / n_micro
+        aux_total = jax.lax.pmean(aux_total, batch_axes)
         # leading singleton picks out this stage's copy; only the last
         # stage's buffer holds real outputs and the caller slices it.
-        return out_buf[None]
+        return out_buf[None], aux_total
 
     params_spec = jax.tree_util.tree_map(lambda _: P(stage_axis), stacked_params)
     x_spec = P(None, batch_axes, *([None] * (x_rank - 2)))
     out_spec = P(stage_axis, None, batch_axes, *([None] * (x_rank - 2)))
 
-    out = jax.shard_map(
+    out, aux = jax.shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
-        out_specs=out_spec,
+        out_specs=(out_spec, P()),
         check_vma=False,
     )(stacked_params, x_microbatches)
+    if with_aux:
+        return out[-1], aux
     return out[-1]
 
 

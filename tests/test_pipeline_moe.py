@@ -161,10 +161,48 @@ def test_moe_llama_end_to_end():
     assert np.isfinite(float(metrics["loss"]))
 
 
-def test_moe_pipelined_rejected():
-    config = tiny(n_layers=4, n_experts=4)
+def test_pipelined_moe_forward_matches_sequential():
+    """MoE layers inside the GPipe pipeline (experts replicated per
+    stage, local dropless gmm route): logits must match the sequential
+    forward — routing is per-token, so microbatching can't change it."""
+    config = tiny(n_layers=4, remat=False, n_experts=4, expert_top_k=2)
     mesh = build_mesh({"stage": 4, "data": 2})
-    params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
-    tokens = jnp.zeros((8, 8), jnp.int32)
-    with pytest.raises(ValueError, match="dense"):
-        llama.forward_pipelined(params, tokens, config, mesh, n_microbatches=4)
+    params = llama.init(config, jax.random.PRNGKey(3))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (8, 16), 0, config.vocab_size)
+
+    ref = llama.forward(params, tokens, config)
+    stacked = llama.stack_params(params)
+    out, aux = jax.jit(
+        lambda p, t: llama.forward_pipelined_and_aux(
+            p, t, config, mesh, n_microbatches=4)
+    )(stacked, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    # aux is computed at MICROBATCH granularity (the per-shard aux of
+    # GShard/Switch): smaller token pools inflate the product-of-means,
+    # so expect same order of magnitude, not equality
+    _, aux_ref = llama.forward_and_aux(params, tokens, config)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+    ratio = float(aux) / float(aux_ref)
+    assert 0.3 < ratio < 3.0, (float(aux), float(aux_ref))
+
+
+def test_pipelined_moe_loss_grads_finite_and_router_trains():
+    """value_and_grad through pipeline + MoE: finite grads everywhere
+    including the ROUTER (the aux path must reach it through the
+    valid-window gating and psum)."""
+    config = tiny(n_layers=2, remat=False, n_experts=4, expert_top_k=2)
+    mesh = build_mesh({"stage": 2, "data": 4})
+    params = llama.init(config, jax.random.PRNGKey(5))
+    stacked = llama.stack_params(params)
+    # 16 rows / 4 microbatches -> microbatch 4, sharded over data(4)
+    tokens = jax.random.randint(jax.random.PRNGKey(6), (16, 17), 0, config.vocab_size)
+
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn_pp(p, tokens, config, mesh, n_microbatches=4)
+    ))(stacked)
+    assert np.isfinite(float(loss))
+    flat = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.isfinite(g).all()) for g in flat)
+    router_g = grads["layers"]["moe"]["router"]
+    assert float(jnp.abs(router_g).max()) > 0.0, "router must receive grads"

@@ -661,3 +661,22 @@ def test_failed_chunked_prefill_frees_slot(model, monkeypatch):
     while not req2.done:
         eng.step()
     assert req2.tokens == ref_generate(params, config, longp, 4)
+
+
+def test_chunked_prefill_lifts_bucket_cap(model):
+    """With chunking enabled, a prompt larger than the largest bucket is
+    admissible (the chunked path is bucket-free); max_len still bounds."""
+    params, config = model
+    rng = np.random.default_rng(13)
+    eng = ServingEngine(params, config, slots=2, max_len=128,
+                        prompt_buckets=[16, 32], prefill_chunk=16)
+    longp = rng.integers(1, config.vocab_size, size=50).astype(np.int32)
+    req = eng.submit(longp, max_new_tokens=4)
+    while not req.done:
+        eng.step()
+    assert req.tokens == ref_generate(params, config, longp, 4)
+    # without chunking the same submit must still reject
+    eng2 = ServingEngine(params, config, slots=2, max_len=128,
+                         prompt_buckets=[16, 32], prefill_chunk=0)
+    with pytest.raises(ValueError, match="largest"):
+        eng2.submit(longp, max_new_tokens=4)
