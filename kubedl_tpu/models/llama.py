@@ -749,7 +749,6 @@ def forward_pipelined_and_aux(
     x = pipeline.microbatch(x, n_microbatches)
     y, aux = pipeline.pipeline_apply(
         params["layers"], x, layer_fn, mesh=mesh, remat=config.remat,
-        with_aux=True,
     )
     x = pipeline.unmicrobatch(y)
     return _lm_head(x, params, config), aux

@@ -566,12 +566,7 @@ class ServingEngine:
             raise ValueError(
                 f"prefix {prefix_len} + prompt {prompt.size} + "
                 f"{max_new_tokens} new tokens exceeds max_len {self.max_len}")
-        # mirrors _use_chunked (monotone: anything past the largest
-        # bucket is chunk-eligible, so longer never rejects while
-        # shorter admits)
-        chunk_eligible = (self.prefill_chunk > 0 and not self.ring
-                          and (prompt.size > self.prefill_chunk
-                               or prompt.size > self.prompt_buckets[-1]))
+        chunk_eligible = self._chunk_eligible(prompt.size)
         if (prefix_id is None and prompt.size > self.prompt_buckets[-1]
                 and not chunk_eligible):
             # reject at submission, not when _admit pops it mid-flight;
@@ -708,19 +703,25 @@ class ServingEngine:
         self._admitted += 1
         req.cache_len = cache_len
 
-    def _use_chunked(self, req: Request) -> bool:
-        """Route to the chunked prefill path: prompts too long for a
-        chunk OR for the largest wave bucket (monotone in length — the
-        block steps handle a partial final chunk, so anything the wave
-        can't take, chunking can). Ring caches can't honor block appends
-        (a block can wrap over its own in-flight positions — same
-        restriction as prefix caching)."""
+    def _chunk_eligible(self, prompt_len: int) -> bool:
+        """Chunked-prefill eligibility: prompts too long for a chunk OR
+        for the largest wave bucket (monotone in length — the block
+        steps handle a partial final chunk, so anything the wave can't
+        take, chunking can). Ring caches can't honor block appends (a
+        block can wrap over its own in-flight positions — same
+        restriction as prefix caching). The ONE predicate both submit()
+        admission and _admit() routing use — drift between them would
+        send an over-bucket prompt into the wave's _bucket() and wedge
+        its claimed slots."""
         return (
             self.prefill_chunk > 0
             and not self.ring
-            and (len(req.prompt) > self.prefill_chunk
-                 or len(req.prompt) > self.prompt_buckets[-1])
+            and (prompt_len > self.prefill_chunk
+                 or prompt_len > self.prompt_buckets[-1])
         )
+
+    def _use_chunked(self, req: Request) -> bool:
+        return self._chunk_eligible(len(req.prompt))
 
     def _advance_chunk(self) -> None:
         """One prefill_chunk-token block step of the in-flight chunked

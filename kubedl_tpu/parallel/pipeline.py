@@ -19,9 +19,11 @@ a send/recv translation:
     loss is the pipelined backward pass for free.
 
 Composes with data parallelism (batch sharded over data+fsdp, params
-replicated across those axes inside the stage shard_map). Tensor/context/
-expert sharding inside a pipelined layer would need manual collectives in
-shard_map and is intentionally out of scope for the pipelined path — use
+replicated across those axes inside the stage shard_map) and with MoE
+layers (experts replicated per stage, aux loss threaded through the
+schedule — models/llama.py forward_pipelined_and_aux). Tensor/context/
+expert MESH AXES inside a pipelined layer would need manual collectives
+in shard_map and stay out of scope for the pipelined path — use
 tp/cp/ep on the non-pipelined forward instead.
 """
 from __future__ import annotations
@@ -56,20 +58,18 @@ def pipeline_apply(
     stage_axis: str = "stage",
     batch_axes: Tuple[str, ...] = BATCH_AXES,
     remat: bool = False,
-    with_aux: bool = False,
-) -> Any:
-    """Run every microbatch through all pipeline stages; returns activations
-    with the same shape as `x_microbatches` (or an (activations, aux)
-    tuple with with_aux=True — see below).
+) -> Tuple[jax.Array, jax.Array]:
+    """Run every microbatch through all pipeline stages; returns
+    (activations shaped like `x_microbatches`, aux_total scalar).
 
     `stacked_params` leaves have leading dim n_layers (divisible by the
-    stage-axis size); `layer_fn(act, layer_params) -> act` applies ONE layer
-    and must be shape-preserving. Microbatch dim 0 is the pipeline's time
-    axis; dim 1 (micro batch) is sharded over `batch_axes`.
+    stage-axis size); `layer_fn(act, layer_params) -> (act, aux_scalar)`
+    applies ONE layer, must be shape-preserving, and reports a per-layer
+    aux scalar — e.g. the MoE load-balance loss (dense layers return a
+    zero scalar). Microbatch dim 0 is the pipeline's time axis; dim 1
+    (micro batch) is sharded over `batch_axes`.
 
-    with_aux=True: `layer_fn` returns (act, aux_scalar) — e.g. the MoE
-    load-balance loss — and the call returns (activations, aux_total).
-    Contributions are gated to each stage's VALID window (the GPipe
+    Aux contributions are gated to each stage's VALID window (the GPipe
     fill/drain steps feed clipped garbage that must not count), summed
     over this stage's layers and steps, psummed across stages, and
     averaged over microbatches — the microbatch-mean approximation of
@@ -91,9 +91,6 @@ def pipeline_apply(
     x_rank = x_microbatches.ndim
 
     per_layer = layer_fn
-    if not with_aux:
-        def per_layer(a, layer):  # noqa: F811 — uniform (act, aux) shape
-            return layer_fn(a, layer), jnp.zeros((), jnp.float32)
     if remat:
         per_layer = jax.checkpoint(per_layer)
 
@@ -163,9 +160,7 @@ def pipeline_apply(
         out_specs=(out_spec, P()),
         check_vma=False,
     )(stacked_params, x_microbatches)
-    if with_aux:
-        return out[-1], aux
-    return out[-1]
+    return out[-1], aux
 
 
 def microbatch(x: jax.Array, n_microbatches: int) -> jax.Array:

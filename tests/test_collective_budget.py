@@ -1,0 +1,68 @@
+"""Compiled-HLO collective budget for the dropless expert-parallel MoE
+route (models/moe.py _dropless_mlp_sharded).
+
+EP perf dies silently when a sharding annotation makes XLA replicate
+activations or re-gather weights — the program still computes the right
+numbers, just with catastrophic extra collectives. Pinning the compiled
+forward's collective counts turns that failure mode into a test diff:
+
+  * 3 all-to-alls: token rows out, expert ids out, outputs back;
+  * <= 1 all-gather: re-assembling y to the caller's output sharding;
+  * all-reduces only for the EP x TP psum completing the FFN.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubedl_tpu.models.moe import moe_init, moe_mlp, moe_param_specs
+from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+
+
+def _op_count(txt: str, op: str) -> int:
+    # HLO op lines: `%name = <type> op-name(...)` — match the opcode
+    # position (space-prefixed, immediately followed by an open paren);
+    # async pairs add -start with the same stem, counted once
+    return txt.count(f" {op}(") + txt.count(f" {op}-start(")
+
+
+def _compiled_text(mesh, rules, params, h):
+    fn = jax.jit(lambda h, p: moe_mlp(
+        h, p, top_k=2, capacity_factor=2.0, mesh=mesh, rules=rules,
+        dropless=True)[0])
+    return fn.lower(h, params).compile().as_text()
+
+
+def _sharded_inputs(mesh, rules, seed=0):
+    d, ff, e = 128, 256, 4
+    params = moe_init(jax.random.PRNGKey(seed), d, ff, e, dtype=jnp.float32)
+    specs = moe_param_specs(rules)
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+               for k, v in params.items()}
+    h = jax.device_put(
+        jax.random.normal(jax.random.PRNGKey(seed + 1), (8, 16, d), jnp.float32),
+        NamedSharding(mesh, P(("data",), None, None)))
+    return sharded, h
+
+
+def test_ep_dropless_forward_collective_budget():
+    mesh = build_mesh({"expert": 4, "data": 2})
+    rules = ShardingRules()
+    params, h = _sharded_inputs(mesh, rules)
+    txt = _compiled_text(mesh, rules, params, h)
+    assert _op_count(txt, "all-to-all") == 3, txt.count("all-to-all")
+    assert _op_count(txt, "all-gather") <= 1
+    assert _op_count(txt, "all-reduce") == 0, (
+        "pure EP forward needs no all-reduce — one appearing means XLA "
+        "is repairing a sharding mismatch")
+    assert _op_count(txt, "collective-permute") == 0
+
+
+def test_ep_tp_dropless_forward_collective_budget():
+    mesh = build_mesh({"expert": 2, "tensor": 2, "data": 2})
+    rules = ShardingRules()
+    params, h = _sharded_inputs(mesh, rules, seed=2)
+    txt = _compiled_text(mesh, rules, params, h)
+    assert _op_count(txt, "all-to-all") == 3
+    # the one intended all-reduce: the psum completing the TP FFN
+    n_ar = _op_count(txt, "all-reduce")
+    assert 1 <= n_ar <= 2, n_ar
