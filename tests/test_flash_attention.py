@@ -100,6 +100,7 @@ def test_head_dim_64_pads_onto_fused_kernel(s):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_streamed_fwd_matches_default_kernel(monkeypatch):
     """The K-streaming 3D-grid forward (seq > STREAM_MIN_SEQ) must agree
     with the default full-K/V kernel and the reference — forced here by
@@ -288,6 +289,7 @@ def test_config_rejects_zero_window():
         LlamaConfig.tiny(sliding_window=0)
 
 
+@pytest.mark.slow
 def test_softcap_forward_and_gradients_match_reference():
     """Gemma-2 logit softcapping inside the kernel: forward and all
     three gradients match the reference exactly, with and without a

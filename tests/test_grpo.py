@@ -43,6 +43,7 @@ def test_group_advantages_normalization():
     np.testing.assert_allclose(adv[2], 0.0, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_grpo_loss_on_policy_fixed_point(model):
     """With current == old == reference policy: every ratio is exactly 1
     (no clipping), the k3 KL is exactly 0, and the surrogate reduces to

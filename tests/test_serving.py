@@ -131,6 +131,7 @@ def test_cancel_frees_queue_and_slot(model):
     assert len(c.tokens) == 3
 
 
+@pytest.mark.slow
 def test_prefix_cache_matches_full_prompt(model):
     params, config = model
     rng = np.random.default_rng(3)
@@ -546,6 +547,7 @@ def test_chunked_prefill_parity_with_generate(model):
     assert r_short.tokens == ref_generate(params, config, short, 12)
 
 
+@pytest.mark.slow
 def test_chunked_prefill_interleaves_with_decode(model):
     """Active slots keep emitting between chunks: by the time the long
     request finishes its prefill, the short one has made progress."""

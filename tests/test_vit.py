@@ -62,6 +62,7 @@ def test_sharded_training_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_vision_program_runs(capsys):
     from kubedl_tpu.train import vision
 
