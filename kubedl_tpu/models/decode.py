@@ -350,8 +350,11 @@ def decode_block_step(
     return_hidden=True, (pre-head activations [b, T, d], cache).
     logits[:, i] predicts the token AFTER tokens[:, i]. Query i attends
     the full cache plus the block prefix up to itself (causal within the
-    block). Uniform (scalar-length) caches only — the speculative-verify
-    and chunked-prefill consumer paths are uniform by construction.
+    block). Uniform (scalar-length) caches take one dynamic_update_slice
+    per layer; RAGGED caches ([b] lengths — the serving batch) append
+    each row's T tokens at ITS OWN length via vmapped per-row writes
+    (the speculative-serving verify path). Ring caches are single-token
+    only.
 
     A caller that accepts fewer than T positions (speculative decoding)
     rolls back by shrinking cache["lengths"]: entries past the length
@@ -359,28 +362,41 @@ def decode_block_step(
     c = config
     b, T = tokens.shape
     pos = cache["lengths"]
-    if pos.ndim != 0:
-        raise ValueError("decode_block_step requires a uniform cache "
-                         "(init_kv_cache(..., uniform=True))")
+    ragged = pos.ndim == 1
     max_cap = cache["k"][0].shape[2]
     ring = "ring" in cache
-    if ring and T > 1:
+    if ring and (T > 1 or ragged):
         # a T-block can wrap over its own writes and earlier queries of
         # the block would need positions the ring already evicted
-        raise ValueError("ring caches support single-token steps only")
+        raise ValueError("ring caches support uniform single-token steps only")
     if T > max_cap:
         raise ValueError(f"block of {T} tokens exceeds cache max_len {max_cap}")
-    if (not ring and not isinstance(pos, jax.core.Tracer)
-            and int(pos) + T > max_cap):
-        # appending past capacity would CLAMP the write offset and
-        # silently corrupt earlier positions — the multi-turn footgun
-        raise ValueError(
-            f"cache holds {int(pos)} of {max_cap} positions; appending "
-            f"{T} more overflows it — init a larger max_len"
-        )
-    wpos = jnp.mod(pos, max_cap) if ring else pos  # ring: wrap the write
+    if not ring and not isinstance(pos, jax.core.Tracer):
+        top = int(jnp.max(pos)) if ragged else int(pos)
+        if top + T > max_cap:
+            # appending past capacity would CLAMP the write offset and
+            # silently corrupt earlier positions — the multi-turn footgun
+            raise ValueError(
+                f"cache holds {top} of {max_cap} positions; appending "
+                f"{T} more overflows it — init a larger max_len"
+            )
+    wpos = pos if not ring else jnp.mod(pos, max_cap)  # ring: wrap the write
     int8_kv = "ks" in cache
-    positions = jnp.broadcast_to((pos + jnp.arange(T, dtype=jnp.int32))[None], (b, T))
+    if ragged:
+        positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None]  # [b, T]
+        write_row = jax.vmap(
+            lambda cache_row, new_row, p: jax.lax.dynamic_update_slice_in_dim(
+                cache_row, new_row, p, axis=1
+            )
+        )  # [b,hkv,L,d], [b,hkv,T,d], [b] -> per-row block at its offset
+        write_scale = jax.vmap(
+            lambda scale_row, new_scale, p: jax.lax.dynamic_update_slice_in_dim(
+                scale_row, new_scale, p, axis=1
+            )
+        )  # [b,hkv,L], [b,hkv,T], [b]
+    else:
+        positions = jnp.broadcast_to(
+            (pos + jnp.arange(T, dtype=jnp.int32))[None], (b, T))
     limits = positions + 1  # query i sees cache < pos + i + 1
 
     x = params["embed"][tokens].astype(c.dtype)  # [b, T, d]
@@ -401,12 +417,21 @@ def decode_block_step(
         if int8_kv:
             qk, sk = _quantize_kv(k)
             qv, sv = _quantize_kv(v)
-            ck = jax.lax.dynamic_update_slice(cache["k"][i], qk, (0, 0, wpos, 0))
-            cv = jax.lax.dynamic_update_slice(cache["v"][i], qv, (0, 0, wpos, 0))
-            cks = jax.lax.dynamic_update_slice(cache["ks"][i], sk, (0, 0, wpos))
-            cvs = jax.lax.dynamic_update_slice(cache["vs"][i], sv, (0, 0, wpos))
+            if ragged:
+                ck = write_row(cache["k"][i], qk, wpos)
+                cv = write_row(cache["v"][i], qv, wpos)
+                cks = write_scale(cache["ks"][i], sk, wpos)
+                cvs = write_scale(cache["vs"][i], sv, wpos)
+            else:
+                ck = jax.lax.dynamic_update_slice(cache["k"][i], qk, (0, 0, wpos, 0))
+                cv = jax.lax.dynamic_update_slice(cache["v"][i], qv, (0, 0, wpos, 0))
+                cks = jax.lax.dynamic_update_slice(cache["ks"][i], sk, (0, 0, wpos))
+                cvs = jax.lax.dynamic_update_slice(cache["vs"][i], sv, (0, 0, wpos))
             new_ks.append(cks)
             new_vs.append(cvs)
+        elif ragged:
+            ck = write_row(cache["k"][i], k.astype(c.dtype), wpos)
+            cv = write_row(cache["v"][i], v.astype(c.dtype), wpos)
         else:
             ck = jax.lax.dynamic_update_slice(
                 cache["k"][i], k.astype(c.dtype), (0, 0, wpos, 0))

@@ -108,6 +108,9 @@ class ServingEngine:
         max_top_k: int = 64,
         max_adapters: int = 8,
         prefill_chunk: int = 256,
+        draft_params: Optional[Dict] = None,
+        draft_config: Optional[LlamaConfig] = None,
+        spec_k: int = 4,
     ) -> None:
         self.params = params
         self.config = config
@@ -178,6 +181,52 @@ class ServingEngine:
         self.prefill_chunk = int(prefill_chunk)
         self._chunking: Optional[Dict] = None  # {req, slot, cache, pos}
         self._chunked_prefills = 0
+        # speculative continuous batching: a small draft model shares the
+        # slot structure (its own ragged KV cache, prefilled at admission
+        # beside the target's). While every active slot is GREEDY, each
+        # engine step becomes a ROUND: k draft steps propose, ONE ragged
+        # target block verifies all slots at once, each slot keeps its
+        # longest matching prefix + the target's own next token — up to
+        # k tokens per slot per round, exact greedy outputs by
+        # construction. Sampled/filtered traffic falls back to normal
+        # ticks for that step (distribution-preserving rejection is a
+        # per-slot control-flow mess the static batch can't justify).
+        self._spec = draft_params is not None
+        if self._spec:
+            if draft_config is None:
+                raise ValueError("draft_params needs draft_config")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_config.vocab_size} != target "
+                    f"{config.vocab_size}; the models must share a tokenizer")
+            if self.ring:
+                raise ValueError(
+                    "speculative serving is unsupported with ring caches "
+                    "(the verify block can't wrap)")
+            if spec_k < 2:
+                raise ValueError(f"spec_k must be >= 2, got {spec_k}")
+            self.draft_params = draft_params
+            self.draft_config = draft_config
+            self.spec_k = int(spec_k)
+            self.draft_cache = decode.init_kv_cache(
+                draft_config, slots, max_len, kv_dtype=kv_dtype)
+            self._spec_rounds = 0
+            self._spec_slot_rounds = 0  # sum over rounds of active slots
+            self._spec_accepted = 0
+
+            def draft_prefill_fn(dparams, prompt, length):
+                scratch = decode.init_kv_cache(
+                    draft_config, prompt.shape[0], max_len, kv_dtype=kv_dtype)
+                return decode.prefill(
+                    dparams, prompt, scratch, draft_config, lengths=length)
+
+            self._draft_prefill = jax.jit(draft_prefill_fn)
+            self._draft_insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+            self._spec_round = jax.jit(
+                self._spec_round_impl, static_argnums=(4,),
+                donate_argnums=(2, 3))
+            self._draft_sync = jax.jit(
+                self._draft_sync_impl, donate_argnums=(1,))
 
         # compiled pieces: params is threaded as an ARGUMENT everywhere —
         # a jit that closes over multi-GB weights bakes them into the
@@ -385,6 +434,112 @@ class ServingEngine:
             body, (cache, cur_tokens), jax.random.split(key, k))
         return cache, cur, toks, lps
 
+    def _spec_round_impl(self, params, dparams, t_cache, d_cache, k,
+                         cur_tokens, active, lora, adapter_ids):
+        """One speculative round over the whole slot batch (greedy).
+
+        Returns (t_cache, d_cache, new_cur, emit [slots, k], accepted
+        [slots], lp [slots, k]): per slot, emit[:accepted+1] are the
+        tokens produced this round (accepted drafts then the target's
+        own next token); rows past a slot's count are junk the host
+        never reads. Both caches roll back to base + accepted + 1
+        (frozen slots stay at base — their stale writes are masked and
+        overwritten later, exactly like the normal tick's freeze)."""
+        base = t_cache["lengths"]
+        d_base = d_cache["lengths"]
+
+        def body(carry, _):
+            tok, dc = carry
+            lg, dc = decode.decode_step(dparams, tok, dc, self.draft_config)
+            nxt = jnp.where(active, jnp.argmax(lg, -1).astype(jnp.int32), 0)
+            return (nxt, dc), nxt
+
+        (_, d_cache), drafted = jax.lax.scan(
+            body, (cur_tokens, d_cache), None, length=k)
+        drafted = drafted.T  # [slots, k]
+        # verify width k (cur + k-1 testable drafts): the k-th draft can
+        # never be emitted (acceptance caps at k-1 so the draft cache —
+        # which only ever saw k inputs — stays position-aligned), so a
+        # k+1-wide block would burn ~1/(k+1) of the verify FLOPs on a
+        # column nothing reads. The k-step draft SCAN stays: its last
+        # step's KV write (position base+k-1) is needed at full accept.
+        blk = jnp.concatenate(
+            [cur_tokens[:, None], drafted[:, : k - 1]], axis=1)  # [s, k]
+        blk_logits, t_cache = decode.decode_block_step(
+            params, blk, t_cache, self.config,
+            lora=lora, adapter_ids=adapter_ids)
+        ta = jnp.argmax(blk_logits, axis=-1).astype(jnp.int32)  # [s, k]
+        matches = (drafted[:, : k - 1] == ta[:, : k - 1]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [s], <= k-1
+        bonus = jnp.take_along_axis(ta, a[:, None], axis=1)[:, 0]
+        cols = jnp.arange(k)[None, :]
+        emit = jnp.where(cols < a[:, None], drafted, 0)
+        emit = jnp.where(cols == a[:, None], bonus[:, None], emit)
+        # model logprob of each emitted token (position j's logits
+        # predict emit j)
+        lg32 = blk_logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(lg32, axis=-1)
+        lp = jnp.take_along_axis(
+            lg32, emit[:, :, None], axis=2)[:, :, 0] - lse
+        adv = a + 1
+        t_cache["lengths"] = jnp.where(active, base + adv, base)
+        d_cache["lengths"] = jnp.where(active, d_base + adv, d_base)
+        new_cur = jnp.where(active, bonus, cur_tokens)
+        return t_cache, d_cache, new_cur, emit, jnp.where(active, a, 0), lp
+
+    def _use_spec_round(self, decoding: List[int]) -> bool:
+        """Speculative rounds need all-greedy traffic AND spec_k tokens
+        of KV headroom on every decoding slot — the ragged block write
+        clamps (silently corrupting history) instead of raising under
+        jit, so the guard lives here."""
+        if self._sample_mode() != "greedy":
+            return False
+        head = self.max_len - max(
+            self._slot_req[s].cache_len for s in decoding)
+        return head >= self.spec_k
+
+    def _draft_sync_impl(self, dparams, d_cache, cur_tokens, active):
+        """Append the tick's input token to the draft cache (frozen
+        slots don't advance) so fallback ticks keep draft state aligned
+        with the target's."""
+        old = d_cache["lengths"]
+        _, d_cache = decode.decode_step(
+            dparams, cur_tokens, d_cache, self.draft_config)
+        d_cache["lengths"] = jnp.where(active, d_cache["lengths"], old)
+        return d_cache
+
+    def _spec_step(self, decoding: List[int]) -> int:
+        """Advance every greedy decoding slot one speculative ROUND (up
+        to spec_k tokens each) with one host sync."""
+        t_dec0 = time.monotonic()
+        k = self.spec_k
+        self.cache, self.draft_cache, self.cur_tokens, emit, acc, lps = \
+            self._spec_round(
+                self.params, self.draft_params, self.cache, self.draft_cache,
+                k, self.cur_tokens, self.active, self.lora, self.slot_adapter)
+        self._ticks += 1
+        emit_h, acc_h, lp_h = (np.asarray(x) for x in
+                               jax.device_get((emit, acc, lps)))
+        self._decode_time += time.monotonic() - t_dec0
+        self._spec_rounds += 1
+        for slot in decoding:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            self._spec_slot_rounds += 1
+            n = int(acc_h[slot]) + 1
+            emitted = 0
+            for j in range(n):
+                if req.done:
+                    break  # EOS/stop mid-round: trailing tokens dropped
+                req.cache_len += 1
+                self._emit(slot, int(emit_h[slot, j]), float(lp_h[slot, j]))
+                emitted += 1
+            # only drafts that became OUTPUT count toward the acceptance
+            # dial (EOS mid-round drops the trailing accepted ones)
+            self._spec_accepted += min(emitted, int(acc_h[slot]))
+        return len(decoding)
+
     # -- public API --------------------------------------------------------
 
     _SUFFIX_CHUNK = 16  # block size for prefix-append prefill
@@ -543,6 +698,11 @@ class ServingEngine:
             # reusing it under an adapter would silently mix models
             raise ValueError("adapter_id cannot combine with prefix_id "
                              "(prefix K/V is base-model state)")
+        if self._spec and prefix_id is not None:
+            # the draft model has no prefix K/V to splice, and drafting
+            # from a cold cache would silently floor acceptance
+            raise ValueError("prefix caching is unsupported with "
+                             "speculative serving")
         stop_seqs = []
         for s in (stop or []):
             ids = [int(t) for t in s]
@@ -779,6 +939,20 @@ class ServingEngine:
         self.cache, self.cur_tokens, self.active = self._insert(
             self.cache, st["cache"], slot, jnp.asarray([t], jnp.int32),
             first, self.cur_tokens, self.active)
+        if self._spec:
+            # draft state for the long prompt in one shot (the draft is
+            # small; chunking it would buy nothing) — width padded to a
+            # power of two so compiles stay log-bounded
+            t_pad = min(1 << (t - 1).bit_length(), self.max_len)
+            padded = np.zeros((1, t_pad), np.int32)
+            padded[0, :t] = prompt
+            _, d_rows = self._draft_prefill(
+                self.draft_params, jnp.asarray(padded),
+                jnp.asarray([t], jnp.int32))
+            self.draft_cache, _, _ = self._draft_insert(
+                self.draft_cache, self._row_slice(d_rows, 0), slot,
+                jnp.asarray([t], jnp.int32), first,
+                self.cur_tokens, self.active)
         self._claim_slot(slot, req, t)
         self._chunking = None
         self._chunked_prefills += 1
@@ -857,6 +1031,12 @@ class ServingEngine:
             self.params, jnp.asarray(padded), jnp.asarray(lengths),
             self.lora, jnp.asarray(adapters))
         self._prefill_batches += 1
+        if self._spec:
+            # the draft shares slot structure: prefill the same wave
+            # through the draft model and splice its rows beside the
+            # target's (draft is small — one cheap extra dispatch)
+            _, d_rows = self._draft_prefill(
+                self.draft_params, jnp.asarray(padded), jnp.asarray(lengths))
         if any(r.needs_filter for r in reqs):
             mode = "filtered"
         elif any(r.temperature > 0 for r in reqs):
@@ -874,6 +1054,11 @@ class ServingEngine:
                 self.cache, row_cache, slot,
                 jnp.asarray([lengths[i]], jnp.int32), firsts[i],
                 self.cur_tokens, self.active)
+            if self._spec:
+                self.draft_cache, _, _ = self._draft_insert(
+                    self.draft_cache, self._row_slice(d_rows, i), slot,
+                    jnp.asarray([lengths[i]], jnp.int32), firsts[i],
+                    self.cur_tokens, self.active)
             self._claim_slot(slot, req, int(lengths[i]))
             wave.append((slot, firsts[i], lps[i]))
 
@@ -951,14 +1136,30 @@ class ServingEngine:
         number of active slots this tick."""
         self._admit()
         self._advance_chunk()
+        return self._step_inner()
+
+    def _step_inner(self) -> int:
+        """One tick AFTER admission/chunk work — the shared tail step()
+        and step_block()'s degenerate fallbacks use (calling step() from
+        those would re-run _admit/_advance_chunk in the same pass and
+        double-advance the chunked prefill per decode tick)."""
         # host-side count: decoding slots mirror `active` exactly, and a
         # device_get here would sync the host against every tick
         decoding = self._decoding()
         n_active = len(decoding)
         if n_active == 0:
             return 0
+        if self._spec and self._use_spec_round(decoding):
+            return self._spec_step(decoding)
         t_dec0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
+        if self._spec:
+            # the draft cache must see the SAME tokens the target does,
+            # or speculation resumes desynced after this fallback tick
+            # and acceptance floors for the rest of every request
+            self.draft_cache = self._draft_sync(
+                self.draft_params, self.draft_cache, self.cur_tokens,
+                self.active)
         self.cache, nxt, lp = self._tick(
             self.params, self.cache, self.cur_tokens, self.active, sub,
             self.samp_temps, self.samp_topk, self.samp_topp,
@@ -992,6 +1193,15 @@ class ServingEngine:
         reqs = [self._slot_req[s] for s in decoding]
         if not reqs:
             return 0
+        if self._spec:
+            if self._use_spec_round(decoding):
+                # a speculative round is already a multi-token block (up
+                # to spec_k per slot, one sync)
+                return self._spec_step(decoding)
+            # fallback on a spec engine runs single ticks so the draft
+            # cache stays in sync (the fused block scan doesn't thread
+            # it); mixed traffic on a spec engine pays per-tick syncs
+            return self._step_inner()
         k = min(r.max_new_tokens - len(r.tokens) for r in reqs)
         k = min(k, max_block)
         if any(r.eos_token is not None or r.stop_sequences for r in reqs):
@@ -1002,7 +1212,7 @@ class ServingEngine:
             # back the sync savings
             k = min(k, max(max_block // 4, 8))
         if k <= 1:
-            return self.step()
+            return self._step_inner()
         # round UP to the next power of two and trim the overshoot on the
         # host: a handful of wasted ticks (<= k-1 small-batch decode steps)
         # buys whole round-trip syncs (63 needed = 2x32-blocks, not
@@ -1015,7 +1225,7 @@ class ServingEngine:
         if k > head:
             k = 1 << (head.bit_length() - 1) if head >= 1 else 0
         if k <= 1:
-            return self.step()
+            return self._step_inner()
         t_dec0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
         self.cache, self.cur_tokens, toks, lps = self._tick_block(
@@ -1063,4 +1273,13 @@ class ServingEngine:
             "decode_time_s": round(self._decode_time, 4),
             "prefill_batches": self._prefill_batches,
             "chunked_prefills": self._chunked_prefills,
+            **({
+                "spec_rounds": self._spec_rounds,
+                # accepted drafts per (round, active slot) over the cap
+                # k-1: the draft-quality dial (1.0 = every draft token
+                # accepted, tokens/round -> spec_k per slot)
+                "spec_acceptance": round(
+                    self._spec_accepted
+                    / max(self._spec_slot_rounds * (self.spec_k - 1), 1), 4),
+            } if self._spec else {}),
         }

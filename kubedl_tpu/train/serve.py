@@ -68,6 +68,17 @@ def parse_args(argv=None):
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache with exact scale folding — half the "
                         "per-token cache read at long contexts")
+    p.add_argument("--draft-model", default="",
+                   help="named config for a speculative draft model "
+                        "(models/llama.py config_for); requires "
+                        "--draft-checkpoint-path or --draft-hf-model")
+    p.add_argument("--draft-checkpoint-path", default="",
+                   help="Orbax checkpoint for the draft model")
+    p.add_argument("--draft-hf-model", default="",
+                   help="HF checkpoint for the draft model (must share "
+                        "the target's tokenizer)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
     p.add_argument("--max-steps", type=int, default=0,
                    help="stop after N pump passes, each up to --decode-block "
                         "device ticks (smoke tests); 0 = forever")
@@ -547,10 +558,32 @@ def main(argv=None) -> int:
         from kubedl_tpu.models import quant
 
         params = jax.jit(quant.quantize_params)(params)
+    draft_params = draft_config = None
+    if args.draft_model or args.draft_hf_model or args.draft_checkpoint_path:
+        if not (args.draft_hf_model or args.draft_checkpoint_path):
+            # resolve_params would silently fresh-init a weightless
+            # draft; random drafts floor acceptance and make serving
+            # STRICTLY slower than the plain engine
+            if not args.allow_fresh_init:
+                print("error: --draft-model needs weights "
+                      "(--draft-checkpoint-path or --draft-hf-model); "
+                      "pass --allow-fresh-init to force a random draft "
+                      "for tests", file=sys.stderr)
+                return 1
+            print("warning: random-init draft — speculation will be "
+                  "slower than plain serving (test mode)", file=sys.stderr)
+        draft_params, draft_config = resolve_params(
+            args.draft_model or "tiny", args.draft_hf_model,
+            args.draft_checkpoint_path, args.allow_fresh_init,
+            label="draft")
+        if draft_params is None:
+            return 1
     engine = ServingEngine(
         params, config, slots=args.slots, max_len=args.max_len,
         temperature=args.temperature,
         kv_dtype="int8" if args.kv_int8 else None,
+        draft_params=draft_params, draft_config=draft_config,
+        spec_k=args.spec_k,
     )
     svc = _Service(engine, tokenizer=tokenizer, decode_block=args.decode_block)
     for spec in args.adapter:

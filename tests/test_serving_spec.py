@@ -1,0 +1,183 @@
+"""Speculative continuous batching (models/serving.py): a draft model
+proposes k tokens per slot, one ragged target block verifies every slot
+at once. Greedy outputs must be EXACTLY the non-speculative engine's —
+a bad draft can only cost speed, never change tokens."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.models.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def models():
+    config = llama.LlamaConfig.tiny(use_flash=False, dtype=jnp.float32)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    # a DIFFERENT-weights draft (seed 42): realistic low acceptance,
+    # which stresses the rollback path instead of the happy path
+    draft = llama.init(config, jax.random.PRNGKey(42))
+    return params, draft, config
+
+
+def _serve(eng, prompts, n):
+    reqs = [eng.submit(p, n) for p in prompts]
+    while not all(r.done for r in reqs):
+        eng.step()
+    return [r.tokens for r in reqs]
+
+
+def test_spec_serving_matches_plain_engine(models):
+    params, draft, config = models
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, size=s).astype(np.int32)
+               for s in (3, 7, 12, 5)]
+    plain = ServingEngine(params, config, slots=3, max_len=64)
+    want = _serve(plain, prompts, 8)
+    spec = ServingEngine(params, config, slots=3, max_len=64,
+                         draft_params=draft, draft_config=config, spec_k=4)
+    got = _serve(spec, prompts, 8)
+    assert got == want
+    st = spec.stats()
+    assert st["spec_rounds"] > 0
+    assert 0.0 <= st["spec_acceptance"] <= 1.0
+
+
+def test_spec_serving_self_draft_full_acceptance(models):
+    """Target drafting for itself accepts every draft: tokens identical
+    AND rounds collapse toward tokens/spec_k."""
+    params, _, config = models
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, config.vocab_size, size=6).astype(np.int32)
+    plain = ServingEngine(params, config, slots=2, max_len=64)
+    want = _serve(plain, [prompt], 12)
+    spec = ServingEngine(params, config, slots=2, max_len=64,
+                         draft_params=params, draft_config=config, spec_k=4)
+    got = _serve(spec, [prompt], 12)
+    assert got == want
+    st = spec.stats()
+    assert st["spec_acceptance"] > 0.9, st
+    # 12 tokens at up to 4/round: far fewer rounds than tokens
+    assert st["spec_rounds"] <= 5, st
+
+
+def test_spec_serving_midflight_admission_and_eos(models):
+    params, draft, config = models
+    rng = np.random.default_rng(2)
+    p1 = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
+    p2 = rng.integers(1, config.vocab_size, size=9).astype(np.int32)
+    plain = ServingEngine(params, config, slots=2, max_len=64)
+    w1 = _serve(plain, [p1], 10)[0]
+    eos = w1[4]  # force an EOS mid-stream for the spec engine
+    plain2 = ServingEngine(params, config, slots=2, max_len=64)
+    want1 = plain2.submit(p1, 10, eos_token=eos)
+    plain2.step()
+    want2 = plain2.submit(p2, 6)
+    while not (want1.done and want2.done):
+        plain2.step()
+
+    spec = ServingEngine(params, config, slots=2, max_len=64,
+                         draft_params=draft, draft_config=config, spec_k=3)
+    r1 = spec.submit(p1, 10, eos_token=eos)
+    spec.step()
+    r2 = spec.submit(p2, 6)
+    while not (r1.done and r2.done):
+        spec.step()
+    assert r1.tokens == want1.tokens
+    assert r2.tokens == want2.tokens
+
+
+def test_spec_falls_back_for_sampled_traffic(models):
+    """A sampled request in the batch routes steps through the normal
+    tick (speculative rounds are greedy-only); everything still
+    completes and the sampled slot actually sampled."""
+    params, draft, config = models
+    rng = np.random.default_rng(3)
+    p = rng.integers(1, config.vocab_size, size=5).astype(np.int32)
+    eng = ServingEngine(params, config, slots=2, max_len=64,
+                        draft_params=draft, draft_config=config, spec_k=3)
+    r_greedy = eng.submit(p, 6)
+    r_sampled = eng.submit(p, 6, temperature=0.9)
+    while not (r_greedy.done and r_sampled.done):
+        eng.step()
+    assert len(r_greedy.tokens) == 6 and len(r_sampled.tokens) == 6
+    assert eng.stats()["spec_rounds"] == 0, "mixed traffic must fall back"
+
+
+def test_spec_serving_block_pump_and_chunked_prefill(models):
+    """step_block + a long prompt through the chunked path: the draft
+    prefills in one shot at chunk completion, outputs stay exact."""
+    params, draft, config = models
+    rng = np.random.default_rng(4)
+    longp = rng.integers(1, config.vocab_size, size=40).astype(np.int32)
+    short = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
+    plain = ServingEngine(params, config, slots=2, max_len=128,
+                          prefill_chunk=16)
+    w_s = plain.submit(short, 8)
+    w_l = plain.submit(longp, 6)
+    while not (w_s.done and w_l.done):
+        plain.step_block()
+    spec = ServingEngine(params, config, slots=2, max_len=128,
+                         prefill_chunk=16,
+                         draft_params=draft, draft_config=config, spec_k=3)
+    r_s = spec.submit(short, 8)
+    r_l = spec.submit(longp, 6)
+    while not (r_s.done and r_l.done):
+        spec.step_block()
+    assert r_s.tokens == w_s.tokens
+    assert r_l.tokens == w_l.tokens
+    assert spec.stats()["chunked_prefills"] == 1
+
+
+def test_spec_rejects_prefix_and_ring(models):
+    params, draft, config = models
+    eng = ServingEngine(params, config, slots=2, max_len=64,
+                        draft_params=draft, draft_config=config)
+    with pytest.raises(ValueError, match="prefix"):
+        eng.submit(np.array([1, 2], np.int32), 4, prefix_id=0)
+    ring_cfg = llama.LlamaConfig.tiny(use_flash=False, dtype=jnp.float32,
+                                      sliding_window=8)
+    ring_params = llama.init(ring_cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="ring"):
+        ServingEngine(ring_params, ring_cfg, slots=2, max_len=64,
+                      draft_params=draft, draft_config=ring_cfg)
+
+
+def test_spec_near_capacity_stays_exact(models):
+    """A slot within spec_k tokens of max_len must NOT run a clamped
+    verify write (silent history corruption): rounds fall back to plain
+    ticks near the edge and outputs stay exact."""
+    params, draft, config = models
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, config.vocab_size, size=26).astype(np.int32)
+    # 26 + 6 == max_len 32: the final rounds have < spec_k headroom
+    plain = ServingEngine(params, config, slots=2, max_len=32)
+    want = _serve(plain, [prompt], 6)
+    spec = ServingEngine(params, config, slots=2, max_len=32,
+                         draft_params=draft, draft_config=config, spec_k=4)
+    got = _serve(spec, [prompt], 6)
+    assert got == want
+
+
+def test_spec_resyncs_draft_after_fallback(models):
+    """Greedy requests surviving a sampled co-tenant must resume
+    speculation with an aligned draft cache: with a SELF-draft the
+    acceptance after fallback ticks stays ~1.0 (a desynced draft would
+    floor it)."""
+    params, _, config = models
+    rng = np.random.default_rng(6)
+    pg = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
+    ps = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
+    eng = ServingEngine(params, config, slots=2, max_len=128,
+                        draft_params=params, draft_config=config, spec_k=4)
+    r_g = eng.submit(pg, 40)
+    r_s = eng.submit(ps, 5, temperature=0.9)  # short sampled co-tenant
+    while not (r_g.done and r_s.done):
+        eng.step()
+    st = eng.stats()
+    assert st["spec_rounds"] > 0, "speculation must resume after fallback"
+    assert st["spec_acceptance"] > 0.9, st
+    plain = ServingEngine(params, config, slots=2, max_len=128)
+    assert r_g.tokens == _serve(plain, [pg], 40)[0]
