@@ -484,9 +484,10 @@ def _tpu_child(results_path: str) -> int:
     # -- 4e. continuous-batching serving: mixed prompt lengths streaming
     # through a fixed slot pool (models/serving.py) — the sustained-load
     # number a serving deployment actually sees -------------------------
-    def _serving_setup():
+    def _serving_setup(**engine_kw):
         """Shared engine + mixed-length traffic so the greedy baseline
-        ("serving") and the sampled variant stay comparable."""
+        ("serving") and every variant (sampled/lora/speculative) stay
+        comparable; engine_kw tweaks only the ServingEngine knobs."""
         from kubedl_tpu.models import llama
         from kubedl_tpu.models.serving import ServingEngine
 
@@ -494,8 +495,13 @@ def _tpu_child(results_path: str) -> int:
                   else llama.LlamaConfig.bench_150m(max_seq_len=1024, remat=False))
         params = llama.init(config, jax.random.PRNGKey(0))
         slots, new = (2, 6) if small else (8, 64)
+        if engine_kw.pop("quantized_self_draft", False):
+            from kubedl_tpu.models import quant
+
+            engine_kw["draft_params"] = jax.jit(quant.quantize_params)(params)
+            engine_kw["draft_config"] = config
         eng = ServingEngine(params, config, slots=slots,
-                            max_len=64 if small else 512)
+                            max_len=64 if small else 512, **engine_kw)
         rng = np.random.default_rng(0)
         lens = [5, 9] if small else [33, 150, 80, 250, 61, 190, 40, 120]
         prompts = [rng.integers(1, config.vocab_size, size=n).astype(np.int32)
@@ -614,6 +620,32 @@ def _tpu_child(results_path: str) -> int:
             # timed run only — the warm pass completes its own prefills
             "chunked_prefills": eng.stats()["chunked_prefills"] - warm_chunked,
             "requests": len(lens), "long_prompt": max(lens), "slots": slots,
+        })
+
+    # -- 4f4. speculative continuous batching: the int8-quantized target
+    # drafts for itself (a deployable pair with no external checkpoint —
+    # cheap draft passes, near-1 acceptance), k tokens verified per
+    # ragged target block per round --------------------------------------
+    def serving_spec_milestone():
+        eng, prompts, slots, new = _serving_setup(
+            quantized_self_draft=True, spec_k=4)
+        eng.serve_all(prompts, max_new_tokens=new)  # warm
+        # timed-run-only counters (same discipline as serving_mixed)
+        warm_rounds = eng._spec_rounds
+        warm_acc = eng._spec_accepted
+        warm_slot_rounds = eng._spec_slot_rounds
+        t0 = time.perf_counter()
+        eng.serve_all(prompts, max_new_tokens=new)
+        dt = time.perf_counter() - t0
+        rounds = eng._spec_rounds - warm_rounds
+        acc = eng._spec_accepted - warm_acc
+        slot_rounds = eng._spec_slot_rounds - warm_slot_rounds
+        _emit(out, "serving_spec", {
+            "serving_spec_tokens_per_sec": round(len(prompts) * new / dt, 0),
+            "spec_acceptance": round(
+                acc / max(slot_rounds * (eng.spec_k - 1), 1), 4),
+            "spec_rounds": rounds,
+            "requests": len(prompts), "slots": slots, "spec_k": eng.spec_k,
         })
 
     # -- 4g. GRPO iteration: G rollouts/prompt through the decode stack +
@@ -767,6 +799,7 @@ def _tpu_child(results_path: str) -> int:
         ("serving_sampled", serving_sampled_milestone, 120),
         ("serving_lora", serving_lora_milestone, 120),
         ("serving_mixed", serving_mixed_milestone, 150),
+        ("serving_spec", serving_spec_milestone, 150),
         ("grpo", grpo_milestone, 150),
     ]
     for name, fn, min_budget in milestones:
