@@ -181,3 +181,22 @@ def test_spec_resyncs_draft_after_fallback(models):
     assert st["spec_acceptance"] > 0.9, st
     plain = ServingEngine(params, config, slots=2, max_len=128)
     assert r_g.tokens == _serve(plain, [pg], 40)[0]
+
+
+def test_spec_serving_with_int8_kv_cache(models):
+    """Speculative rounds over int8 KV caches exercise the ragged block
+    step's vmapped scale writes; outputs must match the plain engine
+    with the same int8 caches."""
+    params, draft, config = models
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(1, config.vocab_size, size=s).astype(np.int32)
+               for s in (4, 9)]
+    plain = ServingEngine(params, config, slots=2, max_len=64,
+                          kv_dtype="int8")
+    want = _serve(plain, prompts, 8)
+    spec = ServingEngine(params, config, slots=2, max_len=64,
+                         kv_dtype="int8",
+                         draft_params=draft, draft_config=config, spec_k=3)
+    got = _serve(spec, prompts, 8)
+    assert got == want
+    assert spec.stats()["spec_rounds"] > 0
