@@ -106,6 +106,7 @@ def test_spec_falls_back_for_sampled_traffic(models):
     assert eng.stats()["spec_rounds"] == 0, "mixed traffic must fall back"
 
 
+@pytest.mark.slow
 def test_spec_serving_block_pump_and_chunked_prefill(models):
     """step_block + a long prompt through the chunked path: the draft
     prefills in one shot at chunk completion, outputs stay exact."""
@@ -161,6 +162,7 @@ def test_spec_near_capacity_stays_exact(models):
     assert got == want
 
 
+@pytest.mark.slow
 def test_spec_resyncs_draft_after_fallback(models):
     """Greedy requests surviving a sampled co-tenant must resume
     speculation with an aligned draft cache: with a SELF-draft the
