@@ -222,8 +222,8 @@ class ServingEngine:
 
             self._draft_prefill = jax.jit(draft_prefill_fn)
             self._draft_insert = jax.jit(self._insert_impl, donate_argnums=(0,))
-            self._spec_round = jax.jit(
-                self._spec_round_impl, static_argnums=(4,),
+            self._spec_block = jax.jit(
+                self._spec_block_impl, static_argnums=(4, 5),
                 donate_argnums=(2, 3))
             self._draft_sync = jax.jit(
                 self._draft_sync_impl, donate_argnums=(1,))
@@ -434,8 +434,9 @@ class ServingEngine:
             body, (cache, cur_tokens), jax.random.split(key, k))
         return cache, cur, toks, lps
 
-    def _spec_round_impl(self, params, dparams, t_cache, d_cache, k,
-                         cur_tokens, active, lora, adapter_ids):
+    def _spec_round_core(self, params, dparams, t_cache, d_cache, k,
+                         cur_tokens, active, lora, adapter_ids,
+                         base, d_base):
         """One speculative round over the whole slot batch (greedy).
 
         Returns (t_cache, d_cache, new_cur, emit [slots, k], accepted
@@ -445,8 +446,6 @@ class ServingEngine:
         never reads. Both caches roll back to base + accepted + 1
         (frozen slots stay at base — their stale writes are masked and
         overwritten later, exactly like the normal tick's freeze)."""
-        base = t_cache["lengths"]
-        d_base = d_cache["lengths"]
 
         def body(carry, _):
             tok, dc = carry
@@ -487,16 +486,56 @@ class ServingEngine:
         new_cur = jnp.where(active, bonus, cur_tokens)
         return t_cache, d_cache, new_cur, emit, jnp.where(active, a, 0), lp
 
-    def _use_spec_round(self, decoding: List[int]) -> bool:
+    def _spec_block_impl(self, params, dparams, t_cache, d_cache, k, r,
+                         cur_tokens, active, lora, adapter_ids):
+        """r speculative rounds chained on-device (lax.scan), ONE host
+        sync — the tick_block pattern applied to rounds. Activity can't
+        change mid-block, so rounds past a request's EOS/budget generate
+        junk the host drops; r stays small and headroom-gated."""
+
+        def round_fn(carry, _):
+            t_cache, d_cache, cur = carry
+            t_cache, d_cache, cur, emit, acc, lp = self._spec_round_core(
+                params, dparams, t_cache, d_cache, k, cur, active,
+                lora, adapter_ids, t_cache["lengths"], d_cache["lengths"])
+            return (t_cache, d_cache, cur), (emit, acc, lp)
+
+        (t_cache, d_cache, cur), (emits, accs, lps) = jax.lax.scan(
+            round_fn, (t_cache, d_cache, cur_tokens), None, length=r)
+        return t_cache, d_cache, cur, emits, accs, lps  # [r, slots, ...]
+
+    def _spec_head(self, decoding: List[int]) -> int:
+        """KV headroom of the fullest decoding slot — computed once per
+        step and shared by the go/no-go guard and the round sizing (the
+        invariant head >= spec_k implies r >= 1 lives in one place)."""
+        return self.max_len - max(
+            self._slot_req[s].cache_len for s in decoding)
+
+    def _use_spec_round(self, head: int) -> bool:
         """Speculative rounds need all-greedy traffic AND spec_k tokens
         of KV headroom on every decoding slot — the ragged block write
         clamps (silently corrupting history) instead of raising under
         jit, so the guard lives here."""
-        if self._sample_mode() != "greedy":
-            return False
-        head = self.max_len - max(
-            self._slot_req[s].cache_len for s in decoding)
-        return head >= self.spec_k
+        return self._sample_mode() == "greedy" and head >= self.spec_k
+
+    def _spec_rounds_for(self, decoding: List[int], head: int) -> int:
+        """Rounds to fuse in one dispatch: bounded by KV headroom (each
+        round writes spec_k positions), the smallest remaining token
+        budget (each round emits >= 1), a small cap while requests are
+        queued or an EOS could end a request mid-block (junk rounds are
+        pure waste), and power-of-two sizing so at most log2(cap) scan
+        variants compile."""
+        reqs = [self._slot_req[s] for s in decoding]
+        r = min(4, head // self.spec_k)
+        if any(q.eos_token is not None or q.stop_sequences for q in reqs):
+            r = min(r, 2)
+        if self._queue or self._chunking is not None:
+            r = min(r, 2)
+        budget = min(q.max_new_tokens - len(q.tokens) for q in reqs)
+        # a round emits at least 1 token, so r rounds can't be needed
+        # past the smallest budget
+        r = max(min(r, budget), 1)
+        return 1 << (r.bit_length() - 1)
 
     def _draft_sync_impl(self, dparams, d_cache, cur_tokens, active):
         """Append the tick's input token to the draft cache (frozen
@@ -508,36 +547,42 @@ class ServingEngine:
         d_cache["lengths"] = jnp.where(active, d_cache["lengths"], old)
         return d_cache
 
-    def _spec_step(self, decoding: List[int]) -> int:
-        """Advance every greedy decoding slot one speculative ROUND (up
-        to spec_k tokens each) with one host sync."""
+    def _spec_step(self, decoding: List[int], head: int) -> int:
+        """Advance every greedy decoding slot `r` fused speculative
+        ROUNDS (up to r * spec_k tokens each) with ONE host sync."""
         t_dec0 = time.monotonic()
         k = self.spec_k
-        self.cache, self.draft_cache, self.cur_tokens, emit, acc, lps = \
-            self._spec_round(
+        r = self._spec_rounds_for(decoding, head)
+        self.cache, self.draft_cache, self.cur_tokens, emits, accs, lps = \
+            self._spec_block(
                 self.params, self.draft_params, self.cache, self.draft_cache,
-                k, self.cur_tokens, self.active, self.lora, self.slot_adapter)
-        self._ticks += 1
-        emit_h, acc_h, lp_h = (np.asarray(x) for x in
-                               jax.device_get((emit, acc, lps)))
+                k, r, self.cur_tokens, self.active, self.lora,
+                self.slot_adapter)
+        self._ticks += r
+        emits_h, accs_h, lps_h = (np.asarray(x) for x in
+                                  jax.device_get((emits, accs, lps)))
         self._decode_time += time.monotonic() - t_dec0
-        self._spec_rounds += 1
+        self._spec_rounds += r
         for slot in decoding:
             req = self._slot_req[slot]
             if req is None:
                 continue
-            self._spec_slot_rounds += 1
-            n = int(acc_h[slot]) + 1
-            emitted = 0
-            for j in range(n):
+            for ri in range(r):
                 if req.done:
-                    break  # EOS/stop mid-round: trailing tokens dropped
-                req.cache_len += 1
-                self._emit(slot, int(emit_h[slot, j]), float(lp_h[slot, j]))
-                emitted += 1
-            # only drafts that became OUTPUT count toward the acceptance
-            # dial (EOS mid-round drops the trailing accepted ones)
-            self._spec_accepted += min(emitted, int(acc_h[slot]))
+                    break  # later fused rounds for a finished slot: junk
+                self._spec_slot_rounds += 1
+                n = int(accs_h[ri, slot]) + 1
+                emitted = 0
+                for j in range(n):
+                    if req.done:
+                        break  # EOS/stop mid-round: trailing tokens dropped
+                    req.cache_len += 1
+                    self._emit(slot, int(emits_h[ri, slot, j]),
+                               float(lps_h[ri, slot, j]))
+                    emitted += 1
+                # only drafts that became OUTPUT count toward the
+                # acceptance dial
+                self._spec_accepted += min(emitted, int(accs_h[ri, slot]))
         return len(decoding)
 
     # -- public API --------------------------------------------------------
@@ -1149,8 +1194,10 @@ class ServingEngine:
         n_active = len(decoding)
         if n_active == 0:
             return 0
-        if self._spec and self._use_spec_round(decoding):
-            return self._spec_step(decoding)
+        if self._spec:
+            head = self._spec_head(decoding)
+            if self._use_spec_round(head):
+                return self._spec_step(decoding, head)
         t_dec0 = time.monotonic()
         self._key, sub = jax.random.split(self._key)
         if self._spec:
@@ -1194,10 +1241,11 @@ class ServingEngine:
         if not reqs:
             return 0
         if self._spec:
-            if self._use_spec_round(decoding):
+            head = self._spec_head(decoding)
+            if self._use_spec_round(head):
                 # a speculative round is already a multi-token block (up
                 # to spec_k per slot, one sync)
-                return self._spec_step(decoding)
+                return self._spec_step(decoding, head)
             # fallback on a spec engine runs single ticks so the draft
             # cache stays in sync (the fused block scan doesn't thread
             # it); mixed traffic on a spec engine pays per-tick syncs

@@ -202,3 +202,40 @@ def test_spec_serving_with_int8_kv_cache(models):
     got = _serve(spec, prompts, 8)
     assert got == want
     assert spec.stats()["spec_rounds"] > 0
+
+
+def test_spec_chunked_composition_fast(models):
+    """Fast-lane twin of the slow chunked-composition test: small
+    shapes, same code paths (chunked admission on a spec engine +
+    fused-round cap while the chunker is busy)."""
+    params, draft, config = models
+    rng = np.random.default_rng(8)
+    longp = rng.integers(1, config.vocab_size, size=14).astype(np.int32)
+    plain = ServingEngine(params, config, slots=2, max_len=64,
+                          prefill_chunk=8)
+    want = _serve(plain, [longp], 5)
+    spec = ServingEngine(params, config, slots=2, max_len=64,
+                         prefill_chunk=8,
+                         draft_params=draft, draft_config=config, spec_k=3)
+    got = _serve(spec, [longp], 5)
+    assert got == want
+    assert spec.stats()["chunked_prefills"] == 1
+
+
+def test_spec_resync_fast(models):
+    """Fast-lane twin of the slow fallback-resync test: a short sampled
+    co-tenant forces fallback ticks, speculation must resume aligned."""
+    params, _, config = models
+    rng = np.random.default_rng(9)
+    pg = rng.integers(1, config.vocab_size, size=3).astype(np.int32)
+    ps = rng.integers(1, config.vocab_size, size=3).astype(np.int32)
+    eng = ServingEngine(params, config, slots=2, max_len=64,
+                        draft_params=params, draft_config=config, spec_k=3)
+    r_g = eng.submit(pg, 14)
+    r_s = eng.submit(ps, 3, temperature=0.9)
+    while not (r_g.done and r_s.done):
+        eng.step()
+    st = eng.stats()
+    assert st["spec_rounds"] > 0 and st["spec_acceptance"] > 0.9, st
+    plain = ServingEngine(params, config, slots=2, max_len=64)
+    assert r_g.tokens == _serve(plain, [pg], 14)[0]
