@@ -2,6 +2,8 @@
 # deploy/docker-build) for a Python operator.
 IMG ?= kubedl-tpu/operator:v0.2.0
 PY ?= python
+# pipefail below needs bash (tee must not mask a pytest failure)
+SHELL := /bin/bash
 
 .PHONY: test
 test:
@@ -9,9 +11,13 @@ test:
 
 # The FULL suite, slow lane included — run before every snapshot commit
 # and quote the tail in the commit message (VERDICT r4 directive 1).
+# The fast lane reports its slowest tests and FAILS if any single test
+# exceeds 60s (VERDICT Weak #8: presubmit wall-clock creep) — mark such
+# tests `slow` instead of letting the fast lane grow silently.
 .PHONY: presubmit
 presubmit:
-	$(PY) -m pytest tests/ -q -m 'not slow'
+	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=15 2>&1 | tee .presubmit-fast.log
+	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench

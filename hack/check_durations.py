@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Presubmit wall-clock guard (VERDICT Weak #8: fast-lane creep).
+
+Parses a pytest `--durations=N` report and fails when any single test
+phase exceeds the budget, so a slow test can't slip into the non-slow
+lane silently — mark it `slow` or speed it up. Any offender necessarily
+appears in the top-N listing (everything ranked above it is slower and
+flagged too), so `--durations=15` is enough for a 60s budget.
+
+    pytest tests/ -m 'not slow' --durations=15 2>&1 | tee fast.log
+    python hack/check_durations.py fast.log --max-seconds 60
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+# "   12.34s call     tests/test_x.py::test_y"
+LINE = re.compile(r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", help="pytest output containing a --durations report")
+    ap.add_argument("--max-seconds", type=float, default=60.0)
+    args = ap.parse_args(argv)
+    over = []
+    saw_report = False
+    with open(args.log, errors="replace") as f:
+        for line in f:
+            if "slowest" in line and "durations" in line:
+                saw_report = True
+            m = LINE.match(line)
+            if m and float(m.group(1)) > args.max_seconds:
+                over.append((float(m.group(1)), m.group(2), m.group(3)))
+    if not saw_report:
+        print(f"error: no --durations report found in {args.log} "
+              "(run pytest with --durations=N)", file=sys.stderr)
+        return 2
+    if over:
+        print(f"FAIL: {len(over)} fast-lane test phase(s) exceed "
+              f"{args.max_seconds:.0f}s — mark them `slow` or speed them up:")
+        for secs, phase, test in sorted(over, reverse=True):
+            print(f"  {secs:8.1f}s {phase:9s} {test}")
+        return 1
+    print(f"durations guard ok: no fast-lane test over {args.max_seconds:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

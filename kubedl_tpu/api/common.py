@@ -168,6 +168,12 @@ class SchedulingPolicy:
     # Admission priority: higher wins a freed slice; ties go FIFO by gang
     # creation (net-new — the reference delegates ordering to kube-batch).
     priority: int = 0
+    # Elastic (net-new, Tenplex-style): ordered SMALLER shapes the job
+    # also accepts, preferred-first after tpu_slice. The capacity
+    # scheduler (sched/) may re-admit the gang at any of these under
+    # contention and grow it back when capacity frees; the workload must
+    # restore shape-agnostically from checkpoint (docs/scheduling.md).
+    tpu_slice_fallbacks: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -73,6 +73,50 @@ def validate_common(job, controller) -> List[str]:
                 "spec.runPolicy.successPolicy.minFinishWorkRate: must be in "
                 f"[0, 100], got {sp.min_finish_worker_percentage}"
             )
+        sched = rp.scheduling_policy
+        if sched is not None and sched.tpu_slice_fallbacks:
+            errs.extend(_validate_elastic_shapes(sched, controller))
+    return errs
+
+
+def _validate_elastic_shapes(sched, controller) -> List[str]:
+    """schedulingPolicy.tpuSliceFallbacks is on the SHARED policy type,
+    but elastic resize restarts the job through checkpoint-restore — a
+    workload must opt in (`supports_elastic`, JAXJob today) or the
+    capacity scheduler would silently lose its training progress on
+    every resize. Shape sanity is checked here for every kind so the
+    admitter never records a fallback larger than the preferred shape."""
+    from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+    path = "spec.runPolicy.schedulingPolicy.tpuSliceFallbacks"
+    errs: List[str] = []
+    if not getattr(controller, "supports_elastic", False):
+        return [
+            f"{path}: elastic resize is not supported by "
+            f"{controller.kind} (the workload must restore "
+            f"shape-agnostically from checkpoint)"
+        ]
+    if not sched.tpu_slice:
+        errs.append(f"{path}: requires tpuSlice (the preferred shape)")
+        preferred = None
+    else:
+        try:
+            preferred = parse_slice_type(sched.tpu_slice)
+        except ValueError as e:
+            preferred = None
+            errs.append(f"spec.runPolicy.schedulingPolicy.tpuSlice: {e}")
+    for alt in sched.tpu_slice_fallbacks:
+        try:
+            st = parse_slice_type(alt)
+        except ValueError as e:
+            errs.append(f"{path}: {e}")
+            continue
+        if preferred is not None and st.chips > preferred.chips:
+            errs.append(
+                f"{path}: entry {alt!r} ({st.chips} chips) exceeds the "
+                f"preferred tpuSlice {sched.tpu_slice!r} "
+                f"({preferred.chips} chips)"
+            )
     return errs
 
 

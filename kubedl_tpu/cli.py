@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -31,6 +32,31 @@ def _load_manifests(path: str):
         return [m for m in yaml.safe_load_all(f) if m]
 
 
+def _kv_pairs(entries, value_type, flag, minimum=None, exclusive=False):
+    """Parse repeated NAME=VALUE flags (--tenant-weight / --tenant-cap),
+    rejecting out-of-range values at startup — a negative weight would
+    silently corrupt every tenant's fair share (sched/quota.py)."""
+    out = {}
+    for entry in entries or []:
+        name, sep, val = entry.partition("=")
+        if not sep or not name:
+            raise SystemExit(f"error: {flag} expects NAME=VALUE, got {entry!r}")
+        try:
+            out[name] = value_type(val)
+        except ValueError:
+            raise SystemExit(f"error: {flag} {entry!r}: bad value {val!r}")
+        if isinstance(out[name], float) and not math.isfinite(out[name]):
+            # nan compares False against any bound below and would
+            # poison every tenant's computed fair share downstream
+            raise SystemExit(f"error: {flag} {entry!r}: value must be finite")
+        if minimum is not None and (
+            out[name] <= minimum if exclusive else out[name] < minimum
+        ):
+            bound = f"> {minimum}" if exclusive else f">= {minimum}"
+            raise SystemExit(f"error: {flag} {entry!r}: value must be {bound}")
+    return out
+
+
 def _mk_operator(args) -> Operator:
     return Operator(
         OperatorConfig(
@@ -38,6 +64,13 @@ def _mk_operator(args) -> Operator:
             enable_gang_scheduling=bool(args.tpu_slices) or args.gang,
             gang_scheduler_name=args.gang_scheduler_name,
             tpu_slices=args.tpu_slices,
+            scheduler_policy=args.scheduler_policy,
+            tenant_weights=_kv_pairs(args.tenant_weight, float, "--tenant-weight",
+                                     minimum=0, exclusive=True),
+            tenant_caps=_kv_pairs(args.tenant_cap, int, "--tenant-cap",
+                                  minimum=0),
+            enable_preemption=not args.disable_preemption,
+            enable_elastic=not args.disable_elastic,
             workloads=args.workloads,
             object_storage=args.object_storage,
             event_storage=args.event_storage,
@@ -320,11 +353,60 @@ def cmd_top(args) -> int:
                          s.get("reserved_by") or "-"))
         _print_table(rows)
         print()
+    cap = vars_.get("capacity")
+    if cap:
+        _print_capacity_tenants(cap)
+        print()
     rows = [("CONTROLLER", "RECONCILES", "ERRORS", "REQUEUES", "QUEUE", "MEAN_MS")]
     for name, c in sorted((vars_.get("controllers") or {}).items()):
         rows.append((name, c.get("reconciles", 0), c.get("errors", 0),
                      c.get("requeues", 0), c.get("queue_depth", ""),
                      round(c.get("mean_seconds", 0.0) * 1e3, 2)))
+    _print_table(rows)
+    return 0
+
+
+def _print_capacity_tenants(cap) -> None:
+    print(f"capacity scheduler: policy={cap.get('policy')} "
+          f"preemptions={cap.get('preemptions_total', 0)} "
+          f"resizes={cap.get('resizes_total', 0)}")
+    rows = [("TENANT", "WEIGHT", "CHIPS", "FAIR_SHARE", "SHARE", "CAP",
+             "CHIP_S", "PREEMPTED")]
+    for tenant, t in sorted((cap.get("tenants") or {}).items()):
+        cap_chips = t.get("cap_chips")
+        rows.append((
+            tenant, t.get("weight", 1.0), t.get("chips_in_use", 0),
+            t.get("fair_share_chips", 0.0),
+            f"{t.get('share', 0.0):.0%}",
+            cap_chips if cap_chips is not None else "-",
+            t.get("chip_seconds", 0.0), t.get("preemptions", 0),
+        ))
+    _print_table(rows)
+
+
+def cmd_queue(args) -> int:
+    """Capacity-scheduler view: the gang queue (who runs, who waits, at
+    what shape) plus per-tenant quota state — the triage surface for
+    "why isn't my job scheduled"."""
+    vars_ = _client_request(args, "GET", "/debug/vars")
+    if vars_ is None:
+        return 1
+    cap = vars_.get("capacity")
+    if not cap:
+        print("capacity scheduler not enabled (start the operator with "
+              "--scheduler-policy)", file=sys.stderr)
+        return 1
+    _print_capacity_tenants(cap)
+    print()
+    rows = [("GANG", "TENANT", "PRIO", "SHAPE", "STATE", "SLICES",
+             "WAIT_S", "PREEMPTED")]
+    for q in cap.get("queue", []):
+        rows.append((
+            q.get("gang", ""), q.get("tenant", ""), q.get("priority", 0),
+            q.get("shape", ""), q.get("state", ""),
+            ",".join(q.get("slices") or []) or "-",
+            q.get("waiting_seconds", 0.0), q.get("preemptions", 0),
+        ))
     _print_table(rows)
     return 0
 
@@ -471,6 +553,23 @@ def main(argv=None) -> int:
     parser.add_argument("--gang", action="store_true", help="enable gang scheduling")
     parser.add_argument("--tpu-slices", nargs="*", default=[],
                         help="TPU pool, e.g. v5e-8 v5p-32")
+    # capacity scheduler (docs/scheduling.md): tenant fair-share,
+    # preemption, elastic resize over the slice pool
+    parser.add_argument("--scheduler-policy", default="",
+                        choices=["", "fifo", "priority", "fair_share", "gavel"],
+                        help="enable the capacity scheduler with this policy")
+    parser.add_argument("--tenant-weight", action="append", default=[],
+                        metavar="TENANT=WEIGHT",
+                        help="fair-share weight (repeatable; default 1.0)")
+    parser.add_argument("--tenant-cap", action="append", default=[],
+                        metavar="TENANT=CHIPS",
+                        help="hard chips-in-use ceiling (repeatable)")
+    parser.add_argument("--disable-preemption", action="store_true",
+                        help="scheduler never evicts running gangs "
+                             "(also disables elastic grow, which evicts)")
+    parser.add_argument("--disable-elastic", action="store_true",
+                        help="scheduler never resizes gangs across their "
+                             "declared tpuSliceFallbacks shapes")
     # persistence flags (ref --object-storage/--event-storage, persist_controller.go:30-74)
     parser.add_argument("--object-storage", default="",
                         help="object history backend name, e.g. sqlite")
@@ -565,6 +664,10 @@ def main(argv=None) -> int:
 
     p_top = client_parser("top", "slice-pool utilization + controller health")
     p_top.set_defaults(fn=cmd_top)
+
+    p_queue = client_parser(
+        "queue", "capacity-scheduler gang queue + tenant quota state")
+    p_queue.set_defaults(fn=cmd_queue)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -56,6 +56,7 @@ class Manager:
         # RuntimeMetrics sink (metrics/runtime_metrics.py); None disables
         self.runtime_metrics = runtime_metrics
         self._controllers: List[ControllerRunner] = []
+        self._loops: List[tuple] = []  # (name, fn, interval) periodic loops
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._started = False
@@ -68,6 +69,38 @@ class Manager:
         if self.runtime_metrics is not None:
             self.runtime_metrics.register_queue(name, c.queue.__len__)
         return c
+
+    def add_loop(self, name: str, fn: Callable[[], None], interval: float) -> None:
+        """Register a periodic (non-workqueue) loop — e.g. the capacity
+        scheduler's tick (sched/capacity.py). Runs every `interval`
+        seconds from start() until stop(); exceptions are logged and the
+        loop continues (a bad tick must not kill scheduling). Latency and
+        errors fold into the runtime metrics like a controller's."""
+        self._loops.append((name, fn, interval))
+        if self._started:
+            self._start_loop(name, fn, interval)
+
+    def _start_loop(self, name: str, fn: Callable[[], None], interval: float) -> None:
+        import time
+
+        rm = self.runtime_metrics
+
+        def run() -> None:
+            while not self._stop.wait(interval):
+                t0 = time.perf_counter()
+                try:
+                    fn()
+                except Exception:
+                    log.error("loop %s failed: %s", name, traceback.format_exc())
+                    if rm is not None:
+                        rm.observe_reconcile(name, time.perf_counter() - t0, error=True)
+                    continue
+                if rm is not None:
+                    rm.observe_reconcile(name, time.perf_counter() - t0)
+
+        t = threading.Thread(target=run, name=f"loop-{name}", daemon=True)
+        t.start()
+        self._threads.append(t)
 
     # -- run loop --------------------------------------------------------
 
@@ -103,6 +136,8 @@ class Manager:
                 )
                 t.start()
                 self._threads.append(t)
+        for name, fn, interval in self._loops:
+            self._start_loop(name, fn, interval)
 
     def _worker(self, c: ControllerRunner) -> None:
         import time

@@ -7,11 +7,76 @@ PodGroup implementation replaced by all-or-nothing TPU-slice admission
 from __future__ import annotations
 
 import abc
-from typing import Dict, Optional
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import ReplicaSpec
 
 ANNOTATION_GANG_NAME = "kubedl.io/gang-name"
+
+
+@dataclass
+class GangSnapshot:
+    """Read-only copy of one gang's scheduling state, safe to inspect
+    outside the admitter's lock (sched/capacity.py works on these)."""
+
+    key: str = ""  # "namespace/name"
+    kind: str = ""
+    tenant: str = ""
+    priority: int = 0
+    seq: int = 0
+    tpu_chips: int = 0
+    num_slices: int = 1
+    requested_slice: str = ""
+    admissible_slices: List[str] = field(default_factory=list)
+    slice_names: List[str] = field(default_factory=list)
+    reserved_chips: int = 0
+    hold_until: float = 0.0  # monotonic; 0 = not held
+    preemptions: int = 0
+    waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
+    granted_at: float = 0.0  # monotonic; when the current reservation was made
+
+    @property
+    def namespace(self) -> str:
+        return self.key.partition("/")[0]
+
+    @property
+    def name(self) -> str:
+        return self.key.partition("/")[2]
+
+
+class CapacityDirector(abc.ABC):
+    """Policy hooks a capacity scheduler plugs into the gang admitter.
+
+    The admitter stays the mechanism (atomic reservation, shields,
+    mirroring); a director owns the waiting-gang policy. Every hook is
+    invoked UNDER the admitter's lock — implementations must not call
+    back into the admitter and may only take leaf locks (tenant quota
+    counters). `usage` maps tenant -> chips currently reserved; the
+    caller keeps it current across grants within one pass.
+    """
+
+    @abc.abstractmethod
+    def order_waiting(self, waiting: List, usage: Dict[str, int], total_chips: int) -> List:
+        """Order the waiting gang states for this reservation pass."""
+
+    @abc.abstractmethod
+    def may_reserve(self, gang, usage: Dict[str, int], total_chips: int) -> bool:
+        """Gate a reservation (tenant caps). A rejected gang is skipped
+        WITHOUT shielding slices (it is not starved, it is capped)."""
+
+    @abc.abstractmethod
+    def choose_slices(self, gang, candidates: List, n: int) -> Optional[List]:
+        """Pick `n` of the matching free `candidates` (heterogeneity
+        pricing); None falls back to the admitter's tightest-fit."""
+
+    def chips_headroom(self, gang, usage: Dict[str, int], total_chips: int) -> Optional[int]:
+        """Hard ceiling on the chips an actual grant for this gang may
+        take (tenant cap minus current usage); None = unlimited. The
+        admitter checks the CHOSEN slices against this — matching admits
+        slices bigger than the request, so a demand-based gate alone
+        would let an oversized grant breach the cap."""
+        return None
 
 
 class GangScheduler(abc.ABC):

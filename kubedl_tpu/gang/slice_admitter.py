@@ -16,6 +16,7 @@ reconciler engine) and the executor's scheduler protocol (assign/release).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -41,7 +42,13 @@ from kubedl_tpu.executor.tpu_topology import (
     parse_slice_type,
     ring_order,
 )
-from kubedl_tpu.gang.interface import ANNOTATION_GANG_NAME, GangScheduler
+from kubedl_tpu.gang.interface import (
+    ANNOTATION_GANG_NAME,
+    CapacityDirector,
+    GangScheduler,
+    GangSnapshot,
+)
+from kubedl_tpu.utils.tenancy import get_tenancy
 
 
 @dataclass
@@ -88,6 +95,20 @@ class _GangState:
     # PodGroups are named after the job), so deletion paths must verify the
     # kind to avoid releasing a same-named other-kind job's gang
     kind: str = ""
+    # -- capacity-scheduler state (sched/capacity.py) -------------------
+    # from the kubedl.io/tenancy annotation; unannotated jobs pool under
+    # "default" (sched/quota.py normalize_tenant)
+    tenant: str = "default"
+    # elastic: ordered admissible shapes, preferred first (requested_slice
+    # is the CURRENT target and may be resized among these by directive)
+    admissible_slices: List[str] = field(default_factory=list)
+    hold_until: float = 0.0  # monotonic; preemption backoff — no reserving before
+    preemptions: int = 0  # times this gang was evicted by directive
+    waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
+    granted_at: float = 0.0  # monotonic; when the current reservation was made
+
+    def held(self, now: Optional[float] = None) -> bool:
+        return self.hold_until > (time.monotonic() if now is None else now)
 
     @property
     def slice_name(self) -> Optional[str]:
@@ -107,6 +128,14 @@ class TPUSliceAdmitter(GangScheduler):
         # implicit single-pod reservations: pod key -> slice name
         self._solo: Dict[str, str] = {}
         self._seq = 0  # monotonic gang admission counter
+        # optional capacity director (sched/capacity.py): owns the
+        # waiting-gang policy; None keeps the built-in (priority, FIFO)
+        self._director: Optional[CapacityDirector] = None
+
+    def set_director(self, director: Optional[CapacityDirector]) -> None:
+        """Attach/detach the capacity scheduler's policy hooks."""
+        with self._lock:
+            self._director = director
 
     @classmethod
     def with_pool(cls, store: ObjectStore, slice_types: List[str]) -> "TPUSliceAdmitter":
@@ -148,6 +177,7 @@ class TPUSliceAdmitter(GangScheduler):
                         if info is not None and info.reserved_by == key:
                             info.reserved_by = None
                     state.slice_names = []
+                    state.waiting_since = time.monotonic()
                     changed_keys.append(key)
             self._solo = {
                 pod_key: sname for pod_key, sname in self._solo.items()
@@ -204,12 +234,29 @@ class TPUSliceAdmitter(GangScheduler):
                 min_member = total
                 requested_slice = ""
                 priority = 0
+                admissible: List[str] = []
                 if sched is not None:
                     # Honor MinAvailable (the reference ignored it).
                     if sched.min_available:
                         min_member = min(sched.min_available, total)
                     requested_slice = sched.tpu_slice
                     priority = int(sched.priority or 0)
+                    if requested_slice:
+                        # elastic: preferred shape first, then declared
+                        # fallbacks (unparseable entries are dropped —
+                        # workload validation reports them to the user)
+                        admissible = [requested_slice]
+                        for alt in getattr(sched, "tpu_slice_fallbacks", None) or []:
+                            try:
+                                parse_slice_type(alt)
+                            except ValueError:
+                                continue
+                            if alt not in admissible:
+                                admissible.append(alt)
+                try:
+                    tenancy = get_tenancy(job)
+                except ValueError:
+                    tenancy = None  # malformed annotation: pooled tenant
                 chips = sum(
                     int(s.replicas or 0) * s.template.spec.tpu_chips()
                     for s in replicas.values()
@@ -222,6 +269,9 @@ class TPUSliceAdmitter(GangScheduler):
                     num_slices=num_slices, total_member=total,
                     priority=priority, seq=self._seq,
                     kind=getattr(job, "kind", "") or "",
+                    tenant=(tenancy.tenant if tenancy else "") or "default",
+                    admissible_slices=admissible,
+                    waiting_since=time.monotonic(),
                 )
                 self._gangs[key] = state
             self._reserve_waiting()
@@ -331,6 +381,253 @@ class TPUSliceAdmitter(GangScheduler):
             }
 
     # ------------------------------------------------------------------
+    # Capacity-scheduler directives (sched/capacity.py). The admitter
+    # executes reserve/evict/resize; the scheduler decides them.
+    # ------------------------------------------------------------------
+
+    def kick(self) -> List[str]:
+        """Run a reservation pass now (scheduler tick / hold expiry).
+        Returns the keys of gangs that obtained a reservation."""
+        with self._lock:
+            granted = self._reserve_waiting()
+        for key in granted:
+            self._remirror_podgroup_status(key)
+        return granted
+
+    def gang_snapshots(self) -> List[GangSnapshot]:
+        """Read-only copies of every gang's scheduling state."""
+        with self._lock:
+            return [self._snapshot(k, s) for k, s in self._gangs.items()]
+
+    def total_chips(self) -> int:
+        """Pool capacity in chips — cheaper than a full utilization()
+        snapshot for callers that only need the denominator."""
+        with self._lock:
+            return sum(s.type.chips for s in self._slices.values())
+
+    def demand_view(
+        self,
+        namespace: str,
+        name: str,
+        slice_type: str = "",
+        respect_shields: bool = False,
+    ) -> Optional[Dict]:
+        """How far is this gang from reserving? Returns {needed, free,
+        holders} where `free` counts grantable free slices and `holders`
+        are (GangSnapshot, matching_count) pairs for running gangs whose
+        reserved slices satisfy the demand — the preemption candidates.
+        `slice_type` probes an alternative shape (elastic what-if);
+        `respect_shields` additionally subtracts free slices held back
+        for OTHER waiting gangs, so elastic decisions don't target
+        capacity the reservation pass would refuse."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            state = self._gangs.get(key)
+            if state is None:
+                return None
+            probe = state
+            if slice_type and slice_type != state.requested_slice:
+                probe = _GangState(
+                    tpu_chips=state.tpu_chips,
+                    requested_slice=slice_type,
+                    num_slices=state.num_slices,
+                    tenant=state.tenant,  # headroom is per-tenant
+                )
+            needed = max(state.num_slices, 1)
+            usage = None
+            total = 0
+            if self._director is not None:
+                usage, total = self._usage_by_tenant()
+                # a RUNNING gang probing another shape (elastic what-if)
+                # would release its own slices first — refund them, or
+                # the probe under-reports headroom the evict/resize
+                # directive would actually have (wedging legal grows)
+                own = sum(
+                    self._slices[s].type.chips
+                    for s in state.slice_names if s in self._slices
+                )
+                if own:
+                    usage[state.tenant] = max(
+                        usage.get(state.tenant, 0) - own, 0)
+            # grantable, not just matching: a probe that counts slices
+            # the grant step would refuse (tenant-cap headroom) makes
+            # the scheduler evict/resize toward capacity that isn't there
+            free_pool = self._free_slices()
+            if respect_shields:
+                shields = [
+                    s for s in self._waiting_shields(usage, total)
+                    if s is not state
+                ]
+                shielded = self._shielded_slices(shields, usage, total)
+                free_pool = [s for s in free_pool if s.name not in shielded]
+            free = len(self._grantable_slices(probe, free_pool, usage, total))
+            holders = []
+            for other_key, other in self._gangs.items():
+                if other_key == key or not other.slice_names:
+                    continue
+                held = [
+                    self._slices[s] for s in other.slice_names if s in self._slices
+                ]
+                matching = len(self._grantable_slices(probe, held, usage, total))
+                if matching:
+                    holders.append((self._snapshot(other_key, other), matching))
+            return {"needed": needed, "free": free, "holders": holders}
+
+    def evict_gang(
+        self,
+        namespace: str,
+        name: str,
+        hold_seconds: float = 0.0,
+        resize_to: str = "",
+    ) -> List[str]:
+        """Scheduler directive: release a running gang's slices and send
+        it back to waiting. `hold_seconds` paces the requeue (preemption
+        backoff — the gang resumes from checkpoint once re-admitted);
+        `resize_to` instead re-targets the gang at another of its
+        declared admissible shapes (elastic grow/shrink) and only
+        proceeds when enough matching slices are free RIGHT NOW, so a
+        grow never trades a running job for nothing. Returns the released
+        slice names ([] = nothing done). The caller is responsible for
+        driving the job's pods through checkpoint-then-kill (deleting
+        them; the engine recreates them Pending)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            state = self._gangs.get(key)
+            if state is None or not state.slice_names:
+                return []
+            grow_chosen: List[SliceInfo] = []
+            if resize_to:
+                if resize_to not in state.admissible_slices:
+                    return []
+                probe = _GangState(
+                    tpu_chips=state.tpu_chips,
+                    requested_slice=resize_to,
+                    num_slices=state.num_slices,
+                    tenant=state.tenant,  # headroom is per-tenant
+                )
+                # slices held back for feasible waiting gangs are NOT
+                # available to a grow — stealing one would starve the
+                # queue (or, under priority, trigger an immediate
+                # preempt-back churn). Grantable, not just matching: a
+                # slice the cap-aware grant step would refuse must not
+                # green-light the eviction.
+                usage, total = self._usage_by_tenant()
+                grow_shields = [
+                    s for s in self._waiting_shields(usage, total)
+                    if s is not state
+                ]
+                shielded = self._shielded_slices(grow_shields, usage, total)
+                # the gang still holds its old slices here; releasing
+                # them refunds its tenant's usage, so headroom must not
+                # count them against the grow
+                own = sum(
+                    self._slices[s].type.chips
+                    for s in state.slice_names if s in self._slices
+                )
+                usage = dict(usage)
+                usage[state.tenant] = max(usage.get(state.tenant, 0) - own, 0)
+                free = [
+                    s for s in self._grantable_slices(
+                        probe, self._free_slices(), usage, total)
+                    if s.name not in shielded
+                ]
+                n = max(state.num_slices, 1)
+                if len(free) < n:
+                    return []  # target shape not actually available
+                # choose the target slices from the VERIFIED list now —
+                # re-deriving shields after the release (when the refund
+                # can widen a same-tenant waiter's headroom) could newly
+                # shield the target and leave the gang with nothing
+                picked = self._pick_slices(
+                    probe, free, n, self._headroom(probe, usage, total))
+                if picked is None:
+                    return []  # multislice sum outgrows the cap
+                grow_chosen = picked
+            released = list(state.slice_names)
+            for sname in released:
+                info = self._slices.get(sname)
+                if info is not None and info.reserved_by == key:
+                    info.reserved_by = None
+            state.slice_names = []
+            state.waiting_since = time.monotonic()
+            if resize_to:
+                state.requested_slice = resize_to
+            else:
+                state.preemptions += 1
+            state.hold_until = time.monotonic() + max(hold_seconds, 0.0)
+            if resize_to:
+                # grant the pre-verified target slices to THIS gang
+                # before the general pass — otherwise a higher-ranked
+                # waiting gang could take them and the grow would have
+                # traded a running job for nothing
+                for s in grow_chosen:
+                    s.reserved_by = key
+                state.slice_names = [s.name for s in grow_chosen]
+                state.granted_at = time.monotonic()
+            changed = [key] + self._reserve_waiting()
+        for k in changed:
+            self._remirror_podgroup_status(k)
+        return released
+
+    def resize_gang(self, namespace: str, name: str, slice_type: str) -> bool:
+        """Scheduler directive: re-target a WAITING gang at another of its
+        declared admissible shapes (elastic shrink while queued). Running
+        gangs resize through evict_gang(resize_to=...)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            state = self._gangs.get(key)
+            if (
+                state is None
+                or state.slice_names
+                or slice_type not in state.admissible_slices
+                or state.requested_slice == slice_type
+            ):
+                return False
+            state.requested_slice = slice_type
+            changed = [key] + self._reserve_waiting()
+        for k in changed:
+            self._remirror_podgroup_status(k)
+        return True
+
+    def _snapshot(self, key: str, state: _GangState) -> GangSnapshot:
+        return GangSnapshot(
+            key=key,
+            kind=state.kind,
+            tenant=state.tenant,
+            priority=state.priority,
+            seq=state.seq,
+            tpu_chips=state.tpu_chips,
+            num_slices=state.num_slices,
+            requested_slice=state.requested_slice,
+            admissible_slices=list(state.admissible_slices),
+            slice_names=list(state.slice_names),
+            reserved_chips=sum(
+                self._slices[s].type.chips
+                for s in state.slice_names
+                if s in self._slices
+            ),
+            hold_until=state.hold_until,
+            preemptions=state.preemptions,
+            waiting_since=state.waiting_since,
+            granted_at=state.granted_at,
+        )
+
+    def _usage_by_tenant(self) -> "tuple[Dict[str, int], int]":
+        """(tenant -> reserved chips, total pool chips) — under the lock."""
+        usage: Dict[str, int] = {}
+        for state in self._gangs.values():
+            if not state.slice_names:
+                continue
+            chips = sum(
+                self._slices[s].type.chips
+                for s in state.slice_names
+                if s in self._slices
+            )
+            usage[state.tenant] = usage.get(state.tenant, 0) + chips
+        total = sum(s.type.chips for s in self._slices.values())
+        return usage, total
+
+    # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
 
@@ -338,23 +635,52 @@ class TPUSliceAdmitter(GangScheduler):
         return [s for s in self._slices.values() if s.reserved_by is None]
 
     def _reserve_waiting(self) -> List[str]:
-        """Grant free slices to waiting gangs in (priority desc, FIFO) order
-        so a freed slice goes to the front of the queue, not to whichever
-        gang's executor poll happens to run next. Returns the keys of
-        gangs that obtained a reservation in this pass."""
-        waiting = sorted(
-            (
-                (k, s) for k, s in self._gangs.items()
-                if not s.slice_names and s.tpu_chips > 0
-            ),
-            key=lambda kv: (-kv[1].priority, kv[1].seq),
-        )
+        """Grant free slices to waiting gangs in policy order — the
+        attached CapacityDirector's when present, else the built-in
+        (priority desc, FIFO) — so a freed slice goes to the front of the
+        queue, not to whichever gang's executor poll happens to run next.
+        Gangs under a preemption hold sit the pass out (and shield
+        nothing); gangs a director refuses (tenant cap) are skipped
+        without shielding. Returns the keys of gangs that obtained a
+        reservation in this pass."""
+        now = time.monotonic()
+        eligible = [
+            (k, s) for k, s in self._gangs.items()
+            if not s.slice_names and s.tpu_chips > 0 and not s.held(now)
+        ]
+        director = self._director
+        usage: Dict[str, int] = {}
+        total_chips = 0
+        if director is not None:
+            usage, total_chips = self._usage_by_tenant()
+            key_by_state = {id(s): k for k, s in eligible}
+            ordered = [
+                (key_by_state[id(s)], s)
+                for s in director.order_waiting(
+                    [s for _, s in eligible], usage, total_chips
+                )
+                if id(s) in key_by_state
+            ]
+        else:
+            ordered = sorted(eligible, key=lambda kv: (-kv[1].priority, kv[1].seq))
         granted = []
         shielded: List[_GangState] = []
-        for key, state in waiting:
-            self._try_reserve(key, state, shielded)
+        for key, state in ordered:
+            if director is not None and not director.may_reserve(
+                state, usage, total_chips
+            ):
+                continue  # capped: no reservation, no shield
+            self._try_reserve(
+                key, state, shielded,
+                usage if director is not None else None, total_chips,
+            )
             if state.slice_names:
                 granted.append(key)
+                if director is not None:
+                    # keep caps honest within this pass
+                    usage[state.tenant] = usage.get(state.tenant, 0) + sum(
+                        self._slices[s].type.chips for s in state.slice_names
+                    )
             elif self._feasible(state):
                 # Anti-starvation shield: a feasible-but-unsatisfied gang
                 # (e.g. a multislice gang holding out for N simultaneously
@@ -376,21 +702,53 @@ class TPUSliceAdmitter(GangScheduler):
             state.num_slices, 1
         )
 
-    def _shielded_slices(self, exclude: Optional[List[_GangState]] = None):
-        """Names of free slices held back for earlier waiting gangs."""
+    def _shielded_slices(
+        self,
+        exclude: Optional[List[_GangState]] = None,
+        usage: Optional[Dict[str, int]] = None,
+        total_chips: int = 0,
+    ):
+        """Names of free slices held back for earlier waiting gangs — only
+        slices those gangs could actually be GRANTED (a capped gang must
+        not shield an oversized slice it can never take). Pass
+        `usage`/`total_chips` when a pass already holds them (avoids a
+        redundant full-pool walk per call under the lock)."""
         if not exclude:
             return set()
+        if usage is None and self._director is not None:
+            usage, total_chips = self._usage_by_tenant()
         out = set()
+        free = self._free_slices()
         for g in exclude:
-            out.update(s.name for s in self._matching_slices(g, self._free_slices()))
+            out.update(
+                s.name
+                for s in self._grantable_slices(g, free, usage, total_chips)
+            )
         return out
 
-    def _waiting_shields(self) -> List[_GangState]:
+    def _waiting_shields(
+        self,
+        usage: Optional[Dict[str, int]] = None,
+        total_chips: int = 0,
+    ) -> List[_GangState]:
         """Feasible waiting gangs, as seen by the SOLO-pod path: standalone
-        pods must not snatch slices a queued gang is holding out for."""
+        pods must not snatch slices a queued gang is holding out for.
+        Held (preemption-backoff) gangs shield nothing — they are being
+        paced, not starved — and neither do gangs the director refuses
+        (tenant cap): a capped gang cannot reserve, so withholding the
+        slice from solo pods would just idle capacity. Pass
+        `usage`/`total_chips` when already in hand (avoids a redundant
+        full-pool walk under the lock)."""
+        now = time.monotonic()
+        director = self._director
+        if director is not None and usage is None:
+            usage, total_chips = self._usage_by_tenant()
         return [
             s for s in self._gangs.values()
-            if not s.slice_names and s.tpu_chips > 0 and self._feasible(s)
+            if not s.slice_names and s.tpu_chips > 0
+            and not s.held(now) and self._feasible(s)
+            and (director is None
+                 or director.may_reserve(s, usage, total_chips))
         ]
 
     def _matching_slices(self, state: _GangState, pool) -> List[SliceInfo]:
@@ -406,27 +764,92 @@ class TPUSliceAdmitter(GangScheduler):
             ]
         return [s for s in pool if s.type.chips >= per_slice_chips]
 
+    def _headroom(self, state: _GangState, usage=None, total_chips=0):
+        """The gang's tenant-cap headroom per the director; None = no cap.
+        Pass `usage`/`total_chips` when a reservation pass already holds
+        them (avoids a redundant full-pool walk under the lock)."""
+        if self._director is None:
+            return None
+        if usage is None:
+            usage, total_chips = self._usage_by_tenant()
+        return self._director.chips_headroom(state, usage, total_chips)
+
+    def _grantable_slices(
+        self, state: _GangState, pool, usage=None, total_chips=0
+    ) -> List[SliceInfo]:
+        """Matching slices a grant could ACTUALLY take: matching admits
+        slices bigger than the request, so every availability probe
+        (reserve, demand_view, shields, elastic what-ifs) must also drop
+        slices whose chips alone exceed the tenant-cap headroom — or
+        caps get breached at grant time / probes report capacity the
+        grant step then refuses, wedging elastic decisions."""
+        matching = self._matching_slices(state, pool)
+        headroom = self._headroom(state, usage, total_chips)
+        if headroom is None:
+            return matching
+        return [s for s in matching if s.type.chips <= headroom]
+
     def _try_reserve(
         self,
         key: str,
         state: _GangState,
         exclude: Optional[List[_GangState]] = None,
+        usage: Optional[Dict[str, int]] = None,
+        total_chips: int = 0,
     ) -> None:
         if state.slice_names or state.tpu_chips <= 0:
             return
         n = max(state.num_slices, 1)
-        shielded = self._shielded_slices(exclude)
+        if usage is None and self._director is not None:
+            usage, total_chips = self._usage_by_tenant()
+        headroom = self._headroom(state, usage, total_chips)
+        shielded = self._shielded_slices(exclude, usage, total_chips)
         candidates = [
             s for s in self._matching_slices(state, self._free_slices())
             if s.name not in shielded
+            and (headroom is None or s.type.chips <= headroom)
         ]
         if len(candidates) < n:
             return  # all-or-nothing across ALL the gang's slices
-        # tightest fits first — keep big slices free for big gangs
-        chosen = sorted(candidates, key=lambda s: s.type.chips)[:n]
+        chosen = self._pick_slices(state, candidates, n, headroom)
+        if chosen is None:
+            return
         for s in chosen:
             s.reserved_by = key
         state.slice_names = [s.name for s in chosen]
+        state.granted_at = time.monotonic()
+
+    def _pick_slices(
+        self,
+        state: _GangState,
+        candidates: List[SliceInfo],
+        n: int,
+        headroom: Optional[int],
+    ) -> Optional[List[SliceInfo]]:
+        """Choose the `n` slices a grant takes from the matching
+        `candidates` — the ONE selection used by both the reservation
+        pass and the elastic-grow directive so cap enforcement can't
+        drift between them. Director pick (Gavel-style pricing) when it
+        returns a valid subset, else tightest fits first (keep big
+        slices free for big gangs); the cap binds on the SUM of the
+        actual grant (multislice), retrying with the minimal-chips
+        subset before giving up. None = no cap-fitting choice."""
+        chosen = None
+        if self._director is not None:
+            picked = self._director.choose_slices(state, list(candidates), n)
+            if picked:
+                by_name = {s.name for s in candidates}
+                if len(picked) == n and all(s.name in by_name for s in picked):
+                    chosen = picked
+        tightest = sorted(candidates, key=lambda s: s.type.chips)[:n]
+        if chosen is None:
+            chosen = tightest
+        if headroom is not None:
+            if sum(s.type.chips for s in chosen) > headroom:
+                chosen = tightest
+            if sum(s.type.chips for s in chosen) > headroom:
+                return None
+        return chosen
 
     def _assign_solo(self, pod, chips: int) -> Optional[Placement]:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"

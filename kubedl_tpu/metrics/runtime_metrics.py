@@ -16,6 +16,18 @@ from typing import Callable, Dict, List, Optional
 BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 
 
+def _label(value: str) -> str:
+    """Escape a Prometheus label VALUE (tenant names come from a
+    user-controlled annotation — one stray quote must not invalidate the
+    whole exposition and blank every series for the scrape)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class _Histogram:
     __slots__ = ("counts", "total", "sum")
 
@@ -46,6 +58,8 @@ class RuntimeMetrics:
         self._queue_depth: Dict[str, Callable[[], int]] = {}
         # slice-pool snapshot callable (TPUSliceAdmitter.utilization)
         self._slice_pool: Optional[Callable[[], Dict]] = None
+        # capacity-scheduler snapshot callable (CapacityScheduler.snapshot)
+        self._capacity: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -68,6 +82,12 @@ class RuntimeMetrics:
         """snapshot_fn returns TPUSliceAdmitter.utilization()-shaped dicts."""
         with self._lock:
             self._slice_pool = snapshot_fn
+
+    def register_capacity(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns CapacityScheduler.snapshot()-shaped dicts
+        (per-tenant quota/usage + the waiting queue)."""
+        with self._lock:
+            self._capacity = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -140,10 +160,43 @@ class RuntimeMetrics:
                     lines.append(f"{metric} {snap[key]}")
                 lines.append("# TYPE kubedl_slice_reserved gauge")
                 for s in snap["slices"]:
+                    # slice names derive from node-pool labels in kube
+                    # mode — external input, escape like tenant names
                     lines.append(
-                        f'kubedl_slice_reserved{{slice="{s["name"]}",type="{s["type"]}"}} '
+                        f'kubedl_slice_reserved{{slice="{_label(s["name"])}"'
+                        f',type="{_label(s["type"])}"}} '
                         f'{1 if s["reserved_by"] else 0}'
                     )
+        with self._lock:
+            cap_fn = self._capacity
+        if cap_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                cap = cap_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                cap = None
+            if cap is not None:
+                for metric, key, mtype, help_ in (
+                    ("kubedl_tenant_chips_in_use", "chips_in_use", "gauge",
+                     "TPU chips currently reserved per tenant"),
+                    ("kubedl_tenant_share", "share", "gauge",
+                     "Fraction of pool chips held per tenant"),
+                    ("kubedl_tenant_fair_share_chips", "fair_share_chips",
+                     "gauge", "Weighted fair share of pool chips per tenant"),
+                    ("kubedl_tenant_chip_seconds_total", "chip_seconds",
+                     "counter", "Accumulated chip-seconds per tenant"),
+                    ("kubedl_tenant_preemptions_total", "preemptions",
+                     "counter", "Gangs preempted per tenant"),
+                ):
+                    lines.append(f"# HELP {metric} {help_}")
+                    lines.append(f"# TYPE {metric} {mtype}")
+                    for tenant, t in sorted(cap["tenants"].items()):
+                        lines.append(
+                            f'{metric}{{tenant="{_label(tenant)}"}} {t[key]}')
+                lines.append("# TYPE kubedl_preemptions_total counter")
+                lines.append(f"kubedl_preemptions_total {cap['preemptions_total']}")
+                lines.append("# TYPE kubedl_elastic_resizes_total counter")
+                lines.append(f"kubedl_elastic_resizes_total {cap['resizes_total']}")
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -165,10 +218,16 @@ class RuntimeMetrics:
                     depth = -1
                 out["controllers"].setdefault(name, {})["queue_depth"] = depth
             slice_fn = self._slice_pool
+            cap_fn = self._capacity
         if slice_fn is not None:
             try:
                 out["slice_pool"] = slice_fn()  # outside the lock, see render()
             except Exception:  # noqa: BLE001 — callback raced shutdown
                 out["slice_pool"] = None
+        if cap_fn is not None:
+            try:
+                out["capacity"] = cap_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["capacity"] = None
         out["threads"] = [t.name for t in threading.enumerate()]
         return out

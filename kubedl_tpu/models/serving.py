@@ -777,9 +777,18 @@ class ServingEngine:
             # reject at submission, not when _admit pops it mid-flight;
             # the chunked path needs no bucket (its block steps are
             # bucket-free), so it lifts this cap — max_len still bounds
+            hint = ""
+            if self.prefill_chunk > 0 and not self.ring:
+                blocks = -(-int(prompt.size) // self.prefill_chunk)
+                if blocks * self.prefill_chunk > self.max_len:
+                    hint = (
+                        f" (chunked prefill would pad to "
+                        f"{blocks * self.prefill_chunk} cache positions, "
+                        f"past max_len {self.max_len} — raise max_len to a "
+                        f"multiple of prefill_chunk {self.prefill_chunk})")
             raise ValueError(
                 f"prompt of {prompt.size} tokens exceeds the largest "
-                f"prompt bucket {self.prompt_buckets[-1]}")
+                f"prompt bucket {self.prompt_buckets[-1]}{hint}")
         req = Request(self._next_id, prompt, max_new_tokens, eos_token,
                       prefix_id=prefix_id,
                       temperature=(self.temperature if temperature is None
@@ -909,21 +918,29 @@ class ServingEngine:
         req.cache_len = cache_len
 
     def _chunk_eligible(self, prompt_len: int) -> bool:
-        """Chunked-prefill eligibility: prompts too long for a chunk OR
-        for the largest wave bucket (monotone in length — the block
-        steps handle a partial final chunk, so anything the wave can't
-        take, chunking can). Ring caches can't honor block appends (a
-        block can wrap over its own in-flight positions — same
-        restriction as prefix caching). The ONE predicate both submit()
-        admission and _admit() routing use — drift between them would
-        send an over-bucket prompt into the wave's _bucket() and wedge
-        its claimed slots."""
-        return (
-            self.prefill_chunk > 0
-            and not self.ring
-            and (prompt_len > self.prefill_chunk
-                 or prompt_len > self.prompt_buckets[-1])
-        )
+        """Chunked-prefill eligibility: ONLY prompts the wave cannot take
+        (over the largest bucket). The threshold is deliberately
+        decoupled from the chunk block size — mid-length prompts in
+        (prefill_chunk, buckets[-1]] keep batched-wave admission instead
+        of serializing one-at-a-time through the chunker (ADVICE r5
+        medium). Alignment is a hard gate: the padded final block writes
+        ceil(len/chunk)*chunk K/V positions through the jit'd block
+        step, whose overflow check is tracer-skipped and whose
+        dynamic_update_slice clamps the offset — past max_len it would
+        silently overwrite earlier KV positions and return wrong tokens
+        (ADVICE r5 high), so misaligned prompts either fall back to the
+        wave (if a bucket fits) or are rejected at submit(). Ring caches
+        can't honor block appends (a block can wrap over its own
+        in-flight positions — same restriction as prefix caching). The
+        ONE predicate both submit() admission and _admit() routing use —
+        drift between them would send an over-bucket prompt into the
+        wave's _bucket() and wedge its claimed slots."""
+        if self.prefill_chunk <= 0 or self.ring:
+            return False
+        if prompt_len <= self.prompt_buckets[-1]:
+            return False  # the wave admits it in one batched dispatch
+        blocks = -(-prompt_len // self.prefill_chunk)
+        return blocks * self.prefill_chunk <= self.max_len
 
     def _use_chunked(self, req: Request) -> bool:
         return self._chunk_eligible(len(req.prompt))

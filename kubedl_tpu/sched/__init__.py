@@ -1,0 +1,32 @@
+"""Cluster capacity scheduler — tenant fair-share, preemption, elastic resize.
+
+Sits between the reconciler engine and the gang admitter
+(gang/slice_admitter.py): the admitter keeps the *mechanism* (atomic
+slice reservation, anti-starvation shields, PodGroup mirroring) while this
+package owns the *policy* — who runs, on which slice generation, at what
+shape. See docs/scheduling.md.
+"""
+from kubedl_tpu.sched.capacity import CapacityConfig, CapacityScheduler
+from kubedl_tpu.sched.policy import (
+    CapacityPolicy,
+    FairSharePolicy,
+    FifoPolicy,
+    GavelPolicy,
+    PriorityPolicy,
+    make_policy,
+    policy_names,
+)
+from kubedl_tpu.sched.quota import TenantQuotas
+
+__all__ = [
+    "CapacityConfig",
+    "CapacityScheduler",
+    "CapacityPolicy",
+    "FairSharePolicy",
+    "FifoPolicy",
+    "GavelPolicy",
+    "PriorityPolicy",
+    "TenantQuotas",
+    "make_policy",
+    "policy_names",
+]

@@ -1,0 +1,180 @@
+"""Pluggable capacity policies — who waits, who's preempted, which slice.
+
+A policy answers four questions the admitter/scheduler mechanism asks:
+
+  * `order_waiting`   — in what order do waiting gangs claim free slices?
+  * `may_reserve`     — may this gang reserve *now* (tenant caps)?
+  * `choose_slices`   — among matching free slices, which to take? (the
+                        Gavel-style heterogeneity hook: price a gang's
+                        demand against each candidate generation)
+  * `select_victims`  — which running gangs may be preempted to unblock a
+                        starved demander?
+
+Policies are stateless over the arguments they receive: `usage` (tenant ->
+chips reserved) and `total_chips` are computed by the caller, so the hooks
+are safe to call from under the admitter's lock (they only touch the
+leaf-locked TenantQuotas). All hooks receive gang *state* objects duck-
+typed as: tenant, priority, seq, preemptions, tpu_chips, num_slices.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional
+
+from kubedl_tpu.executor.tpu_topology import SliceInfo, parse_slice_type
+from kubedl_tpu.sched.quota import TenantQuotas
+
+# Relative per-chip training throughput by TPU generation — the Gavel
+# pricing table (PAPERS.md: heterogeneity-aware policies normalize demand
+# by effective throughput on each accelerator type). Coarse but ordered
+# correctly; refine per-model when profiles exist.
+THROUGHPUT_PER_CHIP = {"v4": 1.0, "v5e": 0.9, "v5p": 2.0, "v6e": 2.5}
+
+
+def slice_cost(info: SliceInfo) -> float:
+    """A slice's price in normalized throughput units."""
+    return info.type.chips * THROUGHPUT_PER_CHIP.get(info.type.generation, 1.0)
+
+
+def demand_chips(gang) -> int:
+    """Chips a reservation for this gang would take, best-effort: the
+    requested slice shape's size when declared (the gang gets whole
+    slices), else its summed container requests."""
+    if getattr(gang, "requested_slice", ""):
+        try:
+            return parse_slice_type(gang.requested_slice).chips * max(
+                getattr(gang, "num_slices", 1), 1
+            )
+        except ValueError:
+            pass
+    return int(gang.tpu_chips)
+
+
+class CapacityPolicy(abc.ABC):
+    name = ""
+
+    def __init__(self, quotas: Optional[TenantQuotas] = None) -> None:
+        self.quotas = quotas or TenantQuotas()
+
+    # -- ordering --------------------------------------------------------
+
+    def order_waiting(self, waiting: List, usage: Dict[str, int], total_chips: int) -> List:
+        """Default: (priority desc, FIFO) — the admitter's historical order."""
+        return sorted(waiting, key=lambda s: (-s.priority, s.seq))
+
+    # -- admission gates -------------------------------------------------
+
+    def may_reserve(self, gang, usage: Dict[str, int], total_chips: int) -> bool:
+        """Tenant cap: a HARD ceiling — the grant itself must fit, so a
+        single large gang can't blow past the cap from below it. The
+        caller must NOT shield slices for a gang this rejects."""
+        cap = self.quotas.cap(gang.tenant)
+        if cap is None:
+            return True
+        return usage.get(gang.tenant, 0) + demand_chips(gang) <= cap
+
+    # -- slice choice ----------------------------------------------------
+
+    def choose_slices(self, gang, candidates: List[SliceInfo], n: int) -> Optional[List[SliceInfo]]:
+        """None = caller's default (tightest chip fit first)."""
+        return None
+
+    # -- preemption ------------------------------------------------------
+
+    def select_victims(self, demander, holders: List, usage: Dict[str, int], total_chips: int) -> List:
+        """Ordered victim candidates from `holders` (running gangs whose
+        reserved slices match the demander's demand). Empty = never
+        preempt under this policy."""
+        return []
+
+
+class FifoPolicy(CapacityPolicy):
+    """Strict arrival order; priorities ignored; no preemption."""
+
+    name = "fifo"
+
+    def order_waiting(self, waiting, usage, total_chips):
+        return sorted(waiting, key=lambda s: s.seq)
+
+
+class PriorityPolicy(CapacityPolicy):
+    """(priority desc, FIFO) ordering; a strictly-higher-priority demander
+    may evict lower-priority running gangs — lowest priority first,
+    youngest first among equals (least work lost)."""
+
+    name = "priority"
+
+    def select_victims(self, demander, holders, usage, total_chips):
+        victims = [h for h in holders if h.priority < demander.priority]
+        return sorted(victims, key=lambda h: (h.priority, -h.seq))
+
+
+class FairSharePolicy(CapacityPolicy):
+    """Weighted max-min: waiting gangs of the most under-served tenant
+    (lowest usage/fair-share ratio) claim freed slices first; an
+    under-share demander may evict gangs of over-share tenants."""
+
+    name = "fair_share"
+
+    def _active(self, gangs, usage) -> List[str]:
+        return list({g.tenant for g in gangs} | set(usage))
+
+    def order_waiting(self, waiting, usage, total_chips):
+        active = self._active(waiting, usage)
+        shares = self.quotas.fair_shares(active, total_chips)
+        return sorted(
+            waiting,
+            key=lambda s: (
+                self.quotas.share_ratio(s.tenant, usage, shares),
+                -s.priority,
+                s.seq,
+            ),
+        )
+
+    def select_victims(self, demander, holders, usage, total_chips):
+        active = self._active([demander] + holders, usage)
+        shares = self.quotas.fair_shares(active, total_chips)
+        if self.quotas.share_ratio(demander.tenant, usage, shares) >= 1.0:
+            return []  # the demander is already at/over its share
+        victims = [
+            h for h in holders
+            if h.tenant != demander.tenant
+            and self.quotas.share_ratio(h.tenant, usage, shares) > 1.0
+        ]
+        # most over-served tenant first, then lowest priority, then youngest
+        return sorted(
+            victims,
+            key=lambda h: (
+                -self.quotas.share_ratio(h.tenant, usage, shares),
+                h.priority,
+                -h.seq,
+            ),
+        )
+
+
+class GavelPolicy(PriorityPolicy):
+    """Heterogeneity-aware slice pricing on top of priority ordering:
+    among matching free slices, take the cheapest adequate hardware in
+    normalized-throughput units (THROUGHPUT_PER_CHIP), keeping
+    high-throughput generations free for demand that needs them."""
+
+    name = "gavel"
+
+    def choose_slices(self, gang, candidates, n):
+        if len(candidates) < n:
+            return None
+        return sorted(candidates, key=slice_cost)[:n]
+
+
+_POLICIES = {p.name: p for p in (FifoPolicy, PriorityPolicy, FairSharePolicy, GavelPolicy)}
+
+
+def policy_names() -> List[str]:
+    return sorted(_POLICIES)
+
+
+def make_policy(name: str, quotas: Optional[TenantQuotas] = None) -> CapacityPolicy:
+    cls = _POLICIES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown scheduler policy {name!r} (have: {policy_names()})")
+    return cls(quotas)

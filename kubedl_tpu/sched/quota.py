@@ -1,0 +1,133 @@
+"""Tenant quota accounting — weights, caps, fair shares, chip-seconds.
+
+Tenants come from the `kubedl.io/tenancy` annotation (utils/tenancy.py);
+jobs without one are pooled under the "default" tenant. A tenant's fair
+share is its weighted fraction of the pool's chips over the tenants that
+are *active* (running or queued) — an idle tenant's weight does not strand
+capacity. Caps are hard ceilings: once a tenant's chips-in-use reaches its
+cap, the admitter stops granting it new reservations (waiting gangs stay
+queued without shielding slices from others).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, Optional
+
+DEFAULT_TENANT = "default"
+
+
+def normalize_tenant(tenant: str) -> str:
+    return tenant or DEFAULT_TENANT
+
+
+class TenantQuotas:
+    """Static config (weights/caps) + accumulated usage counters.
+
+    The counters are leaf-locked so policy hooks may read them from under
+    the admitter's lock without ordering hazards.
+    """
+
+    def __init__(
+        self,
+        weights: Optional[Dict[str, float]] = None,
+        caps: Optional[Dict[str, int]] = None,
+        default_weight: float = 1.0,
+    ) -> None:
+        for name, w in (weights or {}).items():
+            if not math.isfinite(float(w)) or float(w) <= 0:
+                raise ValueError(
+                    f"tenant weight must be finite and > 0, got {name}={w} "
+                    f"(a negative or NaN weight would corrupt every other "
+                    f"tenant's fair share)")
+        for name, c in (caps or {}).items():
+            if int(c) < 0:
+                raise ValueError(f"tenant cap must be >= 0, got {name}={c}")
+        self._weights = {normalize_tenant(k): float(v) for k, v in (weights or {}).items()}
+        self._caps = {normalize_tenant(k): int(v) for k, v in (caps or {}).items()}
+        self.default_weight = float(default_weight)
+        self._lock = threading.Lock()
+        self._chip_seconds: Dict[str, float] = {}
+        self._preemptions: Dict[str, int] = {}
+
+    # -- config reads ----------------------------------------------------
+
+    def weight(self, tenant: str) -> float:
+        return self._weights.get(normalize_tenant(tenant), self.default_weight)
+
+    def cap(self, tenant: str) -> Optional[int]:
+        return self._caps.get(normalize_tenant(tenant))
+
+    def fair_shares(
+        self, active_tenants: Iterable[str], total_chips: int
+    ) -> Dict[str, float]:
+        """Weighted fair share of the pool, in chips, per active tenant."""
+        active = sorted({normalize_tenant(t) for t in active_tenants})
+        total_weight = sum(self.weight(t) for t in active)
+        if not active or total_weight <= 0:
+            return {}
+        return {t: total_chips * self.weight(t) / total_weight for t in active}
+
+    def share_ratio(
+        self, tenant: str, usage: Dict[str, int], shares: Dict[str, float]
+    ) -> float:
+        """chips-in-use / fair-share; >1 means over-served. A tenant with
+        no share (weight 0) counts as infinitely over-served."""
+        tenant = normalize_tenant(tenant)
+        share = shares.get(tenant, 0.0)
+        used = usage.get(tenant, 0)
+        if share <= 0:
+            return float("inf") if used else 0.0
+        return used / share
+
+    # -- accounting ------------------------------------------------------
+
+    def accrue(self, usage: Dict[str, int], dt: float) -> None:
+        """Integrate chips-in-use over `dt` seconds into chip-seconds."""
+        if dt <= 0:
+            return
+        with self._lock:
+            for tenant, chips in usage.items():
+                if chips <= 0:
+                    continue
+                t = normalize_tenant(tenant)
+                self._chip_seconds[t] = self._chip_seconds.get(t, 0.0) + chips * dt
+
+    def note_preemption(self, tenant: str) -> None:
+        with self._lock:
+            t = normalize_tenant(tenant)
+            self._preemptions[t] = self._preemptions.get(t, 0) + 1
+
+    def preemptions(self, tenant: str) -> int:
+        with self._lock:
+            return self._preemptions.get(normalize_tenant(tenant), 0)
+
+    # -- exposition ------------------------------------------------------
+
+    def snapshot(
+        self,
+        usage: Dict[str, int],
+        total_chips: int,
+        active_tenants: Iterable[str],
+    ) -> Dict[str, Dict]:
+        """Per-tenant state for metrics/CLI: usage, share, fair share,
+        chip-seconds, preemptions."""
+        shares = self.fair_shares(active_tenants, total_chips)
+        with self._lock:
+            tenants = sorted(
+                {normalize_tenant(t) for t in active_tenants}
+                | set(self._chip_seconds) | set(self._preemptions)
+            )
+            out = {}
+            for t in tenants:
+                used = usage.get(t, 0)
+                out[t] = {
+                    "weight": self.weight(t),
+                    "cap_chips": self.cap(t),
+                    "chips_in_use": used,
+                    "fair_share_chips": round(shares.get(t, 0.0), 3),
+                    "share": round(used / total_chips, 4) if total_chips else 0.0,
+                    "chip_seconds": round(self._chip_seconds.get(t, 0.0), 3),
+                    "preemptions": self._preemptions.get(t, 0),
+                }
+            return out

@@ -33,6 +33,13 @@ def get_tenancy(obj) -> Optional[Tenancy]:
         data = json.loads(raw)
     except json.JSONDecodeError as e:
         raise ValueError(f"malformed tenancy annotation: {e}") from e
+    if not isinstance(data, dict):
+        # valid JSON but not an object ('["x"]', '"x"', '5', 'null') —
+        # the ref unmarshals into a struct, which errors the same way
+        raise ValueError(
+            f"malformed tenancy annotation: expected a JSON object, "
+            f"got {type(data).__name__}"
+        )
     return Tenancy(
         tenant=data.get("tenant", ""),
         user=data.get("user", ""),

@@ -122,6 +122,11 @@ class JAXJobController(BaseWorkloadController):
 
     replica_key_map = _CANONICAL
 
+    # elastic resize opt-in (api/validation.py): the trainer restores
+    # shape-agnostically from Orbax checkpoints, so the capacity
+    # scheduler may re-admit the gang at a declared fallback shape
+    supports_elastic = True
+
     def job_type(self):
         return JAXJob
 
@@ -173,6 +178,20 @@ class JAXJobController(BaseWorkloadController):
                 )
         elif job.spec.dcn_mesh is not None:
             errs.append("spec.dcnMesh requires spec.numSlices > 1")
+        sched = (job.spec.run_policy.scheduling_policy
+                 if job.spec.run_policy else None)
+        if sched is not None and sched.tpu_slice_fallbacks and (
+            job.spec.checkpoint is None or not job.spec.checkpoint.path
+        ):
+            # shape sanity is validated for every kind in validate_common;
+            # the checkpoint requirement is the JAX-specific half —
+            # resizes restart the trainer through checkpoint-restore
+            errs.append(
+                "schedulingPolicy.tpuSliceFallbacks requires "
+                "spec.checkpoint (elastic resize restarts the job "
+                "through checkpoint-restore; without one every resize "
+                "would silently lose all training progress)"
+            )
         return errs
 
     def set_cluster_spec(self, job, pod_template, rtype: str, index: int) -> None:

@@ -1,0 +1,587 @@
+"""Capacity-scheduler simulations (sched/): fair-share convergence under
+contention, tenant caps, policy-driven preemption with backoff requeue,
+elastic shrink/regrow directives, and Gavel-style heterogeneous slice
+pricing — all against the real admitter, no processes."""
+import json
+import time
+
+from kubedl_tpu.api.common import (
+    ANNOTATION_TENANCY,
+    ReplicaSpec,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from kubedl_tpu.api.job import BaseJob, BaseJobSpec
+from kubedl_tpu.api.meta import ObjectMeta
+from kubedl_tpu.api.pod import (
+    Container,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+from kubedl_tpu.sched import CapacityConfig, CapacityScheduler
+
+
+def _job(name, chips=8, priority=0, tenant="", tpu_slice="", fallbacks=()):
+    tmpl = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", resources=ResourceRequirements(
+            limits={"google.com/tpu": chips}))
+    ]))
+    meta = ObjectMeta(name=name, namespace="default")
+    if tenant:
+        meta.annotations[ANNOTATION_TENANCY] = json.dumps({"tenant": tenant})
+    return BaseJob(
+        metadata=meta,
+        spec=BaseJobSpec(
+            replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)},
+            run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(
+                priority=priority, tpu_slice=tpu_slice,
+                tpu_slice_fallbacks=list(fallbacks),
+            )),
+        ),
+        kind="TestJob",
+    )
+
+
+def _setup(slices, policy="priority", **cfg):
+    store = ObjectStore()
+    adm = TPUSliceAdmitter.with_pool(store, slices)
+    sched = CapacityScheduler(adm, store, CapacityConfig(policy=policy, **cfg))
+    return adm, sched
+
+
+def _reserved(adm, name):
+    state = adm.get_gang("default", name)
+    return list(state.slice_names) if state else []
+
+
+def _usage_by_tenant(adm):
+    usage = {}
+    for g in adm.gang_snapshots():
+        if g.reserved_chips:
+            usage[g.tenant] = usage.get(g.tenant, 0) + g.reserved_chips
+    return usage
+
+
+# ---------------------------------------------------------------------------
+# fair share
+# ---------------------------------------------------------------------------
+
+
+def test_fair_share_converges_to_weights_under_contention():
+    """Acceptance: with weights 3:1 over a saturated pool, time-averaged
+    chip allocation tracks the configured shares within 10%."""
+    adm, sched = _setup(
+        ["v5e-8"] * 8, policy="fair_share",
+        tenant_weights={"a": 3.0, "b": 1.0}, enable_preemption=False,
+    )
+    jobs = {}
+    counters = {"a": 0, "b": 0}
+
+    def submit(tenant):
+        counters[tenant] += 1
+        job = _job(f"{tenant}-{counters[tenant]}", tenant=tenant)
+        jobs[job.metadata.name] = job
+        adm.create_gang(job, job.spec.replica_specs)
+
+    for _ in range(6):  # deep backlog for both tenants
+        submit("a")
+        submit("b")
+    samples = []
+    for round_no in range(30):
+        sched.tick()
+        usage = _usage_by_tenant(adm)
+        if round_no >= 8:  # past the FIFO warmup
+            samples.append((usage.get("a", 0), usage.get("b", 0)))
+        # the oldest-granted gang finishes; its tenant resubmits
+        running = [g for g in adm.gang_snapshots() if g.slice_names]
+        done = min(running, key=lambda g: g.granted_at)
+        adm.delete_gang(jobs.pop(done.name))
+        submit(done.tenant)
+        sched.tick()
+    mean_a = sum(a for a, _ in samples) / len(samples)
+    mean_b = sum(b for _, b in samples) / len(samples)
+    share_a = mean_a / (mean_a + mean_b)
+    assert abs(share_a - 0.75) <= 0.10, (
+        f"tenant a averaged {share_a:.0%} of allocated chips; "
+        f"configured fair share is 75% (a={mean_a:.1f}, b={mean_b:.1f})"
+    )
+
+
+def test_tenant_cap_blocks_admission_without_shielding():
+    adm, sched = _setup(
+        ["v5e-8", "v5e-8"], policy="fair_share",
+        tenant_caps={"b": 8}, enable_preemption=False,
+    )
+    b1, b2 = _job("b1", tenant="b"), _job("b2", tenant="b")
+    adm.create_gang(b1, b1.spec.replica_specs)
+    adm.create_gang(b2, b2.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "b1") and not _reserved(adm, "b2"), (
+        "cap of 8 chips admits exactly one 8-chip gang")
+    # the capped gang must not shield the free slice from another tenant
+    a1 = _job("a1", tenant="a")
+    adm.create_gang(a1, a1.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "a1")
+    # even once a slice frees, the capped tenant stays at its ceiling
+    adm.delete_gang(a1)
+    sched.tick()
+    assert not _reserved(adm, "b2")
+
+
+def test_cap_is_a_hard_ceiling_for_large_gangs():
+    """A tenant below its cap must not blow past it with one big gang:
+    the grant itself has to fit (usage + demand <= cap)."""
+    adm, sched = _setup(["v5e-16"], policy="fair_share",
+                        tenant_caps={"b": 8}, enable_preemption=False)
+    big = _job("big", chips=16, tenant="b", tpu_slice="v5e-16")
+    adm.create_gang(big, big.spec.replica_specs)
+    sched.tick()
+    assert not _reserved(adm, "big"), (
+        "16-chip reservation exceeds the 8-chip cap even from zero usage")
+
+
+def test_elastic_fallbacks_require_checkpoint_and_sane_shapes():
+    import pytest
+
+    from kubedl_tpu.api.validation import ValidationError, validate
+    from kubedl_tpu.utils.serde import from_dict
+    from kubedl_tpu.workloads.jaxjob import JAXJob, JAXJobController
+
+    def jaxjob(spec_extra):
+        job = from_dict(JAXJob, {
+            "metadata": {"name": "j"},
+            "spec": {
+                "jaxReplicaSpecs": {"Worker": {"replicas": 1, "template":
+                    {"spec": {"containers": [{"name": "jax"}]}}}},
+                "runPolicy": {"schedulingPolicy": {
+                    "tpuSlice": "v5e-16",
+                    "tpuSliceFallbacks": ["v5e-8"]}},
+                **spec_extra,
+            },
+        })
+        job.kind = "JAXJob"
+        return job
+
+    ctrl = JAXJobController()
+    with pytest.raises(ValidationError, match="spec.checkpoint"):
+        # elastic without checkpointing silently loses progress per resize
+        validate(jaxjob({}), ctrl)
+    ckpt = {"checkpoint": {"path": "/tmp/c", "saveIntervalSteps": 5}}
+    validate(jaxjob(ckpt), ctrl)  # must not raise
+    bigger = jaxjob(ckpt)
+    bigger.spec.run_policy.scheduling_policy.tpu_slice_fallbacks = ["v5e-32"]
+    with pytest.raises(ValidationError, match="exceeds the"):
+        validate(bigger, ctrl)
+
+
+def test_elastic_fallbacks_rejected_for_non_elastic_workloads():
+    """tpuSliceFallbacks rides the SHARED SchedulingPolicy, but only
+    workloads that restore shape-agnostically (supports_elastic) may
+    declare them — anything else would lose progress on every resize."""
+    import pytest
+
+    from kubedl_tpu.api.validation import ValidationError, validate
+    from kubedl_tpu.utils.serde import from_dict
+    from kubedl_tpu.workloads.tensorflow import TFJobController
+
+    ctrl = TFJobController()
+    job = from_dict(ctrl.job_type(), {
+        "metadata": {"name": "tf"},
+        "spec": {
+            "tfReplicaSpecs": {"Worker": {"replicas": 1, "template":
+                {"spec": {"containers": [{"name": "tensorflow"}]}}}},
+            "runPolicy": {"schedulingPolicy": {
+                "tpuSlice": "v5e-16", "tpuSliceFallbacks": ["v5e-8"]}},
+        },
+    })
+    job.kind = ctrl.kind
+    with pytest.raises(ValidationError, match="not supported"):
+        validate(job, ctrl)
+
+
+def test_disable_preemption_also_disables_elastic_grow():
+    adm, sched = _setup(
+        ["v5e-16", "v5e-8"], policy="priority",
+        enable_preemption=False, shrink_delay=0.0, grow_delay=0.0,
+    )
+    gang = _job("g", tpu_slice="v5e-16", fallbacks=["v5e-8"])
+    adm.create_gang(gang, gang.spec.replica_specs)
+    # force onto the fallback, then free the preferred slice
+    adm.evict_gang("default", "g", resize_to="v5e-8")
+    assert _reserved(adm, "g") == ["slice-1-v5e-8"]
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "g") == ["slice-1-v5e-8"], (
+        "--disable-preemption promises no eviction of running gangs, "
+        "which includes the grow path")
+    assert sched.snapshot()["resizes_total"] == 0
+
+
+def test_fair_share_preempts_over_share_tenant():
+    adm, sched = _setup(
+        ["v5e-8", "v5e-8"], policy="fair_share",
+        tenant_weights={"a": 1.0, "b": 1.0}, preemption_backoff=0.05,
+    )
+    a1, a2 = _job("a1", tenant="a"), _job("a2", tenant="a")
+    adm.create_gang(a1, a1.spec.replica_specs)
+    adm.create_gang(a2, a2.spec.replica_specs)
+    assert _reserved(adm, "a1") and _reserved(adm, "a2")  # a hogs the pool
+    b1 = _job("b1", tenant="b")
+    adm.create_gang(b1, b1.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "b1"), "under-share tenant must get a slice"
+    snaps = {g.name: g for g in adm.gang_snapshots()}
+    evicted = [n for n in ("a1", "a2") if not snaps[n].slice_names]
+    assert len(evicted) == 1 and snaps[evicted[0]].preemptions == 1
+    assert sched.snapshot()["preemptions_total"] == 1
+    # equal shares reached: no further violence on later ticks
+    sched.tick()
+    assert sched.snapshot()["preemptions_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# priority preemption + backoff requeue
+# ---------------------------------------------------------------------------
+
+
+def test_priority_preemption_evicts_then_requeues_with_backoff():
+    adm, sched = _setup(["v5e-8"], policy="priority", preemption_backoff=0.2)
+    low = _job("low", priority=1)
+    adm.create_gang(low, low.spec.replica_specs)
+    assert _reserved(adm, "low")
+    high = _job("high", priority=9)
+    adm.create_gang(high, high.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "high"), "higher priority must take the slice"
+    low_state = adm.get_gang("default", "low")
+    assert not low_state.slice_names and low_state.preemptions == 1
+    assert low_state.hold_until > time.monotonic(), "requeued with backoff"
+    # the freed slice comes back; the hold paces the victim's re-admission
+    adm.delete_gang(high)
+    sched.tick()
+    assert not _reserved(adm, "low"), "still inside the backoff hold"
+    time.sleep(0.25)
+    sched.tick()
+    assert _reserved(adm, "low"), "victim resumes once the hold expires"
+
+
+def test_fifo_policy_never_preempts():
+    adm, sched = _setup(["v5e-8"], policy="fifo", preemption_backoff=0.01)
+    low = _job("low", priority=1)
+    adm.create_gang(low, low.spec.replica_specs)
+    high = _job("high", priority=9)
+    adm.create_gang(high, high.spec.replica_specs)
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "low") and not _reserved(adm, "high")
+    assert sched.snapshot()["preemptions_total"] == 0
+
+
+def test_infeasible_demand_never_triggers_eviction_storm():
+    """A demand the pool can never satisfy (numSlices beyond the pool)
+    must not checkpoint-evict running gangs forever for nothing."""
+    adm, sched = _setup(["v5e-8", "v5e-8"], policy="priority",
+                        preemption_backoff=0.01)
+    low1, low2 = _job("low1", priority=1), _job("low2", priority=1)
+    adm.create_gang(low1, low1.spec.replica_specs)
+    adm.create_gang(low2, low2.spec.replica_specs)
+    giant = _job("giant", priority=9)
+    giant.spec.num_slices = 3  # pool only has 2 matching slices
+    adm.create_gang(giant, giant.spec.replica_specs)
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "low1") and _reserved(adm, "low2"), (
+        "running gangs must keep their slices")
+    assert sched.snapshot()["preemptions_total"] == 0
+
+
+def test_capped_gang_does_not_shield_slices_from_solo_pods():
+    from kubedl_tpu.api.pod import Pod
+    from kubedl_tpu.api.meta import ObjectMeta as _OM
+
+    adm, _ = _setup(["v5e-8"], policy="fair_share", tenant_caps={"b": 0})
+    b1 = _job("b1", tenant="b")
+    adm.create_gang(b1, b1.spec.replica_specs)
+    assert not _reserved(adm, "b1"), "cap of 0 admits nothing"
+    pod = Pod(metadata=_OM(name="solo", namespace="default"),
+              spec=PodSpec(containers=[Container(
+                  name="c", resources=ResourceRequirements(
+                      limits={"google.com/tpu": 8}))]))
+    placement = adm.assign(pod)
+    assert placement is not None, (
+        "a gang its tenant cap blocks must not idle the slice")
+
+
+def test_grow_aborts_rather_than_stealing_from_waiting_gangs():
+    """evict_gang(resize_to=...) must refuse when a feasible waiting
+    gang shields the target slice: proceeding would either starve the
+    queue or (under priority) trigger an immediate preempt-back churn —
+    and the running gang would have been checkpoint-killed for nothing."""
+    adm, _ = _setup(["v5e-16", "v5e-8"], policy="priority")
+    rival = _job("rival", priority=5, tpu_slice="v5e-16")
+    adm.create_gang(rival, rival.spec.replica_specs)
+    grower = _job("grower", priority=0, tpu_slice="v5e-16",
+                  fallbacks=["v5e-8"])
+    adm.create_gang(grower, grower.spec.replica_specs)  # big slice taken
+    adm.resize_gang("default", "grower", "v5e-8")  # shrink to the fallback
+    assert _reserved(adm, "grower") == ["slice-1-v5e-8"]
+    contender = _job("contender", priority=9, tpu_slice="v5e-16")
+    adm.create_gang(contender, contender.spec.replica_specs)  # queued
+    # delete_gang frees the big slice WITHOUT a reservation pass — the
+    # exact window where the grow directive races the waiting contender
+    adm.delete_gang(rival)
+    released = adm.evict_gang("default", "grower", resize_to="v5e-16")
+    assert released == [], "the contender shields the freed big slice"
+    assert _reserved(adm, "grower") == ["slice-1-v5e-8"], (
+        "the running gang keeps running — never traded for nothing")
+    adm.kick()
+    assert _reserved(adm, "contender") == ["slice-0-v5e-16"]
+
+
+def test_no_preemption_of_equal_or_higher_priority():
+    adm, sched = _setup(["v5e-8"], policy="priority", preemption_backoff=0.01)
+    first = _job("first", priority=5)
+    adm.create_gang(first, first.spec.replica_specs)
+    peer = _job("peer", priority=5)
+    adm.create_gang(peer, peer.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "first") and not _reserved(adm, "peer")
+    assert sched.snapshot()["preemptions_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic resize
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_shrink_on_preemption_then_regrow():
+    """The acceptance shape: a preempted elastic job re-admits at its
+    declared smaller shape while the pool stays tight, then grows back
+    to the preferred shape once it frees."""
+    adm, sched = _setup(
+        ["v5e-16", "v5e-8"], policy="priority",
+        preemption_backoff=0.05, shrink_delay=0.0, grow_delay=0.0,
+    )
+    victim = _job("victim", priority=0, tpu_slice="v5e-16",
+                  fallbacks=["v5e-8"])
+    adm.create_gang(victim, victim.spec.replica_specs)
+    assert _reserved(adm, "victim") == ["slice-0-v5e-16"]
+    vip = _job("vip", priority=9, tpu_slice="v5e-16")
+    adm.create_gang(vip, vip.spec.replica_specs)
+    sched.tick()  # preempt + shrink directive land this round
+    assert _reserved(adm, "vip") == ["slice-0-v5e-16"]
+    state = adm.get_gang("default", "victim")
+    assert state.requested_slice == "v5e-8", "downgraded to the fallback"
+    time.sleep(0.15)  # past the preemption hold
+    sched.tick()
+    assert _reserved(adm, "victim") == ["slice-1-v5e-8"], (
+        "victim resumes at the smaller admissible shape")
+    # pool frees: the job grows back to its preferred shape
+    adm.delete_gang(vip)
+    sched.tick()
+    state = adm.get_gang("default", "victim")
+    assert state.requested_slice == "v5e-16"
+    assert _reserved(adm, "victim") == ["slice-0-v5e-16"]
+    snap = sched.snapshot()
+    assert snap["preemptions_total"] == 1
+    assert snap["resizes_total"] == 2  # one shrink + one grow
+
+
+def test_cap_binds_on_the_actual_grant_not_the_request():
+    """Matching admits slices BIGGER than the request; the cap must hold
+    against the chips actually granted, not the shape asked for."""
+    adm, sched = _setup(["v5e-8"], policy="fair_share",
+                        tenant_caps={"a": 4}, enable_preemption=False)
+    j = _job("a1", chips=4, tenant="a")  # only an 8-chip slice exists
+    adm.create_gang(j, j.spec.replica_specs)
+    sched.tick()
+    assert not _reserved(adm, "a1"), (
+        "granting the 8-chip slice would double the 4-chip cap")
+    assert _usage_by_tenant(adm) == {}
+
+
+def test_grow_never_steals_a_shielded_slice():
+    """A slice held back for a feasible waiting gang is not 'free' to an
+    elastic grow — stealing it would starve the queue (or churn
+    preempt-back under priority policies)."""
+    adm, sched = _setup(["v5e-8", "v5e-8", "v5e-4"], policy="fifo",
+                        shrink_delay=0.0, grow_delay=0.0)
+    b1, b2 = _job("b1"), _job("b2")
+    adm.create_gang(b1, b1.spec.replica_specs)
+    adm.create_gang(b2, b2.spec.replica_specs)  # both v5e-8 slices taken
+    grower = _job("grower", tpu_slice="v5e-8", fallbacks=["v5e-4"])
+    adm.create_gang(grower, grower.spec.replica_specs)
+    assert adm.resize_gang("default", "grower", "v5e-4")
+    assert _reserved(adm, "grower") == ["slice-2-v5e-4"]
+    # a multislice gang waits for BOTH v5e-8 slices at once; the one b1
+    # frees is shielded for it — not grow fodder
+    waiter = _job("waiter", tpu_slice="v5e-8")
+    waiter.spec.num_slices = 2
+    adm.create_gang(waiter, waiter.spec.replica_specs)
+    adm.delete_gang(b1)
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "grower") == ["slice-2-v5e-4"], (
+        "the free v5e-8 is shielded for the waiting multislice gang")
+    assert not _reserved(adm, "waiter")
+    assert sched.snapshot()["resizes_total"] == 0
+    # the shield resolves once the second slice frees: waiter gets both
+    adm.delete_gang(b2)
+    sched.tick()
+    assert sorted(_reserved(adm, "waiter")) == [
+        "slice-0-v5e-8", "slice-1-v5e-8"]
+
+
+def test_capped_tenant_with_only_oversized_slice_shrinks_to_fit():
+    """Matching admits oversized slices, but a capped tenant can never be
+    GRANTED one — the probes must agree with the grant step, so the gang
+    shrinks to its cap-fitting fallback instead of wedging Pending (and
+    is never grow-evicted toward capacity the cap forbids)."""
+    adm, sched = _setup(
+        ["v5e-32", "v5e-8"], policy="fair_share", tenant_caps={"a": 24},
+        shrink_delay=0.0, grow_delay=0.0, enable_preemption=False,
+    )
+    g = _job("a1", tenant="a", tpu_slice="v5e-16", fallbacks=["v5e-8"])
+    adm.create_gang(g, g.spec.replica_specs)
+    sched.tick()
+    assert _reserved(adm, "a1") == ["slice-1-v5e-8"], (
+        "only grantable shape within the cap is the v5e-8 fallback")
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "a1") == ["slice-1-v5e-8"], (
+        "no grow toward the v5e-32 the 24-chip cap forbids")
+    snap = sched.snapshot()
+    assert snap["resizes_total"] == 1 and snap["preemptions_total"] == 0
+
+
+def test_malformed_tenancy_annotation_pools_under_default():
+    """Valid-JSON-but-not-an-object tenancy annotations must pool the
+    job under the default tenant, not crash the reconcile loop."""
+    adm, _ = _setup(["v5e-8"], policy="fair_share")
+    for i, raw in enumerate(('["research"]', '"x"', "5", "null", "{bad")):
+        j = _job(f"j{i}")
+        j.metadata.annotations[ANNOTATION_TENANCY] = raw
+        adm.create_gang(j, j.spec.replica_specs)  # must not raise
+        assert adm.get_gang("default", f"j{i}").tenant == "default"
+
+
+def test_grow_refunds_own_chips_against_the_cap():
+    """Growing releases the gang's current slices, so its own chips must
+    not count against the cap headroom — cap 16 with 8 in use still
+    allows a grow to a 16-chip shape."""
+    adm, sched = _setup(
+        ["v5e-16", "v5e-8"], policy="priority", tenant_caps={"a": 16},
+        shrink_delay=0.0, grow_delay=0.0,
+    )
+    blocker = _job("b1", tenant="b", priority=9, tpu_slice="v5e-16")
+    adm.create_gang(blocker, blocker.spec.replica_specs)
+    g = _job("a1", tenant="a", tpu_slice="v5e-16", fallbacks=["v5e-8"])
+    adm.create_gang(g, g.spec.replica_specs)
+    sched.tick()  # preferred shape busy -> shrink to the fallback
+    assert _reserved(adm, "a1") == ["slice-1-v5e-8"]
+    adm.delete_gang(blocker)
+    sched.tick()
+    assert _reserved(adm, "a1") == ["slice-0-v5e-16"], (
+        "8 own chips refund against the 16-chip cap; the grow is legal")
+    assert sched.snapshot()["resizes_total"] == 2
+
+
+def test_grow_respects_tenant_cap():
+    """A capped tenant's elastic gang shrinks into its cap and must NOT
+    be grown back past it, even with the bigger slice sitting free."""
+    adm, sched = _setup(
+        ["v5e-16", "v5e-8"], policy="fair_share", tenant_caps={"b": 8},
+        shrink_delay=0.0, grow_delay=0.0,
+    )
+    gang = _job("b1", tenant="b", tpu_slice="v5e-16", fallbacks=["v5e-8"])
+    adm.create_gang(gang, gang.spec.replica_specs)
+    sched.tick()  # 16-chip preferred shape exceeds the cap -> shrink
+    assert _reserved(adm, "b1") == ["slice-1-v5e-8"]
+    for _ in range(3):
+        sched.tick()
+    assert _reserved(adm, "b1") == ["slice-1-v5e-8"], (
+        "growing to 16 chips would blow the 8-chip cap")
+    assert sched.snapshot()["resizes_total"] == 1  # the shrink only
+
+
+def test_grow_aborts_when_target_shape_taken():
+    """evict_gang(resize_to=...) must be a no-op when the better shape is
+    not actually free — a grow never trades a running job for nothing."""
+    adm, _ = _setup(["v5e-16", "v5e-8"], policy="priority")
+    holder = _job("holder", tpu_slice="v5e-16")
+    adm.create_gang(holder, holder.spec.replica_specs)
+    small = _job("small", tpu_slice="v5e-8", fallbacks=[])
+    adm.create_gang(small, small.spec.replica_specs)
+    assert _reserved(adm, "small") == ["slice-1-v5e-8"]
+    released = adm.evict_gang("default", "small", resize_to="v5e-16")
+    assert released == [] and _reserved(adm, "small") == ["slice-1-v5e-8"]
+
+
+# ---------------------------------------------------------------------------
+# heterogeneity-aware (Gavel-style) slice pricing
+# ---------------------------------------------------------------------------
+
+
+def test_gavel_prices_demand_onto_cheapest_generation():
+    """Both pool slices hold 8 chips; v5p throughput is priced ~2x v4.
+    The gavel scorer parks a generic 8-chip gang on the cheap v4 slice,
+    keeping the fast hardware free; the default tightest-fit (no
+    scheduler) takes whichever slice comes first in the pool."""
+    store = ObjectStore()
+    # v5p/v4 names count TensorCores: each slice resolves to 8 chips
+    plain = TPUSliceAdmitter.with_pool(store, ["v5p-16", "v4-16"])
+    job = _job("j", chips=8)
+    plain.create_gang(job, job.spec.replica_specs)
+    assert _reserved(plain, "j") == ["slice-0-v5p-8"]
+
+    adm, _ = _setup(["v5p-16", "v4-16"], policy="gavel")
+    job2 = _job("j", chips=8)
+    adm.create_gang(job2, job2.spec.replica_specs)
+    assert _reserved(adm, "j") == ["slice-1-v4-8"]
+
+
+# ---------------------------------------------------------------------------
+# exposition: metrics + operator wiring
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_gauges_and_debug_vars():
+    from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+    adm, sched = _setup(
+        ["v5e-8", "v5e-8"], policy="fair_share",
+        tenant_weights={"a": 1.0}, preemption_backoff=0.01,
+    )
+    a1 = _job("a1", tenant="a")
+    adm.create_gang(a1, a1.spec.replica_specs)
+    sched.tick()
+    rm = RuntimeMetrics()
+    rm.register_capacity(sched.snapshot)
+    text = rm.render()
+    assert 'kubedl_tenant_chips_in_use{tenant="a"} 8' in text
+    assert 'kubedl_tenant_fair_share_chips{tenant="a"} 16' in text
+    assert "kubedl_preemptions_total 0" in text
+    dv = rm.debug_vars()
+    assert dv["capacity"]["policy"] == "fair_share"
+    assert dv["capacity"]["queue"][0]["gang"] == "default/a1"
+    assert dv["capacity"]["queue"][0]["state"] == "Reserved"
+
+
+def test_operator_wires_capacity_scheduler():
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    op = Operator(OperatorConfig(
+        tpu_slices=["v5e-8"], scheduler_policy="fair_share",
+        run_executor=False,
+    ))
+    try:
+        assert op.capacity_scheduler is not None
+        assert op.config.enable_gang_scheduling
+        assert op._gang._director is op.capacity_scheduler
+        assert "capacity" in op.runtime_metrics.debug_vars()
+    finally:
+        op.stop()

@@ -148,6 +148,69 @@ def test_top_shows_pool_and_controllers(tmp_path, capsys):
         op.stop()
 
 
+def test_queue_shows_tenants_and_gangs(tmp_path, capsys):
+    """`kubedl-tpu queue` surfaces the capacity scheduler's quota + gang
+    queue state (docs/scheduling.md triage surface)."""
+    import json as _json
+    import time as _time
+
+    op = Operator(OperatorConfig(
+        tpu_slices=["v5e-8"], scheduler_policy="fair_share",
+        tenant_weights={"research": 3.0},
+    ))
+    op.register_all()
+    op.start()
+    srv = OperatorHTTPServer(op, port=0)
+    port = srv.start()
+    try:
+        manifest = tmp_path / "job.yaml"
+        manifest.write_text(f"""
+apiVersion: kubedl-tpu.io/v1alpha1
+kind: JAXJob
+metadata:
+  name: queued-job
+  annotations:
+    kubedl.io/tenancy: '{_json.dumps({"tenant": "research"})}'
+spec:
+  jaxReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: ExitCode
+      template:
+        spec:
+          containers:
+            - name: jax
+              command: [{sys.executable}, -c, "import time; time.sleep(5)"]
+              resources:
+                limits:
+                  google.com/tpu: 8
+""")
+        url = f"http://127.0.0.1:{port}"
+        assert cli_main(["apply", "--server", url, "-f", str(manifest)]) == 0
+        capsys.readouterr()
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if op._gang.get_gang("default", "queued-job") is not None:
+                break
+            _time.sleep(0.05)
+        rc = cli_main(["queue", "--server", url])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "policy=fair_share" in out
+        assert "research" in out and "default/queued-job" in out
+        assert cli_main(["top", "--server", url]) == 0
+        assert "capacity scheduler" in capsys.readouterr().out
+    finally:
+        srv.stop()
+        op.stop()
+
+
+def test_queue_without_scheduler_is_an_error(server, capsys):
+    _, url = server
+    assert cli_main(["queue", "--server", url]) == 1
+    assert "not enabled" in capsys.readouterr().err
+
+
 def test_get_watch_prints_status_changes(server, tmp_path, capsys, monkeypatch):
     """get -w polls and prints rows whose status changed. Deterministic:
     the pod blocks on a gate file, so the initial snapshot sees the job

@@ -534,8 +534,10 @@ def test_chunked_prefill_parity_with_generate(model):
     rng = np.random.default_rng(7)
     long_prompt = rng.integers(1, config.vocab_size, size=40).astype(np.int32)
     short = rng.integers(1, config.vocab_size, size=5).astype(np.int32)
+    # buckets below the long prompt: chunking engages only past the
+    # largest bucket (the threshold is decoupled from prefill_chunk)
     eng = ServingEngine(params, config, slots=3, max_len=128,
-                        prefill_chunk=16)
+                        prefill_chunk=16, prompt_buckets=[16, 32])
     # short request first so decode ticks are live while the long
     # prompt's chunks advance
     r_short = eng.submit(short, max_new_tokens=12)
@@ -556,7 +558,7 @@ def test_chunked_prefill_interleaves_with_decode(model):
     short = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
     long_prompt = rng.integers(1, config.vocab_size, size=48).astype(np.int32)
     eng = ServingEngine(params, config, slots=2, max_len=128,
-                        prefill_chunk=16)
+                        prefill_chunk=16, prompt_buckets=[16, 32])
     r_short = eng.submit(short, max_new_tokens=20)
     eng.step()  # admit + first token for the short request
     r_long = eng.submit(long_prompt, max_new_tokens=4)
@@ -584,7 +586,7 @@ def test_chunked_prefill_parity_block_steps(model):
     long_prompt = rng.integers(1, config.vocab_size, size=33).astype(np.int32)
     short = rng.integers(1, config.vocab_size, size=5).astype(np.int32)
     eng = ServingEngine(params, config, slots=2, max_len=128,
-                        prefill_chunk=16)
+                        prefill_chunk=16, prompt_buckets=[16, 32])
     r_short = eng.submit(short, max_new_tokens=10)
     req = eng.submit(long_prompt, max_new_tokens=8)
     while not (req.done and r_short.done):
@@ -646,7 +648,7 @@ def test_failed_chunked_prefill_frees_slot(model, monkeypatch):
     params, config = model
     rng = np.random.default_rng(12)
     eng = ServingEngine(params, config, slots=2, max_len=128,
-                        prefill_chunk=16)
+                        prefill_chunk=16, prompt_buckets=[16, 32])
 
     def boom(*a, **k):
         raise RuntimeError("synthetic chunk failure")
@@ -682,3 +684,53 @@ def test_chunked_prefill_lifts_bucket_cap(model):
                          prompt_buckets=[16, 32], prefill_chunk=0)
     with pytest.raises(ValueError, match="largest"):
         eng2.submit(longp, max_new_tokens=4)
+
+
+def test_chunk_misaligned_max_len_falls_back_to_wave(model):
+    """ADVICE r5 high: max_len=20, prefill_chunk=8, prompt=18 — the
+    chunker's padded final block would write positions 16..24, past
+    max_len=20; the jit'd block step's clamp silently overwrites earlier
+    KV and returns wrong tokens. The host-side guard keeps this shape on
+    the unchunked wave path, matching the chunk-free reference exactly."""
+    params, config = model
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(1, config.vocab_size, size=18).astype(np.int32)
+    ref = ServingEngine(params, config, slots=1, max_len=20, prefill_chunk=0)
+    want = ref.serve_all([prompt], max_new_tokens=2)[0]
+    eng = ServingEngine(params, config, slots=1, max_len=20, prefill_chunk=8)
+    got = eng.serve_all([prompt], max_new_tokens=2)[0]
+    assert eng.stats()["chunked_prefills"] == 0, "guard must reroute to wave"
+    assert got == want == ref_generate(params, config, prompt, 2)
+
+
+def test_chunk_misaligned_no_bucket_rejected_at_submit(model):
+    """Same misalignment with no bucket big enough to fall back to: the
+    submit must reject host-side (wrong-token corruption is never an
+    acceptable outcome) and say why."""
+    params, config = model
+    eng = ServingEngine(params, config, slots=1, max_len=20,
+                        prefill_chunk=8, prompt_buckets=[8])
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.ones(18, np.int32), max_new_tokens=2)
+    # the aligned shape from the same config is still chunkable
+    assert eng._chunk_eligible(16)
+    assert not eng._chunk_eligible(18)
+
+
+def test_mid_length_prompts_keep_wave_admission(model):
+    """ADVICE r5 medium: prompts in (prefill_chunk, buckets[-1]] must
+    admit together in a batched wave, not serialize one-at-a-time
+    through the chunker."""
+    params, config = model
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(1, config.vocab_size, size=20).astype(np.int32)
+               for _ in range(3)]
+    eng = ServingEngine(params, config, slots=3, max_len=64, prefill_chunk=8)
+    reqs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+    while not all(r.done for r in reqs):
+        eng.step()
+    st = eng.stats()
+    assert st["chunked_prefills"] == 0
+    assert st["prefill_batches"] == 1, "one wave dispatch for the trio"
+    for p, r in zip(prompts, reqs):
+        assert r.tokens == ref_generate(params, config, p, 3)

@@ -115,13 +115,13 @@ def test_spec_serving_block_pump_and_chunked_prefill(models):
     longp = rng.integers(1, config.vocab_size, size=40).astype(np.int32)
     short = rng.integers(1, config.vocab_size, size=4).astype(np.int32)
     plain = ServingEngine(params, config, slots=2, max_len=128,
-                          prefill_chunk=16)
+                          prefill_chunk=16, prompt_buckets=[16, 32])
     w_s = plain.submit(short, 8)
     w_l = plain.submit(longp, 6)
     while not (w_s.done and w_l.done):
         plain.step_block()
     spec = ServingEngine(params, config, slots=2, max_len=128,
-                         prefill_chunk=16,
+                         prefill_chunk=16, prompt_buckets=[16, 32],
                          draft_params=draft, draft_config=config, spec_k=3)
     r_s = spec.submit(short, 8)
     r_l = spec.submit(longp, 6)
@@ -212,10 +212,10 @@ def test_spec_chunked_composition_fast(models):
     rng = np.random.default_rng(8)
     longp = rng.integers(1, config.vocab_size, size=14).astype(np.int32)
     plain = ServingEngine(params, config, slots=2, max_len=64,
-                          prefill_chunk=8)
+                          prefill_chunk=8, prompt_buckets=[8])
     want = _serve(plain, [longp], 5)
     spec = ServingEngine(params, config, slots=2, max_len=64,
-                         prefill_chunk=8,
+                         prefill_chunk=8, prompt_buckets=[8],
                          draft_params=draft, draft_config=config, spec_k=3)
     got = _serve(spec, [longp], 5)
     assert got == want
