@@ -16,13 +16,20 @@ test:
 # tests `slow` instead of letting the fast lane grow silently.
 .PHONY: presubmit
 presubmit:
-	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=15 2>&1 | tee .presubmit-fast.log
-	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60
+	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee .presubmit-fast.log
+	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60 \
+	  --total tests/test_gmm_moe.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
 bench:
 	$(PY) bench.py
+
+# MoE-only fast loop: just the llama_moe milestone + the dispatch
+# overhead breakdown (gating/permute/gmm/combine/a2a), printed as JSON.
+.PHONY: bench-moe
+bench-moe:
+	$(PY) bench.py --moe-only
 
 .PHONY: manifests
 manifests:

@@ -299,6 +299,14 @@ def _tpu_child(results_path: str) -> int:
 
     threading.Thread(target=_watchdog, daemon=True).start()
 
+    # milestone filter: KUBEDL_BENCH_ONLY="llama_moe,moe_breakdown" runs
+    # just those (the `bench.py --moe-only` / `make bench-moe` fast loop)
+    only = {s.strip() for s in
+            os.environ.get("KUBEDL_BENCH_ONLY", "").split(",") if s.strip()}
+
+    def _enabled(name):
+        return not only or name in only
+
     # -- 2. flash attention: numeric check + timing on the chip -------------
     def flash_milestone():
         from kubedl_tpu.ops.flash_attention import attention_reference, flash_attention
@@ -737,9 +745,12 @@ def _tpu_child(results_path: str) -> int:
             "1b": llama.LlamaConfig.bench_1b(remat=False, max_seq_len=1024),
             # top-2-of-4 experts on the 150m backbone: single-chip MoE
             # compute proof (the expert axis itself is multichip-only,
-            # covered by the dryrun)
-            "moe": llama.LlamaConfig.bench_150m(
-                max_seq_len=seq, remat=False, n_experts=4, expert_top_k=2),
+            # covered by the dryrun); tiny shapes for the CPU smoke
+            "moe": (llama.LlamaConfig.tiny(
+                use_flash=False, n_experts=4, expert_top_k=2) if small
+                else llama.LlamaConfig.bench_150m(
+                    max_seq_len=seq, remat=False, n_experts=4,
+                    expert_top_k=2)),
         }
         config = configs[config_name]
         rules = ShardingRules()
@@ -802,7 +813,115 @@ def _tpu_child(results_path: str) -> int:
         ("serving_spec", serving_spec_milestone, 150),
         ("grpo", grpo_milestone, 150),
     ]
+    # -- 6. MoE dispatch-overhead breakdown: per-stage timing of the
+    # dropless hot path (models/moe.py stages) so a moe_mfu move is
+    # attributable to gating / permute / gmm / combine / a2a instead of
+    # being one opaque number --------------------------------------------
+    def moe_breakdown_milestone():
+        import functools as ft
+        import statistics as stats
+
+        from kubedl_tpu.models import moe as moe_mod
+
+        # the llama_moe milestone's MoE layer shapes (150m backbone)
+        d, ff, e, k = (64, 128, 4, 2) if small else (1024, 2816, 4, 2)
+        s = 256 if small else 8192
+        dtype = jnp.bfloat16
+        params = moe_mod.moe_init(jax.random.PRNGKey(0), d, ff, e, dtype=dtype)
+        hf = jax.random.normal(jax.random.PRNGKey(1), (s, d), dtype)
+        ks = k * s
+        src_rows = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
+
+        def timed(fn, n1=10, n2=40, reps=3):
+            """Median per-call seconds of fn(carry)->f32 scalar via an
+            on-device scan, differencing two loop lengths to cancel
+            fixed dispatch costs (same discipline as the flash
+            milestone); the carry chains iterations so XLA can neither
+            CSE nor hoist the body."""
+            @ft.partial(jax.jit, static_argnames="n")
+            def loop(n):
+                def body(c, _):
+                    return fn(c) * 1e-20, ()
+                out, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+                return out
+
+            jax.device_get(loop(n=n1))
+            jax.device_get(loop(n=n2))
+            diffs = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.device_get(loop(n=n1))
+                t1 = time.perf_counter()
+                jax.device_get(loop(n=n2))
+                t2 = time.perf_counter()
+                diffs.append(((t2 - t1) - (t1 - t0)) / (n2 - n1))
+            return max(stats.median(diffs), 0.0)
+
+        # gating: router matmul + top-k + combine weights
+        def gating_fn(c):
+            _, _, w, _, _ = moe_mod._top_k_gating(
+                (hf + c.astype(dtype)).astype(jnp.float32) @ params["router"],
+                k, s + 1, need_slots=False)
+            return jnp.sum(w)
+
+        # fixed routing for the downstream stages
+        experts, _, weights, _, _ = moe_mod._top_k_gating(
+            hf.astype(jnp.float32) @ params["router"], k, s + 1,
+            need_slots=False)
+        ef = experts.reshape(ks)
+
+        # permute: dispatch plan (sort + offsets) + padded gather/scatter;
+        # rolling ef per iteration keeps the plan inside the loop
+        def permute_fn(c):
+            ef_i = jnp.roll(ef, c.astype(jnp.int32) % ks)
+            order, dest, _, _, m_pad = moe_mod._dispatch_plan(ef_i, e)
+            x = moe_mod._permute(hf, src_rows, order, dest, m_pad)
+            return jnp.sum(x.astype(jnp.float32))
+
+        tile = moe_mod._row_tile(ks, e)
+        m_pad = (ks + tile - 1) // tile * tile + e * tile
+        order, dest, pos_of_entry, tile_expert, _ = jax.jit(
+            lambda ef: moe_mod._dispatch_plan(ef, e))(ef)
+        x_pad = jax.jit(lambda: moe_mod._permute(
+            hf, src_rows, order, dest, m_pad))()
+
+        # gmm: the fused expert FFN on the padded rows
+        def gmm_fn(c):
+            rows = moe_mod._ffn_rows(
+                x_pad + c.astype(dtype), tile_expert, params)
+            return jnp.sum(rows.astype(jnp.float32))
+
+        rows_pad = jnp.concatenate(
+            [moe_mod._ffn_rows(x_pad, tile_expert, params),
+             jnp.zeros((1, d), dtype)], axis=0)
+
+        # combine: gather entries back + weighted k-way sum
+        def combine_fn(c):
+            y = moe_mod._combine(
+                (rows_pad + c.astype(dtype))[pos_of_entry], weights, dtype)
+            return jnp.sum(y.astype(jnp.float32))
+
+        t = {
+            "gating": timed(gating_fn),
+            "permute": timed(permute_fn),
+            "gmm": timed(gmm_fn),
+            "combine": timed(combine_fn),
+            # the expert-axis all_to_all needs a multichip mesh; the
+            # single-chip bench reports it as zero rather than faking it
+            "a2a": 0.0,
+        }
+        total = sum(t.values()) or 1.0
+        _emit(out, "moe_breakdown", {
+            **{f"{name}_ms": round(v * 1e3, 4) for name, v in t.items()},
+            "fractions": {name: round(v / total, 4) for name, v in t.items()},
+            "dispatch_overhead_frac": round(1.0 - t["gmm"] / total, 4),
+            "shape": {"tokens": s, "d": d, "ff": ff, "experts": e, "top_k": k},
+            "environment": "single chip; a2a requires an expert-axis mesh",
+        })
+
     for name, fn, min_budget in milestones:
+        if not _enabled(name):
+            continue
         if left() < min_budget:
             _emit(out, name, {"skipped": f"budget exhausted ({left():.0f}s left)"})
             continue
@@ -815,7 +934,9 @@ def _tpu_child(results_path: str) -> int:
     # Llama: prove the path on a ~150M model, then attempt the 1B target
     # with whatever budget remains (it needs most of it for first compile).
     try:
-        if left() > 120:
+        if not _enabled("llama_150m"):
+            pass
+        elif left() > 120:
             _mark("llama_150m")
             llama_milestone("tiny" if small else "150m",
                             batch=2 if small else 8, seq=128 if small else 1024,
@@ -825,7 +946,9 @@ def _tpu_child(results_path: str) -> int:
     except Exception as e:  # noqa: BLE001
         _emit(out, "llama_150m", {"error": f"{type(e).__name__}: {e}"[:300]})
     try:
-        if small:
+        if not _enabled("llama_1b"):
+            pass
+        elif small:
             _emit(out, "llama_1b", {"skipped": "KUBEDL_BENCH_SMALL set"})
         elif left() > 240:
             _mark("llama_1b")
@@ -836,15 +959,28 @@ def _tpu_child(results_path: str) -> int:
     except Exception as e:  # noqa: BLE001
         _emit(out, "llama_1b", {"error": f"{type(e).__name__}: {e}"[:300]})
     try:
-        if small:
-            _emit(out, "llama_moe", {"skipped": "KUBEDL_BENCH_SMALL set"})
+        if not _enabled("llama_moe"):
+            pass
         elif left() > 180:
             _mark("llama_moe")
-            llama_milestone("moe", batch=8, seq=1024, steps=10, key="llama_moe")
+            llama_milestone("moe", batch=2 if small else 8,
+                            seq=128 if small else 1024,
+                            steps=3 if small else 10, key="llama_moe")
         else:
             _emit(out, "llama_moe", {"skipped": f"budget exhausted ({left():.0f}s left)"})
     except Exception as e:  # noqa: BLE001
         _emit(out, "llama_moe", {"error": f"{type(e).__name__}: {e}"[:300]})
+    try:
+        if not _enabled("moe_breakdown"):
+            pass
+        elif left() > 60:
+            _mark("moe_breakdown")
+            moe_breakdown_milestone()
+        else:
+            _emit(out, "moe_breakdown",
+                  {"skipped": f"budget exhausted ({left():.0f}s left)"})
+    except Exception as e:  # noqa: BLE001
+        _emit(out, "moe_breakdown", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     _emit(out, "done", {"budget_left_s": round(left(), 1)})
     out.close()
@@ -923,9 +1059,24 @@ def _collect_results(results_path: str):
     return extras
 
 
+def _moe_only() -> int:
+    """`bench.py --moe-only` (make bench-moe): run ONLY the MoE training
+    milestone + the dispatch-overhead breakdown, in-process, and print
+    the records as indented JSON — the quick iteration loop for MoE perf
+    work. No operator launch-delay run, no other TPU milestones."""
+    os.environ.setdefault("KUBEDL_BENCH_ONLY", "llama_moe,moe_breakdown")
+    results_path = os.path.join(REPO, ".bench_results_moe.jsonl")
+    open(results_path, "w").close()
+    rc = _tpu_child(results_path)
+    print(json.dumps(_parse_results(results_path), indent=1, sort_keys=True))
+    return rc
+
+
 def main() -> int:
     if len(sys.argv) > 2 and sys.argv[1] == "--tpu-child":
         return _tpu_child(sys.argv[2])
+    if "--moe-only" in sys.argv:
+        return _moe_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

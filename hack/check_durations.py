@@ -5,16 +5,25 @@ Parses a pytest `--durations=N` report and fails when any single test
 phase exceeds the budget, so a slow test can't slip into the non-slow
 lane silently — mark it `slow` or speed it up. Any offender necessarily
 appears in the top-N listing (everything ranked above it is slower and
-flagged too), so `--durations=15` is enough for a 60s budget.
+flagged too), so `--durations=15` is enough for a 60s per-test budget.
 
-    pytest tests/ -m 'not slow' --durations=15 2>&1 | tee fast.log
-    python hack/check_durations.py fast.log --max-seconds 60
+`--total FILE=SECONDS` additionally enforces an AGGREGATE budget over
+every listed phase of one test file — the guard for parametrized
+matrices (e.g. the gmm/MoE parity grid in tests/test_gmm_moe.py) whose
+individual cases are fast but whose cross product could quietly grow
+into minutes. Aggregate budgets need `--durations=0` so the report
+covers every test, not just the top N.
+
+    pytest tests/ -m 'not slow' --durations=0 2>&1 | tee fast.log
+    python hack/check_durations.py fast.log --max-seconds 60 \\
+        --total tests/test_gmm_moe.py=60
 """
 from __future__ import annotations
 
 import argparse
 import re
 import sys
+from collections import defaultdict
 
 # "   12.34s call     tests/test_x.py::test_y"
 LINE = re.compile(r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+(\S+)")
@@ -24,28 +33,77 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log", help="pytest output containing a --durations report")
     ap.add_argument("--max-seconds", type=float, default=60.0)
+    ap.add_argument(
+        "--total", action="append", default=[], metavar="FILE=SECONDS",
+        help="aggregate budget for one test file's listed phases "
+             "(repeatable); use with --durations=0")
     args = ap.parse_args(argv)
+    budgets = {}
+    for spec in args.total:
+        path, sep, secs = spec.partition("=")
+        if not sep:
+            print(f"error: --total expects FILE=SECONDS, got {spec!r}",
+                  file=sys.stderr)
+            return 2
+        budgets[path] = float(secs)
     over = []
+    totals: "defaultdict[str, float]" = defaultdict(float)
     saw_report = False
+    top_n_report = False
     with open(args.log, errors="replace") as f:
         for line in f:
             if "slowest" in line and "durations" in line:
                 saw_report = True
+                # "slowest 15 durations" = truncated top-N report;
+                # "slowest durations" = the full --durations=0 listing
+                if re.search(r"slowest\s+\d+\s+durations", line):
+                    top_n_report = True
             m = LINE.match(line)
-            if m and float(m.group(1)) > args.max_seconds:
-                over.append((float(m.group(1)), m.group(2), m.group(3)))
+            if not m:
+                continue
+            secs, phase, test = float(m.group(1)), m.group(2), m.group(3)
+            if secs > args.max_seconds:
+                over.append((secs, phase, test))
+            totals[test.partition("::")[0]] += secs
     if not saw_report:
         print(f"error: no --durations report found in {args.log} "
               "(run pytest with --durations=N)", file=sys.stderr)
         return 2
+    if budgets and top_n_report:
+        print("error: --total aggregate budgets need the FULL report — "
+              "the log holds a truncated top-N listing, so per-file sums "
+              "would under-count and pass on bad data; rerun pytest with "
+              "--durations=0", file=sys.stderr)
+        return 2
+    rc = 0
     if over:
         print(f"FAIL: {len(over)} fast-lane test phase(s) exceed "
               f"{args.max_seconds:.0f}s — mark them `slow` or speed them up:")
         for secs, phase, test in sorted(over, reverse=True):
             print(f"  {secs:8.1f}s {phase:9s} {test}")
-        return 1
-    print(f"durations guard ok: no fast-lane test over {args.max_seconds:.0f}s")
-    return 0
+        rc = 1
+    for path, budget in sorted(budgets.items()):
+        if path not in totals:
+            # a budget that matches no report lines is vacuous — a
+            # renamed/typo'd path would otherwise pass forever on 0.0s
+            print(f"error: --total path {path} matched no phases in the "
+                  "report (renamed file? typo? every phase under pytest's "
+                  "5ms listing floor?) — fix the path or drop the budget",
+                  file=sys.stderr)
+            rc = 2
+            continue
+        spent = totals.get(path, 0.0)
+        if spent > budget:
+            print(f"FAIL: {path} totals {spent:.1f}s of listed phases — "
+                  f"over its {budget:.0f}s aggregate budget; trim the "
+                  "matrix or move cases to the slow lane")
+            rc = 1
+        else:
+            print(f"aggregate ok: {path} {spent:.1f}s <= {budget:.0f}s")
+    if rc == 0:
+        print(f"durations guard ok: no fast-lane test over "
+              f"{args.max_seconds:.0f}s")
+    return rc
 
 
 if __name__ == "__main__":

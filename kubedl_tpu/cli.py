@@ -399,12 +399,13 @@ def cmd_queue(args) -> int:
     _print_capacity_tenants(cap)
     print()
     rows = [("GANG", "TENANT", "PRIO", "SHAPE", "STATE", "SLICES",
-             "WAIT_S", "PREEMPTED")]
+             "DRAINING", "WAIT_S", "PREEMPTED")]
     for q in cap.get("queue", []):
         rows.append((
             q.get("gang", ""), q.get("tenant", ""), q.get("priority", 0),
             q.get("shape", ""), q.get("state", ""),
             ",".join(q.get("slices") or []) or "-",
+            ",".join(q.get("draining") or []) or "-",
             q.get("waiting_seconds", 0.0), q.get("preemptions", 0),
         ))
     _print_table(rows)

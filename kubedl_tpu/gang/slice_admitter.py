@@ -115,12 +115,33 @@ class _GangState:
         return self.slice_names[0] if self.slice_names else None
 
 
+@dataclass
+class _Drain:
+    """Slices released by evict_gang but NOT yet grantable: the victim's
+    pods may still be inside the executor's SIGTERM grace, checkpointing.
+    The slices free when every tracked pod is confirmed gone (the
+    executor calls release() AFTER the grace window closes) or when the
+    deadline passes (safety valve for executors that never confirm —
+    e.g. real-kubelet mode, where the kubelet owns the grace)."""
+
+    slices: List[str] = field(default_factory=list)
+    # pod keys awaiting confirmation; None = unknown (the pod listing
+    # failed at evict time) — then ONLY the deadline frees the slices
+    pods: Optional[set] = None
+    deadline: float = 0.0  # monotonic
+
+
 class TPUSliceAdmitter(GangScheduler):
     """Pool of TPU slices + an unlimited local CPU 'node'."""
 
     name = "tpu-slice"
 
-    def __init__(self, store: ObjectStore, slices: Optional[List[SliceInfo]] = None) -> None:
+    def __init__(
+        self,
+        store: ObjectStore,
+        slices: Optional[List[SliceInfo]] = None,
+        drain_timeout: float = 30.0,
+    ) -> None:
         self.store = store
         self._lock = threading.RLock()
         self._slices: Dict[str, SliceInfo] = {s.name: s for s in (slices or [])}
@@ -131,6 +152,14 @@ class TPUSliceAdmitter(GangScheduler):
         # optional capacity director (sched/capacity.py): owns the
         # waiting-gang policy; None keeps the built-in (priority, FIFO)
         self._director: Optional[CapacityDirector] = None
+        # eviction drain phase: gang key -> slices held back until the
+        # victim's pods confirm exit (see evict_gang / release)
+        self._drains: Dict[str, _Drain] = {}
+        self.drain_timeout = drain_timeout
+
+    @staticmethod
+    def _drain_marker(gang_key: str) -> str:
+        return f"drain:{gang_key}"
 
     def set_director(self, director: Optional[CapacityDirector]) -> None:
         """Attach/detach the capacity scheduler's policy hooks."""
@@ -183,6 +212,16 @@ class TPUSliceAdmitter(GangScheduler):
                 pod_key: sname for pod_key, sname in self._solo.items()
                 if sname in new and sname not in invalidated
             }
+            # drains only track slices that still exist in the pool; a
+            # drain whose every slice vanished has nothing left to hold
+            for gk in list(self._drains):
+                drain = self._drains[gk]
+                drain.slices = [
+                    s for s in drain.slices
+                    if s in new and s not in invalidated
+                ]
+                if not drain.slices:
+                    del self._drains[gk]
             changed_keys.extend(self._reserve_waiting())
         for key in changed_keys:
             self._remirror_podgroup_status(key)
@@ -347,14 +386,88 @@ class TPUSliceAdmitter(GangScheduler):
 
     def release(self, pod) -> None:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        gang_key = pod.metadata.annotations.get(ANNOTATION_GANG_NAME)
+        changed: List[str] = []
         with self._lock:
             slice_name = self._solo.pop(key, None)
             if slice_name:
                 info = self._slices.get(slice_name)
                 if info and info.reserved_by == key:
                     info.reserved_by = None
+            # drain confirmation: the executor calls release() only
+            # AFTER the pod's processes exited (SIGTERM grace included),
+            # so the last confirmation proves the victim stopped
+            # touching its slices — now they may free and re-grant
+            drain = self._drains.get(gang_key) if gang_key else None
+            if drain is not None and drain.pods is not None:
+                drain.pods.discard(key)
+                if not drain.pods:
+                    changed = self._finish_drain(gang_key)
+        for k in changed:
+            self._remirror_podgroup_status(k)
         # Gang reservations outlive individual pods (restarts keep the
         # slice); they free on delete_gang.
+
+    def _finish_drain(self, gang_key: str) -> List[str]:
+        """Free a completed drain's slices (under the lock) and run a
+        reservation pass — the successor takes over only now. Returns
+        the keys of gangs granted in that pass."""
+        drain = self._drains.pop(gang_key, None)
+        if drain is None:
+            return []
+        marker = self._drain_marker(gang_key)
+        for sname in drain.slices:
+            info = self._slices.get(sname)
+            if info is not None and info.reserved_by == marker:
+                info.reserved_by = None
+        return self._reserve_waiting()
+
+    def _expire_drains(self, now: float) -> None:
+        """Free the slices of drains whose deadline passed (under the
+        lock; no follow-up pass — callers run one). The safety valve
+        for modes where nobody calls release() per pod (real-kubelet
+        backends own the grace window themselves)."""
+        for gk in [k for k, d in self._drains.items() if d.deadline <= now]:
+            drain = self._drains.pop(gk)
+            marker = self._drain_marker(gk)
+            for sname in drain.slices:
+                info = self._slices.get(sname)
+                if info is not None and info.reserved_by == marker:
+                    info.reserved_by = None
+
+    def draining(self) -> Dict[str, List[str]]:
+        """Gang key -> slice names still in the eviction drain phase
+        (observability: CLI queue view, tests)."""
+        with self._lock:
+            return {k: list(d.slices) for k, d in self._drains.items()}
+
+    def _gang_pod_keys(self, gang_key: str) -> Optional[List[str]]:
+        """Keys of the gang's live pods (store listing, done OUTSIDE the
+        admitter lock) — the set whose exit confirmations complete an
+        eviction drain. Same owner-kind guard as the capacity
+        scheduler's pod deletion: gang keys are ns/name, so a same-named
+        job of another kind carries the identical annotation. Returns
+        None when the listing FAILS — the caller must fail closed
+        (deadline-only drain), not treat the error as "no pods"."""
+        with self._lock:
+            state = self._gangs.get(gang_key)
+            if state is None or not state.slice_names:
+                return []
+            kind = state.kind
+        namespace = gang_key.partition("/")[0]
+        try:
+            pods = self.store.list("Pod", namespace=namespace)
+        except Exception:  # noqa: BLE001 — store racing shutdown
+            return None
+        keys = []
+        for pod in pods:
+            if pod.metadata.annotations.get(ANNOTATION_GANG_NAME) != gang_key:
+                continue
+            ref = pod.metadata.controller_ref()
+            if kind and (ref is None or ref.kind != kind):
+                continue
+            keys.append(f"{pod.metadata.namespace}/{pod.metadata.name}")
+        return keys
 
     def utilization(self) -> Dict:
         """Pool occupancy snapshot (BASELINE.md "slice utilization" gauge)."""
@@ -366,6 +479,9 @@ class TPUSliceAdmitter(GangScheduler):
             return {
                 "slices_total": len(slices),
                 "slices_reserved": len(reserved),
+                "slices_draining": sum(
+                    1 for s in reserved
+                    if str(s.reserved_by).startswith("drain:")),
                 "chips_total": total_chips,
                 "chips_reserved": reserved_chips,
                 "utilization": (reserved_chips / total_chips) if total_chips else 0.0,
@@ -419,7 +535,10 @@ class TPUSliceAdmitter(GangScheduler):
         `slice_type` probes an alternative shape (elastic what-if);
         `respect_shields` additionally subtracts free slices held back
         for OTHER waiting gangs, so elastic decisions don't target
-        capacity the reservation pass would refuse."""
+        capacity the reservation pass would refuse. The extra
+        `draining` field counts matching slices still in an eviction
+        drain — capacity already committed to free, so the preemption
+        pass must not evict MORE victims while those complete."""
         key = f"{namespace}/{name}"
         with self._lock:
             state = self._gangs.get(key)
@@ -471,7 +590,15 @@ class TPUSliceAdmitter(GangScheduler):
                 matching = len(self._grantable_slices(probe, held, usage, total))
                 if matching:
                     holders.append((self._snapshot(other_key, other), matching))
-            return {"needed": needed, "free": free, "holders": holders}
+            drain_pool = [
+                s for s in self._slices.values()
+                if isinstance(s.reserved_by, str)
+                and s.reserved_by.startswith("drain:")
+            ]
+            draining = len(
+                self._grantable_slices(probe, drain_pool, usage, total))
+            return {"needed": needed, "free": free, "holders": holders,
+                    "draining": draining}
 
     def evict_gang(
         self,
@@ -489,8 +616,20 @@ class TPUSliceAdmitter(GangScheduler):
         grow never trades a running job for nothing. Returns the released
         slice names ([] = nothing done). The caller is responsible for
         driving the job's pods through checkpoint-then-kill (deleting
-        them; the engine recreates them Pending)."""
+        them; the engine recreates them Pending).
+
+        Drain phase: when the gang has live pods, the released slices
+        do NOT free (or re-grant) immediately — they enter a draining
+        state (`reserved_by = "drain:<gang>"`) until the executor
+        confirms every pod exited (release() after the SIGTERM-grace
+        checkpoint) or `drain_timeout` passes. Without the drain, a
+        successor gang's pods could start on a slice whose previous
+        owner is still checkpointing inside the grace window — a real
+        double-booking on hardware (ROADMAP "drain phase" item). A
+        grow (`resize_to`) still pre-grants its NEW slices immediately;
+        only the OLD slices drain."""
         key = f"{namespace}/{name}"
+        drain_pods = self._gang_pod_keys(key)
         with self._lock:
             state = self._gangs.get(key)
             if state is None or not state.slice_names:
@@ -544,10 +683,40 @@ class TPUSliceAdmitter(GangScheduler):
                     return []  # multislice sum outgrows the cap
                 grow_chosen = picked
             released = list(state.slice_names)
-            for sname in released:
-                info = self._slices.get(sname)
-                if info is not None and info.reserved_by == key:
-                    info.reserved_by = None
+            if drain_pods is None or drain_pods:
+                # hold the slices in draining until every pod confirms
+                # exit (or the deadline) — NOT free, NOT re-grantable.
+                # drain_pods None = the pod listing FAILED: fail closed
+                # (deadline-only drain), never fail open into an
+                # immediate re-grant over possibly-live pods.
+                marker = self._drain_marker(key)
+                for sname in released:
+                    info = self._slices.get(sname)
+                    if info is not None and info.reserved_by == key:
+                        info.reserved_by = marker
+                new_pods = None if drain_pods is None else set(drain_pods)
+                drain = self._drains.get(key)
+                if drain is None:
+                    self._drains[key] = _Drain(
+                        slices=list(released), pods=new_pods,
+                        deadline=time.monotonic() + self.drain_timeout)
+                else:
+                    # a second eviction while an old drain is pending
+                    # (grow then preempt): merge, keep the later deadline
+                    drain.slices.extend(
+                        s for s in released if s not in drain.slices)
+                    if drain.pods is None or new_pods is None:
+                        drain.pods = None  # unknown wins: deadline-only
+                    else:
+                        drain.pods |= new_pods
+                    drain.deadline = max(
+                        drain.deadline, time.monotonic() + self.drain_timeout)
+            else:
+                # no live pods to wait for — free immediately
+                for sname in released:
+                    info = self._slices.get(sname)
+                    if info is not None and info.reserved_by == key:
+                        info.reserved_by = None
             state.slice_names = []
             state.waiting_since = time.monotonic()
             if resize_to:
@@ -644,6 +813,7 @@ class TPUSliceAdmitter(GangScheduler):
         without shielding. Returns the keys of gangs that obtained a
         reservation in this pass."""
         now = time.monotonic()
+        self._expire_drains(now)
         eligible = [
             (k, s) for k, s in self._gangs.items()
             if not s.slice_names and s.tpu_chips > 0 and not s.held(now)

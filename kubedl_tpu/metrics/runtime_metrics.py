@@ -153,11 +153,14 @@ class RuntimeMetrics:
                 for metric, key in (
                     ("kubedl_slices_total", "slices_total"),
                     ("kubedl_slices_reserved", "slices_reserved"),
+                    # eviction drain phase: reserved-but-not-grantable
+                    # slices waiting on victim pod-exit confirmations
+                    ("kubedl_slices_draining", "slices_draining"),
                     ("kubedl_slice_chips_total", "chips_total"),
                     ("kubedl_slice_chips_reserved", "chips_reserved"),
                 ):
                     lines.append(f"# TYPE {metric} gauge")
-                    lines.append(f"{metric} {snap[key]}")
+                    lines.append(f"{metric} {snap.get(key, 0)}")
                 lines.append("# TYPE kubedl_slice_reserved gauge")
                 for s in snap["slices"]:
                     # slice names derive from node-pool labels in kube

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubedl_tpu.utils.jax_compat import shard_map
+
 from kubedl_tpu.parallel.mesh import BATCH_AXES
 
 # Default mesh axis carrying table rows. "tensor" is the model-parallel axis;
@@ -151,7 +153,7 @@ def sparse_lookup(
         emb = jax.lax.psum(emb, axis)
         return pool(emb, ids_l, w_l)
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), ids_spec, ids_spec),

@@ -130,6 +130,14 @@ class LlamaConfig:
     # None = auto (gmm off-mesh, capacity path on a mesh); True forces the
     # dropless gmm route, False forces capacity/scatter (models/moe.py)
     moe_dropless: Optional[bool] = None
+    # None = auto (True): fused SwiGLU grouped-matmul epilogue
+    # (ops/gmm.py gmm_swiglu); False keeps the three-launch reference
+    # path (parity tests / kernel triage)
+    moe_fused: Optional[bool] = None
+    # expert-parallel dispatch pipelining: split the all-to-all quota
+    # into this many chunks so ICI transfer overlaps the local grouped
+    # matmuls (models/moe.py _dropless_shard_fn); 1 = no chunking
+    moe_a2a_chunks: int = 1
 
     def __post_init__(self):
         if self.sliding_window is not None and self.sliding_window < 1:
@@ -485,7 +493,8 @@ def _mlp_block(x, layer, config: LlamaConfig, mesh=None, rules=None,
         y, aux = moe_mlp(
             h, layer["moe"], top_k=config.expert_top_k,
             capacity_factor=config.expert_capacity_factor, mesh=mesh, rules=rules,
-            dropless=config.moe_dropless,
+            dropless=config.moe_dropless, fused=config.moe_fused,
+            a2a_chunks=config.moe_a2a_chunks,
         )
         y = y.astype(x.dtype)
     else:

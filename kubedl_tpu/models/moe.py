@@ -4,8 +4,12 @@ The reference has no expert parallelism (SURVEY.md §2.4: "Expert parallelism
 (EP): absent"); this is the net-new TPU-native path behind the JAXJob mesh
 spec's `expert` axis:
 
-  * top-k gating with a fixed per-expert capacity C (static shape — no
-    data-dependent shapes under jit);
+  * top-k gating via ONE `jax.lax.top_k` over the router probs plus a
+    sort-based slot assignment — no [S, E] one-hot planes, no per-k
+    cumsum sweeps (the iterative argmax scheme built k such planes per
+    layer; at bench shapes that was pure dispatch overhead on the VPU
+    while the MXU idled). The old iterative scheme survives as
+    `_top_k_gating_reference` for parity tests;
   * routing is GATHER/SCATTER, not GShard's dense one-hot einsums: the
     `[S,E,C] x [S,d]` dispatch/combine matmuls cost S*E*C*d FLOPs EACH —
     at bench shapes (S=8k, E=4, C=5.1k, d=1k) that equals the expert FFN
@@ -14,11 +18,20 @@ spec's `expert` axis:
     O(E*C*d) bytes instead, leaving the MXU to the expert matmuls.
     Dropped tokens and empty slots route to a zero row via a sentinel
     index — same static shapes, same Switch drop semantics;
-  * the `[E,C,d]` buffer's sharding constraint still makes XLA insert the
-    token all-to-all over ICI when tokens are data-sharded and experts
-    expert-sharded — no hand-written collective;
-  * per-expert FFN is one batched einsum over the expert dim — E local
-    matmuls on each expert shard, MXU-shaped;
+  * the dropless expert FFN runs through the fused grouped-matmul
+    kernels (ops/gmm.py): `gmm_swiglu` computes silu(x@w1)*(x@w3) in
+    the accumulator (one launch, no [M, ffn] gate/up round-trips) and
+    the w2 projection folds int8 per-expert output scales in its
+    epilogue (`gmm_scaled`). `fused=False` keeps the original
+    three-launch reference path selectable for parity tests;
+  * the expert-parallel dispatch (`_dropless_shard_fn`) optionally
+    CHUNKS the quota dimension so the all-to-all for chunk i+1 is
+    issued before chunk i's local expert FFN — with TPU async
+    collectives the ICI transfer overlaps the grouped matmuls instead
+    of serializing against them (`a2a_chunks` knob; the comm/compute
+    overlap arXiv:1810.08955 / arXiv:2412.14374 recover);
+  * per-expert FFN on the capacity path is one batched einsum over the
+    expert dim — E local matmuls on each expert shard, MXU-shaped;
   * auxiliary load-balance loss (mean-prob x mean-assignment, GShard
     eq. (4)-style) keeps the router from collapsing.
 
@@ -34,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
+
+from kubedl_tpu.utils.jax_compat import shard_map
 
 from kubedl_tpu.parallel.mesh import ShardingRules
 
@@ -82,6 +97,7 @@ def _top_k_gating(
     gate_logits: jax.Array,  # [S, E] f32
     top_k: int,
     capacity: int,
+    need_slots: bool = True,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
            Tuple[jax.Array, jax.Array]]:
     """Routing as INDICES instead of one-hot planes.
@@ -94,11 +110,74 @@ def _top_k_gating(
     top-1 assignment — the factors of the GShard load-balance loss
     aux = E * sum(me * ce), returned unfused so the expert-parallel
     path can pmean them to global means before combining.
+
+    One `jax.lax.top_k` picks all k choices at once; slot assignment is
+    a single stable sort of the k*S (choice, token) entries by expert —
+    position within the expert's run IS the slot, and the choice-major
+    entry order reproduces the classic priority (all k=0 choices claim
+    slots before any k=1 choice). No [S, E] mask planes anywhere.
+
+    `need_slots=False` skips the sort entirely for callers that run
+    their own dispatch ordering (the dropless paths): slots come back
+    zero, keeps all-true, and `capacity` is ignored.
     """
     s, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits, axis=-1)
 
-    # iterative top-k: pick argmax, mask, repeat (k is tiny and static)
+    topv, topi = jax.lax.top_k(probs, top_k)  # [S, k] each
+    experts = topi.T.astype(jnp.int32)  # [k, S], choice-major
+    gates = topv.T.astype(jnp.float32)  # [k, S]
+
+    # load-balance aux factors: mean(prob), mean(top-1 assignment)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.zeros((e,), jnp.float32).at[experts[0]].add(1.0 / s)
+
+    if not need_slots:
+        weights = gates / jnp.maximum(
+            jnp.sum(gates, axis=0, keepdims=True), 1e-9)
+        return (
+            experts,
+            jnp.zeros((top_k, s), jnp.int32),
+            weights,
+            jnp.ones((top_k, s), bool),
+            (me, ce),
+        )
+
+    # per-expert slot assignment: flatten entries choice-major
+    # (f = kk*S + token), stable-sort by expert — within an expert the
+    # run is ordered by f, i.e. k=0 entries first then token order,
+    # exactly the iterative scheme's priority. The slot is the position
+    # inside the run.
+    ks = top_k * s
+    ef = experts.reshape(ks)
+    order = jnp.argsort(ef)  # stable
+    sorted_ef = ef[order]
+    counts = jnp.zeros((e,), jnp.int32).at[ef].add(1)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    pos = jnp.arange(ks, dtype=jnp.int32) - starts[sorted_ef]
+    slots = jnp.zeros((ks,), jnp.int32).at[order].set(pos).reshape(top_k, s)
+    keeps = slots < capacity
+
+    weights = gates * keeps  # [k, S]
+    # renormalize over the choices that actually kept the token
+    weights = weights / jnp.maximum(
+        jnp.sum(weights, axis=0, keepdims=True), 1e-9)
+    return experts, slots, weights, keeps, (me, ce)
+
+
+def _top_k_gating_reference(
+    gate_logits: jax.Array,  # [S, E] f32
+    top_k: int,
+    capacity: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array,
+           Tuple[jax.Array, jax.Array]]:
+    """The original iterative argmax/one-hot/cumsum gating — k [S, E]
+    mask planes per call. Kept ONLY as the parity reference for
+    tests/test_gmm_moe.py; the hot path is `_top_k_gating`."""
+    s, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+
     remaining = probs
     masks, gates, experts = [], [], []
     for _ in range(top_k):
@@ -109,11 +188,9 @@ def _top_k_gating(
         gates.append(jnp.sum(probs * onehot, axis=-1))
         remaining = remaining * (1.0 - onehot)
 
-    # load-balance aux factors: mean(prob), mean(top-1 assignment)
     me = jnp.mean(probs, axis=0)
     ce = jnp.mean(masks[0], axis=0)
 
-    # per-expert slot assignment in token order, k=0 choices first
     slots, keeps = [], []
     pos_offset = jnp.zeros((e,), jnp.float32)
     for k in range(top_k):
@@ -125,7 +202,6 @@ def _top_k_gating(
         keeps.append(slot < capacity)
 
     weights = jnp.stack(gates) * jnp.stack(keeps)  # [k, S]
-    # renormalize over the choices that actually kept the token
     weights = weights / jnp.maximum(
         jnp.sum(weights, axis=0, keepdims=True), 1e-9)
     return (
@@ -137,74 +213,173 @@ def _top_k_gating(
     )
 
 
+# ---------------------------------------------------------------------------
+# dropless dispatch stages. _gmm_ffn composes plan -> permute -> ffn ->
+# gather; they are split so bench.py can time each stage (the
+# gating/permute/gmm/combine attribution in .bench_extras.json).
+# ---------------------------------------------------------------------------
+
+
+def _row_tile(m: int, e: int) -> int:
+    """Row-tile for the padded dispatch layout. The gmm kernels stream
+    one [K, N] weight block per row-tile, so rhs HBM traffic scales as
+    (m / tile) * K * N — larger tiles are the difference between
+    bandwidth-bound and compute-bound expert matmuls (ops/gmm.py
+    _row_tile_of). The price is up to e*tile padding rows; cap it at
+    ~1/8 of the real rows so small dispatches keep the fine tile."""
+    from kubedl_tpu.ops.gmm import TILE_M
+
+    for tm in (512, 256):
+        if e * tm * 8 <= m:
+            return tm
+    return TILE_M
+
+
+def _dispatch_plan(eid: jax.Array, e: int):
+    """Lay out M routed entries as per-expert row-tile-padded runs.
+
+    Returns (order, dest, pos_of_entry, tile_expert, m_pad):
+      * order [M]: stable expert-sort permutation of the entries;
+      * dest [M]: padded-layout row of the p-th SORTED entry (sentinel
+        entries, eid == e, point at the out-of-range row m_pad);
+      * pos_of_entry [M]: padded-layout row of each ORIGINAL entry;
+      * tile_expert [m_pad // tile]: owning expert per row-tile, where
+        `tile = _row_tile(M, e)` (512 for large dispatches, TILE_M for
+        small — the gmm kernels derive the tile size from this array's
+        length). Tiles past the real rows clamp to the last expert and
+        multiply zeros — bounded, harmless;
+      * m_pad: static worst case, rounded to whole row-tiles — the
+        per-group padded runs sum to <= round_up(M) + e*tile and the
+        gmm grid must cover every row (a ragged tail would silently
+        never be written).
+    """
+    m = eid.shape[0]
+    tile = _row_tile(m, e)
+    order = jnp.argsort(eid)  # stable: equal experts keep entry order
+    sorted_eid = eid[order]
+    ones = jnp.ones((m,), jnp.int32)
+    group_sizes = jnp.zeros((e,), jnp.int32).at[eid].add(ones, mode="drop")
+    pad_sizes = ((group_sizes + tile - 1) // tile) * tile
+    pad_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pad_sizes)[:-1]])
+    grp_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]])
+    real_eid = jnp.clip(sorted_eid, 0, e - 1)
+    pos_in_group = jnp.arange(m, dtype=jnp.int32) - grp_offsets[real_eid]
+    m_pad = (m + tile - 1) // tile * tile + e * tile
+    dest = jnp.where(sorted_eid < e,
+                     pad_offsets[real_eid] + pos_in_group, m_pad)  # [M]
+    tile_starts = jnp.arange(m_pad // tile, dtype=jnp.int32) * tile
+    tile_expert = jnp.clip(
+        jnp.searchsorted(jnp.cumsum(pad_sizes), tile_starts, side="right"),
+        0, e - 1).astype(jnp.int32)
+    pos_of_entry = jnp.zeros((m,), jnp.int32).at[order].set(dest)
+    return order, dest, pos_of_entry, tile_expert, m_pad
+
+
+def _permute(
+    src: jax.Array,  # [n_src, d]
+    src_rows: jax.Array,  # [M] i32
+    order: jax.Array,
+    dest: jax.Array,
+    m_pad: int,
+) -> jax.Array:
+    """Gather the routed rows into the padded expert-sorted layout.
+    Sentinel entries target the out-of-range row m_pad and are dropped
+    by the scatter (gathered back later as the zero row)."""
+    d = src.shape[1]
+    return jnp.zeros((m_pad, d), src.dtype).at[dest].set(
+        src[src_rows[order]], mode="drop")
+
+
+def _ffn_rows(
+    x: jax.Array,  # [m_pad, d] padded expert-sorted rows
+    tile_expert: jax.Array,  # [m_pad // row_tile] i32
+    params: Dict,
+    fused: bool = True,
+    row_tile: Optional[int] = None,
+) -> jax.Array:
+    """The expert SwiGLU FFN on the padded layout.
+
+    fused=True (default): `gmm_swiglu` computes silu(x@w1)*(x@w3) in
+    one launch with int8 scales (when present) folded in-kernel, then
+    `gmm_scaled`/`gmm` projects through w2 — two launches, one [m_pad,
+    ffn] intermediate. fused=False keeps the original three-launch path
+    (scales still folded in-kernel — never materialized as [m_pad, ffn]
+    row arrays) as the reference for parity tests."""
+    from kubedl_tpu.ops.gmm import gmm, gmm_scaled, gmm_swiglu
+
+    if row_tile is None:
+        # trusted internal path: x and tile_expert come from the same
+        # _dispatch_plan, so the tile is their ratio by construction
+        row_tile = x.shape[0] // tile_expert.shape[0]
+    w1, w3, w2 = params["w1"], params["w3"], params["w2"]
+    if isinstance(w1, dict):
+        # int8 experts: per-expert [E, out] scales applied inside the
+        # kernel epilogues (no repeat(TILE_M) row-scale arrays)
+        q1 = w1["q"].astype(x.dtype)
+        q3 = w3["q"].astype(x.dtype)
+        q2 = w2["q"].astype(x.dtype)
+        s1 = w1["s"].astype(jnp.float32)
+        s3 = w3["s"].astype(jnp.float32)
+        s2 = w2["s"].astype(jnp.float32)
+        if fused:
+            h = gmm_swiglu(x, q1, q3, tile_expert, s1, s3, row_tile=row_tile)
+        else:
+            gate = jax.nn.silu(
+                gmm_scaled(x, q1, tile_expert, s1, row_tile=row_tile)
+                .astype(jnp.float32)
+            ).astype(x.dtype)
+            up = gmm_scaled(x, q3, tile_expert, s3, row_tile=row_tile)
+            h = gate * up
+        return gmm_scaled(h, q2, tile_expert, s2, row_tile=row_tile)
+    if fused:
+        ones = jnp.ones((w1.shape[0], w1.shape[-1]), jnp.float32)
+        h = gmm_swiglu(x, w1, w3, tile_expert, ones, ones, row_tile=row_tile)
+    else:
+        gate = jax.nn.silu(
+            gmm(x, w1, tile_expert, row_tile=row_tile)
+            .astype(jnp.float32)).astype(x.dtype)
+        up = gmm(x, w3, tile_expert, row_tile=row_tile)
+        h = gate * up
+    return gmm(h, w2, tile_expert, row_tile=row_tile)
+
+
 def _gmm_ffn(
     src: jax.Array,  # [n_src, d] source rows to gather from
     src_rows: jax.Array,  # [M] i32 row of `src` backing each routed entry
     eid: jax.Array,  # [M] i32 expert per entry, in [0, e]; e = empty sentinel
     params: Dict,
     e: int,
+    fused: bool = True,
 ) -> jax.Array:
     """Route M rows through their experts' SwiGLU FFN via the grouped
-    matmul kernel (ops/gmm.py): sort entries by expert, pad each
-    expert's run to the row-tile, run the three FFN matmuls as gmm.
-    Returns [M, d] outputs aligned to the input entries; sentinel
-    entries (eid == e) come back as zero rows."""
-    from kubedl_tpu.ops.gmm import TILE_M, gmm
-
-    m = eid.shape[0]
+    matmul kernels (ops/gmm.py): sort entries by expert, pad each
+    expert's run to the row-tile, run the fused FFN. Returns [M, d]
+    outputs aligned to the input entries; sentinel entries (eid == e)
+    come back as zero rows."""
     d = src.shape[1]
-    order = jnp.argsort(eid)  # stable: equal experts keep entry order
-    sorted_eid = eid[order]
-    ones = jnp.ones((m,), jnp.int32)
-    group_sizes = jnp.zeros((e,), jnp.int32).at[eid].add(ones, mode="drop")
-    pad_sizes = ((group_sizes + TILE_M - 1) // TILE_M) * TILE_M
-    pad_offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(pad_sizes)[:-1]])
-    grp_offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)[:-1]])
-    # destination row (padded layout) of the p-th sorted entry; sentinel
-    # entries sort last and are routed to the out-of-range row m_pad
-    # (dropped by the scatter, gathered back as the zero row)
-    real_eid = jnp.clip(sorted_eid, 0, e - 1)
-    pos_in_group = jnp.arange(m, dtype=jnp.int32) - grp_offsets[real_eid]
-    # static worst case, rounded to a whole number of row-tiles: the
-    # per-group padded runs sum to <= round_up(m) + e*TILE_M and the gmm
-    # grid (m_pad // TILE_M) must cover every row — a ragged tail would
-    # silently never be written (and int8 row-scales are built per tile)
-    m_pad = (m + TILE_M - 1) // TILE_M * TILE_M + e * TILE_M
-    dest = jnp.where(sorted_eid < e,
-                     pad_offsets[real_eid] + pos_in_group, m_pad)  # [M]
-    x = jnp.zeros((m_pad, d), src.dtype).at[dest].set(
-        src[src_rows[order]], mode="drop")
-    # expert of each row-tile: tiles past the real rows clamp to the
-    # last expert and multiply zeros — bounded, harmless
-    tile_starts = jnp.arange(m_pad // TILE_M, dtype=jnp.int32) * TILE_M
-    tile_expert = jnp.clip(
-        jnp.searchsorted(jnp.cumsum(pad_sizes), tile_starts, side="right"),
-        0, e - 1).astype(jnp.int32)
-
-    w1, w3, w2 = params["w1"], params["w3"], params["w2"]
-    if isinstance(w1, dict):
-        # int8 experts: fold the per-expert output scale via a row gather
-        row_scale1 = w1["s"][tile_expert].repeat(TILE_M, axis=0)
-        row_scale3 = w3["s"][tile_expert].repeat(TILE_M, axis=0)
-        row_scale2 = w2["s"][tile_expert].repeat(TILE_M, axis=0)
-        gate = jax.nn.silu(
-            (gmm(x, w1["q"].astype(x.dtype), tile_expert)
-             * row_scale1.astype(x.dtype)).astype(jnp.float32)).astype(x.dtype)
-        up = gmm(x, w3["q"].astype(x.dtype), tile_expert) * row_scale3.astype(x.dtype)
-        rows = gmm(gate * up, w2["q"].astype(x.dtype), tile_expert) \
-            * row_scale2.astype(x.dtype)
-    else:
-        gate = jax.nn.silu(
-            gmm(x, w1, tile_expert).astype(jnp.float32)).astype(x.dtype)
-        up = gmm(x, w3, tile_expert)
-        rows = gmm(gate * up, w2, tile_expert)
+    order, dest, pos_of_entry, tile_expert, m_pad = _dispatch_plan(eid, e)
+    x = _permute(src, src_rows, order, dest, m_pad)
+    rows = _ffn_rows(x, tile_expert, params, fused=fused)
     # entry p's output sits at padded row dest[p]; sentinel dest == m_pad
     # gathers the appended zero row
-    pos_of_entry = jnp.zeros((m,), jnp.int32).at[order].set(dest)
     rows = jnp.concatenate([rows, jnp.zeros((1, d), rows.dtype)], axis=0)
     return rows[pos_of_entry]
+
+
+def _combine(
+    rows: jax.Array,  # [k*S, d] FFN outputs, entry f = choice*S + token
+    weights: jax.Array,  # [k, S] f32 combine weights
+    out_dtype,
+) -> jax.Array:
+    """Weighted sum of each token's k expert outputs."""
+    k, s = weights.shape
+    d = rows.shape[1]
+    y = jnp.zeros((s, d), out_dtype)
+    for kk in range(k):
+        y = y + weights[kk][:, None].astype(out_dtype) * rows[kk * s:(kk + 1) * s]
+    return y
 
 
 def _dropless_mlp(
@@ -213,6 +388,7 @@ def _dropless_mlp(
     experts: jax.Array,  # [k, S] i32 expert choice per token
     weights: jax.Array,  # [k, S] f32 combine weights
     e: int,
+    fused: bool = True,
 ) -> jax.Array:
     """Single-shard dropless dispatch: compute scales with the TOKENS
     ROUTED (k*S + E*tile rows), not with a capacity bound, and nothing
@@ -222,11 +398,8 @@ def _dropless_mlp(
     ks = k * s
     ef = experts.reshape(ks)  # flat id f = choice*S + token
     src_rows = jnp.tile(jnp.arange(s, dtype=jnp.int32), k)
-    rows = _gmm_ffn(hf, src_rows, ef, params, e)  # [ks, d]
-    y = jnp.zeros((s, d), hf.dtype)
-    for kk in range(k):
-        y = y + weights[kk][:, None].astype(hf.dtype) * rows[kk * s:(kk + 1) * s]
-    return y
+    rows = _gmm_ffn(hf, src_rows, ef, params, e, fused=fused)  # [ks, d]
+    return _combine(rows, weights, hf.dtype)
 
 
 def _dropless_shard_fn(
@@ -241,6 +414,8 @@ def _dropless_shard_fn(
     expert_axis: str,
     token_axes: Tuple[str, ...],
     tensor_axes: Tuple[str, ...] = (),
+    fused: bool = True,
+    a2a_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-device body of the expert-parallel dropless route (runs under
     shard_map). Tokens are sharded over `token_axes` (batch axes + the
@@ -258,12 +433,21 @@ def _dropless_shard_fn(
     (weight renormalized over surviving choices) — drops happen at
     SHARD granularity (e_loc experts pooled), far coarser than the
     capacity path's per-expert slots, and vanish for quota factor >= 1
-    under a balanced router."""
+    under a balanced router.
+
+    `a2a_chunks > 1` splits the quota dimension into chunks and issues
+    the all-to-all for chunk i+1 BEFORE chunk i's local FFN: the chunks
+    are dataflow-independent, so XLA's async collectives overlap the
+    ICI transfer with the grouped matmuls instead of serializing
+    (comm/compute pipelining per arXiv:1810.08955 / arXiv:2412.14374).
+    Row-for-row identical results for any chunk count — each entry's
+    slot, expert, and weight are unchanged."""
     s_loc, d = hf_loc.shape
     k = top_k
     ks = k * s_loc
     gate_logits = hf_loc.astype(jnp.float32) @ params["router"]
-    experts, _, gates, _, (me, ce) = _top_k_gating(gate_logits, k, s_loc + 1)
+    experts, _, gates, _, (me, ce) = _top_k_gating(
+        gate_logits, k, s_loc + 1, need_slots=False)
     # load-balance loss over GLOBAL means: every token axis partitions
     # the token set, so pmean over all of them is the global mean
     me = jax.lax.pmean(me, token_axes)
@@ -289,27 +473,59 @@ def _dropless_shard_fn(
     send_eid = jnp.full((n_e * quota,), e, jnp.int32).at[slot].set(
         sorted_ef, mode="drop")
 
-    recv_x = jax.lax.all_to_all(
-        send_x.reshape(n_e, quota, d), expert_axis, 0, 0)
-    recv_eid = jax.lax.all_to_all(
-        send_eid.reshape(n_e, quota), expert_axis, 0, 0)
     ei = jax.lax.axis_index(expert_axis)
-    flat_eid = recv_eid.reshape(n_e * quota)
-    local_eid = jnp.where(flat_eid < e, flat_eid - ei * e_loc, e_loc)
-    rows = recv_x.reshape(n_e * quota, d)
-    y_rows = _gmm_ffn(
-        rows, jnp.arange(n_e * quota, dtype=jnp.int32), local_eid,
-        params, e_loc)
-    if tensor_axes:
-        # tensor-parallel experts: w1/w3 are column-blocked and w2
-        # row-blocked over the tensor axis (classic TP MLP), so each
-        # shard's _gmm_ffn output is a partial sum over its ff block —
-        # tokens are replicated across the tensor axis, so one psum
-        # completes the FFN (int8 per-output-column scales distribute
-        # over the sum)
-        y_rows = jax.lax.psum(y_rows, tensor_axes)
-    back = jax.lax.all_to_all(
-        y_rows.reshape(n_e, quota, d), expert_axis, 0, 0)
+    send_xs = send_x.reshape(n_e, quota, d)
+    send_es = send_eid.reshape(n_e, quota)
+    # chunk count: a divisor of the quota's row-tiles so every chunk
+    # keeps whole TILE_M runs (minimizes per-chunk gmm padding)
+    from kubedl_tpu.ops.gmm import TILE_M
+
+    q_tiles = max(quota // TILE_M, 1)
+    nc = 1
+    for c in range(min(max(a2a_chunks, 1), q_tiles), 0, -1):
+        if q_tiles % c == 0:
+            nc = c
+            break
+    qc = quota // nc
+
+    def dispatch(ci: int):
+        """Issue the forward all-to-all for chunk ci."""
+        rx = jax.lax.all_to_all(
+            send_xs[:, ci * qc:(ci + 1) * qc], expert_axis, 0, 0)
+        re = jax.lax.all_to_all(
+            send_es[:, ci * qc:(ci + 1) * qc], expert_axis, 0, 0)
+        return rx, re
+
+    def ffn_chunk(rx, re):
+        """Local expert FFN on one received chunk + its reverse a2a."""
+        flat_eid = re.reshape(n_e * qc)
+        local_eid = jnp.where(flat_eid < e, flat_eid - ei * e_loc, e_loc)
+        rows = rx.reshape(n_e * qc, d)
+        y_rows = _gmm_ffn(
+            rows, jnp.arange(n_e * qc, dtype=jnp.int32), local_eid,
+            params, e_loc, fused=fused)
+        if tensor_axes:
+            # tensor-parallel experts: w1/w3 are column-blocked and w2
+            # row-blocked over the tensor axis (classic TP MLP), so each
+            # shard's _gmm_ffn output is a partial sum over its ff block —
+            # tokens are replicated across the tensor axis, so one psum
+            # completes the FFN (int8 per-output-column scales distribute
+            # over the sum)
+            y_rows = jax.lax.psum(y_rows, tensor_axes)
+        return jax.lax.all_to_all(
+            y_rows.reshape(n_e, qc, d), expert_axis, 0, 0)
+
+    # software pipeline: the a2a for chunk ci+1 is issued before chunk
+    # ci's FFN, so the transfer and the matmuls are independent in the
+    # dataflow graph and the TPU scheduler overlaps them
+    backs = []
+    nxt = dispatch(0)
+    for ci in range(nc):
+        cur = nxt
+        if ci + 1 < nc:
+            nxt = dispatch(ci + 1)
+        backs.append(ffn_chunk(*cur))
+    back = backs[0] if nc == 1 else jnp.concatenate(backs, axis=1)
 
     # combine at home: entry f's reply sits at slot_of_entry[f]; dropped
     # entries point at the appended zero row
@@ -319,7 +535,7 @@ def _dropless_shard_fn(
     weights = weights / jnp.maximum(
         jnp.sum(weights, axis=0, keepdims=True), 1e-9)
     back_flat = jnp.concatenate(
-        [back.reshape(n_e * quota, d), jnp.zeros((1, d), y_rows.dtype)], axis=0)
+        [back.reshape(n_e * quota, d), jnp.zeros((1, d), back.dtype)], axis=0)
     y = jnp.zeros((s_loc, d), hf_loc.dtype)
     for kk in range(k):
         rows_k = back_flat[slot_of_entry[kk * s_loc:(kk + 1) * s_loc]]
@@ -336,6 +552,8 @@ def _dropless_mlp_sharded(
     mesh: Mesh,
     rules: ShardingRules,
     e: int,
+    fused: bool = True,
+    a2a_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Expert-parallel dropless MoE: shard_map over the mesh with tokens
     sharded over (batch axes x expert axis) and expert weights blocked
@@ -412,12 +630,11 @@ def _dropless_mlp_sharded(
     fn = functools.partial(
         _dropless_shard_fn, top_k=top_k, e=e, e_loc=e_loc, n_e=n_e,
         quota=quota, expert_axis=expert_axis, token_axes=token_axes,
-        tensor_axes=mlp_axes)
-    return jax.shard_map(
+        tensor_axes=mlp_axes, fused=fused, a2a_chunks=a2a_chunks)
+    return shard_map(
         fn, mesh=mesh,
         in_specs=in_specs,
         out_specs=(P(token_axes, None), P()),
-        check_vma=False,
     )(hf, {k: params[k] for k in ("router", "w1", "w3", "w2")})
 
 
@@ -430,6 +647,8 @@ def moe_mlp(
     mesh: Optional[Mesh] = None,
     rules: Optional[ShardingRules] = None,
     dropless: Optional[bool] = None,
+    fused: Optional[bool] = None,
+    a2a_chunks: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (output [b,t,d], aux_load_balance_loss scalar).
 
@@ -444,6 +663,16 @@ def moe_mlp(
     (_dropless_mlp_sharded — explicit all_to_all over the expert axis,
     per-shard gmm) on a mesh; there capacity_factor bounds the per-shard
     all-to-all quota instead of a per-expert slot count.
+
+    fused=None (auto -> True): run the expert FFN through the fused
+    SwiGLU grouped-matmul kernel (ops/gmm.py gmm_swiglu) — one launch
+    for silu(x@w1)*(x@w3), int8 scales folded in-kernel. fused=False
+    selects the original three-launch path (parity reference).
+
+    a2a_chunks: expert-parallel dispatch pipelining — split the
+    all-to-all quota into this many chunks so ICI transfer overlaps the
+    local grouped matmuls (see _dropless_shard_fn). 1 = no chunking;
+    only affects the sharded dropless route.
     """
     rules = rules or ShardingRules()
     b, t, d = h.shape
@@ -459,6 +688,8 @@ def moe_mlp(
         # meshes default to the capacity/scatter path; dropless=True
         # forces the gmm route regardless.
         dropless = mesh is None or mesh.size <= 1
+    if fused is None:
+        fused = True
 
     def constrain(x, *dims):
         if mesh is None:
@@ -471,14 +702,15 @@ def moe_mlp(
         # router runs per-device inside the shard body
         y, aux = _dropless_mlp_sharded(
             hf, params, top_k=top_k, quota_factor=capacity_factor,
-            mesh=mesh, rules=rules, e=e)
+            mesh=mesh, rules=rules, e=e, fused=fused, a2a_chunks=a2a_chunks)
         return y.reshape(b, t, d), aux
     gate_logits = hf.astype(jnp.float32) @ params["router"]
     if dropless:
-        experts, _, gates, _, (me, ce) = _top_k_gating(gate_logits, top_k, s + 1)
-        # capacity s+1 == unlimited: every choice keeps, so `gates`
-        # arrives renormalized over all k choices — true dropless
-        y = _dropless_mlp(hf, params, experts, gates, e)
+        experts, _, gates, _, (me, ce) = _top_k_gating(
+            gate_logits, top_k, s + 1, need_slots=False)
+        # unlimited capacity: every choice keeps, so `gates` arrives
+        # renormalized over all k choices — true dropless
+        y = _dropless_mlp(hf, params, experts, gates, e, fused=fused)
         return y.reshape(b, t, d), e * jnp.sum(me * ce)
     experts, slots, weights, keeps, (me, ce) = _top_k_gating(gate_logits, top_k, c)
     aux = e * jnp.sum(me * ce)
@@ -493,8 +725,8 @@ def moe_mlp(
 
     # tokens -> expert slots, by index: invert (expert, slot) -> token.
     # Unfilled slots and dropped tokens point at the sentinel row s, a
-    # zero vector — slot uniqueness (cumsum assignment) makes set order
-    # irrelevant; mode="drop" discards the sentinel writes themselves.
+    # zero vector — slot uniqueness (sort-based assignment) makes set
+    # order irrelevant; mode="drop" discards the sentinel writes themselves.
     flat = experts * c + slots  # [k, S] in [0, e*c)
     flat = jnp.where(keeps, flat, e * c)
     token_of_slot = jnp.full((e * c,), s, jnp.int32)

@@ -23,6 +23,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kubedl_tpu.utils.jax_compat import tpu_compiler_params
+
 # Swept on v5e (bf16 MXU inputs, causal fwd): at seq 2048, 512/512 hits
 # 53 TF/s vs 47 for 1024/1024 and ~3.5x over 128/128; bigger K/V tiles
 # amortize the online-softmax bookkeeping, but past 512 the f32 score
@@ -273,7 +275,7 @@ def _fwd_streamed(q, k, v, sm_scale, causal, window, block_q, block_k,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

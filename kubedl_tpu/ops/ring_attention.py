@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubedl_tpu.utils.jax_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -139,7 +141,6 @@ def ring_attention(
     fn = functools.partial(
         _ring_attention_sharded, axis_name=axis_name, sm_scale=sm_scale, causal=causal
     )
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(q_spec, q_spec, q_spec), out_specs=q_spec,
-        check_vma=False,
     )(q, k, v)

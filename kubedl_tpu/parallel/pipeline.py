@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from kubedl_tpu.utils.jax_compat import shard_map
+
 from kubedl_tpu.parallel.mesh import BATCH_AXES
 
 
@@ -153,12 +155,11 @@ def pipeline_apply(
     x_spec = P(None, batch_axes, *([None] * (x_rank - 2)))
     out_spec = P(stage_axis, None, batch_axes, *([None] * (x_rank - 2)))
 
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(params_spec, x_spec),
         out_specs=(out_spec, P()),
-        check_vma=False,
     )(stacked_params, x_microbatches)
     return out[-1], aux
 

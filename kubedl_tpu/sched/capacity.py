@@ -57,6 +57,11 @@ class CapacityConfig:
     # declared fallback, and how long it runs degraded before growing back
     shrink_delay: float = 0.5
     grow_delay: float = 2.0
+    # eviction drain safety valve: evicted slices free at the latest this
+    # many seconds after the eviction if pod-exit confirmations never
+    # arrive (real-kubelet mode); the local executor confirms in ~the
+    # SIGTERM grace. Must exceed the executor's grace window.
+    drain_timeout: float = 30.0
 
 
 class CapacityScheduler(CapacityDirector):
@@ -80,6 +85,8 @@ class CapacityScheduler(CapacityDirector):
         self._last_tick: Optional[float] = None
         self._preemptions_total = 0
         self._resizes_total = 0
+        if hasattr(admitter, "drain_timeout"):
+            admitter.drain_timeout = self.config.drain_timeout
         admitter.set_director(self)
 
     # ------------------------------------------------------------------
@@ -158,9 +165,14 @@ class CapacityScheduler(CapacityDirector):
             view = self.admitter.demand_view(demander.namespace, demander.name)
             if view is None:
                 continue
-            shortfall = view["needed"] - view["free"]
+            # draining slices are capacity already committed to free (a
+            # previous eviction's victims are still checkpointing) —
+            # evicting MORE victims on top would be an eviction storm
+            # against latency the drain phase exists to absorb
+            draining = view.get("draining", 0)
+            shortfall = view["needed"] - view["free"] - draining
             if shortfall <= 0:
-                continue  # kick() will grant it without violence
+                continue  # kick() / drain completion will grant it
             holders = [h for h, _ in view["holders"]]
             matching = {h.key: m for h, m in view["holders"]}
             victims = self.policy.select_victims(demander, holders, usage, total)
@@ -171,7 +183,7 @@ class CapacityScheduler(CapacityDirector):
             # never cover (e.g. numSlices beyond the pool) would
             # otherwise trigger a perpetual checkpoint-evict storm that
             # starves every victim without ever admitting the demander.
-            coverable = view["free"] + sum(
+            coverable = view["free"] + draining + sum(
                 matching.get(v.key, 0) for v in victims
             )
             if coverable < view["needed"]:
@@ -212,14 +224,12 @@ class CapacityScheduler(CapacityDirector):
         (it saves a checkpoint and exits); the engine recreates them
         Pending until the gang is re-admitted.
 
-        Known limitation: evict_gang releases (and may re-grant) the
-        victim's slices in the same directive, so the successor's pods
-        can start while the victim is still inside the executor's
-        SIGTERM grace — acceptable in the process-level simulation
-        (slices are virtual; both are host processes), but a real
-        cluster needs a drain phase (cordon the gang, delete pods, free
-        slices once they're gone) before the release. Tracked in
-        ROADMAP.md."""
+        The victim's slices are NOT re-grantable yet: evict_gang parked
+        them in the drain phase, and they free only when the executor
+        confirms each pod's processes exited (release() fires after the
+        SIGTERM-grace kill completes) or the drain deadline passes — so
+        a successor's pods can never start on a slice whose previous
+        owner is still checkpointing."""
         try:
             pods = self.store.list("Pod", namespace=gang.namespace)
         except Exception:  # noqa: BLE001 — store racing shutdown
@@ -347,6 +357,10 @@ class CapacityScheduler(CapacityDirector):
         # or usage) — CPU-only tenants must not dilute the displayed
         # shares into numbers the scheduler never enforces
         active = {g.tenant for g in snaps if g.tpu_chips > 0} | set(usage)
+        draining = (
+            self.admitter.draining()
+            if hasattr(self.admitter, "draining") else {}
+        )
         queue = []
         for g in sorted(snaps, key=lambda s: (-s.priority, s.seq)):
             if g.slice_names:
@@ -366,6 +380,9 @@ class CapacityScheduler(CapacityDirector):
                 "admissible": list(g.admissible_slices),
                 "state": state,
                 "slices": list(g.slice_names),
+                # the gang's PREVIOUS slices still draining post-evict
+                # (held back until its pods confirm exit)
+                "draining": draining.get(g.key, []),
                 "chips": g.reserved_chips,
                 "preemptions": g.preemptions,
                 "waiting_seconds": (

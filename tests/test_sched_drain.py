@@ -1,0 +1,250 @@
+"""Eviction drain phase (gang/slice_admitter.py): a preempted gang's
+slices must not free — and must never be re-granted — until the executor
+confirms every victim pod exited its SIGTERM-grace checkpoint, or the
+drain deadline passes. Simulation against the real admitter + capacity
+scheduler, pods as store objects, release() as the executor's
+confirmation (the local executor calls it only after the grace-window
+kill completes)."""
+import json
+import time
+
+from kubedl_tpu.api.common import (
+    ANNOTATION_TENANCY,
+    ReplicaSpec,
+    RunPolicy,
+    SchedulingPolicy,
+)
+from kubedl_tpu.api.job import BaseJob, BaseJobSpec
+from kubedl_tpu.api.meta import ObjectMeta, OwnerReference
+from kubedl_tpu.api.pod import (
+    Container,
+    Pod,
+    PodSpec,
+    PodTemplateSpec,
+    ResourceRequirements,
+)
+from kubedl_tpu.core.store import ObjectStore
+from kubedl_tpu.gang.interface import ANNOTATION_GANG_NAME
+from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+from kubedl_tpu.sched import CapacityConfig, CapacityScheduler
+
+
+def _job(name, chips=8, priority=0, tenant="", kind="TestJob"):
+    tmpl = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", resources=ResourceRequirements(
+            limits={"google.com/tpu": chips}))
+    ]))
+    meta = ObjectMeta(name=name, namespace="default")
+    if tenant:
+        meta.annotations[ANNOTATION_TENANCY] = json.dumps({"tenant": tenant})
+    return BaseJob(
+        metadata=meta,
+        spec=BaseJobSpec(
+            replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)},
+            run_policy=RunPolicy(
+                scheduling_policy=SchedulingPolicy(priority=priority)),
+        ),
+        kind=kind,
+    )
+
+
+def _pod(store, job, name, chips=8):
+    """A live pod of `job`'s gang, as the reconciler would create it."""
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=job.metadata.namespace,
+            annotations={
+                ANNOTATION_GANG_NAME:
+                    f"{job.metadata.namespace}/{job.metadata.name}"
+            },
+            owner_references=[OwnerReference(
+                kind=job.kind, name=job.metadata.name, controller=True)],
+        ),
+        spec=PodSpec(containers=[
+            Container(name="c", resources=ResourceRequirements(
+                limits={"google.com/tpu": chips}))
+        ]),
+    )
+    return store.create(pod)
+
+
+def _setup(n_slices=1, **cfg):
+    store = ObjectStore()
+    adm = TPUSliceAdmitter.with_pool(store, ["v5e-8"] * n_slices)
+    sched = CapacityScheduler(
+        adm, store, CapacityConfig(policy="priority", **cfg))
+    return store, adm, sched
+
+
+def _slices_of(adm, name):
+    state = adm.get_gang("default", name)
+    return list(state.slice_names) if state else []
+
+
+def test_evict_with_live_pods_enters_drain_not_free():
+    store, adm, sched = _setup()
+    victim = _job("victim")
+    adm.create_gang(victim, victim.spec.replica_specs)
+    assert _slices_of(adm, "victim")
+    pod = _pod(store, victim, "victim-w0")
+
+    released = adm.evict_gang("default", "victim", hold_seconds=5.0)
+    assert released  # eviction proceeded
+    # the slice is NOT free: it sits in the drain, reserved by a marker
+    util = adm.utilization()
+    assert util["slices_reserved"] == 1 and util["slices_draining"] == 1
+    assert adm.draining() == {"default/victim": released}
+
+    # confirmation (executor post-grace release) frees it
+    adm.release(pod)
+    util = adm.utilization()
+    assert util["slices_reserved"] == 0 and util["slices_draining"] == 0
+    assert adm.draining() == {}
+
+
+def test_regrant_never_overlaps_checkpointing_victim():
+    """The simulation the ROADMAP item asks for: between evict and the
+    victim's pod-exit confirmation, the demander must NOT obtain the
+    slice — the re-grant happens only at confirmation time, so a
+    still-checkpointing victim is never double-booked."""
+    store, adm, sched = _setup(preemption_backoff=5.0)
+    victim = _job("low", priority=0)
+    adm.create_gang(victim, victim.spec.replica_specs)
+    victim_slices = _slices_of(adm, "low")
+    assert victim_slices
+    vpod = _pod(store, victim, "low-w0")
+
+    demander = _job("high", priority=10)
+    adm.create_gang(demander, demander.spec.replica_specs)
+    assert not _slices_of(adm, "high")  # pool full, waiting
+
+    sched.tick()  # preempts the victim; pods deleted; drain begins
+    assert not _slices_of(adm, "low")
+    # victim is "still checkpointing": no confirmation yet. Poll the
+    # admitter hard — the demander must never see a grant.
+    for _ in range(5):
+        adm.kick()
+        assert not _slices_of(adm, "high"), (
+            "slice re-granted while the victim was still inside its "
+            "SIGTERM-grace checkpoint (drain phase violated)")
+        assert adm.draining().get("default/low") == victim_slices
+    # the demander's own pod also cannot be placed on the slice
+    dpod = _pod(store, demander, "high-w0")
+    assert adm.assign(dpod) is None
+
+    # executor confirms the victim's processes exited -> slice frees and
+    # goes straight to the demander (same confirmation event)
+    adm.release(vpod)
+    assert _slices_of(adm, "high") == victim_slices
+    assert adm.draining() == {}
+    assert adm.assign(dpod) is not None
+
+
+def test_drain_deadline_is_safety_valve():
+    """No confirmation ever (real-kubelet mode): the drain frees at the
+    deadline instead of wedging the pool forever."""
+    store, adm, sched = _setup(drain_timeout=0.05, preemption_backoff=5.0)
+    assert adm.drain_timeout == 0.05  # config wired through the scheduler
+    victim = _job("v")
+    adm.create_gang(victim, victim.spec.replica_specs)
+    _pod(store, victim, "v-w0")
+    adm.evict_gang("default", "v", hold_seconds=5.0)
+    assert adm.utilization()["slices_draining"] == 1
+    time.sleep(0.08)
+    adm.kick()  # any reservation pass expires overdue drains
+    util = adm.utilization()
+    assert util["slices_draining"] == 0 and util["slices_reserved"] == 0
+
+
+def test_evict_without_pods_frees_immediately():
+    """Nothing to wait for: a gang whose pods were never created (or
+    already gone) keeps the old release-now semantics."""
+    store, adm, sched = _setup()
+    victim = _job("bare")
+    adm.create_gang(victim, victim.spec.replica_specs)
+    # hold keeps the victim from instantly re-reserving its own slice
+    released = adm.evict_gang("default", "bare", hold_seconds=5.0)
+    assert released
+    assert adm.utilization()["slices_reserved"] == 0
+    assert adm.draining() == {}
+
+
+def test_preempt_pass_does_not_storm_while_draining():
+    """While a drain is in flight, the demander's shortfall is covered
+    by the draining slices — the scheduler must not evict a SECOND
+    victim on the next tick."""
+    store, adm, sched = _setup(n_slices=2, preemption_backoff=5.0)
+    v1, v2 = _job("v1", priority=0), _job("v2", priority=0)
+    adm.create_gang(v1, v1.spec.replica_specs)
+    adm.create_gang(v2, v2.spec.replica_specs)
+    _pod(store, v1, "v1-w0")
+    _pod(store, v2, "v2-w0")
+    demander = _job("big", priority=10)
+    adm.create_gang(demander, demander.spec.replica_specs)
+
+    sched.tick()  # evicts exactly one victim into a drain
+    evicted = [n for n in ("v1", "v2") if not _slices_of(adm, n)]
+    assert len(evicted) == 1
+    survivor = "v1" if evicted == ["v2"] else "v2"
+    sched.tick()  # drain covers the demand: the survivor must be safe
+    sched.tick()
+    assert _slices_of(adm, survivor), (
+        "second victim evicted while the first drain was still in "
+        "flight (eviction storm)")
+
+
+def test_same_name_other_kind_pod_does_not_gate_drain():
+    """Gang keys are ns/name; a same-named job of ANOTHER kind carries
+    the identical gang annotation. Its pods must not be counted into
+    the drain set (they will never be deleted, so the drain would
+    always run to the deadline)."""
+    store, adm, sched = _setup()
+    victim = _job("shared", kind="TestJob")
+    adm.create_gang(victim, victim.spec.replica_specs)
+    vpod = _pod(store, victim, "shared-w0")
+    other = _job("shared", kind="OtherJob")
+    opod = _pod(store, other, "other-w0")  # same annotation, other owner
+
+    adm.evict_gang("default", "shared", hold_seconds=5.0)
+    # only the victim's own pod gates the drain
+    adm.release(vpod)
+    assert adm.utilization()["slices_reserved"] == 0
+    assert adm.draining() == {}
+    adm.release(opod)  # harmless no-op
+
+
+def test_elastic_grow_drains_old_slices_only():
+    """A grow pre-grants the NEW slices immediately but the OLD ones
+    drain until the pods die — the gang's restarted pods can use the
+    new reservation while nobody can take the old slices early."""
+    store = ObjectStore()
+    adm = TPUSliceAdmitter.with_pool(store, ["v5e-8", "v5e-16"])
+    CapacityScheduler(adm, store, CapacityConfig(policy="priority"))
+    tmpl = PodTemplateSpec(spec=PodSpec(containers=[
+        Container(name="c", resources=ResourceRequirements(
+            limits={"google.com/tpu": 8}))
+    ]))
+    job = BaseJob(
+        metadata=ObjectMeta(name="grow", namespace="default"),
+        spec=BaseJobSpec(
+            replica_specs={"Worker": ReplicaSpec(replicas=1, template=tmpl)},
+            run_policy=RunPolicy(scheduling_policy=SchedulingPolicy(
+                tpu_slice="v5e-8", tpu_slice_fallbacks=["v5e-16"])),
+        ),
+        kind="TestJob",
+    )
+    adm.create_gang(job, job.spec.replica_specs)
+    old = _slices_of(adm, "grow")
+    assert old and "v5e-8" in old[0]
+    pod = _pod(store, job, "grow-w0")
+
+    released = adm.evict_gang("default", "grow", resize_to="v5e-16")
+    assert released == old
+    new = _slices_of(adm, "grow")
+    assert new and "v5e-16" in new[0]  # new grant is live immediately
+    # old slice drains; total reserved = new grant + draining old
+    util = adm.utilization()
+    assert util["slices_reserved"] == 2 and util["slices_draining"] == 1
+    adm.release(pod)
+    util = adm.utilization()
+    assert util["slices_reserved"] == 1 and util["slices_draining"] == 0
