@@ -18,7 +18,10 @@ test:
 presubmit:
 	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee .presubmit-fast.log
 	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60 \
-	  --total tests/test_gmm_moe.py=60
+	  --total tests/test_gmm_moe.py=60 \
+	  --total tests/test_kv_pool.py=30 \
+	  --total tests/test_serving_disagg.py=120 \
+	  --total tests/test_serving_fleet.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -30,6 +33,13 @@ bench:
 .PHONY: bench-moe
 bench-moe:
 	$(PY) bench.py --moe-only
+
+# Serving-only fast loop: the serving throughput milestone + the
+# disaggregated plane's latency/capacity record (paged-KV admission
+# ratio, prefix-share hit-rate, TTFT/per-token p50/p99 mono vs disagg).
+.PHONY: bench-serving
+bench-serving:
+	$(PY) bench.py --serving-only
 
 .PHONY: manifests
 manifests:

@@ -37,6 +37,14 @@ LABEL_JOB_ROLE = "job-role"
 # (workloads/jaxjob.py stamps it; the slice admitter places by it).
 LABEL_SLICE_ID = "kubedl-tpu.io/slice-id"
 
+# Serving fleet: a pod's role in a disaggregated serving JAXJob
+# ("prefill" | "decode"); workloads/jaxjob.py stamps it, server.py's
+# /serving/fleet endpoint groups by it, and the router drains by it.
+LABEL_SERVING_ROLE = "kubedl-tpu.io/serving-role"
+# Drain request: the operator (POST /serving/drain) annotates the pod;
+# the pod's router loop notices and migrates its streams.
+ANNOTATION_SERVING_DRAIN = "kubedl-tpu.io/serving-drain"
+
 
 def slice_group(total: int, num_slices: int, index: int):
     """THE multislice grouping convention, in one place: `total` workers

@@ -14,11 +14,13 @@ test.
 """
 from __future__ import annotations
 
+import copy
 import json
 import re
 import threading
 import time
 import urllib.parse
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -60,6 +62,9 @@ def _remove_obj(st: "_State", gv: str, plural: str, key, obj: Dict) -> None:
         return  # re-created meanwhile
     st.objects[(gv, plural)].pop(key)
     meta = obj.setdefault("metadata", {})
+    # deletes bump rv like a real apiserver — also what keeps every
+    # event-log seq unique so watch replay-from-rv can't skip one
+    meta["resourceVersion"] = st.next_rv()
     meta.setdefault("deletionTimestamp", _now_rfc3339())
     st.uids.discard(meta.get("uid"))
     st.track_refs(obj, -1)
@@ -135,6 +140,12 @@ class _State:
         self.gc_wake = threading.Event()
         self.uids: set = set()
         self.ref_uids: Dict[str, int] = {}
+        # bounded event history so a watch from resourceVersion=N can
+        # replay the events AFTER N with their TRUE types — without it a
+        # modify landing between a client's list and its watch subscribe
+        # replays as a duplicate ADDED (current-state synthesis), which
+        # real apiservers never do
+        self.event_log: "deque" = deque(maxlen=1024)
 
     @staticmethod
     def refs_of(obj: Dict) -> List[Dict]:
@@ -157,6 +168,11 @@ class _State:
         return str(self.rv)
 
     def emit(self, etype: str, gv: str, plural: str, obj: Dict) -> None:
+        # deep copy: several paths mutate the stored dict in place, and a
+        # replayed event must show the object as it was at emit time
+        self.event_log.append({
+            "seq": self.rv, "type": etype, "gv": gv, "plural": plural,
+            "object": copy.deepcopy(obj)})
         for w in list(self.watchers):
             w.offer(etype, gv, plural, obj)
 
@@ -339,14 +355,29 @@ class _Handler(BaseHTTPRequestHandler):
         w = _Watcher(gv, plural, ns)
         since = int(params.get("resourceVersion", "0") or "0")
         with st.lock:
-            # replay events newer than the requested resourceVersion by
-            # sending current objects with rv > since as ADDED
-            backlog = [
-                {"type": "ADDED", "object": o}
-                for (ons, _), o in sorted(st.objects.get((gv, plural), {}).items())
-                if ons == ns
-                and int(o.get("metadata", {}).get("resourceVersion", "0")) > since
-            ]
+            log = list(st.event_log)
+            # gapless iff no event after `since` has aged out of the log
+            gapless = (since >= log[0]["seq"] - 1) if log else (st.rv <= since)
+            if gapless:
+                # replay the actual events after `since`, true types kept
+                backlog = [
+                    {"type": e["type"], "object": e["object"]}
+                    for e in log
+                    if e["seq"] > since
+                    and (e["gv"], e["plural"]) == (gv, plural)
+                    and e["object"].get("metadata", {}).get("namespace") == ns
+                ]
+            else:
+                # history window lost (real apiserver would 410; clients
+                # here already relist on gaps): synthesize current state
+                backlog = [
+                    {"type": "ADDED", "object": o}
+                    for (ons, _), o in sorted(
+                        st.objects.get((gv, plural), {}).items())
+                    if ons == ns
+                    and int(o.get("metadata", {}).get(
+                        "resourceVersion", "0")) > since
+                ]
             st.watchers.append(w)
         self.send_response(200)
         self.send_header("Content-Type", "application/json")

@@ -50,6 +50,146 @@ def _bucket(n: int, buckets: List[int]) -> int:
     raise ValueError(f"prompt of {n} tokens exceeds the largest bucket {buckets[-1]}")
 
 
+def sample_tokens(logits, key, temps, top_ks, top_ps, mode, max_top_k):
+    """[slots, V] logits -> [slots] token ids, per-slot params.
+
+    Module-level so the disaggregated serving plane (kubedl_tpu/serving/)
+    samples with BYTE-IDENTICAL math to this engine — token parity between
+    the two stacks rests on sharing this function, not on two copies
+    agreeing. `mode` is STATIC, chosen from what the active requests
+    actually use, so a compiled tick program pays only for the sampling it
+    needs (at most three variants per block size):
+
+    * "greedy" — every active slot has temp 0: pure argmax, no
+      Gumbel work on the hot scan body at all (the default
+      deployment's program, byte-identical math to before).
+    * "plain" — sampling but no top_k/top_p anywhere: one
+      categorical over the full vocab; temp-0 rows take argmax.
+    * "filtered" — someone set top_k/top_p. Built for the MXU-less
+      reality of sampling: ONE O(V) lax.top_k into a fixed
+      [slots, max_top_k] candidate set, then per-slot k-masking and
+      top-p (nucleus) over the already-sorted candidates — an
+      O(max_top_k) cumsum instead of a full-vocab sort per tick.
+      top_p renormalizes within the top-max_top_k candidates; raise
+      max_top_k toward vocab_size if exact full-vocab nucleus
+      sampling matters more than tick latency. Rows that set
+      NEITHER knob still get the full-vocab categorical (selected
+      per row), so a request's distribution never depends on what
+      its co-tenants asked for.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if mode == "greedy":
+        return greedy
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    plain = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    if mode == "plain":
+        return jnp.where(temps > 0, plain, greedy)
+    K = min(max_top_k, logits.shape[-1])
+    vals, idx = jax.lax.top_k(scaled, K)  # sorted descending
+    kk = jnp.where(top_ks > 0, jnp.minimum(top_ks, K), K)
+    pos = jnp.arange(K)[None, :]
+    kmask = pos < kk[:, None]
+    probs = jax.nn.softmax(jnp.where(kmask, vals, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # nucleus: smallest prefix with mass >= top_p; the first
+    # candidate is always kept (cum - probs == 0 < top_p)
+    keep = (cum - probs) < top_ps[:, None]
+    masked = jnp.where(kmask & keep, vals, -jnp.inf)
+    choice = jax.random.categorical(key, masked, axis=-1)
+    filtered = jnp.take_along_axis(
+        idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    row_filtered = (top_ks > 0) | (top_ps < 1.0)
+    sampled = jnp.where(row_filtered, filtered, plain)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def chosen_logprob(logits, chosen):
+    """log p(chosen) under the model's (untempered) distribution —
+    one logsumexp over vocab, noise next to the decode matmuls."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return picked - lse
+
+
+def emit_token(req: "Request", token: int, logprob: float = 0.0) -> bool:
+    """Append one decoded token to `req` and apply the termination
+    contract: stop-sequence rollback, EOS, max_new_tokens. Returns True
+    when the request just finished — the caller releases its slot its
+    own way.
+
+    Module-level for the same reason as sample_tokens: exact-token
+    parity between this engine and the disaggregated plane
+    (kubedl_tpu/serving/) rests on ONE copy of this logic, not on two
+    copies agreeing.
+    """
+    # logprob BEFORE token: the SSE handler thread reads both lists
+    # unlocked, gated on the token list's length — appending tokens
+    # first would open a window where a token is visible without its
+    # logprob and the stream drops the field for that index forever
+    if req.logprobs:
+        req.token_logprobs.append(logprob)
+    req.tokens.append(token)
+    if req.first_token_at is None:
+        req.first_token_at = time.monotonic()
+    if req.token_times is not None:
+        req.token_times.append(time.monotonic())
+    hit_stop = False
+    for seq in req.stop_sequences:
+        n = len(seq)
+        if len(req.tokens) >= n and tuple(req.tokens[-n:]) == seq:
+            # OpenAI convention: the matched stop sequence is
+            # excluded from the result
+            del req.tokens[-n:]
+            if req.logprobs:
+                del req.token_logprobs[-n:]
+            hit_stop = True
+            break
+    if (
+        hit_stop
+        or len(req.tokens) >= req.max_new_tokens
+        or (req.eos_token is not None and token == req.eos_token)
+    ):
+        req.done = True
+        req.finished_at = time.monotonic()
+        return True
+    return False
+
+
+def validate_sampling(temperature, top_k, top_p, max_top_k,
+                      stop) -> List[tuple]:
+    """Shared submit-time validation of the sampling/termination knobs:
+    temperature/top_k/top_p ranges and the stop-sequence caps (16 tokens
+    each, 4 sequences). Returns the parsed stop sequences as tuples.
+
+    Module-level for the same reason as sample_tokens/emit_token: the
+    monolithic engine and the disaggregated facade must accept EXACTLY
+    the same requests, and one copy of the limits can't drift."""
+    if temperature is not None and temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0 <= top_k <= max_top_k:
+        # clamping silently changes the sampling distribution; the
+        # engine's candidate budget is an explicit contract
+        raise ValueError(
+            f"top_k must be in [0, {max_top_k}] (engine "
+            f"max_top_k), got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    stop_seqs = []
+    for s in (stop or []):
+        ids = [int(t) for t in s]
+        if not ids:
+            raise ValueError("empty stop sequence")
+        if len(ids) > 16:
+            raise ValueError(
+                f"stop sequence of {len(ids)} tokens (max 16)")
+        stop_seqs.append(tuple(ids))
+    if len(stop_seqs) > 4:
+        raise ValueError(f"{len(stop_seqs)} stop sequences (max 4)")
+    return stop_seqs
+
+
 @dataclass
 class Request:
     request_id: int
@@ -83,7 +223,12 @@ class Request:
     cache_len: int = 0  # prompt(+prefix) tokens + device ticks consumed
 
     submitted_at: float = field(default_factory=time.monotonic)
+    first_token_at: Optional[float] = None  # TTFT = this - submitted_at
     finished_at: Optional[float] = None
+    # per-token emission wall clocks, appended only when a caller (the
+    # serving_latency bench) replaces None with a list — a conditional
+    # append, not a hot-path cost
+    token_times: Optional[List[float]] = None
 
     @property
     def needs_filter(self) -> bool:
@@ -173,6 +318,12 @@ class ServingEngine:
         self._prefill_time = 0.0
         self._decode_time = 0.0
         self._prefill_batches = 0
+        # admission-wave sync (one device_get per wave) — an attribute so
+        # failure-isolation tests can poison a single cluster's fetch
+        # without faking an async XLA runtime error (ADVICE r5 low)
+        self._wave_sync = jax.device_get
+        self._wave_failures = 0  # clusters failed at wave sync
+        self._wave_resets = 0  # full device-state rebuilds
         # chunked prefill: ONE long prompt at a time prefills in
         # prefill_chunk-token block steps, one chunk per engine step, so
         # active slots keep emitting tokens between chunks instead of
@@ -344,62 +495,12 @@ class ServingEngine:
         return out, cur_tokens, active
 
     def _sample(self, logits, key, temps, top_ks, top_ps, mode):
-        """[slots, V] logits -> [slots] token ids, per-slot params.
-
-        `mode` is STATIC, chosen from what the active requests actually
-        use, so the compiled tick program pays only for the sampling it
-        needs (at most three variants per block size):
-
-        * "greedy" — every active slot has temp 0: pure argmax, no
-          Gumbel work on the hot scan body at all (the default
-          deployment's program, byte-identical math to before).
-        * "plain" — sampling but no top_k/top_p anywhere: one
-          categorical over the full vocab; temp-0 rows take argmax.
-        * "filtered" — someone set top_k/top_p. Built for the MXU-less
-          reality of sampling: ONE O(V) lax.top_k into a fixed
-          [slots, max_top_k] candidate set, then per-slot k-masking and
-          top-p (nucleus) over the already-sorted candidates — an
-          O(max_top_k) cumsum instead of a full-vocab sort per tick.
-          top_p renormalizes within the top-max_top_k candidates; raise
-          max_top_k toward vocab_size if exact full-vocab nucleus
-          sampling matters more than tick latency. Rows that set
-          NEITHER knob still get the full-vocab categorical (selected
-          per row), so a request's distribution never depends on what
-          its co-tenants asked for.
-        """
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if mode == "greedy":
-            return greedy
-        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-        plain = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-        if mode == "plain":
-            return jnp.where(temps > 0, plain, greedy)
-        K = min(self.max_top_k, logits.shape[-1])
-        vals, idx = jax.lax.top_k(scaled, K)  # sorted descending
-        kk = jnp.where(top_ks > 0, jnp.minimum(top_ks, K), K)
-        pos = jnp.arange(K)[None, :]
-        kmask = pos < kk[:, None]
-        probs = jax.nn.softmax(jnp.where(kmask, vals, -jnp.inf), axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # nucleus: smallest prefix with mass >= top_p; the first
-        # candidate is always kept (cum - probs == 0 < top_p)
-        keep = (cum - probs) < top_ps[:, None]
-        masked = jnp.where(kmask & keep, vals, -jnp.inf)
-        choice = jax.random.categorical(key, masked, axis=-1)
-        filtered = jnp.take_along_axis(
-            idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
-        row_filtered = (top_ks > 0) | (top_ps < 1.0)
-        sampled = jnp.where(row_filtered, filtered, plain)
-        return jnp.where(temps > 0, sampled, greedy)
+        """[slots, V] -> [slots] ids; see module-level sample_tokens."""
+        return sample_tokens(logits, key, temps, top_ks, top_ps, mode,
+                             self.max_top_k)
 
     def _chosen_logprob(self, logits, chosen):
-        """log p(chosen) under the model's (untempered) distribution —
-        one logsumexp over vocab, noise next to the decode matmuls."""
-        logits = logits.astype(jnp.float32)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        picked = jnp.take_along_axis(
-            logits, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
-        return picked - lse
+        return chosen_logprob(logits, chosen)
 
     def _tick_impl(self, params, cache, cur_tokens, active, key,
                    temps, top_ks, top_ps, mode, lora, adapter_ids):
@@ -724,16 +825,8 @@ class ServingEngine:
         stop: Optional[list] = None,
     ) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if temperature is not None and temperature < 0:
-            raise ValueError(f"temperature must be >= 0, got {temperature}")
-        if not 0 <= top_k <= self.max_top_k:
-            # clamping silently changes the sampling distribution; the
-            # engine's candidate budget is an explicit contract
-            raise ValueError(
-                f"top_k must be in [0, {self.max_top_k}] (engine "
-                f"max_top_k), got {top_k}")
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        stop_seqs = validate_sampling(
+            temperature, top_k, top_p, self.max_top_k, stop)
         if not 0 <= adapter_id <= len(self._adapter_rows):
             raise ValueError(
                 f"unknown adapter_id {adapter_id} "
@@ -748,17 +841,6 @@ class ServingEngine:
             # from a cold cache would silently floor acceptance
             raise ValueError("prefix caching is unsupported with "
                              "speculative serving")
-        stop_seqs = []
-        for s in (stop or []):
-            ids = [int(t) for t in s]
-            if not ids:
-                raise ValueError("empty stop sequence")
-            if len(ids) > 16:
-                raise ValueError(
-                    f"stop sequence of {len(ids)} tokens (max 16)")
-            stop_seqs.append(tuple(ids))
-        if len(stop_seqs) > 4:
-            raise ValueError(f"{len(stop_seqs)} stop sequences (max 4)")
         if prompt.size == 0:
             raise ValueError("empty prompt (with a prefix, pass at least "
                              "the first suffix token)")
@@ -824,7 +906,11 @@ class ServingEngine:
         # comes from the shared prefix, not a fresh prefill). One
         # device_get fetches every first token at the end.
         t_admit0 = time.monotonic()
-        wave = []  # (slot, first_token_device, first_logprob_device)
+        # (slot, first_token_device, first_logprob_device, cluster_key):
+        # the cluster key records WHICH prefill dispatch produced the
+        # entry, so a poisoned dispatch fails only its own requests at
+        # the wave sync instead of the whole wave (ADVICE r5 low)
+        wave = []
         batch: List[Request] = []
         batch_slots: List[int] = []
         deferred: List[Request] = []  # long prompts waiting for the chunker
@@ -844,7 +930,7 @@ class ServingEngine:
                     jnp.asarray([t], jnp.int32), first,
                     self.cur_tokens, self.active)
                 self._claim_slot(slot, req, t)
-                wave.append((slot, first, first_lp))
+                wave.append((slot, first, first_lp, f"prefix:{req.request_id}"))
             elif self._use_chunked(req):
                 if self._chunking is not None:
                     # one chunked prefill at a time; short requests behind
@@ -871,14 +957,45 @@ class ServingEngine:
             # ONE device_get for the whole wave (tokens + logprobs).
             # Dispatch is async, so a runtime failure in the prefill
             # surfaces HERE at the sync, not inside _admit_group's try —
-            # same free-the-slots policy or the wave wedges forever
+            # the recovery path then re-syncs per CLUSTER so only the
+            # poisoned dispatch's requests fail (ADVICE r5 low)
             try:
-                firsts, lps = jax.device_get(
-                    (jnp.stack([f for _, f, _ in wave]),
-                     jnp.stack([l for _, _, l in wave])))
-            except Exception as e:  # noqa: BLE001
-                _log.exception("admission wave sync failed")
-                for slot, _, _ in wave:
+                firsts, lps = self._wave_sync(
+                    (jnp.stack([f for _, f, _, _ in wave]),
+                     jnp.stack([l for _, _, l, _ in wave])))
+            except Exception:  # noqa: BLE001
+                _log.exception("admission wave sync failed; isolating "
+                               "per cluster")
+                self._recover_wave(wave)
+                self._prefill_time += time.monotonic() - t_admit0
+                return
+            for (slot, _, _, _), tok, lp in zip(wave, np.asarray(firsts),
+                                                np.asarray(lps)):
+                self._emit(slot, int(tok), float(lp))
+            self._prefill_time += time.monotonic() - t_admit0
+
+    def _recover_wave(self, wave) -> None:
+        """A wave sync raised: re-sync each prefill CLUSTER separately so
+        only the poisoned dispatch's requests fail (everyone used to be
+        failed wholesale — one bad bucket compile killed unrelated
+        requests), then VALIDATE the engine's device-resident state
+        before claiming recovery: the row inserts thread self.cache
+        through every admission, so a poisoned cluster can poison the
+        whole chain; serving on without checking would emit garbage (or
+        wedge) for every in-flight stream."""
+        clusters: Dict[str, list] = {}
+        for entry in wave:
+            clusters.setdefault(entry[3], []).append(entry)
+        for ckey, entries in clusters.items():
+            try:
+                firsts, lps = self._wave_sync(
+                    (jnp.stack([f for _, f, _, _ in entries]),
+                     jnp.stack([l for _, _, l, _ in entries])))
+            except Exception as e:  # noqa: BLE001 — fail THIS cluster only
+                self._wave_failures += 1
+                _log.exception("prefill cluster %s poisoned (%d request(s))",
+                               ckey, len(entries))
+                for slot, _, _, _ in entries:
                     req = self._slot_req[slot]
                     if req is not None:
                         req.error = f"prefill failed: {e}"
@@ -886,12 +1003,33 @@ class ServingEngine:
                         req.finished_at = time.monotonic()
                         self._slot_req[slot] = None
                     self.active = self.active.at[slot].set(False)
-                self._prefill_time += time.monotonic() - t_admit0
-                return
-            for (slot, _, _), tok, lp in zip(wave, np.asarray(firsts),
-                                             np.asarray(lps)):
+                continue
+            for (slot, _, _, _), tok, lp in zip(entries, np.asarray(firsts),
+                                                np.asarray(lps)):
                 self._emit(slot, int(tok), float(lp))
-            self._prefill_time += time.monotonic() - t_admit0
+        # validate device-resident state: the healthy clusters' inserts
+        # were chained through the same donated cache as the poisoned
+        # one's. A fetchable cache is a usable cache; an unfetchable one
+        # is rebuilt empty and every in-flight request failed loudly
+        # (their K/V is unrecoverable) rather than served as garbage.
+        try:
+            self._wave_sync((self.cache["lengths"], self.cur_tokens))
+        except Exception:  # noqa: BLE001
+            self._wave_resets += 1
+            _log.exception("device cache poisoned after wave failure; "
+                           "rebuilding empty")
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    req.error = "engine cache rebuilt after prefill failure"
+                    req.done = True
+                    req.finished_at = time.monotonic()
+                    self._slot_req[slot] = None
+            self.cache = decode.init_kv_cache(
+                self.config, self.slots, self.max_len,
+                kv_dtype=self.kv_dtype, ring=self.ring)
+            self.cur_tokens = jnp.zeros((self.slots,), jnp.int32)
+            self.active = jnp.zeros((self.slots,), jnp.bool_)
+            self._chunking = None
 
     def _sample_first(self, logits, req: Request):
         """First-token sample (+ model logprob) for ONE request's [1, V]
@@ -1053,7 +1191,8 @@ class ServingEngine:
             g_slots = [slots[i] for i in idxs]
             bucket = hi
             try:
-                self._admit_group(g_reqs, g_slots, bucket, wave)
+                self._admit_group(g_reqs, g_slots, bucket, wave,
+                                  cluster=f"bucket:{lo}-{hi}")
             except Exception as e:  # noqa: BLE001 — a poisoned batch (OOM,
                 # compile failure for a new variant) must not wedge its
                 # slots forever with _admitted/cache state never set
@@ -1067,7 +1206,7 @@ class ServingEngine:
                         req.finished_at = time.monotonic()
 
     def _admit_group(self, reqs: List[Request], slots: List[int],
-                     bucket: int, wave: list) -> None:
+                     bucket: int, wave: list, cluster: str = "") -> None:
         """One prefill forward for a same-bucket group. Rows are padded
         to the bucket (per-row `lengths` keep ragged prompts exact under
         the causal mask); the batch dim is padded to the next power of
@@ -1122,36 +1261,12 @@ class ServingEngine:
                     jnp.asarray([lengths[i]], jnp.int32), firsts[i],
                     self.cur_tokens, self.active)
             self._claim_slot(slot, req, int(lengths[i]))
-            wave.append((slot, firsts[i], lps[i]))
+            wave.append((slot, firsts[i], lps[i], cluster))
 
     def _emit(self, slot: int, token: int, logprob: float = 0.0) -> None:
         req = self._slot_req[slot]
-        # logprob BEFORE token: the SSE handler thread reads both lists
-        # unlocked, gated on the token list's length — appending tokens
-        # first would open a window where a token is visible without its
-        # logprob and the stream drops the field for that index forever
-        if req.logprobs:
-            req.token_logprobs.append(logprob)
-        req.tokens.append(token)
         self._tokens_out += 1
-        hit_stop = False
-        for seq in req.stop_sequences:
-            n = len(seq)
-            if len(req.tokens) >= n and tuple(req.tokens[-n:]) == seq:
-                # OpenAI convention: the matched stop sequence is
-                # excluded from the result
-                del req.tokens[-n:]
-                if req.logprobs:
-                    del req.token_logprobs[-n:]
-                hit_stop = True
-                break
-        if (
-            hit_stop
-            or len(req.tokens) >= req.max_new_tokens
-            or (req.eos_token is not None and token == req.eos_token)
-        ):
-            req.done = True
-            req.finished_at = time.monotonic()
+        if emit_token(req, token, logprob):
             self._slot_req[slot] = None
             self.active = self.active.at[slot].set(False)
 
@@ -1338,6 +1453,8 @@ class ServingEngine:
             "decode_time_s": round(self._decode_time, 4),
             "prefill_batches": self._prefill_batches,
             "chunked_prefills": self._chunked_prefills,
+            "wave_failures": self._wave_failures,
+            "wave_resets": self._wave_resets,
             **({
                 "spec_rounds": self._spec_rounds,
                 # accepted drafts per (round, active slot) over the cap

@@ -11,6 +11,8 @@ object CRUD. Standalone, this server provides both:
   POST /apis/<kind>                 apply a manifest (create-or-update)
   DELETE /apis/<kind>/<ns>/<name>   delete a job
   GET  /events/<ns>                 recent events in a namespace
+  GET  /serving/fleet               serving-fleet pods by role (JSON)
+  POST /serving/drain/<ns>/<pod>    annotate a serving pod for drain
 
 Auth: loopback binds are open; any other bind REQUIRES a bearer token
 (`token=` arg or KUBEDL_API_TOKEN env) — the reference inherits
@@ -23,6 +25,7 @@ import hmac
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -150,6 +153,39 @@ class OperatorHTTPServer:
                 elif len(parts) == 2 and parts[0] == "events":
                     evs = op.store.list("Event", namespace=parts[1])
                     self._json(200, {"items": [to_dict(e) for e in evs]})
+                elif split.path == "/serving/fleet":
+                    # the serving-fleet view the router and operators
+                    # watch: every pod carrying a serving role label,
+                    # grouped by job, with phase + drain state — derived
+                    # entirely from the store so it needs no extra
+                    # operator wiring and stays correct across restarts
+                    from kubedl_tpu.api.common import (
+                        ANNOTATION_SERVING_DRAIN,
+                        LABEL_JOB_NAME,
+                        LABEL_SERVING_ROLE,
+                    )
+
+                    fleets: dict = {}
+                    for pod in op.store.list("Pod"):
+                        role = (pod.metadata.labels or {}).get(
+                            LABEL_SERVING_ROLE)
+                        if not role:
+                            continue
+                        job = (pod.metadata.labels or {}).get(
+                            LABEL_JOB_NAME, "")
+                        key = f"{pod.metadata.namespace}/{job}"
+                        entry = fleets.setdefault(
+                            key, {"prefill": [], "decode": []})
+                        phase = getattr(pod.status, "phase", "")
+                        entry.setdefault(role, []).append({
+                            "name": pod.metadata.name,
+                            "namespace": pod.metadata.namespace,
+                            "phase": getattr(phase, "value",
+                                             str(phase) if phase else ""),
+                            "draining": ANNOTATION_SERVING_DRAIN in (
+                                pod.metadata.annotations or {}),
+                        })
+                    self._json(200, {"fleets": fleets})
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -157,7 +193,33 @@ class OperatorHTTPServer:
                 if not self._authorized():
                     return
                 parts = [p for p in self.path.split("/") if p]
-                if len(parts) == 2 and parts[0] == "apis":
+                if (len(parts) == 4 and parts[0] == "serving"
+                        and parts[1] == "drain"):
+                    # kubectl-drain for a serving pod: annotate it; the
+                    # pod's router loop migrates its streams and the
+                    # operator can then delete it without dropping any
+                    from kubedl_tpu.api.common import (
+                        ANNOTATION_SERVING_DRAIN,
+                        LABEL_SERVING_ROLE,
+                    )
+
+                    try:
+                        pod = op.store.get("Pod", parts[2], parts[3])
+                    except NotFound as e:
+                        self._json(404, {"error": str(e)})
+                        return
+                    if LABEL_SERVING_ROLE not in (pod.metadata.labels or {}):
+                        self._json(400, {
+                            "error": f"pod {parts[2]}/{parts[3]} has no "
+                                     f"serving role — not a fleet pod"})
+                        return
+                    if pod.metadata.annotations is None:
+                        pod.metadata.annotations = {}
+                    pod.metadata.annotations[ANNOTATION_SERVING_DRAIN] = (
+                        str(int(time.time())))
+                    op.store.update(pod)
+                    self._json(200, {"draining": f"{parts[2]}/{parts[3]}"})
+                elif len(parts) == 2 and parts[0] == "apis":
                     length = int(self.headers.get("Content-Length", "0"))
                     try:
                         manifest = json.loads(self.rfile.read(length) or b"{}")

@@ -1,0 +1,49 @@
+"""Disaggregated serving plane (ROADMAP item 1).
+
+The monolithic `models/serving.py` engine interleaves admission prefill
+and decode ticks on one host thread over one contiguous
+[slots, max_len] cache. This package splits that hot path into three
+cooperating pieces, the Podracer move (PAPERS.md) applied to serving:
+
+  * kv_pool      — paged KV: a block-pool allocator (fixed-size blocks,
+                   per-request block tables, refcounted copy-on-write
+                   prefix sharing keyed by prompt-prefix hash) plus the
+                   device-side row pool the decode tick gathers through;
+  * engine_prefill — chunk-batched prefill with no decode ticks in its
+                   critical path; emits KV rows + the first token;
+  * engine_decode  — tick-only decode over the paged pool;
+  * handoff      — prefill->decode KV transfer, by reference in-process
+                   or serialized for a cross-pod hop over DCN;
+  * disaggregated — a facade with the monolithic engine's exact API and
+                   exact-token parity (the compatibility surface);
+  * router       — the multi-pod fleet: shortest-queue prefill routing,
+                   least-outstanding-blocks decode routing, per-pod
+                   health/drain with mid-stream migration.
+"""
+from kubedl_tpu.serving.disaggregated import DisaggregatedEngine
+from kubedl_tpu.serving.engine_decode import DecodeEngine
+from kubedl_tpu.serving.engine_prefill import PrefillEngine
+from kubedl_tpu.serving.handoff import (
+    HandoffItem,
+    HandoffQueue,
+    deserialize_item,
+    serialize_item,
+)
+from kubedl_tpu.serving.kv_pool import BlockPool, PoolExhausted, PrefixIndex
+from kubedl_tpu.serving.router import DecodePod, PrefillPod, ServingRouter
+
+__all__ = [
+    "BlockPool",
+    "DecodeEngine",
+    "DecodePod",
+    "DisaggregatedEngine",
+    "HandoffItem",
+    "HandoffQueue",
+    "PoolExhausted",
+    "PrefillEngine",
+    "PrefillPod",
+    "PrefixIndex",
+    "ServingRouter",
+    "deserialize_item",
+    "serialize_item",
+]

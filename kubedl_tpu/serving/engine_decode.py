@@ -1,0 +1,465 @@
+"""DecodeEngine — tick-only decode over the paged KV pool.
+
+The tick gathers each slot's logical KV view through its row map (block
+table flattened to per-position physical rows) INSIDE the jitted step,
+runs the same `decode.decode_step` math as the contiguous engine —
+identical shapes ([slots, kv_heads, max_len, head_dim]), identical
+masks, so identical tokens — and scatters the one newly-written row per
+slot back into the pool. Admission is a scatter of prefilled rows into
+freshly-allocated blocks; a shared prefix is admitted by REFERENCE (the
+matched blocks join the slot's table with a refcount bump, zero bytes
+moved).
+
+Unlike the contiguous engine, a slot's rows change hands when a request
+finishes, so frozen slots must never write where they used to: freed
+slots' row maps point at the reserved trash block, and the tick routes
+every inactive slot's stale write there too.
+
+Capacity is managed ahead of the tick: `ensure_capacity(k)` allocates
+the blocks the next k ticks will write (releasing least-recently-used
+shared prefixes under pressure) and raises `PoolExhausted` when the
+pool genuinely cannot cover them — the caller then evicts a stream (its
+request re-prefills later; greedy outputs are unchanged by
+construction) instead of silently corrupting a neighbour's blocks.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import decode
+from kubedl_tpu.models.llama import LlamaConfig
+from kubedl_tpu.models.serving import (
+    Request,
+    chosen_logprob,
+    emit_token,
+    sample_tokens,
+)
+from kubedl_tpu.serving.handoff import HandoffItem
+from kubedl_tpu.serving.kv_pool import (
+    BlockPool,
+    PoolExhausted,
+    PrefixIndex,
+    init_device_pool,
+    table_to_rows,
+)
+
+
+class DecodeEngine:
+    """Paged continuous-batching decode for one model on one chip/mesh."""
+
+    def __init__(
+        self,
+        params: Dict,
+        config: LlamaConfig,
+        slots: int = 8,
+        max_len: int = 1024,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        temperature: float = 0.0,
+        seed: int = 0,
+        max_top_k: int = 64,
+        share_prefixes: bool = True,
+    ) -> None:
+        if max_len % block_size:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of block_size "
+                f"{block_size} (the row map flattens whole blocks)")
+        self.params = params
+        self.config = config
+        self.slots = slots
+        self.max_len = max_len
+        self.block_size = block_size
+        self.blocks_per_slot = max_len // block_size
+        if num_blocks is None:
+            # EQUAL MEMORY to the contiguous [slots, max_len] cache, plus
+            # the trash block — the capacity win comes from mixed-length
+            # traffic not hoarding max_len rows per slot, not from more
+            # memory
+            num_blocks = slots * self.blocks_per_slot + 1
+        self.pool = BlockPool(num_blocks, block_size)
+        self.prefix_index = PrefixIndex(self.pool) if share_prefixes else None
+        self.pages = init_device_pool(config, num_blocks, block_size)
+        self.temperature = temperature
+        self.max_top_k = max_top_k
+        self._key = jax.random.PRNGKey(seed)
+
+        self.row_map = jnp.zeros((slots, max_len), jnp.int32)
+        self.lengths = jnp.zeros((slots,), jnp.int32)
+        self.cur_tokens = jnp.zeros((slots,), jnp.int32)
+        self.active = jnp.zeros((slots,), jnp.bool_)
+        self.samp_temps = jnp.full((slots,), temperature, jnp.float32)
+        self.samp_topk = jnp.zeros((slots,), jnp.int32)
+        self.samp_topp = jnp.ones((slots,), jnp.float32)
+        self._tables: List[List[int]] = [[] for _ in range(slots)]
+        self._slot_req: List[Optional[Request]] = [None] * slots
+        self._slot_seq = [0] * slots  # admission order (eviction picks max)
+        self._admit_seq = 0
+        self._ticks = 0
+        self._tokens_out = 0
+        self._admitted = 0
+        self._evictions = 0
+        self._decode_time = 0.0
+        self._t0 = time.monotonic()
+
+        self._tick_jit = jax.jit(
+            self._tick_impl, static_argnums=(10,), donate_argnums=(1,))
+        self._tick_block_jit = jax.jit(
+            self._tick_block_impl, static_argnums=(7, 11),
+            donate_argnums=(1,))
+        self._scatter_jit = jax.jit(self._scatter_impl, donate_argnums=(0,))
+        self._copy_block_jit = jax.jit(
+            self._copy_block_impl, donate_argnums=(0,))
+        self._scratch_jit = jax.jit(self._scratch_impl)
+
+    # -- compiled pieces ---------------------------------------------------
+
+    def _views(self, pages, row_map):
+        """Per-layer logical KV views gathered through the row map:
+        [slots, max_len, h, d] -> [slots, h, max_len, d] — the exact
+        shape the contiguous cache feeds `decode.decode_step`, so the
+        attention math (and therefore every token) is identical.
+        Positions past a slot's length gather trash/stale rows the
+        ragged attend mask already excludes."""
+        ks = [p[row_map].transpose(0, 2, 1, 3) for p in pages["k"]]
+        vs = [p[row_map].transpose(0, 2, 1, 3) for p in pages["v"]]
+        return ks, vs
+
+    def _tick_core(self, params, pages, row_map, lengths, cur, active, key,
+                   temps, top_ks, top_ps, mode):
+        ks, vs = self._views(pages, row_map)
+        cache = {"k": ks, "v": vs, "lengths": lengths}
+        logits, cache = decode.decode_step(params, cur, cache, self.config)
+        nxt = sample_tokens(logits, key, temps, top_ks, top_ps, mode,
+                            self.max_top_k)
+        nxt = jnp.where(active, nxt, 0)
+        lp = chosen_logprob(logits, nxt)
+        new_len = jnp.where(active, cache["lengths"], lengths)
+        # scatter the single written row per slot back into the pool;
+        # frozen slots land in the trash block (their old rows may
+        # already belong to someone else)
+        wrow = jnp.take_along_axis(row_map, lengths[:, None], axis=1)[:, 0]
+        wrow = jnp.where(active, wrow, 0)
+        take = jax.vmap(
+            lambda leaf, p: jax.lax.dynamic_slice_in_dim(leaf, p, 1, axis=1))
+        new_pages = {
+            "k": [pl.at[wrow].set(take(view, lengths)[:, :, 0, :])
+                  for pl, view in zip(pages["k"], cache["k"])],
+            "v": [pl.at[wrow].set(take(view, lengths)[:, :, 0, :])
+                  for pl, view in zip(pages["v"], cache["v"])],
+        }
+        return new_pages, new_len, nxt, lp
+
+    def _tick_impl(self, params, pages, row_map, lengths, cur, active, key,
+                   temps, top_ks, top_ps, mode):
+        return self._tick_core(params, pages, row_map, lengths, cur, active,
+                               key, temps, top_ks, top_ps, mode)
+
+    def _tick_block_impl(self, params, pages, row_map, lengths, cur, active,
+                         key, k, temps, top_ks, top_ps, mode):
+        """k ticks chained on-device, ONE host sync — the contiguous
+        engine's fused block, re-gathering the (updated) pool each step.
+        Activity and sampling params can't change mid-block; overshoot
+        past an EOS is trimmed host-side."""
+
+        def body(carry, subkey):
+            pages, lengths, cur = carry
+            pages, lengths, nxt, lp = self._tick_core(
+                params, pages, row_map, lengths, cur, active, subkey,
+                temps, top_ks, top_ps, mode)
+            return (pages, lengths, nxt), (nxt, lp)
+
+        (pages, lengths, cur), (toks, lps) = jax.lax.scan(
+            body, (pages, lengths, cur), jax.random.split(key, k))
+        return pages, lengths, cur, toks, lps
+
+    def _scatter_impl(self, pages, rows_k, rows_v, wr):
+        """Write [t_pad, h, d] prefilled rows at physical rows `wr`
+        (pad/invalid entries point at the trash block)."""
+        return {
+            "k": [pl.at[wr].set(r.astype(pl.dtype))
+                  for pl, r in zip(pages["k"], rows_k)],
+            "v": [pl.at[wr].set(r.astype(pl.dtype))
+                  for pl, r in zip(pages["v"], rows_v)],
+        }
+
+    def _copy_block_impl(self, pages, src0, dst0):
+        """Copy-on-write: duplicate one block's rows (src -> dst)."""
+        bs = self.block_size
+
+        def cp(p):
+            rows = jax.lax.dynamic_slice_in_dim(p, src0, bs, axis=0)
+            return jax.lax.dynamic_update_slice_in_dim(p, rows, dst0, axis=0)
+
+        return {"k": [cp(p) for p in pages["k"]],
+                "v": [cp(p) for p in pages["v"]]}
+
+    def _scratch_impl(self, pages, idx):
+        """Batch-1 uniform scratch cache holding the rows at `idx`
+        ([max_len], trash-padded past the prefix) — the suffix-append
+        prefill runs over this exactly like the monolithic prefix path."""
+        ks = [p[idx].transpose(1, 0, 2)[None] for p in pages["k"]]
+        vs = [p[idx].transpose(1, 0, 2)[None] for p in pages["v"]]
+        return ks, vs
+
+    # -- admission ---------------------------------------------------------
+
+    def match_prefix(self, prompt: np.ndarray) -> List[int]:
+        """Longest indexed full-block prefix (increfed for the caller);
+        empty when sharing is off."""
+        if self.prefix_index is None:
+            return []
+        return self.prefix_index.match(prompt)
+
+    def build_prefix_scratch(self, blocks: List[int]) -> Dict:
+        """Uniform scratch cache seeded with the shared prefix rows, for
+        `PrefillEngine.prefill_suffix`."""
+        bs = self.block_size
+        idx = np.zeros((self.max_len,), np.int32)
+        for i, b in enumerate(blocks):
+            idx[i * bs:(i + 1) * bs] = b * bs + np.arange(bs, dtype=np.int32)
+        ks, vs = self._scratch_jit(self.pages, jnp.asarray(idx))
+        return {"k": ks, "v": vs,
+                "lengths": jnp.asarray(len(blocks) * bs, jnp.int32)}
+
+    def free_slots(self) -> int:
+        return sum(1 for r in self._slot_req if r is None)
+
+    def claim(self, slot: int, req: Request) -> None:
+        """Reserve a slot for a request mid-admission (so `.index(None)`
+        advances while a wave builds up, mirroring the monolithic pop
+        loop)."""
+        self._slot_req[slot] = req
+
+    def admit(self, item: HandoffItem, req: Request,
+              slot: Optional[int] = None) -> int:
+        """Scatter a prefilled request into the paged batch. Allocates
+        ceil(total/block_size) blocks minus the shared-prefix blocks the
+        item already references; raises PoolExhausted (after dropping
+        the prefix references) if they aren't available — the caller
+        requeues the request, nothing is half-admitted."""
+        if slot is None:
+            slot = self._slot_req.index(None)
+        bs = self.block_size
+        total = item.total_len
+        if total > self.max_len:
+            raise ValueError(f"prompt of {total} tokens > max_len {self.max_len}")
+        table = list(item.matched_blocks)
+        n_blocks = -(-total // bs)
+        try:
+            fresh = self.pool.alloc(n_blocks - len(table))
+        except PoolExhausted:
+            if self.prefix_index is not None:
+                released = self.prefix_index.release_lru(
+                    n_blocks - len(table) - self.pool.blocks_free)
+                if released:
+                    try:
+                        fresh = self.pool.alloc(n_blocks - len(table))
+                    except PoolExhausted:
+                        if item.matched_blocks:
+                            self.pool.free(item.matched_blocks)
+                        raise
+                else:
+                    if item.matched_blocks:
+                        self.pool.free(item.matched_blocks)
+                    raise
+            else:
+                raise
+        table += fresh
+        rows = table_to_rows(table, bs, self.max_len)
+        self.row_map = self.row_map.at[slot].set(jnp.asarray(rows))
+        # scatter the prefilled rows (positions [valid_from, total) of
+        # the item's [start, start + t_pad) window; everything else —
+        # padding, already-resident prefix rows — goes to trash)
+        t_pad = int(item.rows_k[0].shape[0])
+        valid_from = int(item.meta.get("valid_from", item.start))
+        wr = np.zeros((t_pad,), np.int32)
+        for j in range(t_pad):
+            pos = item.start + j
+            if valid_from <= pos < total:
+                wr[j] = rows[pos]
+        self.pages = self._scatter_jit(
+            self.pages,
+            [jnp.asarray(r) for r in item.rows_k],
+            [jnp.asarray(r) for r in item.rows_v],
+            jnp.asarray(wr))
+        self.lengths = self.lengths.at[slot].set(total)
+        self.cur_tokens = self.cur_tokens.at[slot].set(item.first_token)
+        self.active = self.active.at[slot].set(True)
+        self.samp_temps = self.samp_temps.at[slot].set(req.temperature)
+        self.samp_topk = self.samp_topk.at[slot].set(req.top_k)
+        self.samp_topp = self.samp_topp.at[slot].set(req.top_p)
+        self._tables[slot] = table
+        self._slot_req[slot] = req
+        self._admit_seq += 1
+        self._slot_seq[slot] = self._admit_seq
+        self._admitted += 1
+        req.cache_len = total
+        if self.prefix_index is not None:
+            self.prefix_index.insert(item.prompt, table)
+        return slot
+
+    def free_slot(self, slot: int) -> None:
+        if self._tables[slot]:
+            self.pool.free(self._tables[slot])
+            self._tables[slot] = []
+        self.row_map = self.row_map.at[slot].set(
+            jnp.zeros((self.max_len,), jnp.int32))
+        self._slot_req[slot] = None
+        self.active = self.active.at[slot].set(False)
+
+    def evict_slot(self, slot: int) -> Request:
+        """Free a live stream's blocks under pool pressure; the caller
+        re-queues its request with prompt + emitted tokens (greedy
+        continuations are exact — the re-prefill recomputes the same
+        KV)."""
+        req = self._slot_req[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is empty")
+        self.free_slot(slot)
+        self._evictions += 1
+        return req
+
+    def cancel_slot(self, req: Request) -> bool:
+        for slot, r in enumerate(self._slot_req):
+            if r is req:
+                self.free_slot(slot)
+                return True
+        return False
+
+    # -- capacity ----------------------------------------------------------
+
+    def ensure_capacity(self, k: int) -> None:
+        """Allocate the blocks the next k ticks will write, for every
+        active stream; copy-on-write any shared block that would be
+        extended in place. Raises PoolExhausted when the pool can't
+        cover it (after releasing LRU shared prefixes)."""
+        bs = self.block_size
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            table = self._tables[slot]
+            need = min(req.cache_len + k, self.max_len)
+            nb = -(-need // bs)
+            if nb > len(table):
+                want = nb - len(table)
+                if want > self.pool.blocks_free and self.prefix_index is not None:
+                    self.prefix_index.release_lru(
+                        want - self.pool.blocks_free)
+                fresh = self.pool.alloc(want)  # raises PoolExhausted
+                table.extend(fresh)
+                rows = table_to_rows(table, bs, self.max_len)
+                self.row_map = self.row_map.at[slot].set(jnp.asarray(rows))
+            # COW guard: the block holding the next write must be
+            # exclusively ours. The sharing index only indexes FULL
+            # prompt blocks and writes land past the prompt, so this
+            # almost never copies — it's the mechanical enforcement of
+            # "never write a shared block", not a hot path.
+            bi = min(req.cache_len, self.max_len - 1) // bs
+            if bi < len(table):
+                nb2, copied = self.pool.writable(table[bi])
+                if copied:
+                    self.pages = self._copy_block_jit(
+                        self.pages, table[bi] * bs, nb2 * bs)
+                    self.pool.free([table[bi]])
+                    table[bi] = nb2
+                    rows = table_to_rows(table, bs, self.max_len)
+                    self.row_map = self.row_map.at[slot].set(jnp.asarray(rows))
+
+    # -- ticking -----------------------------------------------------------
+
+    def decoding(self) -> List[int]:
+        return [s for s, r in enumerate(self._slot_req) if r is not None]
+
+    def sample_mode(self) -> str:
+        reqs = [r for r in self._slot_req if r is not None]
+        if any(r.needs_filter for r in reqs):
+            return "filtered"
+        if any(r.temperature > 0 for r in reqs):
+            return "plain"
+        return "greedy"
+
+    def tick(self, key=None) -> int:
+        decoding = self.decoding()
+        if not decoding:
+            return 0
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        self.ensure_capacity(1)
+        t0 = time.monotonic()
+        self.pages, self.lengths, nxt, lp = self._tick_jit(
+            self.params, self.pages, self.row_map, self.lengths,
+            self.cur_tokens, self.active, key, self.samp_temps,
+            self.samp_topk, self.samp_topp, self.sample_mode())
+        self.cur_tokens = nxt
+        self._ticks += 1
+        emitted, lps = (np.asarray(a) for a in jax.device_get((nxt, lp)))
+        self._decode_time += time.monotonic() - t0
+        for slot in decoding:
+            req = self._slot_req[slot]
+            if req is not None:
+                req.cache_len += 1
+                self._emit(slot, int(emitted[slot]), float(lps[slot]))
+        return len(decoding)
+
+    def tick_block(self, k: int, key=None) -> int:
+        decoding = self.decoding()
+        if not decoding:
+            return 0
+        if k <= 1:
+            return self.tick(key)
+        if key is None:
+            self._key, key = jax.random.split(self._key)
+        self.ensure_capacity(k)
+        t0 = time.monotonic()
+        self.pages, self.lengths, self.cur_tokens, toks, lps = \
+            self._tick_block_jit(
+                self.params, self.pages, self.row_map, self.lengths,
+                self.cur_tokens, self.active, key, int(k), self.samp_temps,
+                self.samp_topk, self.samp_topp, self.sample_mode())
+        self._ticks += k
+        block, block_lp = (np.asarray(a) for a in jax.device_get((toks, lps)))
+        self._decode_time += time.monotonic() - t0
+        for i in range(k):
+            for slot in decoding:
+                req = self._slot_req[slot]
+                if req is not None:
+                    req.cache_len += 1
+                    self._emit(slot, int(block[i, slot]),
+                               float(block_lp[i, slot]))
+        return len(decoding)
+
+    def _emit(self, slot: int, token: int, logprob: float = 0.0) -> None:
+        req = self._slot_req[slot]
+        self._tokens_out += 1
+        if emit_token(req, token, logprob):
+            self.free_slot(slot)
+
+    # -- introspection -----------------------------------------------------
+
+    def blocks_outstanding(self) -> int:
+        return self.pool.blocks_in_use
+
+    def stats(self) -> Dict:
+        wall = max(time.monotonic() - self._t0, 1e-9)
+        busy = sum(1 for r in self._slot_req if r is not None)
+        out = {
+            "slots": self.slots,
+            "slots_busy": busy,
+            "admitted": self._admitted,
+            "ticks": self._ticks,
+            "tokens_out": self._tokens_out,
+            "tokens_per_sec": self._tokens_out / wall,
+            "decode_time_s": round(self._decode_time, 4),
+            "evictions": self._evictions,
+            "kv_blocks_in_use": self.pool.blocks_in_use,
+            "kv_blocks_total": self.pool.num_blocks,
+            **self.pool.stats(),
+        }
+        if self.prefix_index is not None:
+            out.update(self.prefix_index.stats())
+        return out

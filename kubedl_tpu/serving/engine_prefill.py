@@ -1,0 +1,238 @@
+"""PrefillEngine — prompt ingestion with NO decode ticks in its path.
+
+The monolithic engine runs prefill and decode on one thread over one
+cache, so a burst of long prompts freezes every in-flight stream between
+ticks. This engine owns prefill alone: bucketed wave batches (same
+clustering economics as the monolithic `_admit_batch`), chunked block
+appends for prompts past the largest bucket, and suffix appends over a
+shared-prefix scratch. Output is a `HandoffItem` per request — KV rows
+plus the sampled first token — which a decode engine admits by reference
+(same process) or after a serialized DCN hop (cross-pod).
+
+Token parity with the monolithic engine is load-bearing: the wave path
+pads prompts to the same buckets, pads the batch to the same power of
+two, and samples first tokens through the same `sample_tokens` with the
+same one-key-per-cluster discipline, so a facade driving both stacks
+with the same seed gets the same tokens — greedy AND sampled.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import decode
+from kubedl_tpu.models.llama import LlamaConfig, _lm_head
+from kubedl_tpu.models.serving import Request, chosen_logprob, sample_tokens
+
+SUFFIX_CHUNK = 16  # block size for prefix-suffix appends (engine parity)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
+
+
+class PrefillEngine:
+    """Prefill half of the disaggregated plane (one model, one mesh)."""
+
+    def __init__(
+        self,
+        params: Dict,
+        config: LlamaConfig,
+        max_len: int = 1024,
+        prompt_buckets: Optional[List[int]] = None,
+        prefill_chunk: int = 256,
+        max_top_k: int = 64,
+    ) -> None:
+        self.params = params
+        self.config = config
+        self.max_len = max_len
+        if prompt_buckets is None:
+            prompt_buckets = []
+            b = 16
+            while b < max_len:
+                prompt_buckets.append(b)
+                b *= 2
+            prompt_buckets.append(max_len)
+        self.prompt_buckets = sorted(prompt_buckets)
+        if self.prompt_buckets[-1] > max_len:
+            raise ValueError(
+                f"largest prompt bucket {self.prompt_buckets[-1]} exceeds "
+                f"max_len {max_len}")
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_top_k = max_top_k
+        self._prefills = 0
+        self._chunked_prefills = 0
+        self._prefill_time = 0.0
+
+        def prefill_fn(params, prompt, length):
+            # scratch capacity = the padded prompt width: prefill writes
+            # positions [0, t) only, and the handoff slices exactly the
+            # rows it needs — no reason to zero max_len rows per wave
+            scratch = decode.init_kv_cache(
+                self.config, prompt.shape[0], prompt.shape[1])
+            return decode.prefill(params, prompt, scratch, self.config,
+                                  lengths=length)
+
+        self._prefill = jax.jit(prefill_fn)
+        self._sample_jit = jax.jit(self._sample, static_argnums=(5,))
+        self._lp_jit = jax.jit(chosen_logprob)
+
+        def append(params, toks, cache):
+            return decode.decode_block_step(
+                params, toks, cache, self.config, return_hidden=True)
+
+        self._append_donated = jax.jit(append, donate_argnums=(2,))
+
+        def extract(rows, i, t_pad):
+            # per-request handoff rows from a batched wave cache:
+            # [b, h, cap, d] -> [t_pad, h, d] (pool row layout)
+            out_k = [k[i, :, :t_pad].transpose(1, 0, 2) for k in rows["k"]]
+            out_v = [v[i, :, :t_pad].transpose(1, 0, 2) for v in rows["v"]]
+            return out_k, out_v
+
+        self._extract = jax.jit(extract, static_argnums=(1, 2))
+
+        def extract_scratch(cache, t_pad):
+            # [1, h, cap, d] -> [t_pad, h, d] from a chunked scratch
+            out_k = [k[0, :, :t_pad].transpose(1, 0, 2) for k in cache["k"]]
+            out_v = [v[0, :, :t_pad].transpose(1, 0, 2) for v in cache["v"]]
+            return out_k, out_v
+
+        self._extract_scratch = jax.jit(extract_scratch, static_argnums=(1,))
+
+        def head_fn(params, hidden, tail):
+            # head ONE row (the last real token) — a full [T, vocab]
+            # head matmul would dominate every chunk
+            return _lm_head(hidden[:, tail - 1:tail], params,
+                            self.config)[:, 0]
+
+        self._head = jax.jit(head_fn, static_argnums=(2,))
+
+    def _sample(self, logits, key, temps, top_ks, top_ps, mode):
+        return sample_tokens(logits, key, temps, top_ks, top_ps, mode,
+                             self.max_top_k)
+
+    def sample_first(self, logits, req: Request, key):
+        """First-token sample (+ model logprob) for one request's [1, V]
+        logits — byte-identical discipline to the monolithic engine's
+        `_sample_first`."""
+        first = self._sample_jit(
+            logits, key, jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+            "filtered" if req.needs_filter
+            else ("plain" if req.temperature > 0 else "greedy"))[0]
+        return first, self._lp_jit(logits, first[None])[0]
+
+    # -- wave (bucketed batch) prefill ------------------------------------
+
+    def prefill_group(self, reqs: List[Request], bucket: int, key):
+        """One prefill forward for a same-bucket cluster; returns
+        (firsts, lps, rows_cache, lengths) with the monolithic engine's
+        exact padding: rows to `bucket`, batch to the next power of two
+        (dummy length-1 rows never leave the device)."""
+        t0 = time.monotonic()
+        k = len(reqs)
+        k_pad = 1 << (k - 1).bit_length()
+        padded = np.zeros((k_pad, bucket), np.int32)
+        lengths = np.ones((k_pad,), np.int32)
+        temps = np.zeros((k_pad,), np.float32)
+        topks = np.zeros((k_pad,), np.int32)
+        topps = np.ones((k_pad,), np.float32)
+        for i, r in enumerate(reqs):
+            t = len(r.prompt)
+            padded[i, :t] = r.prompt
+            lengths[i] = t
+            temps[i] = r.temperature
+            topks[i] = r.top_k
+            topps[i] = r.top_p
+        logits, rows = self._prefill(
+            self.params, jnp.asarray(padded), jnp.asarray(lengths))
+        if any(r.needs_filter for r in reqs):
+            mode = "filtered"
+        elif any(r.temperature > 0 for r in reqs):
+            mode = "plain"
+        else:
+            mode = "greedy"
+        firsts = self._sample_jit(
+            logits, key, jnp.asarray(temps), jnp.asarray(topks),
+            jnp.asarray(topps), mode)
+        lps = self._lp_jit(logits, firsts)
+        self._prefills += len(reqs)
+        self._prefill_time += time.monotonic() - t0
+        return firsts, lps, rows, lengths
+
+    def extract_rows(self, rows, i: int, t_pad: int):
+        """Row i of a wave cache as pool-layout [t_pad, h, d] per layer."""
+        return self._extract(rows, i, t_pad)
+
+    # -- chunked prefill (prompts past the largest bucket) ----------------
+
+    def prefill_chunked(self, req: Request, key) -> Tuple:
+        """All chunks back to back — this engine has no decode ticks to
+        interleave with, that is the point of the split. Returns
+        (first_token_dev, first_lp_dev, rows_k, rows_v, t, t_pad)."""
+        t0 = time.monotonic()
+        c = self.prefill_chunk
+        prompt = np.asarray(req.prompt, np.int32)
+        t = len(prompt)
+        blocks = -(-t // c)
+        cap = blocks * c
+        if cap > self.max_len:
+            raise ValueError(
+                f"chunked prefill of {t} tokens pads to {cap} positions, "
+                f"past max_len {self.max_len}")
+        cache = decode.init_kv_cache(self.config, 1, cap, uniform=True)
+        hidden = None
+        tail = c
+        for pos in range(0, t, c):
+            toks = prompt[pos:pos + c]
+            tail = len(toks)
+            if tail < c:
+                # pad to the ONE chunk shape; pad K/V past the real
+                # length is masked by the ragged attend and never
+                # extracted into the handoff
+                toks = np.pad(toks, (0, c - tail))
+            hidden, cache = self._append_donated(
+                self.params, jnp.asarray(toks[None]), cache)
+        logits = self._head(self.params, hidden, tail)
+        first, first_lp = self.sample_first(logits, req, key)
+        t_pad = min(_pow2(t), cap)
+        if t_pad < t:
+            t_pad = cap
+        rows_k, rows_v = self._extract_scratch(cache, t_pad)
+        self._chunked_prefills += 1
+        self._prefill_time += time.monotonic() - t0
+        return first, first_lp, rows_k, rows_v, t, t_pad
+
+    # -- suffix append over a shared-prefix scratch -----------------------
+
+    def prefill_suffix(self, scratch_cache: Dict, suffix: np.ndarray,
+                       req: Request, key) -> Tuple:
+        """Append `suffix` to a scratch cache already holding the shared
+        prefix (lengths = prefix rows); fixed SUFFIX_CHUNK block steps,
+        the monolithic prefix path's exact math. Returns
+        (first, first_lp, cache_with_suffix, total_len)."""
+        t0 = time.monotonic()
+        start = int(scratch_cache["lengths"])
+        hidden = None
+        for i in range(0, len(suffix), SUFFIX_CHUNK):
+            toks = jnp.asarray(suffix[None, i:i + SUFFIX_CHUNK])
+            hidden, scratch_cache = self._append_donated(
+                self.params, toks, scratch_cache)
+        logits = self._head(self.params, hidden, hidden.shape[1])
+        first, first_lp = self.sample_first(logits, req, key)
+        self._prefills += 1
+        self._prefill_time += time.monotonic() - t0
+        return first, first_lp, scratch_cache, start + len(suffix)
+
+    def stats(self) -> Dict:
+        return {
+            "prefills": self._prefills,
+            "chunked_prefills": self._chunked_prefills,
+            "prefill_time_s": round(self._prefill_time, 4),
+        }

@@ -1,0 +1,151 @@
+"""Prefill -> decode KV handoff.
+
+A finished prefill is (per layer) `total_len` KV rows plus the sampled
+first token. In-process (facade mode, or a router whose prefill and
+decode pods share the host) the item carries device arrays BY REFERENCE
+— the decode engine scatters them straight into its pool, no host copy.
+Across pods the item serializes to one contiguous byte payload (npz) for
+the DCN hop; `deserialize_item` restores numpy rows the receiving
+engine uploads. Serialization drops the in-process conveniences (the
+live Request object, matched prefix blocks) — exactly the things that
+cannot cross a process boundary.
+"""
+from __future__ import annotations
+
+import io
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class HandoffItem:
+    """One prefilled request, ready for decode admission.
+
+    rows_k/rows_v: per-layer [t_rows, kv_heads, head_dim] — the KV rows
+    the prefill computed, row r = prompt position `start + r`. With
+    prefix sharing, `start = len(matched_blocks) * block_size` rows were
+    NOT computed (the decode pod already holds them); matched_blocks
+    carries the physical ids (already increfed for this request)."""
+
+    request: Any  # models.serving.Request (None after a serialized hop)
+    prompt: np.ndarray  # full prompt tokens [total prompt len]
+    total_len: int  # prompt tokens incl. shared prefix
+    start: int  # first row's logical position (0 unless prefix-shared)
+    rows_k: List[Any]  # per layer [t_rows, h_kv, d] (device or numpy)
+    rows_v: List[Any]
+    first_token: int
+    first_logprob: float
+    matched_blocks: List[int] = field(default_factory=list)
+    # sampling/meta for cross-pod admission (the Request doesn't travel)
+    meta: Dict = field(default_factory=dict)
+    prefilled_at: float = field(default_factory=time.monotonic)
+
+
+class HandoffQueue:
+    """Thread-safe FIFO between a prefill pump and a decode pump. The
+    queue is the disaggregation point: prefill bursts pile up HERE
+    instead of between two decode ticks."""
+
+    def __init__(self, maxlen: Optional[int] = None) -> None:
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self.maxlen = maxlen
+        self.put_count = 0
+
+    def put(self, item: HandoffItem) -> None:
+        with self._lock:
+            if self.maxlen is not None and len(self._q) >= self.maxlen:
+                raise RuntimeError(
+                    f"handoff queue full ({self.maxlen}) — decode pods "
+                    f"are not draining; add capacity or admit slower")
+            self._q.append(item)
+            self.put_count += 1
+
+    def get(self) -> Optional[HandoffItem]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def requeue(self, item: HandoffItem) -> None:
+        """Put BACK an item taken with get() (e.g. every decode pod was
+        full): head of the queue, no put_count bump, no maxlen check —
+        the item was already admitted once."""
+        with self._lock:
+            self._q.appendleft(item)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+def serialize_item(item: HandoffItem) -> bytes:
+    """One npz payload for the cross-pod (DCN) hop. Device arrays are
+    fetched to host here — the serialization boundary IS the transfer
+    boundary. Prefix-shared blocks cannot travel (they are physical ids
+    in the SENDER's pool), so items carrying them must re-prefill or
+    stay in-process; refusing loudly beats corrupting the receiver."""
+    if item.matched_blocks:
+        raise ValueError(
+            "cannot serialize a handoff item with matched prefix blocks "
+            "(physical block ids are meaningless across pods) — route "
+            "prefix-shared traffic to a same-pool decode engine")
+    buf = io.BytesIO()
+    arrays = {
+        "prompt": np.asarray(item.prompt, np.int32),
+        "scalars": np.asarray(
+            [item.total_len, item.start, item.first_token], np.int64),
+        "first_logprob": np.asarray([item.first_logprob], np.float64),
+    }
+    for li, (k, v) in enumerate(zip(item.rows_k, item.rows_v)):
+        arrays[f"k{li}"] = np.asarray(k)
+        arrays[f"v{li}"] = np.asarray(v)
+    # npz forgets extension dtypes (bfloat16 saves as raw |V2 void) —
+    # record the rows dtype by name so deserialize can view it back.
+    # ONE name covers every layer, so mixed-dtype rows must not slip in
+    # (they'd deserialize through the wrong view, silent corruption)
+    row_dtypes = {str(arrays[f"k{li}"].dtype) for li in range(len(item.rows_k))}
+    row_dtypes |= {str(arrays[f"v{li}"].dtype) for li in range(len(item.rows_v))}
+    if len(row_dtypes) != 1:
+        raise ValueError(f"mixed KV row dtypes {sorted(row_dtypes)} — "
+                         f"the wire format records one dtype for all layers")
+    arrays["rows_dtype"] = np.asarray(sorted(row_dtypes))
+    meta_keys = sorted(item.meta)
+    arrays["meta_keys"] = np.asarray(meta_keys, dtype=object)
+    arrays["meta_vals"] = np.asarray(
+        [item.meta[k] for k in meta_keys], dtype=object)
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def deserialize_item(payload: bytes) -> HandoffItem:
+    import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
+
+    with np.load(io.BytesIO(payload), allow_pickle=True) as z:
+        n_layers = sum(1 for name in z.files
+                       if name.startswith("k") and name[1:].isdigit())
+        total_len, start, first_token = (int(x) for x in z["scalars"])
+        meta = dict(zip(z["meta_keys"].tolist(), z["meta_vals"].tolist()))
+        rd = np.dtype(str(z["rows_dtype"][0])) if "rows_dtype" in z.files \
+            else z["k0"].dtype
+
+        def rows(name):
+            a = z[name]
+            # numeric dtypes round-trip intact; extension dtypes come
+            # back as raw void and need the recorded dtype viewed on
+            return a.view(rd) if a.dtype.kind == "V" else a
+
+        return HandoffItem(
+            request=None,
+            prompt=z["prompt"],
+            total_len=total_len,
+            start=start,
+            rows_k=[rows(f"k{li}") for li in range(n_layers)],
+            rows_v=[rows(f"v{li}") for li in range(n_layers)],
+            first_token=first_token,
+            first_logprob=float(z["first_logprob"][0]),
+            meta=meta,
+        )

@@ -1,0 +1,432 @@
+"""ServingRouter — the multi-pod serving fleet's traffic brain.
+
+The operator reconciles N prefill pods + M decode pods (see
+workloads/jaxjob.py `spec.serving`); this module is the routing logic
+those pods and the front-end share:
+
+  * prefill routing: SHORTEST QUEUE among healthy, non-draining prefill
+    pods — prefill work is queue-bound, so queue depth IS the load;
+  * decode routing: LEAST OUTSTANDING KV BLOCKS among healthy,
+    non-draining decode pods with a free slot — blocks, not request
+    count, measure a decode pod's true occupancy under paged KV (one
+    2k-context stream outweighs five short chats);
+  * per-pod health/draining with MID-STREAM MIGRATION: draining or
+    failing a decode pod re-routes its in-flight streams as
+    continuations (prompt + tokens emitted so far) through the normal
+    path; emitted tokens are never lost, and greedy streams resume
+    token-exact in practice — the re-prefill recomputes the same KV
+    mathematically, though prefill's float order can flip an argmax
+    near-tie against the tick path.
+
+In-process the handoff travels by reference; with `cross_pod=True`
+every prefill->decode hop round-trips through `serialize_item`/
+`deserialize_item` — the DCN wire discipline, exercised in tests and
+the multichip dryrun so the byte path can't rot.
+
+This module is deliberately transport-agnostic: pods here are
+in-process objects (one engine each), which is both the test harness
+and the single-host deployment; a networked deployment keeps this
+routing logic and swaps the pod handles for HTTP clients.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from kubedl_tpu.models.serving import Request, validate_sampling
+from kubedl_tpu.serving.engine_decode import DecodeEngine
+from kubedl_tpu.serving.engine_prefill import PrefillEngine
+from kubedl_tpu.serving.handoff import (
+    HandoffItem,
+    HandoffQueue,
+    deserialize_item,
+    serialize_item,
+)
+from kubedl_tpu.serving.kv_pool import PoolExhausted
+
+import jax
+
+
+class PrefillPod:
+    """One prefill engine + its work queue (a pod in the serving fleet)."""
+
+    def __init__(self, name: str, params, config, max_len: int = 1024,
+                 prompt_buckets=None, prefill_chunk: int = 256,
+                 seed: int = 0, max_top_k: int = 64) -> None:
+        self.name = name
+        self.engine = PrefillEngine(
+            params, config, max_len=max_len, prompt_buckets=prompt_buckets,
+            prefill_chunk=prefill_chunk, max_top_k=max_top_k)
+        self.healthy = True
+        self.draining = False
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed)
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def enqueue(self, req: Request) -> None:
+        with self._lock:
+            self._queue.append(req)
+
+    def steal_queue(self) -> List[Request]:
+        """Drain the waiting queue (for re-routing on drain/failure)."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            return out
+
+    def pump_one(self) -> Optional[HandoffItem]:
+        """Prefill one queued request; returns its handoff item."""
+        with self._lock:
+            if not self._queue:
+                return None
+            req = self._queue.popleft()
+            self._key, sub = jax.random.split(self._key)
+        eng = self.engine
+        prompt = np.asarray(req.prompt, np.int32)
+        try:
+            if (len(prompt) > eng.prompt_buckets[-1]
+                    and eng.prefill_chunk > 0):
+                first, _lp, rows_k, rows_v, t, _tp = eng.prefill_chunked(
+                    req, sub)
+                total = t
+            else:
+                from kubedl_tpu.models.serving import _bucket
+
+                bucket = _bucket(len(prompt), eng.prompt_buckets)
+                firsts, _lps, rows, lengths = eng.prefill_group(
+                    [req], bucket, sub)
+                rows_k, rows_v = eng.extract_rows(rows, 0, bucket)
+                first = firsts[0]
+                total = int(lengths[0])
+        except Exception as e:  # noqa: BLE001 — fail the request, keep
+            # the pod serving (a poisoned prompt must not kill the pod)
+            req.error = f"prefill failed: {e}"
+            req.done = True
+            req.finished_at = time.monotonic()
+            return None
+        return HandoffItem(
+            request=req, prompt=prompt, total_len=total, start=0,
+            rows_k=rows_k, rows_v=rows_v,
+            first_token=int(jax.device_get(first)), first_logprob=0.0,
+            meta={"request_id": req.request_id,
+                  "max_new_tokens": req.max_new_tokens,
+                  "temperature": req.temperature,
+                  "top_k": req.top_k, "top_p": req.top_p,
+                  "eos_token": req.eos_token})
+
+
+class DecodePod:
+    """One paged decode engine (a pod in the serving fleet)."""
+
+    def __init__(self, name: str, params, config, slots: int = 8,
+                 max_len: int = 1024, block_size: int = 16,
+                 num_blocks: Optional[int] = None, seed: int = 0,
+                 max_top_k: int = 64, share_prefixes: bool = False) -> None:
+        self.name = name
+        # pods serve full prefills from remote prefill pods; prefix
+        # sharing needs the prefill to happen against THIS pod's pool,
+        # so it stays a facade/same-pool feature unless enabled
+        self.engine = DecodeEngine(
+            params, config, slots=slots, max_len=max_len,
+            block_size=block_size, num_blocks=num_blocks, seed=seed,
+            max_top_k=max_top_k, share_prefixes=share_prefixes)
+        self.healthy = True
+        self.draining = False
+        self._lock = threading.Lock()
+
+    def free_slots(self) -> int:
+        with self._lock:
+            return self.engine.free_slots()
+
+    def blocks_outstanding(self) -> int:
+        with self._lock:
+            return self.engine.blocks_outstanding()
+
+    def admit(self, item: HandoffItem, req: Request) -> None:
+        with self._lock:
+            slot = self.engine.admit(item, req)
+            # first emission happens pod-side so streams see the token
+            # as soon as the handoff lands
+            if not req.done:
+                self.engine._emit(slot, item.first_token, item.first_logprob)
+
+    def tick_block(self, k: int = 8) -> int:
+        with self._lock:
+            decoding = self.engine.decoding()
+            if not decoding:
+                return 0
+            try:
+                self.engine.ensure_capacity(k)
+            except PoolExhausted:
+                k = 1  # tick-by-tick while streams finish and free blocks
+                self.engine.ensure_capacity(1)
+            return self.engine.tick_block(k)
+
+    def in_flight(self) -> List[Request]:
+        with self._lock:
+            return [r for r in self.engine._slot_req if r is not None]
+
+    def evict_youngest(self) -> Optional[Request]:
+        """Evict the most recently admitted stream under pool pressure
+        (its re-prefill costs the least); None when one lone stream
+        holds the pool — evicting it would just loop."""
+        with self._lock:
+            decoding = self.engine.decoding()
+            if len(decoding) <= 1:
+                return None
+            victim = max(decoding, key=lambda s: self.engine._slot_seq[s])
+            return self.engine.evict_slot(victim)
+
+    def evict_all(self) -> List[Request]:
+        """Free every in-flight stream's blocks (drain/failover path);
+        returns the evicted requests for re-routing."""
+        with self._lock:
+            out = []
+            for slot, req in enumerate(self.engine._slot_req):
+                if req is not None:
+                    out.append(self.engine.evict_slot(slot))
+            return out
+
+
+class ServingRouter:
+    """Load-aware routing + health/drain over a prefill/decode fleet."""
+
+    def __init__(self, prefill_pods: List[PrefillPod],
+                 decode_pods: List[DecodePod],
+                 cross_pod: bool = False) -> None:
+        if not prefill_pods or not decode_pods:
+            raise ValueError("a serving fleet needs >= 1 prefill and "
+                             ">= 1 decode pod")
+        self.prefill_pods = list(prefill_pods)
+        self.decode_pods = list(decode_pods)
+        self.cross_pod = cross_pod
+        # the tightest pod bounds every request (any pod may serve it)
+        self.max_len = min(p.engine.max_len
+                           for p in self.prefill_pods + self.decode_pods)
+        self.max_top_k = min(p.engine.max_top_k for p in self.decode_pods)
+        self.handoffs = HandoffQueue()
+        # live requests only: entries are reaped as requests finish, so
+        # a long-running router never accumulates dead prompt arrays
+        self._by_id: Dict[int, Request] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self.migrations = 0
+        self.serialized_bytes = 0
+
+    # -- routing policies --------------------------------------------------
+
+    def _eligible(self, pods):
+        return [p for p in pods if p.healthy and not p.draining]
+
+    def route_prefill(self) -> PrefillPod:
+        """Shortest queue among eligible prefill pods."""
+        pods = self._eligible(self.prefill_pods)
+        if not pods:
+            raise RuntimeError("no healthy prefill pods")
+        return min(pods, key=lambda p: p.queue_len())
+
+    def route_decode(self) -> Optional[DecodePod]:
+        """Least outstanding KV blocks among eligible decode pods with a
+        free slot; None when every pod is full (the handoff waits)."""
+        pods = [p for p in self._eligible(self.decode_pods)
+                if p.free_slots() > 0]
+        if not pods:
+            return None
+        return min(pods, key=lambda p: p.blocks_outstanding())
+
+    # -- traffic -----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               eos_token: Optional[int] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # same shared validator as the monolith and the facade — the
+        # router is a third submit entry point, and an unvalidated top_k
+        # would silently clamp in sample_tokens instead of rejecting
+        validate_sampling(temperature, top_k, top_p,
+                          self.max_top_k, None)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size + max_new_tokens > self.max_len:
+            # same guard as the monolithic submit — past max_len the
+            # decode write clamps to the last row and silently corrupts
+            # the stream's KV, so over-long requests must die HERE
+            raise ValueError(
+                f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                f"exceeds max_len {self.max_len}")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        req = Request(rid, prompt, max_new_tokens, eos_token,
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p))
+        with self._lock:
+            self._by_id[rid] = req
+        self.route_prefill().enqueue(req)
+        return req
+
+    def _resubmit(self, req: Request) -> None:
+        """Continuation re-route after a drain/failover: the prompt
+        grows by the tokens already emitted, so the re-prefill recomputes
+        the stream's KV and greedy decoding resumes where it left off
+        (emitted tokens are never lost; see the module doc's float-order
+        caveat on exactness)."""
+        req.prompt = np.concatenate(
+            [np.asarray(req.prompt, np.int32),
+             np.asarray(req.tokens, np.int32)])
+        self.migrations += 1
+        self.route_prefill().enqueue(req)
+
+    def pump_prefill(self) -> int:
+        """One prefill from every eligible pod's queue -> handoff queue
+        (serialized round trip in cross_pod mode)."""
+        moved = 0
+        for pod in self._eligible(self.prefill_pods):
+            item = pod.pump_one()
+            if item is None:
+                continue
+            if self.cross_pod:
+                payload = serialize_item(item)
+                self.serialized_bytes += len(payload)
+                item = deserialize_item(payload)
+                item.request = self._by_id[int(item.meta["request_id"])]
+            self.handoffs.put(item)
+            moved += 1
+        return moved
+
+    def dispatch_handoffs(self) -> int:
+        """Admit queued handoffs to the least-loaded decode pods."""
+        admitted = 0
+        held = []
+        while True:
+            item = self.handoffs.get()
+            if item is None:
+                break
+            pod = self.route_decode()
+            if pod is None:
+                held.append(item)  # every pod full; retry next round
+                continue
+            req = item.request
+            try:
+                pod.admit(item, req)
+            except PoolExhausted:
+                held.append(item)
+                continue
+            admitted += 1
+        for item in reversed(held):  # head of queue, original order kept
+            self.handoffs.requeue(item)
+        return admitted
+
+    def pump_decode(self, k: int = 8) -> int:
+        n = 0
+        for pod in self.decode_pods:
+            if not pod.healthy:
+                continue  # draining pods still finish in-flight work
+            try:
+                n += pod.tick_block(k)
+            except PoolExhausted:
+                # even tick-by-tick the pod's pool can't cover every
+                # stream's next block (undersized kvBlocks or a pile-up
+                # of near-max streams): evict the youngest stream and
+                # re-route it as a continuation instead of letting the
+                # pump die and stall the whole fleet
+                req = pod.evict_youngest()
+                if req is None:
+                    raise  # a single stream outgrew the pool: config error
+                self._resubmit(req)
+        self._reap_done()
+        return n
+
+    def _reap_done(self) -> None:
+        """Drop finished requests from the routing table. Covers every
+        completion path (prefill failure, first-token at admit, decode
+        ticks) because it scans, and nothing here outlives the caller's
+        own reference to the Request it submitted."""
+        with self._lock:
+            for rid in [r_id for r_id, r in self._by_id.items() if r.done]:
+                del self._by_id[rid]
+
+    def step_all(self, k: int = 8) -> int:
+        """One deterministic scheduling round (the single-threaded
+        driver tests use; production pumps each stage from its own
+        thread/pod)."""
+        self.pump_prefill()
+        self.dispatch_handoffs()
+        return self.pump_decode(k)
+
+    def serve_all(self, prompts, max_new_tokens: int, k: int = 8,
+                  **kw) -> List[List[int]]:
+        reqs = [self.submit(p, max_new_tokens, **kw) for p in prompts]
+        while not all(r.done for r in reqs):
+            self.step_all(k)
+        return [r.tokens for r in reqs]
+
+    # -- health / drain ----------------------------------------------------
+
+    def _find(self, name: str):
+        for p in self.prefill_pods + self.decode_pods:
+            if p.name == name:
+                return p
+        raise KeyError(f"unknown pod {name!r}")
+
+    def drain(self, name: str, migrate: bool = True) -> int:
+        """Stop routing new work to a pod. With migrate=True (the
+        default) its in-flight/queued work re-routes immediately as
+        continuations; otherwise a decode pod finishes its streams
+        before the operator takes it down. Returns requests moved."""
+        pod = self._find(name)
+        pod.draining = True
+        moved = 0
+        if isinstance(pod, PrefillPod):
+            for req in pod.steal_queue():
+                self.route_prefill().enqueue(req)
+                moved += 1
+        elif migrate:
+            for req in pod.evict_all():
+                self._resubmit(req)
+                moved += 1
+        return moved
+
+    def fail(self, name: str) -> int:
+        """Hard failure: the pod is gone; its device state with it. Every
+        in-flight stream re-routes as a continuation."""
+        pod = self._find(name)
+        pod.healthy = False
+        moved = 0
+        if isinstance(pod, PrefillPod):
+            for req in pod.steal_queue():
+                self.route_prefill().enqueue(req)
+                moved += 1
+        else:
+            for req in pod.evict_all():
+                self._resubmit(req)
+                moved += 1
+        return moved
+
+    def stats(self) -> Dict:
+        return {
+            "prefill_pods": [
+                {"name": p.name, "queue": p.queue_len(),
+                 "healthy": p.healthy, "draining": p.draining,
+                 **p.engine.stats()}
+                for p in self.prefill_pods],
+            "decode_pods": [
+                {"name": p.name, "blocks": p.blocks_outstanding(),
+                 "free_slots": p.free_slots(),
+                 "healthy": p.healthy, "draining": p.draining,
+                 **p.engine.stats()}
+                for p in self.decode_pods],
+            "handoff_queue": len(self.handoffs),
+            "handoffs_total": self.handoffs.put_count,
+            "migrations": self.migrations,
+            "serialized_bytes": self.serialized_bytes,
+        }

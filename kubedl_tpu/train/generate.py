@@ -77,7 +77,13 @@ def restore_params(path, label="params"):
     latest = mngr.latest_step()
     if latest is None:
         return None
-    restored = mngr.restore(latest)
+    try:
+        restored = mngr.restore(latest)
+    except KeyError:
+        # orbax >= 0.5 no longer infers the handler for a StandardSave'd
+        # item on an untargeted restore ('Item "default" ... could not be
+        # restored'); ask for the standard pytree restore explicitly
+        restored = mngr.restore(latest, args=ocp.args.StandardRestore())
     if isinstance(restored, (list, tuple)):
         tree = restored[0]
     elif hasattr(restored, "params"):

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import (
+    LABEL_SERVING_ROLE,
     LABEL_SLICE_ID,
     ReplicaSpec,
     ReplicaType,
@@ -85,6 +86,27 @@ class CheckpointSpec:
 
 
 @dataclass
+class ServingSpec:
+    """Disaggregated serving fleet (kubedl_tpu/serving/): the Worker
+    replicas split into prefill and decode ROLES by index — workers
+    [0, prefillReplicas) prefill, the rest decode — behind the router
+    (serving/router.py; server.py exposes fleet state + drain). The
+    paged-KV knobs are injected per pod as KUBEDL_SERVING_* env."""
+
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    slots: int = 8  # concurrent decode streams per decode pod
+    max_len: int = 1024
+    block_size: int = 16  # paged-KV block (rows per block)
+    kv_blocks: int = 0  # 0 = equal memory to slots * max_len
+    share_prefixes: bool = True
+    # routing policies (router.py): the defaults are the only ones
+    # implemented; the fields exist so manifests state intent explicitly
+    prefill_router: str = "shortest-queue"
+    decode_router: str = "least-blocks"
+
+
+@dataclass
 class JAXJobSpec:
     replica_specs: Dict[str, ReplicaSpec] = field(
         default_factory=dict, metadata={"name": "jaxReplicaSpecs"}
@@ -105,6 +127,9 @@ class JAXJobSpec:
     # instead of paying minutes of XLA again. Injected as JAX's native
     # JAX_COMPILATION_CACHE_DIR (serde camelCases the wire name).
     compilation_cache_dir: str = ""
+    # Disaggregated serving mode: Worker replicas become a routed
+    # prefill/decode fleet instead of an SPMD training gang.
+    serving: Optional[ServingSpec] = None
 
 
 @dataclass
@@ -145,7 +170,16 @@ class JAXJobController(BaseWorkloadController):
     def restart_whole_gang(self, job, replicas) -> bool:
         """Multi-worker SPMD jobs restart as a slice: every rank blocks in
         jax.distributed.initialize at startup, so a lone restarted worker
-        would hang against peers that are mid-run."""
+        would hang against peers that are mid-run.
+
+        Serving fleets are the exception: pods are independent routed
+        endpoints, not SPMD ranks — one dead decode pod must restart
+        ALONE while the router fails its streams over, never take the
+        whole fleet down with it (that would turn one pod crash into a
+        full-fleet outage, the exact failure-isolation the
+        disaggregated plane exists to prevent)."""
+        if getattr(getattr(job, "spec", None), "serving", None) is not None:
+            return False
         return sum(int(s.replicas or 0) for s in replicas.values()) > 1
 
     @property
@@ -178,6 +212,44 @@ class JAXJobController(BaseWorkloadController):
                 )
         elif job.spec.dcn_mesh is not None:
             errs.append("spec.dcnMesh requires spec.numSlices > 1")
+        srv = job.spec.serving
+        if srv is not None:
+            pf, dc = int(srv.prefill_replicas), int(srv.decode_replicas)
+            if pf < 1 or dc < 1:
+                errs.append(
+                    f"spec.serving needs >= 1 prefill and >= 1 decode "
+                    f"replica, got {pf}/{dc}")
+            elif pf + dc != workers:
+                errs.append(
+                    f"spec.serving prefillReplicas {pf} + decodeReplicas "
+                    f"{dc} must equal the Worker replica count {workers} "
+                    f"(roles are assigned by worker index)")
+            if ns > 1:
+                errs.append(
+                    "spec.serving is incompatible with spec.numSlices > 1 "
+                    "(serving pods are independent endpoints, not a "
+                    "multislice SPMD gang)")
+            if (srv.block_size < 1 or srv.max_len < 1
+                    or srv.max_len % srv.block_size):
+                errs.append(
+                    f"spec.serving maxLen {srv.max_len} must be a positive "
+                    f"multiple of blockSize {srv.block_size} (>= 1)")
+            if srv.slots < 1:
+                errs.append(
+                    f"spec.serving slots must be >= 1, got {srv.slots}")
+            if srv.kv_blocks != 0 and srv.kv_blocks < 2:
+                errs.append(
+                    f"spec.serving kvBlocks must be 0 (auto-size to the "
+                    f"contiguous cache's memory) or >= 2 (one block is "
+                    f"the reserved trash block), got {srv.kv_blocks}")
+            if srv.prefill_router != "shortest-queue":
+                errs.append(
+                    f"unknown spec.serving prefillRouter "
+                    f"{srv.prefill_router!r} (supported: shortest-queue)")
+            if srv.decode_router != "least-blocks":
+                errs.append(
+                    f"unknown spec.serving decodeRouter "
+                    f"{srv.decode_router!r} (supported: least-blocks)")
         sched = (job.spec.run_policy.scheduling_policy
                  if job.spec.run_policy else None)
         if sched is not None and sched.tpu_slice_fallbacks and (
@@ -230,6 +302,18 @@ class JAXJobController(BaseWorkloadController):
             # JAX's own min-compile-time default (1s) already skips
             # sub-second compiles — no need to override it here
             env["JAX_COMPILATION_CACHE_DIR"] = job.spec.compilation_cache_dir
+        srv = job.spec.serving
+        if srv is not None:
+            role = ("prefill" if index < int(srv.prefill_replicas)
+                    else "decode")
+            env["KUBEDL_SERVING_ROLE"] = role
+            env["KUBEDL_SERVING_SLOTS"] = str(srv.slots)
+            env["KUBEDL_SERVING_MAX_LEN"] = str(srv.max_len)
+            env["KUBEDL_SERVING_BLOCK_SIZE"] = str(srv.block_size)
+            env["KUBEDL_SERVING_KV_BLOCKS"] = str(srv.kv_blocks)
+            env["KUBEDL_SERVING_SHARE_PREFIXES"] = (
+                "1" if srv.share_prefixes else "0")
+            pod_template.metadata.labels[LABEL_SERVING_ROLE] = role
         common.add_env(pod_template, env)
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,

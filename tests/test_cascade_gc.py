@@ -138,15 +138,22 @@ def test_delete_running_job_reaps_pods_processes_and_slice():
             "running gang must hold its slice"
         )
 
-        # collect the live worker pids before pulling the trigger
-        with op.executor._lock:
-            pids = [
-                proc.pid
-                for key, entry in op.executor._running.items()
-                if "doomed-worker" in key
-                for proc in (entry.procs or {}).values()
-            ]
-        assert len(pids) == 2, f"expected 2 worker processes, saw pids={pids}"
+        # collect the live worker pids before pulling the trigger; the
+        # Running condition can land a beat before the second proc
+        # registers in _running, so wait for both rather than sampling
+        def _worker_pids():
+            with op.executor._lock:
+                return [
+                    proc.pid
+                    for key, entry in op.executor._running.items()
+                    if "doomed-worker" in key
+                    for proc in (entry.procs or {}).values()
+                ]
+
+        assert _wait(lambda: len(_worker_pids()) == 2, timeout=30), (
+            f"expected 2 worker processes, saw pids={_worker_pids()}"
+        )
+        pids = _worker_pids()
 
         op.store.delete("JAXJob", "default", "doomed")
 

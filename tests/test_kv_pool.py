@@ -1,0 +1,176 @@
+"""Paged-KV allocator properties (kubedl_tpu/serving/kv_pool.py).
+
+Host-side only — no jax, no model. The invariants here are the ones KV
+corruption bugs hide behind: conservation (free + in_use == total),
+no double-free, refcounted sharing, copy-on-write exclusivity, and the
+fragmentation bound (a block pool never loses capacity to churn —
+whatever is free is allocatable)."""
+import numpy as np
+import pytest
+
+from kubedl_tpu.serving.kv_pool import (
+    BlockPool,
+    PoolExhausted,
+    PrefixIndex,
+    table_to_rows,
+)
+
+
+def test_alloc_free_conservation():
+    pool = BlockPool(num_blocks=8, block_size=4)
+    assert pool.blocks_in_use == 1  # trash block is pinned
+    a = pool.alloc(3)
+    b = pool.alloc(2)
+    assert pool.blocks_in_use == 6
+    assert pool.blocks_free + pool.blocks_in_use == 8
+    assert len(set(a) | set(b)) == 5  # distinct blocks
+    assert 0 not in a + b  # trash never handed out
+    pool.free(a)
+    assert pool.blocks_free + pool.blocks_in_use == 8
+    assert pool.blocks_in_use == 3
+
+
+def test_double_free_raises():
+    pool = BlockPool(num_blocks=4, block_size=4)
+    a = pool.alloc(1)
+    pool.free(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free(a)
+    with pytest.raises(ValueError, match="trash"):
+        pool.free([0])
+
+
+def test_alloc_all_or_nothing():
+    pool = BlockPool(num_blocks=4, block_size=4)
+    pool.alloc(2)
+    free_before = pool.blocks_free
+    with pytest.raises(PoolExhausted):
+        pool.alloc(free_before + 1)
+    # a failed alloc must not leak partial grants
+    assert pool.blocks_free == free_before
+
+
+def test_refcounted_sharing():
+    pool = BlockPool(num_blocks=4, block_size=4)
+    [b] = pool.alloc(1)
+    pool.incref([b])
+    pool.free([b])  # first holder leaves
+    assert pool.refcount(b) == 1
+    assert pool.blocks_in_use == 2  # trash + b still referenced
+    pool.free([b])  # last holder leaves
+    assert pool.refcount(b) == 0
+    assert pool.blocks_in_use == 1
+
+
+def test_copy_on_write():
+    pool = BlockPool(num_blocks=6, block_size=4)
+    [b] = pool.alloc(1)
+    # exclusive: write in place
+    same, copied = pool.writable(b)
+    assert same == b and not copied
+    # shared: a fresh block comes back, the original keeps its refs
+    pool.incref([b])
+    new, copied = pool.writable(b)
+    assert copied and new != b and pool.refcount(new) == 1
+    assert pool.cow_copies == 1
+    # writable() on a free block is a caller bug, not a copy
+    [c] = pool.alloc(1)
+    pool.free([c])
+    with pytest.raises(ValueError, match="free block"):
+        pool.writable(c)
+
+
+def test_fragmentation_bound_under_churn():
+    """After arbitrary alloc/free churn, everything reported free is
+    allocatable in one call — blocks never leak or fragment away."""
+    rng = np.random.default_rng(0)
+    pool = BlockPool(num_blocks=32, block_size=8)
+    held = []
+    for _ in range(300):
+        if held and rng.random() < 0.5:
+            victim = held.pop(rng.integers(len(held)))
+            pool.free(victim)
+        else:
+            n = int(rng.integers(1, 5))
+            if n <= pool.blocks_free:
+                held.append(pool.alloc(n))
+        assert pool.blocks_free + pool.blocks_in_use == 32
+    for v in held:
+        pool.free(v)
+    assert pool.blocks_in_use == 1  # only the trash block
+    got = pool.alloc(pool.blocks_free)
+    assert len(got) == 31
+
+
+def test_prefix_index_match_and_cap():
+    pool = BlockPool(num_blocks=16, block_size=4)
+    idx = PrefixIndex(pool)
+    prompt = np.arange(1, 13, dtype=np.int32)  # 12 tokens = 3 full blocks
+    table = pool.alloc(3)
+    assert idx.insert(prompt, table) == 3
+    # identical prompt: matches at most floor((12-1)/4) = 2 blocks — one
+    # token must remain for the prefill to produce first-token logits
+    m = idx.match(prompt)
+    assert m == table[:2]
+    # original table ref + index ref + the match's caller ref
+    assert all(pool.refcount(b) == 3 for b in m)
+    pool.free(m)
+    # longer prompt sharing the prefix matches all 3 indexed blocks
+    longer = np.concatenate([prompt, np.asarray([7, 8, 9], np.int32)])
+    m2 = idx.match(longer)
+    assert m2 == table
+    pool.free(m2)
+    # diverging prompt matches only the common full blocks
+    div = prompt.copy()
+    div[5] = 99  # breaks block 1 (tokens 4..7)
+    m3 = idx.match(div)
+    assert m3 == table[:1]
+    pool.free(m3)
+    assert idx.hit_rate() > 0
+
+
+def test_prefix_index_lru_release():
+    pool = BlockPool(num_blocks=8, block_size=2)
+    idx = PrefixIndex(pool)
+    p1 = np.asarray([1, 2, 3, 4], np.int32)
+    p2 = np.asarray([5, 6, 7, 8], np.int32)
+    t1, t2 = pool.alloc(2), pool.alloc(2)
+    idx.insert(p1, t1)
+    idx.insert(p2, t2)
+    pool.free(t1)
+    pool.free(t2)  # only the index holds them now
+    assert pool.blocks_in_use == 5
+    idx.match(p2)  # touch p2 so p1 is the LRU victim
+    pool.free(idx.match(p2) or [])
+    released = idx.release_lru(2)
+    assert released == 2
+    assert len(idx) == 2  # p2's entries survive
+    m = idx.match(p1)
+    assert m == []  # p1's chain is gone
+
+
+def test_index_eviction_never_breaks_live_tables():
+    """Release skips entries a live table still references (dropping
+    them frees no block now and forfeits future hits), reports only
+    blocks ACTUALLY returned, and reclaims once the table lets go."""
+    pool = BlockPool(num_blocks=8, block_size=2)
+    idx = PrefixIndex(pool)
+    prompt = np.asarray([1, 2, 3, 4, 5], np.int32)
+    table = pool.alloc(3)
+    idx.insert(prompt, table)  # indexes the 2 full blocks
+    assert idx.release_lru(10) == 0  # all entries shared with the table
+    assert len(idx) == 2  # cache value kept: nothing freed, nothing lost
+    assert all(pool.refcount(b) >= 1 for b in table)
+    pool.free(table)  # request done; indexed blocks now index-only
+    assert pool.blocks_in_use == 3  # trash + the 2 cached prefix blocks
+    assert idx.release_lru(10) == 2
+    assert len(idx) == 0
+    assert pool.blocks_in_use == 1
+
+
+def test_table_to_rows():
+    rows = table_to_rows([3, 1], block_size=4, max_len=16)
+    assert rows.shape == (16,)
+    assert list(rows[:4]) == [12, 13, 14, 15]  # block 3
+    assert list(rows[4:8]) == [4, 5, 6, 7]  # block 1
+    assert all(r == 0 for r in rows[8:])  # unmapped -> trash rows

@@ -1,0 +1,168 @@
+"""Operator-routed serving fleet: JAXJob spec.serving reconciles Worker
+replicas into prefill/decode ROLES (labels + KUBEDL_SERVING_* env),
+restarts pods individually instead of as a gang, and surfaces fleet
+state + drain through server.py."""
+import json
+import urllib.request
+
+import pytest
+
+from kubedl_tpu.api.common import (
+    ANNOTATION_SERVING_DRAIN,
+    LABEL_SERVING_ROLE,
+)
+from kubedl_tpu.operator import Operator, OperatorConfig
+from kubedl_tpu.server import OperatorHTTPServer
+from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+
+def _manifest(name="fleet", workers=3, prefill=1, decode=2, **srv):
+    return {
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "jaxReplicaSpecs": {"Worker": {"replicas": workers, "template": {
+                "spec": {"containers": [{
+                    "name": "jax", "image": "x",
+                    "command": ["python", "-c", "import time; time.sleep(5)"],
+                }]}}}},
+            "serving": {"prefillReplicas": prefill, "decodeReplicas": decode,
+                        "slots": 4, "maxLen": 64, "blockSize": 16, **srv},
+        },
+    }
+
+
+@pytest.fixture()
+def op():
+    operator = Operator(OperatorConfig())
+    operator.register_all()
+    operator.start()
+    yield operator
+    operator.stop()
+
+
+def _wait_pods(op, n, timeout=15.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods = op.store.list("Pod")
+        if len(pods) >= n:
+            return pods
+        time.sleep(0.05)
+    raise AssertionError(f"expected {n} pods, have {len(op.store.list('Pod'))}")
+
+
+def test_fleet_roles_env_and_labels(op):
+    op.apply(_manifest())
+    pods = sorted(_wait_pods(op, 3), key=lambda p: p.metadata.name)
+    roles = [(p.metadata.labels or {}).get(LABEL_SERVING_ROLE) for p in pods]
+    assert roles == ["prefill", "decode", "decode"]  # by worker index
+    for p, role in zip(pods, roles):
+        env = {}
+        for c in p.spec.containers:
+            for e in (c.env or []):
+                if hasattr(e, "name"):
+                    env[e.name] = e.value
+                else:
+                    env[e] = (c.env or {}).get(e)
+        assert env.get("KUBEDL_SERVING_ROLE") == role
+        assert env.get("KUBEDL_SERVING_SLOTS") == "4"
+        assert env.get("KUBEDL_SERVING_MAX_LEN") == "64"
+        assert env.get("KUBEDL_SERVING_BLOCK_SIZE") == "16"
+
+
+def test_fleet_pods_restart_alone():
+    """A serving fleet must NOT gang-restart: one dead decode pod
+    restarts by itself while the router fails over its streams — the
+    monolithic alternative (restart everything) is the admission-wave
+    blast radius this subsystem exists to remove."""
+    from kubedl_tpu.utils.serde import from_dict
+    from kubedl_tpu.workloads.jaxjob import JAXJob
+
+    ctl = JAXJobController()
+    serving_job = from_dict(JAXJob, _manifest())
+    train_job = from_dict(JAXJob, {
+        "kind": "JAXJob", "metadata": {"name": "train"},
+        "spec": {"jaxReplicaSpecs": {"Worker": {"replicas": 3}}}})
+    replicas = serving_job.spec.replica_specs
+    assert ctl.restart_whole_gang(serving_job, replicas) is False
+    assert ctl.restart_whole_gang(
+        train_job, train_job.spec.replica_specs) is True
+
+
+@pytest.mark.parametrize("patch,needle", [
+    ({"prefillReplicas": 2, "decodeReplicas": 2}, "must equal the Worker"),
+    ({"prefillReplicas": 0, "decodeReplicas": 3}, ">= 1 prefill"),
+    ({"maxLen": 60}, "multiple of blockSize"),
+    ({"maxLen": 0}, "multiple of blockSize"),
+    ({"maxLen": -32, "blockSize": 16}, "multiple of blockSize"),
+    ({"slots": 0}, "slots must be >= 1"),
+    ({"kvBlocks": 1}, "kvBlocks must be 0"),
+    ({"decodeRouter": "round-robin"}, "unknown spec.serving decodeRouter"),
+])
+def test_fleet_validation(op, patch, needle):
+    m = _manifest()
+    m["spec"]["serving"].update(patch)
+    with pytest.raises(Exception, match=needle):
+        op.apply(m)
+
+
+def test_router_submit_validates_sampling():
+    """The router is a third submit entry point next to ServingEngine and
+    DisaggregatedEngine; it must reject what they reject — an unvalidated
+    top_k would silently clamp inside sample_tokens, and top_p=0 would
+    deterministically emit candidate 0 instead of erroring."""
+    import jax
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.router import DecodePod, PrefillPod, ServingRouter
+
+    cfg = llama.LlamaConfig.tiny(use_flash=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    r = ServingRouter(
+        [PrefillPod("p0", params, cfg, max_len=64)],
+        [DecodePod("d0", params, cfg, slots=2, max_len=64, block_size=8)])
+    prompt = np.arange(1, 6, dtype=np.int32)
+    for kwargs, needle in [
+        ({"temperature": -1.0}, "temperature"),
+        ({"top_k": r.max_top_k + 1}, "top_k"),
+        ({"top_p": 0.0}, "top_p"),
+    ]:
+        with pytest.raises(ValueError, match=needle):
+            r.submit(prompt, 4, **kwargs)
+
+
+def test_fleet_endpoint_and_drain(op):
+    op.apply(_manifest())
+    pods = _wait_pods(op, 3)
+    srv = OperatorHTTPServer(op, port=0)
+    port = srv.start()
+    try:
+        fleet = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/serving/fleet"))
+        entry = fleet["fleets"]["default/fleet"]
+        assert len(entry["prefill"]) == 1 and len(entry["decode"]) == 2
+        assert not any(p["draining"]
+                       for p in entry["prefill"] + entry["decode"])
+        victim = entry["decode"][0]["name"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/serving/drain/default/{victim}",
+            method="POST", data=b"")
+        out = json.load(urllib.request.urlopen(req))
+        assert out["draining"] == f"default/{victim}"
+        pod = op.store.get("Pod", "default", victim)
+        assert ANNOTATION_SERVING_DRAIN in (pod.metadata.annotations or {})
+        fleet2 = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/serving/fleet"))
+        assert any(p["draining"]
+                   for p in fleet2["fleets"]["default/fleet"]["decode"])
+        # draining an unknown pod is a 404, not a silent annotation
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/serving/drain/default/nope",
+            method="POST", data=b"")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(bad)
+    finally:
+        srv.stop()
