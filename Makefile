@@ -21,7 +21,8 @@ presubmit:
 	  --total tests/test_gmm_moe.py=60 \
 	  --total tests/test_kv_pool.py=30 \
 	  --total tests/test_serving_disagg.py=120 \
-	  --total tests/test_serving_fleet.py=60
+	  --total tests/test_serving_fleet.py=60 \
+	  --total tests/test_reshard.py=45
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -40,6 +41,13 @@ bench-moe:
 .PHONY: bench-serving
 bench-serving:
 	$(PY) bench.py --serving-only
+
+# Resize-only fast loop: the resize_downtime record — live reshard vs
+# checkpoint-restore downtime for the same shrink/grow on the same model
+# (merges ONLY the resize key into .bench_extras.json).
+.PHONY: bench-resize
+bench-resize:
+	$(PY) bench.py --resize-only
 
 .PHONY: manifests
 manifests:

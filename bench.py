@@ -999,6 +999,144 @@ def _tpu_child(results_path: str) -> int:
         del state, params
         return mfu
 
+    # -- live reshard vs checkpoint round trip (ISSUE 8): the SAME model
+    # resizes between an n-device and an n/2-device mesh two ways — the
+    # live plane (quiesce -> reshard_state -> rebuild -> first step) and
+    # the Orbax path (save -> restore into the new sharding -> rebuild ->
+    # first step). The checkpoint number EXCLUDES pod recreate +
+    # re-admission, so the real-world gap is wider than the ratio here. --
+    def resize_downtime_milestone():
+        import shutil
+        import tempfile
+
+        import optax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+        from kubedl_tpu.parallel.train_step import make_train_step
+        from kubedl_tpu.train import reshard_runtime
+
+        devs = jax.devices()
+        n = 1
+        while n * 2 <= len(devs):
+            n *= 2
+        if n < 2:
+            _emit(out, "resize_downtime",
+                  {"skipped": f"needs >=2 devices, have {len(devs)}"})
+            return
+        half = n // 2
+        # enough state (tens of MB on the smoke lane) that the resize cost
+        # is byte-dominated, not fixed-overhead-dominated
+        config = (llama.LlamaConfig.tiny(
+            vocab_size=2048, d_model=256, n_layers=4, d_ff=512)
+            if small else llama.LlamaConfig.config_for("bench-150m"))
+        batch, seq = (8, 128) if small else (8, 512)
+        rules = ShardingRules()
+        tx = optax.adamw(3e-4, weight_decay=0.01)
+        spec_tree = llama.param_specs(config, rules)
+
+        def build(mesh):
+            def loss(p, b):
+                return llama.loss_fn(p, b, config, mesh=mesh, rules=rules)
+
+            return make_train_step(
+                loss, tx, mesh, spec_tree, rules.spec("batch", None), rules)
+
+        tokens = np.random.default_rng(0).integers(
+            0, config.vocab_size, (batch, seq), dtype=np.int32)
+        batch_arr = jnp.asarray(tokens)
+
+        # Both paths pay the IDENTICAL new-mesh compile on a resize (and
+        # checkpoint restarts replay it from the persistent compile
+        # cache), so both meshes are warmed up-front and each timed
+        # window measures the path's OWN cost: state movement for the
+        # live plane, the durable save+restore round trip for Orbax.
+        mesh_a = build_mesh({"data": n}, devices=devs[:n])
+        mesh_b = build_mesh({"data": half}, devices=devs[:half])
+        init_a, step_a = build(mesh_a)
+        init_b, step_b = build(mesh_b)
+        params0 = llama.init(config, jax.random.PRNGKey(0))
+        warm_b = init_b(params0)
+        warm_b, m = step_b(warm_b, batch_arr)
+        jax.device_get(m["loss"])
+        del warm_b
+        state = init_a(params0)
+        for _ in range(2):  # settle + compile the steady path
+            state, m = step_a(state, batch_arr)
+        jax.device_get(m["loss"])
+        before = [np.asarray(jax.device_get(x))
+                  for x in jax.tree_util.tree_leaves(state)]
+
+        # Downtime definition (both paths identically): quiesce -> the
+        # FULL TrainState resident on the destination mesh, a train step
+        # dispatchable. The first post-resize step is ordinary training
+        # (paid in either path) and is run UNTIMED afterwards to prove
+        # trainability.
+        # live shrink n -> n/2
+        t0 = time.perf_counter()
+        _mesh_b2, state_b, plan = reshard_runtime.live_resize(
+            state, mesh_a, half)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state_b))
+        live_shrink_s = time.perf_counter() - t0
+        after = [np.asarray(jax.device_get(x))
+                 for x in jax.tree_util.tree_leaves(state_b)]
+        bitwise = all(
+            a.tobytes() == b.tobytes() for a, b in zip(before, after))
+        state_b, m = step_b(state_b, batch_arr)
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+
+        # live grow n/2 -> n
+        t0 = time.perf_counter()
+        _mesh_c, state_c, _ = reshard_runtime.live_resize(
+            state_b, mesh_b, n)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state_c))
+        live_grow_s = time.perf_counter() - t0
+        state_c, m = step_a(state_c, batch_arr)
+        assert np.isfinite(float(jax.device_get(m["loss"])))
+
+        # checkpoint round trip on the SAME model/resize: durable save,
+        # restart-style template init, restore into the n/2-mesh
+        # sharding — what a resize costs without the live plane (pod
+        # recreate + re-admission excluded)
+        import orbax.checkpoint as ocp
+
+        ckpt_dir = tempfile.mkdtemp(prefix="bench-resize-ckpt-")
+        try:
+            t0 = time.perf_counter()
+            mngr = ocp.CheckpointManager(ckpt_dir)
+            mngr.save(0, args=ocp.args.StandardSave(state_c))
+            mngr.wait_until_finished()
+            template = init_b(params0)
+            abstract = jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, template)
+            restored = mngr.restore(
+                0, args=ocp.args.StandardRestore(abstract))
+            jax.block_until_ready(jax.tree_util.tree_leaves(restored))
+            ckpt_restore_s = time.perf_counter() - t0
+            restored, m = step_b(restored, batch_arr)
+            assert np.isfinite(float(jax.device_get(m["loss"])))
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+        _emit(out, "resize_downtime", {
+            "devices": n,
+            "shrink_to": half,
+            "model": "tiny" if small else "150m",
+            "live_shrink_s": round(live_shrink_s, 3),
+            "live_grow_s": round(live_grow_s, 3),
+            "ckpt_restore_s": round(ckpt_restore_s, 3),
+            "live_over_ckpt_ratio": round(
+                max(live_shrink_s, live_grow_s) / ckpt_restore_s, 4),
+            "bitwise_identical": bitwise,
+            "moved_mb": round(plan.moved_bytes / 2**20, 3),
+            "state_mb": round(plan.total_bytes / 2**20, 3),
+            "environment": "in-process; downtime = quiesce -> full state "
+                           "resident on the new mesh (both paths; meshes "
+                           "pre-compiled — the new-mesh compile is "
+                           "identical in both); ckpt path excludes pod "
+                           "recreate + re-admission (real gap is wider)",
+        })
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -1012,6 +1150,7 @@ def _tpu_child(results_path: str) -> int:
         ("serving_mixed", serving_mixed_milestone, 150),
         ("serving_spec", serving_spec_milestone, 150),
         ("serving_latency", serving_latency_milestone, 150),
+        ("resize_downtime", resize_downtime_milestone, 120),
         ("grpo", grpo_milestone, 150),
     ]
     # -- 6. MoE dispatch-overhead breakdown: per-stage timing of the
@@ -1316,6 +1455,38 @@ def _serving_only() -> int:
     return rc
 
 
+def _resize_only() -> int:
+    """`bench.py --resize-only` (make bench-resize): run ONLY the
+    resize_downtime record — live reshard vs checkpoint round trip on the
+    same model — and merge JUST that key into .bench_extras.json (same
+    guarded-merge discipline as --serving-only: a CPU smoke run must
+    never clobber the chip's committed peak/probe/progress records)."""
+    os.environ.setdefault("KUBEDL_BENCH_ONLY", "resize_downtime")
+    if os.environ.get("KUBEDL_BENCH_SMALL"):
+        # CPU smoke lane: 8 host devices so the n -> n/2 resize exercises
+        # a real multi-device mesh (must land before the jax import)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    results_path = os.path.join(REPO, ".bench_results_resize.jsonl")
+    open(results_path, "w").close()
+    rc = _tpu_child(results_path)
+    records = _parse_results(results_path)
+    extras_path = os.path.join(REPO, ".bench_extras.json")
+    try:
+        with open(extras_path) as f:
+            extras = json.load(f)
+    except (OSError, ValueError):
+        extras = {}
+    extras.update({k: v for k, v in records.items()
+                   if k == "resize_downtime"})
+    with open(extras_path, "w") as f:
+        json.dump(extras, f, indent=1, sort_keys=True)
+    print(json.dumps(records, indent=1, sort_keys=True))
+    return rc
+
+
 def main() -> int:
     if len(sys.argv) > 2 and sys.argv[1] == "--tpu-child":
         return _tpu_child(sys.argv[2])
@@ -1323,6 +1494,8 @@ def main() -> int:
         return _moe_only()
     if "--serving-only" in sys.argv:
         return _serving_only()
+    if "--resize-only" in sys.argv:
+        return _resize_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

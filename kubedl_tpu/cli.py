@@ -370,6 +370,18 @@ def _print_capacity_tenants(cap) -> None:
     print(f"capacity scheduler: policy={cap.get('policy')} "
           f"preemptions={cap.get('preemptions_total', 0)} "
           f"resizes={cap.get('resizes_total', 0)}")
+    reshards = cap.get("reshards_total")
+    if reshards is not None:
+        downtime = cap.get("resize_downtime") or {}
+        n = downtime.get("count", 0)
+        mean = (downtime.get("sum", 0.0) / n) if n else 0.0
+        print(f"live reshards: ok={reshards.get('ok', 0)} "
+              f"staged={reshards.get('staged', 0)} "
+              f"fallback={reshards.get('fallback', 0)} "
+              f"failed={reshards.get('failed', 0)} "
+              f"pending={cap.get('reshards_pending', 0)} "
+              f"downtime last={downtime.get('last', 0.0):.2f}s "
+              f"mean={mean:.2f}s")
     rows = [("TENANT", "WEIGHT", "CHIPS", "FAIR_SHARE", "SHARE", "CAP",
              "CHIP_S", "PREEMPTED")]
     for tenant, t in sorted((cap.get("tenants") or {}).items()):

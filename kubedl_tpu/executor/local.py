@@ -70,6 +70,13 @@ class LocalPodExecutor:
         # container stdout/stderr land here (kubectl-logs equivalent),
         # appended across in-place restarts, removed when the pod is deleted
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="kubedl-logs-")
+        # per-pod control channel (the local analog of a sidecar/ConfigMap
+        # watch): the scheduler posts JSON messages (live-reshard RESIZE,
+        # sched/capacity.py) into the pod's dir, injected as
+        # KUBEDL_CONTROL_DIR; the workload replies next to the message.
+        # Survives in-place restarts, removed with the pod.
+        self.control_root = tempfile.mkdtemp(prefix="kubedl-ctl-")
+        self._control_seq = 0
         self._running: Dict[str, _RunningPod] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -105,6 +112,37 @@ class LocalPodExecutor:
             # tail=0 means "no lines" (kubectl semantics); [-0:] would be all
             text = "\n".join(text.splitlines()[-tail:]) if tail > 0 else ""
         return text
+
+    # -- control channel -------------------------------------------------
+
+    def control_dir(self, namespace: str, name: str) -> str:
+        d = os.path.join(self.control_root, f"{namespace}_{name}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def post_control(self, namespace: str, name: str, message: Dict) -> Optional[str]:
+        """Post a control message to a RUNNING pod; returns the absolute
+        reply path the workload will write (reshard_runtime.ReshardControl
+        conventions), or None when the pod is not running here. Atomic
+        tmp+rename so the poller never parses a half-written message."""
+        with self._lock:
+            if f"{namespace}/{name}" not in self._running:
+                return None
+            self._control_seq += 1
+            seq = self._control_seq
+        d = self.control_dir(namespace, name)
+        msg = dict(message)
+        msg.setdefault("reply", f"reply-{seq:06d}.json")
+        tmp = os.path.join(d, f".msg-{seq:06d}.json.tmp")
+        try:
+            with open(tmp, "w") as f:
+                import json
+
+                json.dump(msg, f)
+            os.replace(tmp, os.path.join(d, f"msg-{seq:06d}.json"))
+        except OSError:
+            return None
+        return os.path.join(d, msg["reply"])
 
     # -- lifecycle -------------------------------------------------------
 
@@ -142,6 +180,13 @@ class LocalPodExecutor:
                 shutil.rmtree(
                     self._pod_log_dir(
                         ev.obj.metadata.namespace, ev.obj.metadata.name
+                    ),
+                    ignore_errors=True,
+                )
+                shutil.rmtree(
+                    os.path.join(
+                        self.control_root,
+                        f"{ev.obj.metadata.namespace}_{ev.obj.metadata.name}",
                     ),
                     ignore_errors=True,
                 )
@@ -315,6 +360,8 @@ class LocalPodExecutor:
         env.update(container.env)
         env["POD_NAME"] = pod.metadata.name
         env["POD_NAMESPACE"] = pod.metadata.namespace
+        env["KUBEDL_CONTROL_DIR"] = self.control_dir(
+            pod.metadata.namespace, pod.metadata.name)
         for k, v in pod.metadata.labels.items():
             env[f"KUBEDL_LABEL_{k.upper().replace('-', '_')}"] = v
         if placement is not None:

@@ -15,6 +15,29 @@ from kubedl_tpu.api.common import ReplicaSpec
 ANNOTATION_GANG_NAME = "kubedl.io/gang-name"
 
 
+def gang_pods(store, gang_key: str, kind: str = "") -> List:
+    """The live pods of one gang — the ONE pod-selection used by every
+    path that messages or deletes a gang's pods (capacity scheduler,
+    operator slice-failure handling). Gang keys are ns/name, so a
+    same-named job of ANOTHER kind carries the identical annotation: the
+    controller-ref kind guard keeps other jobs' pods untouched. Returns
+    [] when the listing fails (callers treat that as "cannot act")."""
+    namespace = gang_key.partition("/")[0]
+    try:
+        pods = store.list("Pod", namespace=namespace)
+    except Exception:  # noqa: BLE001 — store racing shutdown
+        return []
+    out = []
+    for pod in pods:
+        if pod.metadata.annotations.get(ANNOTATION_GANG_NAME) != gang_key:
+            continue
+        ref = pod.metadata.controller_ref()
+        if kind and (ref is None or ref.kind != kind):
+            continue
+        out.append(pod)
+    return out
+
+
 @dataclass
 class GangSnapshot:
     """Read-only copy of one gang's scheduling state, safe to inspect
@@ -35,6 +58,13 @@ class GangSnapshot:
     preemptions: int = 0
     waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
     granted_at: float = 0.0  # monotonic; when the current reservation was made
+    # live-reshard opt-in (JAXJob spec.elastic.liveReshard): resizes may be
+    # executed as an in-place RESIZE control message to the running pods
+    # instead of checkpoint-then-evict (sched/capacity.py)
+    live_reshard: bool = False
+    # the job's declared quiesce budget (spec.elastic.quiesceTimeoutS;
+    # 0 = use the scheduler default) — the reply deadline must cover it
+    quiesce_s: float = 0.0
 
     @property
     def namespace(self) -> str:

@@ -106,6 +106,8 @@ class _GangState:
     preemptions: int = 0  # times this gang was evicted by directive
     waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
     granted_at: float = 0.0  # monotonic; when the current reservation was made
+    live_reshard: bool = False  # spec.elastic.liveReshard opt-in
+    quiesce_s: float = 0.0  # spec.elastic.quiesceTimeoutS (0 = unset)
 
     def held(self, now: Optional[float] = None) -> bool:
         return self.hold_until > (time.monotonic() if now is None else now)
@@ -156,6 +158,10 @@ class TPUSliceAdmitter(GangScheduler):
         # victim's pods confirm exit (see evict_gang / release)
         self._drains: Dict[str, _Drain] = {}
         self.drain_timeout = drain_timeout
+        # slices reported dead (slice_failed): never re-granted; dropped
+        # from the pool once their drain completes — the chips release
+        # exactly once, through the same accounting as an eviction
+        self._dead: set = set()
 
     @staticmethod
     def _drain_marker(gang_key: str) -> str:
@@ -212,6 +218,8 @@ class TPUSliceAdmitter(GangScheduler):
                 pod_key: sname for pod_key, sname in self._solo.items()
                 if sname in new and sname not in invalidated
             }
+            # a re-provisioned pool supersedes stale death reports
+            self._dead &= set(new) - invalidated
             # drains only track slices that still exist in the pool; a
             # drain whose every slice vanished has nothing left to hold
             for gk in list(self._drains):
@@ -301,6 +309,7 @@ class TPUSliceAdmitter(GangScheduler):
                     for s in replicas.values()
                 )
                 num_slices = max(int(getattr(job.spec, "num_slices", 1) or 1), 1)
+                elastic = getattr(job.spec, "elastic", None)
                 self._seq += 1
                 state = _GangState(
                     min_member=min_member, tpu_chips=chips,
@@ -311,6 +320,9 @@ class TPUSliceAdmitter(GangScheduler):
                     tenant=(tenancy.tenant if tenancy else "") or "default",
                     admissible_slices=admissible,
                     waiting_since=time.monotonic(),
+                    live_reshard=bool(getattr(elastic, "live_reshard", False)),
+                    quiesce_s=float(
+                        getattr(elastic, "quiesce_timeout_s", 0.0) or 0.0),
                 )
                 self._gangs[key] = state
             self._reserve_waiting()
@@ -393,7 +405,11 @@ class TPUSliceAdmitter(GangScheduler):
             if slice_name:
                 info = self._slices.get(slice_name)
                 if info and info.reserved_by == key:
-                    info.reserved_by = None
+                    if slice_name in self._dead:
+                        self._dead.discard(slice_name)
+                        del self._slices[slice_name]
+                    else:
+                        info.reserved_by = None
             # drain confirmation: the executor calls release() only
             # AFTER the pod's processes exited (SIGTERM grace included),
             # so the last confirmation proves the victim stopped
@@ -408,6 +424,19 @@ class TPUSliceAdmitter(GangScheduler):
         # Gang reservations outlive individual pods (restarts keep the
         # slice); they free on delete_gang.
 
+    def _free_drained_slice(self, sname: str, marker: str) -> None:
+        """Free one drained slice (under the lock). A slice reported DEAD
+        leaves the pool here instead of freeing — its chips release
+        exactly once, through this single choke point."""
+        info = self._slices.get(sname)
+        if info is None or info.reserved_by != marker:
+            return
+        if sname in self._dead:
+            self._dead.discard(sname)
+            del self._slices[sname]
+        else:
+            info.reserved_by = None
+
     def _finish_drain(self, gang_key: str) -> List[str]:
         """Free a completed drain's slices (under the lock) and run a
         reservation pass — the successor takes over only now. Returns
@@ -417,9 +446,7 @@ class TPUSliceAdmitter(GangScheduler):
             return []
         marker = self._drain_marker(gang_key)
         for sname in drain.slices:
-            info = self._slices.get(sname)
-            if info is not None and info.reserved_by == marker:
-                info.reserved_by = None
+            self._free_drained_slice(sname, marker)
         return self._reserve_waiting()
 
     def _expire_drains(self, now: float) -> None:
@@ -431,9 +458,78 @@ class TPUSliceAdmitter(GangScheduler):
             drain = self._drains.pop(gk)
             marker = self._drain_marker(gk)
             for sname in drain.slices:
-                info = self._slices.get(sname)
-                if info is not None and info.reserved_by == marker:
-                    info.reserved_by = None
+                self._free_drained_slice(sname, marker)
+
+    def confirm_drain(self, gang_key: str) -> None:
+        """Finish a gang's drain early: the capacity scheduler calls this
+        when a live reshard's replies prove the gang is running on its NEW
+        slices — the old ones can free without waiting for pod exits that
+        will never come (the pods did not restart)."""
+        with self._lock:
+            changed = self._finish_drain(gang_key)
+        for k in changed:
+            self._remirror_podgroup_status(k)
+
+    def slice_failed(self, slice_name: str) -> Optional[str]:
+        """Executor/inventory report: a pool slice died mid-run. The dead
+        slice's chips release ONLY ONCE, through the eviction drain
+        accounting: the slice parks as `drain:<owner>` (deadline-only —
+        live-resharding pods never exit, so pod confirmations cannot
+        close it) and leaves the pool when the drain completes. The owning
+        gang loses its ENTIRE reservation (all-or-nothing holds for
+        revocation) and goes back to waiting; the capacity scheduler then
+        offers a live shrink to a declared fallback shape instead of
+        whole-gang eviction. Returns the owning gang key (None for free /
+        solo / unknown slices)."""
+        changed: List[str] = []
+        gang_key: Optional[str] = None
+        with self._lock:
+            info = self._slices.get(slice_name)
+            if info is None:
+                return None
+            owner = info.reserved_by
+            if owner is None:
+                # free slice died: nothing drains, drop it now
+                del self._slices[slice_name]
+                self._dead.discard(slice_name)
+            elif isinstance(owner, str) and owner.startswith("drain:"):
+                # already draining (eviction in flight): just mark dead so
+                # the drain completion drops it instead of re-granting
+                self._dead.add(slice_name)
+            elif owner in self._gangs:
+                gang_key = owner
+                state = self._gangs[owner]
+                self._dead.add(slice_name)
+                info.reserved_by = self._drain_marker(owner)
+                drain = self._drains.get(owner)
+                deadline = time.monotonic() + self.drain_timeout
+                if drain is None:
+                    self._drains[owner] = _Drain(
+                        slices=[slice_name], pods=None, deadline=deadline)
+                else:
+                    if slice_name not in drain.slices:
+                        drain.slices.append(slice_name)
+                    drain.pods = None  # deadline-only: pods stay alive
+                    drain.deadline = max(drain.deadline, deadline)
+                # all-or-nothing: survivors free, the gang re-reserves as
+                # a whole (possibly at a fallback shape)
+                for sname in state.slice_names:
+                    if sname == slice_name:
+                        continue
+                    surv = self._slices.get(sname)
+                    if surv is not None and surv.reserved_by == owner:
+                        surv.reserved_by = None
+                state.slice_names = []
+                state.waiting_since = time.monotonic()
+                changed.append(owner)
+            else:
+                # solo-pod reservation: mark dead; release() drops it when
+                # the pod goes away (deadline-free — the pod owns no gang)
+                self._dead.add(slice_name)
+            changed.extend(self._reserve_waiting())
+        for k in changed:
+            self._remirror_podgroup_status(k)
+        return gang_key
 
     def draining(self) -> Dict[str, List[str]]:
         """Gang key -> slice names still in the eviction drain phase
@@ -779,6 +875,8 @@ class TPUSliceAdmitter(GangScheduler):
             preemptions=state.preemptions,
             waiting_since=state.waiting_since,
             granted_at=state.granted_at,
+            live_reshard=state.live_reshard,
+            quiesce_s=state.quiesce_s,
         )
 
     def _usage_by_tenant(self) -> "tuple[Dict[str, int], int]":

@@ -200,6 +200,45 @@ class RuntimeMetrics:
                 lines.append(f"kubedl_preemptions_total {cap['preemptions_total']}")
                 lines.append("# TYPE kubedl_elastic_resizes_total counter")
                 lines.append(f"kubedl_elastic_resizes_total {cap['resizes_total']}")
+                reshards = cap.get("reshards_total")
+                if reshards is not None:
+                    lines.append("# HELP kubedl_reshards_total Live "
+                                 "reshards by outcome "
+                                 "(ok|staged|fallback|failed)")
+                    lines.append("# TYPE kubedl_reshards_total counter")
+                    for outcome in ("ok", "staged", "fallback", "failed"):
+                        lines.append(
+                            f'kubedl_reshards_total{{outcome='
+                            f'"{_label(outcome)}"}} '
+                            f'{reshards.get(outcome, 0)}')
+                downtime = cap.get("resize_downtime")
+                if downtime is not None:
+                    lines.append("# HELP kubedl_resize_downtime_last_seconds "
+                                 "Most recent live-reshard downtime")
+                    lines.append(
+                        "# TYPE kubedl_resize_downtime_last_seconds gauge")
+                    lines.append(
+                        f"kubedl_resize_downtime_last_seconds "
+                        f"{downtime['last']:.4f}")
+                    lines.append("# HELP kubedl_resize_downtime_seconds "
+                                 "Live-reshard downtime distribution")
+                    lines.append(
+                        "# TYPE kubedl_resize_downtime_seconds histogram")
+                    cum = 0
+                    for le, n in downtime["buckets"]:
+                        cum += n
+                        lines.append(
+                            f'kubedl_resize_downtime_seconds_bucket'
+                            f'{{le="{le}"}} {cum}')
+                    lines.append(
+                        f'kubedl_resize_downtime_seconds_bucket{{le="+Inf"}} '
+                        f'{downtime["count"]}')
+                    lines.append(
+                        f"kubedl_resize_downtime_seconds_sum "
+                        f"{downtime['sum']:.4f}")
+                    lines.append(
+                        f"kubedl_resize_downtime_seconds_count "
+                        f"{downtime['count']}")
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:

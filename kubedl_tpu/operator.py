@@ -149,6 +149,11 @@ class Operator:
         if self.config.run_executor:
             scheduler = self._gang if self.config.tpu_slices else None
             self.executor = LocalPodExecutor(self.store, scheduler=scheduler)
+        if self.capacity_scheduler is not None and self.executor is not None:
+            # live-reshard control channel: the scheduler posts RESIZE
+            # messages into running pods through the executor (kube mode
+            # has no channel yet — resizes take the checkpoint path there)
+            self.capacity_scheduler.attach_control(self.executor.post_control)
         self.reconcilers: Dict[str, JobReconciler] = {}
         self._kind_by_lower: Dict[str, str] = {}
         self._started = False
@@ -351,6 +356,33 @@ class Operator:
                 stopper()
 
     # -- client-ish helpers ---------------------------------------------
+
+    def report_slice_failure(self, slice_name: str) -> None:
+        """A pool slice died mid-run (hardware fault / maintenance). With
+        a capacity scheduler, the owning gang is offered a live shrink to
+        a declared fallback shape (fault tolerance as cheap shrink,
+        docs/scheduling.md); otherwise the dead slice drains out of the
+        pool and the gang's pods take the checkpoint-evict path."""
+        if self.capacity_scheduler is not None:
+            self.capacity_scheduler.slice_failed(slice_name)
+            return
+        if isinstance(self._gang, TPUSliceAdmitter):
+            gang_key = self._gang.slice_failed(slice_name)
+            if gang_key is None:
+                return
+            # no scheduler to orchestrate a live shrink: checkpoint-evict
+            # (shared kind-guarded pod selection — gang/interface.py)
+            from kubedl_tpu.gang.interface import gang_pods
+
+            namespace, _, name = gang_key.partition("/")
+            state = self._gang.get_gang(namespace, name)
+            kind = getattr(state, "kind", "") if state is not None else ""
+            for pod in gang_pods(self.store, gang_key, kind):
+                try:
+                    self.store.delete(
+                        "Pod", pod.metadata.namespace, pod.metadata.name)
+                except NotFound:
+                    pass
 
     def apply(self, manifest: Dict):
         """kubectl-apply equivalent: route a manifest dict to its typed job."""

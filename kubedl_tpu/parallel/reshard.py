@@ -1,0 +1,404 @@
+"""Reshard planner — Tenplex-style tensor-collection slicing between meshes.
+
+Elastic resize and dead-slice shrink change the device mesh under a live
+training state. Instead of the checkpoint round trip (Orbax save -> pod
+recreate -> restore: minutes of lost capacity per event), the state can be
+resharded: every parameter / optimizer-slot leaf is a tensor collection cut
+into per-device chunks by its PartitionSpec, and the old and new chunkings
+overlap in computable hyperrectangle intersections. This module computes
+those intersections and emits a minimal pod-to-pod transfer plan:
+
+  * blocks already resident on their destination pod are "local" (zero DCN
+    bytes — the common case for a shrink that keeps survivors in place);
+  * replicated blocks are fetched from exactly ONE source (lowest surviving
+    pod id), never broadcast;
+  * a block no surviving pod holds raises PlanError — the caller falls back
+    closed to checkpoint restore (train/reshard_runtime.py ladder).
+
+The planner is pure (shapes + specs + mesh axes in, transfers out) so the
+trainer, the scheduler and the property tests (tests/test_reshard.py) all
+agree on one plan; `ReshardPlan.digest()` is the cross-pod consistency
+check — pods compute the plan independently and any digest mismatch aborts
+the reshard before a byte moves.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubedl_tpu.parallel.mesh import AXIS_ORDER
+
+# A hyperrectangle in GLOBAL leaf coordinates: ((start, stop), ...) per dim.
+Rect = Tuple[Tuple[int, int], ...]
+
+
+class PlanError(ValueError):
+    """The (old, new) pair cannot be live-resharded (non-divisible shapes,
+    or a needed block lives only on dead pods). Callers fall back closed."""
+
+
+@dataclass(frozen=True)
+class Transfer:
+    """One block move: `rect` (global coords) from pod `src` to pod `dst`."""
+
+    path: str
+    src: int
+    dst: int
+    rect: Rect
+    nbytes: int
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(b - a for a, b in self.rect)
+
+
+@dataclass
+class ReshardPlan:
+    old_axes: Dict[str, int]
+    new_axes: Dict[str, int]
+    old_pods: int
+    new_pods: int
+    # blocks that must cross pods (the DCN traffic)
+    transfers: List[Transfer] = field(default_factory=list)
+    # blocks whose chosen source pod IS the destination pod (no movement
+    # for an in-memory reshard; the staged-restart lane persists them too,
+    # since nothing survives a process exit)
+    locals_: List[Transfer] = field(default_factory=list)
+
+    @property
+    def moved_bytes(self) -> int:
+        return sum(t.nbytes for t in self.transfers)
+
+    @property
+    def local_bytes(self) -> int:
+        return sum(t.nbytes for t in self.locals_)
+
+    @property
+    def total_bytes(self) -> int:
+        return self.moved_bytes + self.local_bytes
+
+    def for_source(self, pod: int) -> List[Transfer]:
+        """Every block pod `pod` must ship (staged lane: including blocks
+        it keeps for itself — a restarted process has no live memory)."""
+        return [t for t in self.transfers if t.src == pod] + [
+            t for t in self.locals_ if t.src == pod
+        ]
+
+    def for_dest(self, pod: int) -> List[Transfer]:
+        return [t for t in self.transfers if t.dst == pod] + [
+            t for t in self.locals_ if t.dst == pod
+        ]
+
+    def digest(self) -> str:
+        """Topology+plan fingerprint. Pods compute the plan independently
+        from their own view of (old, new); equal digests prove they will
+        stage/expect the same blocks — a mismatch aborts the reshard."""
+        canon = {
+            "old_axes": {k: self.old_axes.get(k, 1) for k in AXIS_ORDER},
+            "new_axes": {k: self.new_axes.get(k, 1) for k in AXIS_ORDER},
+            "old_pods": self.old_pods,
+            "new_pods": self.new_pods,
+            "moves": sorted(
+                (t.path, t.src, t.dst, t.rect, t.nbytes)
+                for t in self.transfers + self.locals_
+            ),
+        }
+        blob = json.dumps(canon, sort_keys=True, default=list)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# mesh / spec geometry
+# ---------------------------------------------------------------------------
+
+
+def mesh_device_count(axes: Dict[str, int]) -> int:
+    return math.prod(int(axes.get(name, 1)) for name in AXIS_ORDER)
+
+
+def normalize_spec(spec, ndim: int) -> List[Tuple[str, ...]]:
+    """PartitionSpec -> per-dim tuple of mesh axis names (padded to ndim)."""
+    entries: List[Tuple[str, ...]] = []
+    for part in tuple(spec or ()):
+        if part is None:
+            entries.append(())
+        elif isinstance(part, str):
+            entries.append((part,))
+        else:
+            entries.append(tuple(part))
+    while len(entries) < ndim:
+        entries.append(())
+    return entries[:ndim]
+
+
+def _chunk_counts(
+    shape: Sequence[int], dims: List[Tuple[str, ...]], axes: Dict[str, int]
+) -> List[int]:
+    counts = []
+    for size, names in zip(shape, dims):
+        n = math.prod(int(axes.get(a, 1)) for a in names)
+        if n > 1 and size % n:
+            raise PlanError(
+                f"dim of size {size} not divisible by {n} shards "
+                f"(axes {names}, mesh {dict(axes)})"
+            )
+        counts.append(n)
+    return counts
+
+
+def _device_chunk_vecs(
+    shape: Sequence[int], dims: List[Tuple[str, ...]], axes: Dict[str, int]
+) -> List[Tuple[int, ...]]:
+    """Per mesh device (flat AXIS_ORDER index): its chunk-index vector for
+    a leaf — which chunk of each dim the device owns. Devices differing
+    only on unsharded axes share a vector (replication)."""
+    sizes = [int(axes.get(name, 1)) for name in AXIS_ORDER]
+    pos = {name: i for i, name in enumerate(AXIS_ORDER)}
+    vecs = []
+    for flat in range(math.prod(sizes)):
+        coords = np.unravel_index(flat, sizes)
+        vec = []
+        for names in dims:
+            idx = 0
+            for a in names:
+                idx = idx * sizes[pos[a]] + int(coords[pos[a]])
+            vec.append(idx)
+        vecs.append(tuple(vec))
+    return vecs
+
+
+def pod_of_device(flat: int, n_devices: int, n_pods: int) -> int:
+    """Mesh devices partition into pods by contiguous flat index —
+    jax.devices() orders by process, and build_mesh reshapes that order."""
+    if n_devices % n_pods:
+        raise PlanError(f"{n_devices} devices not divisible by {n_pods} pods")
+    return flat // (n_devices // n_pods)
+
+
+def _owner_map(
+    shape: Sequence[int],
+    dims: List[Tuple[str, ...]],
+    axes: Dict[str, int],
+    n_pods: int,
+) -> Dict[Tuple[int, ...], List[int]]:
+    """chunk vector -> sorted pod ids holding (a replica of) that chunk."""
+    n_dev = mesh_device_count(axes)
+    owners: Dict[Tuple[int, ...], set] = {}
+    for flat, vec in enumerate(_device_chunk_vecs(shape, dims, axes)):
+        owners.setdefault(vec, set()).add(pod_of_device(flat, n_dev, n_pods))
+    return {vec: sorted(pods) for vec, pods in owners.items()}
+
+
+def _dim_intervals(size: int, n_old: int, n_new: int):
+    """Elementary intervals of one dim under both chunkings: each interval
+    lies inside exactly one old chunk and one new chunk. Yields
+    (start, stop, old_chunk_idx, new_chunk_idx)."""
+    old_len, new_len = size // n_old, size // n_new
+    cuts = sorted({0, size}
+                  | {i * old_len for i in range(n_old)}
+                  | {i * new_len for i in range(n_new)})
+    for a, b in zip(cuts, cuts[1:]):
+        yield a, b, a // old_len, a // new_len
+
+
+def chunk_rect(
+    shape: Sequence[int], counts: Sequence[int], vec: Sequence[int]
+) -> Rect:
+    """Global hyperrect of one chunk vector."""
+    out = []
+    for size, n, idx in zip(shape, counts, vec):
+        ln = size // n
+        out.append((idx * ln, (idx + 1) * ln))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# per-leaf planning
+# ---------------------------------------------------------------------------
+
+
+def plan_leaf(
+    path: str,
+    shape: Sequence[int],
+    itemsize: int,
+    spec,
+    old_axes: Dict[str, int],
+    new_axes: Dict[str, int],
+    old_pods: int = 1,
+    new_pods: int = 1,
+    survivors: Optional[Iterable[int]] = None,
+) -> Tuple[List[Transfer], List[Transfer]]:
+    """(cross-pod transfers, local blocks) for one leaf.
+
+    `survivors` restricts eligible SOURCE pods (dead-slice shrink: the dead
+    pod's blocks must come from replicas elsewhere); None = all old pods.
+    Every destination pod receives each block it needs exactly once.
+    """
+    shape = tuple(int(s) for s in shape)
+    ndim = len(shape)
+    old_dims = normalize_spec(spec, ndim)
+    new_dims = old_dims  # the SPEC is mesh-shape-agnostic; only sizes change
+    old_counts = _chunk_counts(shape, old_dims, old_axes)
+    new_counts = _chunk_counts(shape, new_dims, new_axes)
+    src_owners = _owner_map(shape, old_dims, old_axes, old_pods)
+    dst_owners = _owner_map(shape, new_dims, new_axes, new_pods)
+    alive = set(range(old_pods)) if survivors is None else set(survivors)
+
+    # elementary interval lists per dim
+    per_dim = [
+        list(_dim_intervals(s, no, nn))
+        for s, no, nn in zip(shape, old_counts, new_counts)
+    ]
+    # scalars (0-dim leaves: optimizer step counts) still reshard: one
+    # empty-rect block, old vec == new vec == ()
+    if ndim == 0:
+        per_dim = []
+
+    transfers: List[Transfer] = []
+    locals_: List[Transfer] = []
+
+    def emit(rect: Rect, old_vec, new_vec) -> None:
+        nbytes = itemsize * math.prod(b - a for a, b in rect)
+        srcs = [p for p in src_owners.get(tuple(old_vec), []) if p in alive]
+        if not srcs:
+            raise PlanError(
+                f"{path}: block {rect} has no surviving source pod "
+                f"(owners {src_owners.get(tuple(old_vec))}, alive {sorted(alive)})"
+            )
+        for dst in dst_owners.get(tuple(new_vec), []):
+            src = dst if dst in srcs else srcs[0]
+            t = Transfer(path=path, src=src, dst=dst, rect=rect, nbytes=nbytes)
+            (locals_ if src == dst else transfers).append(t)
+
+    if ndim == 0:
+        emit((), (), ())
+        return transfers, locals_
+
+    def rec(d: int, rect: List[Tuple[int, int]], ov: List[int], nv: List[int]):
+        if d == ndim:
+            emit(tuple(rect), tuple(ov), tuple(nv))
+            return
+        for a, b, oi, ni in per_dim[d]:
+            rec(d + 1, rect + [(a, b)], ov + [oi], nv + [ni])
+
+    rec(0, [], [], [])
+    return transfers, locals_
+
+
+def plan_reshard(
+    leaves: Dict[str, Tuple[Tuple[int, ...], int, object]],
+    old_axes: Dict[str, int],
+    new_axes: Dict[str, int],
+    old_pods: int = 1,
+    new_pods: int = 1,
+    survivors: Optional[Iterable[int]] = None,
+) -> ReshardPlan:
+    """Plan a whole state: `leaves` maps path -> (shape, itemsize, spec).
+
+    Optimizer slots reshard WITH their params by construction: a slot leaf
+    carries its param's shape and PartitionSpec, so its blocks are cut and
+    routed identically (pinned by tests/test_reshard.py).
+    """
+    plan = ReshardPlan(
+        old_axes=dict(old_axes), new_axes=dict(new_axes),
+        old_pods=old_pods, new_pods=new_pods,
+    )
+    for path in sorted(leaves):
+        shape, itemsize, spec = leaves[path]
+        t, l = plan_leaf(
+            path, shape, itemsize, spec, old_axes, new_axes,
+            old_pods=old_pods, new_pods=new_pods, survivors=survivors,
+        )
+        plan.transfers.extend(t)
+        plan.locals_.extend(l)
+    return plan
+
+
+def leaves_from_state(state) -> Dict[str, Tuple[Tuple[int, ...], int, object]]:
+    """Extract (shape, itemsize, PartitionSpec) per leaf from a LIVE sharded
+    pytree (params, optimizer state, step — everything reshards together).
+    Requires NamedSharding on every leaf; anything else means the state's
+    layout is not expressible as a spec and the caller must fall back."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    out = {}
+    for keypath, leaf in flat:
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            raise PlanError(
+                f"leaf {jax.tree_util.keystr(keypath)} has "
+                f"{type(sharding).__name__}, not NamedSharding — layout "
+                f"unknown, cannot plan a reshard"
+            )
+        out[jax.tree_util.keystr(keypath)] = (
+            tuple(leaf.shape), leaf.dtype.itemsize, sharding.spec
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# numpy reference executor (property tests + the staged-restart lane)
+# ---------------------------------------------------------------------------
+
+
+def extract_block(arr: np.ndarray, rect: Rect) -> np.ndarray:
+    return arr[tuple(slice(a, b) for a, b in rect)]
+
+
+def pod_region(
+    shape: Sequence[int], spec, axes: Dict[str, int], n_pods: int, pod: int
+) -> List[Rect]:
+    """Deduped chunk hyperrects pod `pod` owns for a leaf under a mesh."""
+    shape = tuple(int(s) for s in shape)
+    dims = normalize_spec(spec, len(shape))
+    counts = _chunk_counts(shape, dims, axes)
+    n_dev = mesh_device_count(axes)
+    rects = []
+    seen = set()
+    for flat, vec in enumerate(_device_chunk_vecs(shape, dims, axes)):
+        if pod_of_device(flat, n_dev, n_pods) != pod or vec in seen:
+            continue
+        seen.add(vec)
+        rects.append(chunk_rect(shape, counts, vec))
+    return rects
+
+
+def assemble(
+    shape: Sequence[int],
+    dtype,
+    pieces: Iterable[Tuple[Rect, np.ndarray]],
+    region: Optional[Rect] = None,
+) -> np.ndarray:
+    """Build `region` (default: the whole leaf) from blocks, verifying
+    exactly-once coverage — partial or overlapping delivery raises
+    PlanError instead of returning silently corrupt state."""
+    shape = tuple(int(s) for s in shape)
+    if region is None:
+        region = tuple((0, s) for s in shape)
+    off = [a for a, _ in region]
+    rshape = tuple(b - a for a, b in region)
+    out = np.zeros(rshape, dtype=dtype)
+    count = np.zeros(rshape, dtype=np.int16)
+    for rect, block in pieces:
+        sl = tuple(
+            slice(a - o, b - o) for (a, b), o in zip(rect, off)
+        )
+        if block.shape != tuple(b - a for a, b in rect):
+            raise PlanError(f"block shape {block.shape} != rect {rect}")
+        out[sl] = block
+        count[sl] += 1
+    if (count != 1).any():
+        under = int((count == 0).sum())
+        over = int((count > 1).sum())
+        raise PlanError(
+            f"coverage violation assembling {region}: {under} elements "
+            f"missing, {over} delivered more than once"
+        )
+    return out

@@ -25,17 +25,19 @@ pillars:
 """
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
 from dataclasses import dataclass, field, replace
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.gang.interface import (
     ANNOTATION_GANG_NAME,
     CapacityDirector,
     GangSnapshot,
+    gang_pods,
 )
 from kubedl_tpu.sched.policy import make_policy
 from kubedl_tpu.sched.quota import TenantQuotas
@@ -62,6 +64,28 @@ class CapacityConfig:
     # arrive (real-kubelet mode); the local executor confirms in ~the
     # SIGTERM grace. Must exceed the executor's grace window.
     drain_timeout: float = 30.0
+    # live reshard (docs/scheduling.md "Live resharding"): how long the
+    # scheduler waits for every pod's RESIZE reply before declaring the
+    # reshard failed and falling back closed to checkpoint-then-evict,
+    # and the quiesce budget passed down to the gang's staged lane
+    reshard_reply_timeout: float = 20.0
+    quiesce_timeout: float = 30.0
+
+
+# resize-downtime histogram bucket bounds (seconds): live reshards land in
+# the low buckets, checkpoint-restore fallbacks in the tens-of-seconds tail
+RESHARD_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+@dataclass
+class _PendingReshard:
+    """One issued RESIZE awaiting its pods' replies."""
+
+    gang_key: str
+    replies: List[str]  # absolute reply paths, one per pod
+    issued_at: float  # monotonic
+    deadline: float  # monotonic
+    direction: str = ""  # shrink | grow | dead-slice
 
 
 class CapacityScheduler(CapacityDirector):
@@ -85,6 +109,18 @@ class CapacityScheduler(CapacityDirector):
         self._last_tick: Optional[float] = None
         self._preemptions_total = 0
         self._resizes_total = 0
+        # live-reshard plane: control channel into running pods (the
+        # operator wires the executor's post_control; None = no channel,
+        # every resize takes the checkpoint path), pending RESIZEs, and
+        # the kubedl_reshards_total / resize-downtime series
+        self._control: Optional[Callable[[str, str, Dict], Optional[str]]] = None
+        self._pending_reshards: Dict[str, _PendingReshard] = {}
+        self._reshards_total = {"ok": 0, "staged": 0, "fallback": 0,
+                                "failed": 0}
+        self._downtime_counts = [0] * (len(RESHARD_BUCKETS) + 1)
+        self._downtime_sum = 0.0
+        self._downtime_n = 0
+        self._downtime_last = 0.0
         if hasattr(admitter, "drain_timeout"):
             admitter.drain_timeout = self.config.drain_timeout
         admitter.set_director(self)
@@ -123,11 +159,222 @@ class CapacityScheduler(CapacityDirector):
                 self.quotas.accrue(usage, now - self._last_tick)
             self._last_tick = now
         self.admitter.kick()
+        self._reshard_pass()
         if self.config.enable_preemption:
             self._preempt_pass()
         if self.config.enable_elastic:
             self._elastic_pass()
         self.admitter.kick()
+
+    # -- live reshard ----------------------------------------------------
+
+    def attach_control(self, post_fn) -> None:
+        """Wire the pod control channel: post_fn(namespace, pod_name,
+        message) -> reply path or None (executor.post_control). Without
+        one, every resize falls back to checkpoint-then-evict."""
+        with self._lock:
+            self._control = post_fn
+
+    def _gang_pods(self, gang: GangSnapshot) -> List:
+        """The gang's live pods (shared kind-guarded selection —
+        gang/interface.py gang_pods)."""
+        return gang_pods(self.store, gang.key, gang.kind)
+
+    def _post_resize(self, gang: GangSnapshot, direction: str) -> bool:
+        """Post RESIZE to every pod of the gang; returns False (caller
+        takes the checkpoint path) when there is no control channel, no
+        pods, a pod refuses the message, or a RESIZE is already pending
+        for the gang. The new shape is the gang's CURRENT requested_slice
+        (the resize directive retargeted it first)."""
+        with self._lock:
+            control = self._control
+            if control is None or gang.key in self._pending_reshards:
+                return False
+        try:
+            from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+            chips = parse_slice_type(gang.requested_slice).chips
+        except ValueError:
+            return False
+        pods = self._gang_pods(gang)
+        if not pods:
+            return False
+        # the job's own quiesce budget (spec.elastic.quiesceTimeoutS,
+        # riding the gang snapshot) widens both the message and the reply
+        # deadline — worker 0 may legitimately wait that long at the
+        # staging barrier, and a deadline shorter than the budget would
+        # tear down gangs mid-stage
+        quiesce = max(self.config.quiesce_timeout,
+                      float(getattr(gang, "quiesce_s", 0.0)))
+        msg = {
+            "type": "RESIZE",
+            "chips": chips,
+            "slice": gang.requested_slice,
+            "quiesce_timeout_s": quiesce,
+        }
+        replies = []
+        for pod in pods:
+            path = control(pod.metadata.namespace, pod.metadata.name, dict(msg))
+            if path is None:
+                # a pod we cannot reach must not half-resize the gang:
+                # abandon the live path entirely (fallback closed); pods
+                # already messaged will quiesce, find one peer missing at
+                # the staging barrier (multi-pod) or complete harmlessly
+                # (single-pod in-process, re-resized by the fallback)
+                return False
+            replies.append(path)
+        now = time.monotonic()
+        wait = self.config.reshard_reply_timeout + quiesce
+        with self._lock:
+            self._pending_reshards[gang.key] = _PendingReshard(
+                gang_key=gang.key,
+                replies=replies,
+                issued_at=now,
+                deadline=now + wait,
+                direction=direction,
+            )
+        log.info("live reshard (%s): gang %s -> %s (%d pods)",
+                 direction, gang.key, gang.requested_slice, len(pods))
+        return True
+
+    def _reshard_pass(self) -> None:
+        """Poll pending RESIZE replies. All-ok completes the reshard
+        (downtime observed, the old slices' drain confirmed); any
+        fallback/failed reply — or the deadline — fails CLOSED into the
+        checkpoint path: the gang's pods are deleted and re-admitted
+        through Orbax restore. Reply files are written atomically by the
+        trainer, so a parsed reply is always complete."""
+        with self._lock:
+            pending = list(self._pending_reshards.values())
+        now = time.monotonic()
+        for p in pending:
+            results = []
+            for path in p.replies:
+                try:
+                    with open(path) as f:
+                        results.append(json.load(f))
+                except (OSError, ValueError):
+                    results.append(None)
+            ready = [r for r in results if r is not None]
+            bad = [r for r in ready
+                   if r.get("outcome") not in ("ok", "staged")]
+            if bad:
+                self._finish_reshard(p, "fallback",
+                                     reason=bad[0].get("error", "pod fell back"))
+            elif len(ready) == len(p.replies):
+                if any(r.get("outcome") == "staged" for r in ready):
+                    # staged lane: the pods exited to reassemble on the new
+                    # topology — NOT yet provably resharded (reassembly can
+                    # still fall back to checkpoint restore), so no "ok",
+                    # no downtime, and no early drain confirm: the pod
+                    # exits themselves confirm the drain via release()
+                    self._finish_reshard(p, "staged")
+                else:
+                    downtimes = [float(r.get("downtime_s", 0.0))
+                                 for r in ready]
+                    self._finish_reshard(
+                        p, "ok",
+                        downtime=max(downtimes) if downtimes else None)
+            elif p.deadline <= now:
+                self._finish_reshard(
+                    p, "failed",
+                    reason=f"{len(p.replies) - len(ready)} pod replies "
+                           f"missing {now - p.issued_at:.0f}s after issue")
+
+    def _finish_reshard(
+        self,
+        p: _PendingReshard,
+        outcome: str,
+        downtime: Optional[float] = None,
+        reason: str = "",
+    ) -> None:
+        with self._lock:
+            self._pending_reshards.pop(p.gang_key, None)
+            self._reshards_total[outcome] = (
+                self._reshards_total.get(outcome, 0) + 1)
+            if downtime is not None:
+                self._downtime_last = downtime
+                self._downtime_sum += downtime
+                self._downtime_n += 1
+                for i, b in enumerate(RESHARD_BUCKETS):
+                    if downtime <= b:
+                        self._downtime_counts[i] += 1
+                        break
+                else:
+                    self._downtime_counts[-1] += 1
+        namespace, _, name = p.gang_key.partition("/")
+        if outcome == "ok":
+            log.info("live reshard (%s) of gang %s complete: downtime %.3fs",
+                     p.direction, p.gang_key, downtime or 0.0)
+            # the gang provably runs on the new shape: its OLD slices'
+            # drain can finish now (no pod exits will ever confirm it)
+            if hasattr(self.admitter, "confirm_drain"):
+                self.admitter.confirm_drain(p.gang_key)
+            return
+        if outcome == "staged":
+            log.info("live reshard (%s) of gang %s staged: pods restart "
+                     "onto the new topology (reassembly falls back closed "
+                     "to checkpoint restore if invalid)",
+                     p.direction, p.gang_key)
+            return
+        log.warning("live reshard (%s) of gang %s %s (%s); falling back "
+                    "closed to checkpoint-then-evict",
+                    p.direction, p.gang_key, outcome, reason)
+        # fallback CLOSED: delete the pods — each saved (or kept) its last
+        # durable checkpoint; the engine recreates them Pending and the
+        # gang re-admits through checkpoint restore, never through a
+        # half-resharded state
+        snaps = {g.key: g for g in self.admitter.gang_snapshots()}
+        g = snaps.get(p.gang_key)
+        if g is not None:
+            self._delete_gang_pods(g)
+
+    def slice_failed(self, slice_name: str) -> None:
+        """Executor/inventory report: a slice died mid-run. The admitter
+        parks the dead slice in the drain accounting (chips release once)
+        and un-reserves the owning gang; a live-reshard gang is offered a
+        shrink to a declared fallback shape at the step it quiesces —
+        fault tolerance as cheap shrink — and only failing that does the
+        whole gang take the checkpoint-evict path."""
+        if not hasattr(self.admitter, "slice_failed"):
+            return
+        gang_key = self.admitter.slice_failed(slice_name)
+        if gang_key is None:
+            return
+        snaps = {g.key: g for g in self.admitter.gang_snapshots()}
+        g = snaps.get(gang_key)
+        if g is None:
+            return
+        if g.slice_names:
+            # the reservation pass already re-granted the SAME shape on
+            # surviving hardware; pods keep running (local executor) —
+            # nothing to reshard
+            log.info("gang %s re-granted %s after slice %s died",
+                     gang_key, g.slice_names, slice_name)
+            return
+        if g.live_reshard and g.requested_slice in g.admissible_slices:
+            rank = g.admissible_slices.index(g.requested_slice)
+            for alt in g.admissible_slices[rank + 1:]:
+                if not self.admitter.resize_gang(g.namespace, g.name, alt):
+                    continue
+                fresh = {s.key: s for s in self.admitter.gang_snapshots()}
+                g2 = fresh.get(gang_key)
+                if g2 is not None and g2.slice_names:
+                    self._resized(g, alt, "dead-slice shrink")
+                    if self._post_resize(g2, "dead-slice"):
+                        return
+                    break  # retargeted+reserved but unreachable pods
+                # retargeted but nothing free at this shape: keep walking
+                # the ladder from the new current shape
+                g = g2 if g2 is not None else g
+        log.warning("gang %s lost slice %s with no live-reshard path; "
+                    "taking the checkpoint-evict path", gang_key, slice_name)
+        if g.live_reshard:
+            # the gang opted in but no fallback shape was attainable /
+            # reachable: that IS a reshard fallback for the metric
+            with self._lock:
+                self._reshards_total["fallback"] += 1
+        self._delete_gang_pods(g)
 
     def _usage(self, snaps: Optional[List[GangSnapshot]] = None):
         """(tenant -> reserved chips, total pool chips). Pass `snaps`
@@ -230,19 +477,7 @@ class CapacityScheduler(CapacityDirector):
         SIGTERM-grace kill completes) or the drain deadline passes — so
         a successor's pods can never start on a slice whose previous
         owner is still checkpointing."""
-        try:
-            pods = self.store.list("Pod", namespace=gang.namespace)
-        except Exception:  # noqa: BLE001 — store racing shutdown
-            return
-        for pod in pods:
-            if pod.metadata.annotations.get(ANNOTATION_GANG_NAME) != gang.key:
-                continue
-            # gang keys are ns/name, so a same-named job of ANOTHER kind
-            # carries the identical annotation — verify the owner kind
-            # before killing anything (same invariant as delete_gang)
-            ref = pod.metadata.controller_ref()
-            if gang.kind and (ref is None or ref.kind != gang.kind):
-                continue
+        for pod in self._gang_pods(gang):
             try:
                 self.store.delete("Pod", pod.metadata.namespace, pod.metadata.name)
             except NotFound:
@@ -334,6 +569,15 @@ class CapacityScheduler(CapacityDirector):
             )
             if released:
                 self._resized(g, better, "grow")
+                if g.live_reshard:
+                    # live grow: the pods reshard onto the pre-granted new
+                    # slices in place; the OLD slices stay draining until
+                    # the replies confirm (then confirm_drain frees them)
+                    # — any failure falls back closed via _reshard_pass
+                    fresh = {s.key: s for s in self.admitter.gang_snapshots()}
+                    g2 = fresh.get(g.key)
+                    if g2 is not None and self._post_resize(g2, "grow"):
+                        return
                 self._delete_gang_pods(g)
             return
 
@@ -393,6 +637,15 @@ class CapacityScheduler(CapacityDirector):
         with self._lock:
             preemptions = self._preemptions_total
             resizes = self._resizes_total
+            reshards = dict(self._reshards_total)
+            downtime = {
+                "last": self._downtime_last,
+                "sum": self._downtime_sum,
+                "count": self._downtime_n,
+                "buckets": list(zip(RESHARD_BUCKETS, self._downtime_counts)),
+                "overflow": self._downtime_counts[-1],
+            }
+            pending = len(self._pending_reshards)
         return {
             "policy": self.policy.name,
             "total_chips": total,
@@ -400,4 +653,7 @@ class CapacityScheduler(CapacityDirector):
             "queue": queue,
             "preemptions_total": preemptions,
             "resizes_total": resizes,
+            "reshards_total": reshards,
+            "reshards_pending": pending,
+            "resize_downtime": downtime,
         }

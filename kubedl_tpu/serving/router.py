@@ -382,8 +382,19 @@ class ServingRouter:
         """Stop routing new work to a pod. With migrate=True (the
         default) its in-flight/queued work re-routes immediately as
         continuations; otherwise a decode pod finishes its streams
-        before the operator takes it down. Returns requests moved."""
+        before the operator takes it down. Returns requests moved.
+
+        Draining the LAST eligible prefill pod while requests are queued
+        is refused (the pod keeps serving): stealing its queue with no
+        re-route target would strand the requests undone and hang their
+        clients forever."""
         pod = self._find(name)
+        if isinstance(pod, PrefillPod) and pod.queue_len() and not [
+            p for p in self._eligible(self.prefill_pods) if p is not pod
+        ]:
+            raise RuntimeError(
+                f"cannot drain {name!r}: it is the last eligible prefill "
+                f"pod and {pod.queue_len()} request(s) are queued")
         pod.draining = True
         moved = 0
         if isinstance(pod, PrefillPod):
@@ -398,14 +409,25 @@ class ServingRouter:
 
     def fail(self, name: str) -> int:
         """Hard failure: the pod is gone; its device state with it. Every
-        in-flight stream re-routes as a continuation."""
+        in-flight stream re-routes as a continuation. Queued requests of
+        a failed prefill pod with NO eligible replacement fail LOUDLY
+        (error + done) — silently dropping them would hang their clients
+        forever on a done flag nobody will ever set."""
         pod = self._find(name)
         pod.healthy = False
         moved = 0
         if isinstance(pod, PrefillPod):
-            for req in pod.steal_queue():
-                self.route_prefill().enqueue(req)
-                moved += 1
+            stolen = pod.steal_queue()
+            has_target = bool(self._eligible(self.prefill_pods))
+            for req in stolen:
+                if has_target:
+                    self.route_prefill().enqueue(req)
+                    moved += 1
+                else:
+                    req.error = (f"prefill pod {name!r} failed with no "
+                                 f"eligible replacement")
+                    req.done = True
+                    req.finished_at = time.monotonic()
         else:
             for req in pod.evict_all():
                 self._resubmit(req)

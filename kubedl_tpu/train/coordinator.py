@@ -26,6 +26,15 @@ ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"
 # logging, per-slice data sharding, profiling labels.
 ENV_NUM_SLICES = "KUBEDL_NUM_SLICES"
 ENV_SLICE_ID = "KUBEDL_SLICE_ID"
+# Live-reshard protocol (train/reshard_runtime.py): the executor injects a
+# per-pod control dir the scheduler posts RESIZE messages into; the
+# operator opts jobs in via spec.elastic.liveReshard and points the gang
+# at a shared staging dir for the multi-process (restart) lane. These are
+# part of the SAME rendezvous contract: a resized gang re-joins the
+# coordinator with the topology the staging manifest names.
+ENV_CONTROL_DIR = "KUBEDL_CONTROL_DIR"
+ENV_LIVE_RESHARD = "KUBEDL_LIVE_RESHARD"
+ENV_RESHARD_DIR = "KUBEDL_RESHARD_DIR"
 
 
 @dataclass
@@ -35,6 +44,10 @@ class ProcessInfo:
     process_id: int
     num_slices: int = 1
     slice_id: int = 0
+    # live-reshard wiring (empty/False when the job did not opt in)
+    control_dir: str = ""
+    live_reshard: bool = False
+    reshard_dir: str = ""
 
     @property
     def is_distributed(self) -> bool:
@@ -52,6 +65,9 @@ def process_info() -> ProcessInfo:
         process_id=int(os.environ.get(ENV_PROCESS_ID, "0")),
         num_slices=int(os.environ.get(ENV_NUM_SLICES, "1")),
         slice_id=int(os.environ.get(ENV_SLICE_ID, "0")),
+        control_dir=os.environ.get(ENV_CONTROL_DIR, ""),
+        live_reshard=os.environ.get(ENV_LIVE_RESHARD, "") == "1",
+        reshard_dir=os.environ.get(ENV_RESHARD_DIR, ""),
     )
 
 

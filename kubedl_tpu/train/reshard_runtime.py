@@ -1,0 +1,514 @@
+"""Live-reshard runtime — the trainer side of the resize protocol.
+
+The scheduler (sched/capacity.py) posts a RESIZE control message into the
+pod's control dir (executor/local.py injects KUBEDL_CONTROL_DIR); the
+trainer polls it at step boundaries and runs the reshard ladder:
+
+  1. in-process live reshard (single-process gangs): quiesce at the step
+     boundary, refit the mesh's batch axes to the new chip count
+     (`refit_mesh`), move the whole TrainState with `reshard_state`
+     (byte-preserving device_put — params AND optimizer slots), rebuild the
+     sharded train step, resume at step N+1. Seconds, no process restart.
+  2. staged restart (multi-process gangs, where jax.distributed pins the
+     world size): every pod quiesces, writes the shard blocks the new
+     topology needs (parallel/reshard.py plan) into the shared staging dir
+     — the local-executor analog of the DCN stream — plus a digest marker;
+     worker 0 publishes the manifest only after every pod's marker lands
+     with a MATCHING plan digest; pods exit retryable and reassemble from
+     the staging on restart, skipping the Orbax round trip.
+  3. checkpoint restore — the CLOSED fallback. Any failure, timeout, or
+     digest mismatch in (1) or (2) abandons the reshard: the trainer never
+     commits a partially-assembled state (assemble() enforces exactly-once
+     coverage), never saves a checkpoint from one, and exits retryable so
+     the restart restores the last durable Orbax save.
+
+Replies (ok | fallback | failed + downtime) are written next to the message
+so the scheduler can meter kubedl_reshards_total / resize downtime and
+finish the old slices' drain only once the gang is provably on the new
+shape.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from kubedl_tpu.parallel.mesh import AXIS_ORDER, build_mesh
+from kubedl_tpu.parallel.reshard import (
+    PlanError,
+    ReshardPlan,
+    Transfer,
+    leaves_from_state,
+    plan_reshard,
+)
+
+log = logging.getLogger("kubedl_tpu.reshard")
+
+# the wire contract lives with the rendezvous scheme (train/coordinator.py)
+from kubedl_tpu.train.coordinator import (  # noqa: E402
+    ENV_CONTROL_DIR,
+    ENV_LIVE_RESHARD,
+    ENV_RESHARD_DIR,
+)
+
+# test seams (tests/test_chaos.py): stall inside the reshard critical
+# section so a chaos kill provably lands MID-reshard, or force a failure
+# after quiesce to exercise the closed fallback deterministically
+ENV_TEST_DELAY = "KUBEDL_RESHARD_TEST_DELAY_S"
+ENV_TEST_FAIL = "KUBEDL_RESHARD_TEST_FAIL"
+
+_BATCH_AXES = ("data", "fsdp")
+
+
+class ReshardError(RuntimeError):
+    """Live reshard impossible/failed — fall back closed to checkpoint."""
+
+
+# ---------------------------------------------------------------------------
+# control channel (file-based: the local-executor analog of a sidecar watch)
+# ---------------------------------------------------------------------------
+
+
+class ReshardControl:
+    """Polls KUBEDL_CONTROL_DIR for operator control messages and writes
+    replies next to them. Messages are msg-*.json (write-once by the
+    scheduler); replies are atomic tmp+rename so a half-written reply is
+    never parsed."""
+
+    def __init__(self, control_dir: str) -> None:
+        self.dir = control_dir
+        self._seen: set = set()
+
+    @classmethod
+    def from_env(cls) -> Optional["ReshardControl"]:
+        d = os.environ.get(ENV_CONTROL_DIR, "")
+        return cls(d) if d else None
+
+    def poll(self) -> Optional[dict]:
+        """Earliest unprocessed control message, or None. Cheap enough for
+        a per-step call (one listdir of a near-empty dir). A message whose
+        reply file already exists is SKIPPED: _seen is in-memory, so an
+        in-place restart would otherwise replay every already-answered
+        RESIZE in the dir (and re-exit, for the staged lane) forever."""
+        try:
+            entries = set(os.listdir(self.dir))
+        except OSError:
+            return None
+        names = sorted(
+            n for n in entries
+            if n.startswith("msg-") and n.endswith(".json")
+        )
+        for name in names:
+            if name in self._seen:
+                continue
+            self._seen.add(name)
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    msg = json.load(f)
+            except (OSError, ValueError):
+                continue  # half-written / corrupt: skip, never crash a step
+            if not isinstance(msg, dict):
+                continue
+            msg.setdefault("reply", name.replace("msg-", "reply-", 1))
+            if msg["reply"] in entries:
+                continue  # answered by a previous incarnation
+            return msg
+        return None
+
+    def reply(self, msg: dict, **payload) -> None:
+        name = msg.get("reply") or "reply.json"
+        tmp = os.path.join(self.dir, f".{name}.tmp")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, os.path.join(self.dir, name))
+        except OSError:
+            log.warning("could not write reshard reply %s", name)
+
+
+# ---------------------------------------------------------------------------
+# mesh refit + in-process live lane
+# ---------------------------------------------------------------------------
+
+
+def refit_axes(axes: Dict[str, int], new_total: int) -> Dict[str, int]:
+    """New mesh axes for `new_total` devices: model-sharding axes (tensor /
+    context / expert / stage) are preserved exactly — they are fit- and
+    correctness-critical — and the change is absorbed by the batch axes,
+    data first, then fsdp. The grow/shrink factor must be integral so the
+    global batch stays shardable; anything else raises ReshardError (the
+    caller falls back closed)."""
+    full = {k: int(axes.get(k, 1)) for k in AXIS_ORDER}
+    fixed = math.prod(v for k, v in full.items() if k not in _BATCH_AXES)
+    if new_total % fixed:
+        raise ReshardError(
+            f"{new_total} devices not divisible by the model axes "
+            f"({fixed}: { {k: v for k, v in full.items() if k not in _BATCH_AXES and v > 1} })"
+        )
+    budget = new_total // fixed
+    old_budget = full["data"] * full["fsdp"]
+    if budget >= old_budget:
+        if budget % old_budget:
+            raise ReshardError(
+                f"grow factor {budget}/{old_budget} is not integral")
+        full["data"] *= budget // old_budget
+    else:
+        if old_budget % budget:
+            raise ReshardError(
+                f"shrink factor {old_budget}/{budget} is not integral")
+        factor = old_budget // budget
+        d_part = math.gcd(full["data"], factor)
+        f_part = factor // d_part
+        if full["fsdp"] % f_part:
+            raise ReshardError(
+                f"cannot shrink batch axes data={full['data']} "
+                f"fsdp={full['fsdp']} by {factor}")
+        full["data"] //= d_part
+        full["fsdp"] //= f_part
+    return full
+
+
+def refit_mesh(mesh, new_chips: int, devices=None):
+    """Mesh over the first `new_chips` visible devices with refit axes."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if new_chips > len(devices):
+        raise ReshardError(
+            f"resize wants {new_chips} devices, only {len(devices)} visible")
+    axes = refit_axes(dict(mesh.shape), new_chips)
+    return build_mesh(axes, devices=devices[:new_chips])
+
+
+def reshard_state(state, new_mesh):
+    """Move a live sharded pytree onto `new_mesh`, keeping each leaf's
+    PartitionSpec — byte-preserving (pinned by tests/test_reshard.py)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def move(leaf):
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            raise ReshardError(
+                f"state leaf has {type(sharding).__name__}, not "
+                f"NamedSharding — cannot re-express on the new mesh")
+        return jax.device_put(leaf, NamedSharding(new_mesh, sharding.spec))
+
+    return jax.tree_util.tree_map(move, state)
+
+
+def _test_hooks() -> None:
+    """Chaos-test seams, active only when the envs are set."""
+    delay = float(os.environ.get(ENV_TEST_DELAY, "0") or 0)
+    if delay > 0:
+        time.sleep(delay)
+    if os.environ.get(ENV_TEST_FAIL):
+        raise ReshardError("KUBEDL_RESHARD_TEST_FAIL injected failure")
+
+
+def live_resize(state, mesh, new_chips: int):
+    """In-process lane: returns (new_mesh, new_state, plan). The caller
+    already quiesced (block_until_ready) at the step boundary. Raises
+    ReshardError with the OLD state untouched on any failure — the caller
+    may still checkpoint it before falling back."""
+    leaves = leaves_from_state(state)
+    new_mesh = refit_mesh(mesh, new_chips)
+    try:
+        plan = plan_reshard(leaves, dict(mesh.shape), dict(new_mesh.shape))
+    except PlanError as e:
+        raise ReshardError(str(e)) from e
+    _test_hooks()
+    new_state = reshard_state(state, new_mesh)
+    return new_mesh, new_state, plan
+
+
+# ---------------------------------------------------------------------------
+# staged-restart lane (multi-process gangs)
+# ---------------------------------------------------------------------------
+
+
+def _block_key(path: str, rect, dtype) -> str:
+    # dtype rides in the key because blocks are staged as raw uint8
+    # buffers: npz round-trips bf16 (and friends) as |V2 void otherwise
+    # (the serving plane hit the same trap — serving/handoff.py)
+    return json.dumps([path, [list(r) for r in rect], str(np.dtype(dtype))])
+
+
+def _parse_key(key: str) -> Tuple[str, tuple, str]:
+    path, rect, dtype = json.loads(key)
+    return path, tuple(tuple(r) for r in rect), dtype
+
+
+def addressable_blocks(state) -> Dict[Tuple[str, tuple], np.ndarray]:
+    """(path, global rect) -> host copy, for every block this process's
+    devices hold — the source store the staging lane serves from."""
+    import jax
+
+    out: Dict[Tuple[str, tuple], np.ndarray] = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        path = jax.tree_util.keystr(keypath)
+        for shard in leaf.addressable_shards:
+            rect = tuple(
+                (sl.start or 0, sl.stop if sl.stop is not None else dim)
+                for sl, dim in zip(shard.index, leaf.shape)
+            ) if leaf.ndim else ()
+            if (path, rect) not in out:
+                out[(path, rect)] = np.asarray(shard.data)
+    return out
+
+
+def provider_from_blocks(blocks: Dict[Tuple[str, tuple], np.ndarray]):
+    """provide(Transfer) -> block ndarray, served from resident chunks."""
+
+    def provide(t: Transfer) -> np.ndarray:
+        for (path, rect), data in blocks.items():
+            if path != t.path or len(rect) != len(t.rect):
+                continue
+            if all(a >= ra and b <= rb
+                   for (a, b), (ra, rb) in zip(t.rect, rect)):
+                inner = tuple(
+                    slice(a - ra, b - ra)
+                    for (a, b), (ra, _) in zip(t.rect, rect))
+                return np.asarray(data[inner]) if t.rect else np.asarray(data)
+        raise ReshardError(f"this pod does not hold {t.path} {t.rect}")
+
+    return provide
+
+
+def stage_shards(
+    reshard_dir: str,
+    plan: ReshardPlan,
+    pod: int,
+    provide: Callable[[Transfer], np.ndarray],
+    step: int,
+) -> None:
+    """Write every block this pod sources (cross-pod AND kept-local — a
+    restarted process has no live memory) as src-<pod>.npz, then the
+    digest marker. Marker last: its presence promises the npz is complete."""
+    os.makedirs(reshard_dir, exist_ok=True)
+    entries = {}
+    nbytes = 0
+    for t in plan.for_source(pod):
+        block = np.asarray(provide(t))
+        entries[_block_key(t.path, t.rect, block.dtype)] = np.frombuffer(
+            block.tobytes(), np.uint8)
+        nbytes += t.nbytes
+    npz = os.path.join(reshard_dir, f"src-{pod}.npz")
+    tmp = npz + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **entries)
+    os.replace(tmp, npz)
+    marker = os.path.join(reshard_dir, f"src-{pod}.json")
+    tmp = marker + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"digest": plan.digest(), "step": step,
+                   "blocks": len(entries), "bytes": nbytes}, f)
+    os.replace(tmp, marker)
+
+
+def write_manifest(
+    reshard_dir: str,
+    plan: ReshardPlan,
+    step: int,
+    n_pods: int,
+    timeout: float = 30.0,
+) -> bool:
+    """Worker 0 publishes manifest.json only after EVERY pod's marker
+    landed with the same plan digest — the commit point of the staged
+    lane. Timeout or any digest mismatch aborts (no manifest => every
+    restarting pod falls back closed to checkpoint restore)."""
+    digest = plan.digest()
+    deadline = time.monotonic() + timeout
+    while True:
+        # a marker with a foreign digest/step counts as NOT YET staged,
+        # not as instant disagreement: it may be a stale leftover from a
+        # previous reshard the peer is about to overwrite. A genuine
+        # disagreement simply persists until the deadline and aborts then.
+        pending = []
+        for pod in range(n_pods):
+            marker = os.path.join(reshard_dir, f"src-{pod}.json")
+            try:
+                with open(marker) as f:
+                    info = json.load(f)
+            except (OSError, ValueError):
+                pending.append(pod)
+                continue
+            if info.get("digest") != digest or info.get("step") != step:
+                pending.append(pod)
+        if not pending:
+            break
+        if time.monotonic() >= deadline:
+            log.error("staged reshard aborted: pods %s never staged a "
+                      "matching plan within %.1fs", pending, timeout)
+            return False
+        time.sleep(0.05)
+    manifest = {
+        "step": step,
+        "digest": digest,
+        "old_axes": {k: plan.old_axes.get(k, 1) for k in AXIS_ORDER},
+        "new_axes": {k: plan.new_axes.get(k, 1) for k in AXIS_ORDER},
+        "old_pods": plan.old_pods,
+        "new_pods": plan.new_pods,
+    }
+    tmp = os.path.join(reshard_dir, ".manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(reshard_dir, "manifest.json"))
+    return True
+
+
+class StagedBlocks:
+    """Lazy view over the staged npz files: the index (key -> source file
+    member) is built eagerly for validation, but block BYTES decode only
+    on access — a pod must not materialize every peer's full state
+    (O(n_pods x state) host RAM) to assemble its own shards."""
+
+    def __init__(self) -> None:
+        self._index: Dict[Tuple[str, tuple], Tuple[str, str, str]] = {}
+
+    def add(self, block_key: Tuple[str, tuple], npz: str, member: str,
+            dtype: str) -> None:
+        self._index.setdefault(block_key, (npz, member, dtype))
+
+    def keys(self):
+        return self._index.keys()
+
+    def load(self, block_key: Tuple[str, tuple]) -> np.ndarray:
+        npz, member, dtype = self._index[block_key]
+        _, rect = block_key
+        shape = tuple(b - a for a, b in rect)
+        with np.load(npz) as data:
+            return np.frombuffer(
+                data[member].tobytes(), np.dtype(dtype)).reshape(shape)
+
+    def items(self):
+        """Eager iteration (tests / small states)."""
+        for k in self._index:
+            yield k, self.load(k)
+
+
+def staging_exists(reshard_dir: str) -> bool:
+    """A PUBLISHED staging (manifest present). Distinguishes 'nothing /
+    still in flight' from 'committed': only a committed-but-invalid
+    staging may be cleared — clearing on a merely-missing manifest would
+    delete PEERS' in-flight src files mid-stage."""
+    return os.path.exists(os.path.join(reshard_dir, "manifest.json"))
+
+
+def restore_staged(
+    reshard_dir: str,
+    pod: int,
+    n_pods: int,
+    expect_axes: Optional[Dict[str, int]] = None,
+) -> Optional[Tuple[int, Dict[str, int], StagedBlocks]]:
+    """Validate the staging and return (step, new_axes, blocks) or None.
+
+    Fails CLOSED: missing/invalid manifest, a marker digest that does not
+    match, a missing source file, or a topology other than expected all
+    return None — the caller then restores from the Orbax checkpoint. The
+    caller must assemble through reshard.assemble(), which enforces
+    exactly-once coverage, so a stale or partial staging can never become
+    training state."""
+    try:
+        with open(os.path.join(reshard_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    digest = manifest.get("digest")
+    new_axes = manifest.get("new_axes") or {}
+    if expect_axes is not None:
+        want = {k: int(expect_axes.get(k, 1)) for k in AXIS_ORDER}
+        if {k: int(new_axes.get(k, 1)) for k in AXIS_ORDER} != want:
+            log.warning("staging topology %s != expected %s; falling back",
+                        new_axes, want)
+            return None
+    if int(manifest.get("new_pods", -1)) != n_pods:
+        return None
+    blocks = StagedBlocks()
+    for src in range(int(manifest.get("old_pods", n_pods))):
+        marker = os.path.join(reshard_dir, f"src-{src}.json")
+        npz = os.path.join(reshard_dir, f"src-{src}.npz")
+        try:
+            with open(marker) as f:
+                info = json.load(f)
+            if info.get("digest") != digest:
+                log.warning("src-%d digest mismatch; falling back", src)
+                return None
+            with np.load(npz) as data:
+                names = list(data.files)  # index only; no byte decode
+            for key in names:
+                path, rect, dtype = _parse_key(key)
+                blocks.add((path, rect), npz, key, dtype)
+        except (OSError, ValueError, KeyError):
+            log.warning("staging src-%d unreadable; falling back", src)
+            return None
+    return int(manifest["step"]), {
+        k: int(new_axes.get(k, 1)) for k in AXIS_ORDER}, blocks
+
+
+def state_from_staging(blocks, state_template):
+    """Rebuild a sharded TrainState from staged blocks: each addressable
+    device's shard is assembled (exactly-once coverage enforced) and bound
+    via make_array_from_single_device_arrays. `state_template` supplies
+    structure, shapes, dtypes and the NEW mesh's shardings (an init_state
+    run on the new mesh); its values are discarded. Raises ReshardError /
+    PlanError on any gap — the caller falls back closed to checkpoint."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from kubedl_tpu.parallel.reshard import assemble
+
+    # only decode blocks this pod's own shards actually need (StagedBlocks
+    # loads lazily; a plain dict of arrays also works for tests)
+    all_keys = list(blocks.keys())
+    load = blocks.load if hasattr(blocks, "load") else (
+        lambda k: dict(blocks)[k])
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        state_template, is_leaf=lambda x: hasattr(x, "sharding"))
+    rebuilt = []
+    for keypath, leaf in flat:
+        path = jax.tree_util.keystr(keypath)
+        mine = [r for (p, r) in all_keys if p == path]
+        if not mine:
+            raise ReshardError(f"staging holds no blocks for leaf {path}")
+        sharding = leaf.sharding
+        if not isinstance(sharding, NamedSharding):
+            raise ReshardError(f"template leaf {path} lacks NamedSharding")
+        shape = tuple(leaf.shape)
+        idx_map = sharding.addressable_devices_indices_map(shape)
+        bufs = []
+        for dev, idx in idx_map.items():
+            rect = tuple(
+                (sl.start or 0, sl.stop if sl.stop is not None else d)
+                for sl, d in zip(idx, shape)
+            ) if leaf.ndim else ()
+            pieces = [
+                (r, load((path, r))) for r in mine
+                if len(r) == len(rect) and all(
+                    a >= ra and b2 <= rb
+                    for (a, b2), (ra, rb) in zip(r, rect))
+            ]
+            local = assemble(shape, leaf.dtype, pieces, region=rect or None)
+            bufs.append(jax.device_put(local, dev))
+        rebuilt.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, bufs))
+    return treedef.unflatten(rebuilt)
+
+
+def clear_staging(reshard_dir: str) -> None:
+    """Remove a consumed or invalid staging so it can never be replayed."""
+    try:
+        for name in os.listdir(reshard_dir):
+            if name == "manifest.json" or name.startswith("src-"):
+                try:
+                    os.remove(os.path.join(reshard_dir, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass

@@ -143,8 +143,94 @@ def main(argv=None) -> int:
     if args.ce_chunks > 1:
         config = dataclasses.replace(config, ce_chunks=args.ce_chunks)
 
+    # Live-reshard plumbing (train/reshard_runtime.py): control channel +
+    # staging dir, active only when the operator opted the job in
+    # (spec.elastic.liveReshard -> KUBEDL_LIVE_RESHARD=1).
+    from kubedl_tpu.train import reshard_runtime
+    from kubedl_tpu.parallel.mesh import build_mesh
+
+    reshard_on = info.live_reshard
+    reshard_dir = info.reshard_dir
+    ctl = (reshard_runtime.ReshardControl(info.control_dir)
+           if reshard_on and info.control_dir else None)
+
+    # Staged-restart lane: a valid staging (written by the PREVIOUS
+    # incarnation's quiesce) beats both the env mesh and the checkpoint —
+    # it is the resharded state at the quiesce step. Anything invalid is
+    # discarded (fallback closed to Orbax below).
+    staged = None
+    if reshard_on and reshard_dir and args.lora_rank == 0:
+        staged = reshard_runtime.restore_staged(
+            reshard_dir, info.process_id, info.num_processes)
+        if staged is None:
+            # discard only a PUBLISHED-but-invalid staging; a missing
+            # manifest may just mean peers are still mid-stage and worker
+            # 0 has not reached the commit point — their src files must
+            # not be deleted from under them
+            if reshard_runtime.staging_exists(reshard_dir):
+                reshard_runtime.clear_staging(reshard_dir)
+        if staged is not None and os.environ.get("TPU_SLICE_TYPE"):
+            # the staging must match the GRANTED slice: a stale staging
+            # from an earlier resize must never re-inflate the mesh past
+            # what the scheduler granted now
+            import math as _math
+
+            from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+            try:
+                granted = parse_slice_type(
+                    os.environ["TPU_SLICE_TYPE"]).chips
+            except ValueError:
+                granted = None
+            if granted is not None and _math.prod(
+                staged[1].values()) != granted:
+                print(f"staging topology {staged[1]} != granted "
+                      f"{granted}-chip slice; falling back to checkpoint",
+                      file=sys.stderr)
+                reshard_runtime.clear_staging(reshard_dir)
+                staged = None
+        if staged is not None and args.checkpoint_path:
+            # a checkpoint NEWER than the staging wins (the staging is a
+            # quiesce snapshot; replaying it over later saves would lose
+            # steps) — staging only ever moves the state forward
+            try:
+                latest_ck = max(
+                    (int(d) for d in os.listdir(args.checkpoint_path)
+                     if d.isdigit()), default=None)
+            except OSError:
+                latest_ck = None
+            if latest_ck is not None and latest_ck > staged[0]:
+                reshard_runtime.clear_staging(reshard_dir)
+                staged = None
+
     # hybrid ICIxDCN when the operator injected KUBEDL_DCN_MESH (multislice)
-    mesh = build_mesh_from_env()
+    if staged is not None:
+        import math as _math
+
+        n = _math.prod(staged[1].values())
+        if n <= len(jax.devices()):
+            mesh = build_mesh(staged[1], devices=jax.devices()[:n])
+        else:
+            reshard_runtime.clear_staging(reshard_dir)
+            staged = None
+            mesh = build_mesh_from_env()
+    else:
+        devices = None
+        if reshard_on and os.environ.get("TPU_SLICE_TYPE"):
+            # size the mesh to the GRANTED slice, not to every visible
+            # device: after an elastic shrink the pod may see more
+            # devices than its slice has chips (local-executor sim), and
+            # a later grow must have headroom to reshard into
+            from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+            try:
+                chips = parse_slice_type(
+                    os.environ["TPU_SLICE_TYPE"]).chips
+                if 0 < chips <= len(jax.devices()):
+                    devices = jax.devices()[:chips]
+            except ValueError:
+                pass
+        mesh = build_mesh_from_env(devices=devices)
     rules = ShardingRules()
     model_name = args.hf_model or args.model
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
@@ -161,8 +247,12 @@ def main(argv=None) -> int:
     params = (hf_base if hf_base is not None
               else llama.init(config, jax.random.PRNGKey(0)))
 
-    def loss(params, batch):
-        return llama.loss_fn(params, batch, config, mesh=mesh, rules=rules)
+    def loss_on(a_mesh):
+        def loss(params, batch):
+            return llama.loss_fn(params, batch, config, mesh=a_mesh, rules=rules)
+        return loss
+
+    loss = loss_on(mesh)
 
     if args.lr_schedule == "cosine":
         # warmup -> cosine decay to 10% of peak over the run
@@ -201,12 +291,42 @@ def main(argv=None) -> int:
                       "to evaluate the merged model)", flush=True)
                 args.eval_every = 0
         else:
-            spec_tree = llama.param_specs(config, rules)
-            init_state, train_step = make_train_step(
-                loss, tx, mesh, spec_tree, rules.spec("batch", None), rules,
-                accum_steps=args.accum_steps,
-            )
-            state = init_state(params)
+            def build_step(a_mesh):
+                """Mesh-dependent compute, rebuilt after a live reshard."""
+                spec_tree = llama.param_specs(config, rules)
+                return make_train_step(
+                    loss_on(a_mesh), tx, a_mesh, spec_tree,
+                    rules.spec("batch", None), rules,
+                    accum_steps=args.accum_steps,
+                )
+
+            init_state, train_step = build_step(mesh)
+            if staged is not None:
+                # staged-restart lane: the previous incarnation quiesced
+                # and streamed its shard intersections here — rebuild the
+                # resharded state instead of restoring a checkpoint. Any
+                # gap falls back closed to the Orbax path below.
+                try:
+                    template = init_state(params)
+                    state = reshard_runtime.state_from_staging(
+                        staged[2], template)
+                    del template
+                    # NOT cleared here: peers may still be assembling from
+                    # the same staging (clearing would fork the gang onto
+                    # divergent restore points). Replay is safe: a stale
+                    # staging is rejected by the granted-topology and
+                    # newer-checkpoint guards above, and a valid replay IS
+                    # the newest state.
+                    print(f"restored live-reshard staging at step "
+                          f"{staged[0]} (mesh {staged[1]})", flush=True)
+                except Exception as e:  # noqa: BLE001 — fallback closed
+                    print(f"staging unusable ({e}); falling back to "
+                          f"checkpoint restore", file=sys.stderr)
+                    reshard_runtime.clear_staging(reshard_dir)
+                    staged = None
+                    state = init_state(params)
+            else:
+                state = init_state(params)
         # the sharded copies live on the mesh now; a 7B HF import would
         # otherwise pin ~14 GB of dead host arrays for the whole run
         del params
@@ -219,7 +339,7 @@ def main(argv=None) -> int:
 
     # checkpointing (Orbax)
     mngr = None
-    start_step = 0
+    start_step = staged[0] if staged is not None else 0
     if args.checkpoint_path:
         import orbax.checkpoint as ocp
 
@@ -228,7 +348,9 @@ def main(argv=None) -> int:
         )
         mngr = ocp.CheckpointManager(args.checkpoint_path, options=options)
         latest = mngr.latest_step()
-        if latest is not None and os.environ.get("KUBEDL_CHECKPOINT_RESTORE", "1") == "1":
+        if staged is not None:
+            pass  # live-reshard staging beats restore (start_step set above)
+        elif latest is not None and os.environ.get("KUBEDL_CHECKPOINT_RESTORE", "1") == "1":
             # Restore straight into the SHARDED state: the live arrays act
             # as the abstract target, so each leaf comes back with its
             # param_specs sharding instead of landing replicated on one
@@ -257,6 +379,102 @@ def main(argv=None) -> int:
         if final:
             mngr.wait_until_finished()
             print(f"saved final checkpoint at step {step}", flush=True)
+
+    # -- live resize protocol (train/reshard_runtime.py ladder) ----------
+
+    def _resize_fallback(msg, at_step, reason):
+        """Fallback CLOSED: the old state is intact (live_resize raises
+        pre-commit and device_put never donates), so bank it as a final
+        checkpoint, tell the scheduler, and exit retryable — the restart
+        comes back through checkpoint restore. A corrupted state is never
+        saved and never trained on."""
+        print(f"live reshard failed ({reason}); falling back to "
+              f"checkpoint restore", file=sys.stderr)
+        try:
+            save(at_step, final=True)
+        except Exception:  # noqa: BLE001 — last interval save still holds
+            pass
+        if ctl is not None:
+            ctl.reply(msg, outcome="fallback", step=at_step,
+                      error=str(reason)[:300])
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_TPU_PREEMPTED)
+
+    def _resize_staged(msg, at_step, new_chips):
+        """Multi-process lane: jax.distributed pins the world size, so the
+        gang quiesces, streams shard intersections into the staging dir,
+        and restarts onto the new topology (reassembly at startup). The
+        manifest publishes only when every pod staged with a matching
+        plan digest; any gap falls back closed."""
+        try:
+            if not reshard_dir:
+                raise reshard_runtime.ReshardError("no KUBEDL_RESHARD_DIR")
+            leaves = reshard_runtime.leaves_from_state(state)
+            new_axes = reshard_runtime.refit_axes(dict(mesh.shape), new_chips)
+            plan = reshard_runtime.plan_reshard(
+                leaves, dict(mesh.shape), new_axes,
+                info.num_processes, info.num_processes)
+            blocks = reshard_runtime.addressable_blocks(state)
+            reshard_runtime.stage_shards(
+                reshard_dir, plan, info.process_id,
+                reshard_runtime.provider_from_blocks(blocks), at_step)
+            # the job's own quiesce budget (spec.elastic.quiesceTimeoutS,
+            # injected by the controller) outranks the scheduler default
+            quiesce = float(os.environ.get(
+                "KUBEDL_RESHARD_QUIESCE_S",
+                msg.get("quiesce_timeout_s", 30.0)))
+            if info.process_id == 0 and not reshard_runtime.write_manifest(
+                reshard_dir, plan, at_step, info.num_processes,
+                timeout=quiesce,
+            ):
+                raise reshard_runtime.ReshardError("manifest aborted")
+        except Exception as e:  # noqa: BLE001 — fallback closed
+            _resize_fallback(msg, at_step, f"staged lane: {e}")
+        ctl.reply(msg, outcome="staged", step=at_step)
+        print(f"staged reshard at step {at_step}: restarting onto the new "
+              f"topology", flush=True)
+        sys.stdout.flush()
+        os._exit(EXIT_TPU_PREEMPTED)
+
+    def handle_resize(msg, at_step):
+        nonlocal mesh, loss, state, init_state, train_step
+        nonlocal batch_sharding, eval_fn
+        t0 = time.perf_counter()
+        new_chips = int(msg.get("chips", 0))
+        jax.block_until_ready(state.params)  # quiesce at the step boundary
+        if new_chips <= 0:
+            _resize_fallback(msg, at_step, f"bad chip count {new_chips}")
+        if args.lora_rank > 0:
+            _resize_fallback(msg, at_step, "lora runs restart via checkpoint")
+        if info.num_processes > 1:
+            _resize_staged(msg, at_step, new_chips)  # does not return
+        try:
+            new_mesh, new_state, plan = reshard_runtime.live_resize(
+                state, mesh, new_chips)
+        except reshard_runtime.ReshardError as e:
+            _resize_fallback(msg, at_step, str(e))  # does not return
+        mesh, state = new_mesh, new_state
+        loss = loss_on(mesh)
+        init_state, train_step = build_step(mesh)
+        batch_sharding = rules.sharding(mesh, "batch", None)
+        if eval_fn is not None:
+            eval_fn = jax.jit(loss)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state.params))
+        # reply NOW — downtime = quiesce -> full state resident on the new
+        # mesh, a step dispatchable (the bench's definition). The first
+        # post-reshard step's compile is ordinary training the scheduler
+        # must not wait on: on a real model it takes minutes, and a reply
+        # deferred past it would blow reshard_reply_timeout and turn every
+        # successful reshard into a spurious pod teardown.
+        downtime = time.perf_counter() - t0
+        ctl.reply(msg, outcome="ok", step=at_step,
+                  downtime_s=round(downtime, 4), chips=new_chips,
+                  moved_mb=round(plan.moved_bytes / 2**20, 3))
+        print(f"live reshard at step {at_step}: mesh -> "
+              f"{ {k: v for k, v in dict(mesh.shape).items() if v > 1} } "
+              f"({new_chips} devices, downtime {downtime:.3f}s); "
+              f"live reshard: resumed at step {at_step + 1}", flush=True)
 
     # input pipeline: native mmap+prefetch loader over token shards, or
     # synthetic batches when no data path is given. All processes share one
@@ -389,6 +607,15 @@ def main(argv=None) -> int:
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(EXIT_TPU_PREEMPTED)
+        if ctl is not None:
+            cmsg = ctl.poll()
+            if cmsg is not None:
+                if cmsg.get("type") == "RESIZE":
+                    handle_resize(cmsg, step + 1)
+                else:
+                    ctl.reply(cmsg, outcome="failed",
+                              error=f"unknown control message "
+                                    f"{cmsg.get('type')!r}")
         if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
             jax.block_until_ready(metrics["loss"])
             save(step + 1)

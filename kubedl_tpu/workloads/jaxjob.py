@@ -17,6 +17,7 @@ registered with the shared engine. Design (SURVEY.md §7 step 4):
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -86,6 +87,25 @@ class CheckpointSpec:
 
 
 @dataclass
+class ElasticSpec:
+    """Elastic-resize behavior beyond the scheduler's shape ladder
+    (schedulingPolicy.tpuSliceFallbacks declares the shapes).
+
+    liveReshard opts the gang into the live resharding plane
+    (docs/scheduling.md "Live resharding"): scheduler resizes and
+    dead-slice shrinks quiesce the gang at a step boundary and reshard
+    params + optimizer state onto the new mesh (parallel/reshard.py)
+    instead of the checkpoint-then-evict round trip; every failure falls
+    back CLOSED to checkpoint restore, which is why spec.checkpoint is
+    required."""
+
+    live_reshard: bool = False
+    # quiesce budget for the staged (multi-process) lane: how long worker
+    # 0 waits for every pod's shard stage before aborting to checkpoint
+    quiesce_timeout_s: float = 30.0
+
+
+@dataclass
 class ServingSpec:
     """Disaggregated serving fleet (kubedl_tpu/serving/): the Worker
     replicas split into prefill and decode ROLES by index — workers
@@ -130,6 +150,9 @@ class JAXJobSpec:
     # Disaggregated serving mode: Worker replicas become a routed
     # prefill/decode fleet instead of an SPMD training gang.
     serving: Optional[ServingSpec] = None
+    # Elastic behavior (live resharding opt-in); the admissible shapes
+    # themselves live in runPolicy.schedulingPolicy.tpuSliceFallbacks.
+    elastic: Optional[ElasticSpec] = None
 
 
 @dataclass
@@ -252,6 +275,33 @@ class JAXJobController(BaseWorkloadController):
                     f"{srv.decode_router!r} (supported: least-blocks)")
         sched = (job.spec.run_policy.scheduling_policy
                  if job.spec.run_policy else None)
+        el = job.spec.elastic
+        if el is not None and el.live_reshard:
+            if job.spec.checkpoint is None or not job.spec.checkpoint.path:
+                errs.append(
+                    "spec.elastic.liveReshard requires spec.checkpoint "
+                    "(the reshard ladder falls back CLOSED to checkpoint "
+                    "restore; without one a failed reshard would lose all "
+                    "progress)")
+            if sched is None or not sched.tpu_slice_fallbacks:
+                errs.append(
+                    "spec.elastic.liveReshard requires schedulingPolicy."
+                    "tpuSliceFallbacks (the fallback shapes are what the "
+                    "gang reshards between)")
+            if ns > 1:
+                errs.append(
+                    "spec.elastic.liveReshard is incompatible with "
+                    "spec.numSlices > 1 (multislice gangs resize through "
+                    "checkpoint restore today)")
+            if srv is not None:
+                errs.append(
+                    "spec.elastic.liveReshard does not apply to "
+                    "spec.serving fleets (serving pods are independent "
+                    "endpoints; drain them through the router instead)")
+            if float(el.quiesce_timeout_s) <= 0:
+                errs.append(
+                    f"spec.elastic.quiesceTimeoutS must be > 0, got "
+                    f"{el.quiesce_timeout_s}")
         if sched is not None and sched.tpu_slice_fallbacks and (
             job.spec.checkpoint is None or not job.spec.checkpoint.path
         ):
@@ -298,6 +348,14 @@ class JAXJobController(BaseWorkloadController):
             env["KUBEDL_CHECKPOINT_INTERVAL"] = str(ckpt.save_interval_steps)
             env["KUBEDL_CHECKPOINT_KEEP"] = str(ckpt.keep)
             env["KUBEDL_CHECKPOINT_RESTORE"] = "1" if ckpt.restore else "0"
+        el = job.spec.elastic
+        if el is not None and el.live_reshard and ckpt is not None and ckpt.path:
+            # live-reshard opt-in: control-channel polling on, plus the
+            # gang-shared staging dir for the multi-process lane (rides
+            # the checkpoint volume — already required + shared)
+            env["KUBEDL_LIVE_RESHARD"] = "1"
+            env["KUBEDL_RESHARD_DIR"] = os.path.join(ckpt.path, ".reshard")
+            env["KUBEDL_RESHARD_QUIESCE_S"] = str(el.quiesce_timeout_s)
         if job.spec.compilation_cache_dir:
             # JAX's own min-compile-time default (1s) already skips
             # sub-second compiles — no need to override it here

@@ -4,6 +4,11 @@ SURVEY.md §5 notes the reference has no fault-injection framework at all;
 this drives the full stack (operator -> executor -> trainer) through
 multiple SIGTERM preemptions at checkpoint boundaries and requires the job
 to finish with the final-step checkpoint intact.
+
+Resize-under-chaos (ISSUE 8): a pod SIGKILLed mid-live-reshard must land
+on the CLOSED fallback — checkpoint restore with no step loss beyond the
+last save — and a dead slice mid-run must shrink the gang live to its
+declared fallback shape with zero pod restarts.
 """
 import os
 import signal
@@ -104,5 +109,213 @@ def test_repeated_preemption_still_succeeds(tmp_path):
         jm = op.metrics_registry.get("JAXJob")
         assert jm.restarted >= KILLS
         assert _latest_step(ckpt) == STEPS
+    finally:
+        op.stop()
+
+
+# ---------------------------------------------------------------------------
+# resize under chaos (ISSUE 8): live-reshard failure ladder end to end
+# ---------------------------------------------------------------------------
+
+RESIZE_STEPS = 60
+RESIZE_INTERVAL = 5
+
+
+def _elastic_manifest(name, ckpt, extra_env=None):
+    env = dict(extra_env or {})
+    return {
+        "apiVersion": "kubedl-tpu.io/v1alpha1",
+        "kind": "JAXJob",
+        "metadata": {"name": name},
+        "spec": {
+            # short quiesce budget: the scheduler's reply deadline covers
+            # max(scheduler quiesce, this) — keep failure windows fast
+            "elastic": {"liveReshard": True, "quiesceTimeoutS": 2},
+            "checkpoint": {"path": ckpt, "saveIntervalSteps": RESIZE_INTERVAL},
+            "jaxReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "restartPolicy": "ExitCode",
+                "template": {"spec": {"containers": [{
+                    "name": "jax",
+                    "env": env,
+                    "command": [
+                        sys.executable, "-m", "kubedl_tpu.train.trainer",
+                        "--model", "tiny", "--steps", str(RESIZE_STEPS),
+                        "--batch", "8", "--seq-len", "33",
+                        "--checkpoint-path", ckpt,
+                        "--checkpoint-interval", str(RESIZE_INTERVAL),
+                        "--log-every", "1000",
+                    ],
+                    "resources": {"limits": {"google.com/tpu": 8}},
+                }]}},
+            }},
+            "runPolicy": {"schedulingPolicy": {
+                "tpuSlice": "v5e-8",
+                "tpuSliceFallbacks": ["v5e-4"],
+            }},
+        },
+    }
+
+
+def _elastic_operator():
+    from kubedl_tpu.operator import Operator, OperatorConfig
+
+    op = Operator(OperatorConfig(
+        tpu_slices=["v5e-8", "v5e-4"],
+        scheduler_policy="priority",
+        scheduler_interval=0.1,
+        elastic_shrink_delay=0.2,
+        elastic_grow_delay=3600.0,  # no grow-back churn mid-test
+    ))
+    from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+    op.register(JAXJobController())
+    op.start()
+    return op
+
+
+def _worker_log(op, name="resize"):
+    return op.executor.read_logs("default", f"{name}-worker-0")
+
+
+def test_dead_slice_shrinks_live_without_eviction(tmp_path):
+    """A dead slice mid-run becomes a live shrink onto the declared
+    fallback shape: zero pod restarts, zero step loss, job completes."""
+    ckpt = str(tmp_path / "ckpt")
+    op = _elastic_operator()
+    try:
+        job = op.apply(_elastic_manifest("resize", ckpt))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = _latest_step(ckpt)
+            if s is not None and s >= RESIZE_INTERVAL:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("trainer made no checkpointed progress")
+
+        op.report_slice_failure("slice-0-v5e-8")
+
+        # the reshard must complete as OK (not fallback): poll the metric
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = op.capacity_scheduler.snapshot()
+            if snap["reshards_total"]["ok"] >= 1:
+                break
+            assert snap["reshards_total"]["fallback"] == 0, snap
+            assert snap["reshards_total"]["failed"] == 0, snap
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"live reshard never completed: "
+                f"{op.capacity_scheduler.snapshot()['reshards_total']}")
+
+        assert op.wait_for_condition(job, "Succeeded", timeout=180), (
+            f"job did not finish after the live shrink; "
+            f"log tail: {_worker_log(op)[-2000:]}"
+        )
+        log_text = _worker_log(op)
+        assert "live reshard: resumed at step" in log_text
+        # survived WITHOUT eviction: no engine restarts, no Orbax restore
+        jm = op.metrics_registry.get("JAXJob")
+        assert jm.restarted == 0, "gang was restarted — not a live shrink"
+        assert "restored checkpoint" not in log_text
+        assert _latest_step(ckpt) == RESIZE_STEPS
+        # downtime metered (gauge + histogram source)
+        snap = op.capacity_scheduler.snapshot()
+        assert snap["resize_downtime"]["count"] >= 1
+        assert snap["resize_downtime"]["last"] > 0
+        # the dead slice's chips left the pool exactly once
+        util = op._gang.utilization()
+        assert util["slices_total"] == 1
+        assert all(s["name"] != "slice-0-v5e-8" for s in util["slices"])
+    finally:
+        op.stop()
+
+
+def test_pod_kill_mid_reshard_falls_back_to_checkpoint(tmp_path):
+    """SIGKILL a pod INSIDE the reshard critical section (the test seam
+    stalls it there): the reshard must fail CLOSED — the scheduler times
+    out, the gang restarts through checkpoint restore with no step loss
+    beyond the last save, and the job still completes."""
+    ckpt = str(tmp_path / "ckpt")
+    op = _elastic_operator()
+    # reply deadline = reply_timeout + quiesce budget; keep both short so
+    # the scheduler resolves the killed reshard within the test window
+    op.capacity_scheduler.config.reshard_reply_timeout = 5.0
+    op.capacity_scheduler.config.quiesce_timeout = 2.0
+    try:
+        job = op.apply(_elastic_manifest(
+            "resize", ckpt,
+            # stall between quiesce and commit so the kill provably lands
+            # mid-reshard
+            extra_env={"KUBEDL_RESHARD_TEST_DELAY_S": "8"},
+        ))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            s = _latest_step(ckpt)
+            if s is not None and s >= RESIZE_INTERVAL:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("trainer made no checkpointed progress")
+        step_at_kill = _latest_step(ckpt)
+
+        op.report_slice_failure("slice-0-v5e-8")
+        # wait for the RESIZE to be posted, give the trainer a moment to
+        # enter the stalled critical section, then SIGKILL it mid-reshard
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if op.capacity_scheduler.snapshot()["reshards_pending"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("RESIZE was never posted")
+        time.sleep(2.0)
+        with op.executor._lock:
+            entry = next(
+                (e for k, e in op.executor._running.items() if "resize" in k),
+                None)
+        assert entry is not None and entry.procs, "trainer process not found"
+        for proc in entry.procs.values():
+            try:
+                os.kill(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+        # the reshard must resolve as failed/fallback — never ok, never
+        # a silently corrupted state
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            snap = op.capacity_scheduler.snapshot()
+            tot = snap["reshards_total"]
+            if tot["failed"] + tot["fallback"] >= 1:
+                break
+            assert tot["ok"] == 0, f"killed reshard reported ok: {tot}"
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"reshard never resolved: "
+                f"{op.capacity_scheduler.snapshot()['reshards_total']}")
+
+        assert op.wait_for_condition(job, "Succeeded", timeout=240), (
+            f"job did not recover from the mid-reshard kill; "
+            f"log tail: {_worker_log(op)[-2000:]}"
+        )
+        log_text = _worker_log(op)
+        # the closed fallback landed on CHECKPOINT RESTORE...
+        assert "restored checkpoint at step" in log_text
+        # ...with no step loss beyond the last save
+        restored = [
+            int(line.rsplit(" ", 1)[1])
+            for line in log_text.splitlines()
+            if line.startswith("restored checkpoint at step")
+        ]
+        assert restored and min(restored) >= step_at_kill, (
+            f"restore lost steps: restored {restored}, "
+            f"last save before kill {step_at_kill}")
+        jm = op.metrics_registry.get("JAXJob")
+        assert jm.restarted >= 1
+        assert _latest_step(ckpt) == RESIZE_STEPS
     finally:
         op.stop()

@@ -166,3 +166,38 @@ def test_fleet_endpoint_and_drain(op):
             urllib.request.urlopen(bad)
     finally:
         srv.stop()
+
+
+def test_last_prefill_pod_drain_refused_and_fail_is_loud():
+    """Losing the only prefill pod must never strand queued requests on a
+    done flag nobody will set: drain REFUSES (the pod keeps serving) and
+    a hard fail() marks each queued request failed loudly."""
+    import jax
+    import numpy as np
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.router import DecodePod, PrefillPod, ServingRouter
+
+    cfg = llama.LlamaConfig.tiny(use_flash=False)
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    r = ServingRouter(
+        [PrefillPod("p0", params, cfg, max_len=64)],
+        [DecodePod("d0", params, cfg, slots=2, max_len=64, block_size=8)])
+    prompt = np.arange(1, 6, dtype=np.int32)
+    req = r.submit(prompt, 4)
+    assert r.prefill_pods[0].queue_len() == 1
+
+    with pytest.raises(RuntimeError, match="last eligible prefill"):
+        r.drain("p0")
+    # refused: the pod still serves and the queue is intact
+    assert not r.prefill_pods[0].draining
+    assert r.prefill_pods[0].queue_len() == 1
+
+    moved = r.fail("p0")
+    assert moved == 0
+    assert req.done and "no eligible replacement" in (req.error or "")
+    # an empty-queue drain of the last pod is still allowed (teardown)
+    r2 = ServingRouter(
+        [PrefillPod("p0", params, cfg, max_len=64)],
+        [DecodePod("d0", params, cfg, slots=2, max_len=64, block_size=8)])
+    assert r2.drain("p0") == 0
