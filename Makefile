@@ -22,7 +22,8 @@ presubmit:
 	  --total tests/test_kv_pool.py=30 \
 	  --total tests/test_serving_disagg.py=120 \
 	  --total tests/test_serving_fleet.py=60 \
-	  --total tests/test_reshard.py=45
+	  --total tests/test_reshard.py=45 \
+	  --total tests/test_pipeline_1f1b.py=100
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -48,6 +49,14 @@ bench-serving:
 .PHONY: bench-resize
 bench-resize:
 	$(PY) bench.py --resize-only
+
+# Pipeline-only fast loop: the pipeline_schedule record — GPipe vs
+# interleaved 1F1B bubble fraction + step time at the bench shape
+# (M=8, S=4, v=2), plus the 2-stage MPMD lane vs the single-program
+# oracle (merges ONLY the pipeline_schedule key into .bench_extras.json).
+.PHONY: bench-pp
+bench-pp:
+	$(PY) bench.py --pipeline-only
 
 .PHONY: manifests
 manifests:

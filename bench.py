@@ -1137,6 +1137,118 @@ def _tpu_child(results_path: str) -> int:
                            "recreate + re-admission (real gap is wider)",
         })
 
+    # -- pipeline schedule: GPipe vs interleaved 1F1B at the bench shape
+    # (M=8, S=4, v=2) on one mesh — same model, same batch, only the
+    # schedule changes — plus the 2-stage MPMD lane (two separate
+    # programs on disjoint device halves, serialized DCN boundary)
+    # against the single-program oracle. ISSUE 9 acceptance: 1F1B bubble
+    # fraction <= 0.6x GPipe's, loss parity pinned in tests. ------------
+    def pipeline_schedule_milestone():
+        import optax
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.parallel import pipeline as pschedule
+        from kubedl_tpu.parallel.mesh import ShardingRules, build_mesh
+        from kubedl_tpu.parallel.train_step import make_train_step
+        from kubedl_tpu.train.pipeline_runtime import MPMDPipeline
+
+        devs = jax.devices()
+        S, M, V = 4, 8, 2
+        if len(devs) < 8:
+            _emit(out, "pipeline_schedule",
+                  {"skipped": f"needs >= 8 devices for the stage=4 x "
+                              f"data=2 bench mesh, have {len(devs)}"})
+            return
+        config = (llama.LlamaConfig.tiny(
+            dtype=jnp.float32, use_flash=False, n_layers=8, remat=False)
+            if small else llama.LlamaConfig.bench_150m(remat=False))
+        # batch/M microbatch rows must divide the widest batch sharding
+        # in play (the MPMD stage meshes are data=2 x fsdp=2 -> 4-way)
+        batch, seq = (32, 128) if small else (32, 512)
+        mesh = build_mesh({"stage": S, "data": 2}, devices=devs[:8])
+        rules = ShardingRules()
+        params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+        spec_tree = llama.param_specs_pp(config, rules)
+        tokens = jnp.asarray(np.random.default_rng(0).integers(
+            0, config.vocab_size, (batch, seq), dtype=np.int32))
+
+        def build(schedule, interleave):
+            def loss(p, b):
+                return llama.loss_fn_pp(
+                    p, b, config, mesh, rules=rules, n_microbatches=M,
+                    schedule=schedule, interleave=interleave)
+
+            return make_train_step(
+                loss, optax.adamw(1e-3), mesh, spec_tree,
+                rules.spec("batch", None), rules)
+
+        def timed_step(schedule, interleave, reps=5):
+            init_state, train_step = build(schedule, interleave)
+            state = init_state(params)
+            for _ in range(2):  # compile + settle
+                state, m = train_step(state, tokens)
+            jax.device_get(m["loss"])
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                state, m = train_step(state, tokens)
+                jax.device_get(m["loss"])
+                times.append(time.perf_counter() - t0)
+            return statistics.median(times), float(jax.device_get(m["loss"]))
+
+        gpipe_s, loss_g = timed_step("gpipe", 1)
+        f1b_s, loss_f = timed_step("1f1b", V)
+        bub_g = pschedule.bubble_fraction(M, S, 1)
+        bub_f = pschedule.bubble_fraction(M, S, V)
+
+        # MPMD lane: 2 stage programs on DISJOINT device halves, joined
+        # only by the serialized boundary; oracle = the single-program
+        # pipeline at the same (S=2, M) shape on matching granularity
+        mesh2 = build_mesh({"stage": 2}, devices=devs[:2])
+        oracle = float(jax.device_get(jax.jit(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, config, mesh2, rules=rules, n_microbatches=M)
+        )(params, tokens)))
+        meshes = [build_mesh({"data": 2, "fsdp": 2}, devices=devs[:4]),
+                  build_mesh({"data": 2, "fsdp": 2}, devices=devs[4:8])]
+        mp = MPMDPipeline(
+            config, llama.init(config, jax.random.PRNGKey(0)),
+            optax.sgd(0.0), n_stages=2, n_microbatches=M, meshes=meshes,
+            job="bench-pp")
+        mp.step(np.asarray(tokens))  # warm the stage programs
+        r = mp.step(np.asarray(tokens))
+        mp.close()
+
+        _emit(out, "pipeline_schedule", {
+            "shape": {"stages": S, "microbatches": M, "interleave": V,
+                      "model": "tiny" if small else "150m",
+                      "batch": batch, "seq": seq},
+            "bubble_frac_gpipe": round(bub_g, 4),
+            "bubble_frac_1f1b": round(bub_f, 4),
+            "bubble_ratio": round(bub_f / bub_g, 4),
+            "gpipe_step_s": round(gpipe_s, 4),
+            "f1b_step_s": round(f1b_s, 4),
+            "step_speedup": round(gpipe_s / f1b_s, 4),
+            "loss_gpipe": round(loss_g, 6),
+            "loss_1f1b": round(loss_f, 6),
+            "loss_delta": round(abs(loss_g - loss_f), 8),
+            "mpmd": {
+                "stages": 2,
+                "step_loss": round(r["loss"], 6),
+                "oracle_loss": round(oracle, 6),
+                "loss_delta": round(abs(r["loss"] - oracle), 8),
+                "serialized_mb": round(r["serialized_bytes"] / 2**20, 3),
+                "stage_step_s": [round(t, 4) for t in r["stage_step_s"]],
+                "stage_wait_s": [round(t, 4) for t in r["stage_wait_s"]],
+            },
+            "environment": "schedule bubble fractions are analytic "
+                           "((S-1)/(M*v+S-1) — the step counts the "
+                           "compiled loops actually run); step times "
+                           "measured on this process's devices; MPMD "
+                           "lane runs two separate programs on disjoint "
+                           "device halves with every boundary serialized",
+        })
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -1151,6 +1263,7 @@ def _tpu_child(results_path: str) -> int:
         ("serving_spec", serving_spec_milestone, 150),
         ("serving_latency", serving_latency_milestone, 150),
         ("resize_downtime", resize_downtime_milestone, 120),
+        ("pipeline_schedule", pipeline_schedule_milestone, 150),
         ("grpo", grpo_milestone, 150),
     ]
     # -- 6. MoE dispatch-overhead breakdown: per-stage timing of the
@@ -1399,92 +1512,80 @@ def _collect_results(results_path: str):
     return extras
 
 
-def _moe_only() -> int:
-    """`bench.py --moe-only` (make bench-moe): run ONLY the MoE training
-    milestone + the dispatch-overhead breakdown, in-process, and print
-    the records as indented JSON — the quick iteration loop for MoE perf
-    work. No operator launch-delay run, no other TPU milestones."""
-    os.environ.setdefault("KUBEDL_BENCH_ONLY", "llama_moe,moe_breakdown")
-    results_path = os.path.join(REPO, ".bench_results_moe.jsonl")
+def _single_lane(name, milestones, merge_keys=(), small_devices=0):
+    """Shared body of the `--*-only` fast loops (bench-moe / bench-serving /
+    bench-resize / bench-pp): run ONLY the named milestones in-process,
+    print the records as indented JSON, and — when `merge_keys` is set —
+    fold JUST those keys into .bench_extras.json. The guarded merge is
+    the invariant: the child also emits run-scoped records
+    (peak/probe/progress/done) whose committed values describe the last
+    FULL sweep, so a CPU smoke run must never overwrite the chip's
+    peak_tflops (the full-run snapshot merge at the bottom of main()
+    excludes the same keys for the same reason). `small_devices` forces
+    that many virtual host devices on the KUBEDL_BENCH_SMALL smoke lane
+    (must land before the lazy jax import)."""
+    os.environ.setdefault("KUBEDL_BENCH_ONLY", ",".join(milestones))
+    if small_devices and os.environ.get("KUBEDL_BENCH_SMALL"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{small_devices}").strip()
+    results_path = os.path.join(REPO, f".bench_results_{name}.jsonl")
     open(results_path, "w").close()
     rc = _tpu_child(results_path)
-    print(json.dumps(_parse_results(results_path), indent=1, sort_keys=True))
+    records = _parse_results(results_path)
+    if merge_keys:
+        extras_path = os.path.join(REPO, ".bench_extras.json")
+        try:
+            with open(extras_path) as f:
+                extras = json.load(f)
+        except (OSError, ValueError):
+            extras = {}
+        extras.update({k: v for k, v in records.items() if k in merge_keys})
+        with open(extras_path, "w") as f:
+            json.dump(extras, f, indent=1, sort_keys=True)
+    print(json.dumps(records, indent=1, sort_keys=True))
     return rc
+
+
+def _moe_only() -> int:
+    """`bench.py --moe-only` (make bench-moe): ONLY the MoE training
+    milestone + the dispatch-overhead breakdown — the quick iteration
+    loop for MoE perf work (no extras merge; llama_moe rides the full
+    sweep's snapshot discipline)."""
+    return _single_lane("moe", ("llama_moe", "moe_breakdown"))
 
 
 def _serving_only() -> int:
-    """`bench.py --serving-only` (make bench-serving): run ONLY the
-    serving milestones — throughput (serving) + the disaggregated-plane
-    latency/capacity record (serving_latency) — in-process, and print
-    the records as indented JSON. The quick iteration loop for serving
-    work, mirroring the --moe-only / bench-moe lane."""
-    os.environ.setdefault("KUBEDL_BENCH_ONLY", "serving,serving_latency")
-    if os.environ.get("KUBEDL_BENCH_SMALL"):
-        # CPU smoke lane: two host devices so the prefill pod gets its
-        # own execution queue, the way it gets its own chip in the fleet
-        # (must land before the lazy jax import below)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=2").strip()
-    results_path = os.path.join(REPO, ".bench_results_serving.jsonl")
-    open(results_path, "w").close()
-    rc = _tpu_child(results_path)
-    records = _parse_results(results_path)
-    # fold the serving records into .bench_extras.json (merge, don't
-    # clobber other milestones' entries) so the serving-lane evidence —
-    # paged admission ratio, prefix-share hit-rate, mono-vs-disagg
-    # TTFT/per-token percentiles — lands in the committed evidence file
-    # without a full bench sweep
-    extras_path = os.path.join(REPO, ".bench_extras.json")
-    try:
-        with open(extras_path) as f:
-            extras = json.load(f)
-    except (OSError, ValueError):
-        extras = {}
-    # merge ONLY the serving milestones: the child also emits run-scoped
-    # records (peak/probe/progress/done) whose committed values describe
-    # the last FULL sweep — a CPU smoke run must not overwrite the
-    # chip's peak_tflops (the full-run snapshot merge at the bottom of
-    # main() excludes the same keys for the same reason)
-    extras.update({k: v for k, v in records.items()
-                   if k in ("serving", "serving_latency")})
-    with open(extras_path, "w") as f:
-        json.dump(extras, f, indent=1, sort_keys=True)
-    print(json.dumps(records, indent=1, sort_keys=True))
-    return rc
+    """`bench.py --serving-only` (make bench-serving): ONLY the serving
+    throughput + disaggregated-plane latency/capacity records, merged
+    into .bench_extras.json. The smoke lane gets 2 host devices so the
+    prefill pod has its own execution queue, the way it has its own chip
+    in the fleet."""
+    return _single_lane(
+        "serving", ("serving", "serving_latency"),
+        merge_keys=("serving", "serving_latency"), small_devices=2)
 
 
 def _resize_only() -> int:
-    """`bench.py --resize-only` (make bench-resize): run ONLY the
-    resize_downtime record — live reshard vs checkpoint round trip on the
-    same model — and merge JUST that key into .bench_extras.json (same
-    guarded-merge discipline as --serving-only: a CPU smoke run must
-    never clobber the chip's committed peak/probe/progress records)."""
-    os.environ.setdefault("KUBEDL_BENCH_ONLY", "resize_downtime")
-    if os.environ.get("KUBEDL_BENCH_SMALL"):
-        # CPU smoke lane: 8 host devices so the n -> n/2 resize exercises
-        # a real multi-device mesh (must land before the jax import)
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-    results_path = os.path.join(REPO, ".bench_results_resize.jsonl")
-    open(results_path, "w").close()
-    rc = _tpu_child(results_path)
-    records = _parse_results(results_path)
-    extras_path = os.path.join(REPO, ".bench_extras.json")
-    try:
-        with open(extras_path) as f:
-            extras = json.load(f)
-    except (OSError, ValueError):
-        extras = {}
-    extras.update({k: v for k, v in records.items()
-                   if k == "resize_downtime"})
-    with open(extras_path, "w") as f:
-        json.dump(extras, f, indent=1, sort_keys=True)
-    print(json.dumps(records, indent=1, sort_keys=True))
-    return rc
+    """`bench.py --resize-only` (make bench-resize): ONLY the
+    resize_downtime record — live reshard vs checkpoint round trip on
+    the same model; the smoke lane gets 8 host devices so the n -> n/2
+    resize exercises a real multi-device mesh."""
+    return _single_lane(
+        "resize", ("resize_downtime",),
+        merge_keys=("resize_downtime",), small_devices=8)
+
+
+def _pipeline_only() -> int:
+    """`bench.py --pipeline-only` (make bench-pp): ONLY the
+    pipeline_schedule record — GPipe vs interleaved 1F1B step time +
+    bubble fractions and the 2-stage MPMD lane; the smoke lane gets 8
+    host devices for the stage=4 x data=2 bench mesh."""
+    return _single_lane(
+        "pipeline", ("pipeline_schedule",),
+        merge_keys=("pipeline_schedule",), small_devices=8)
 
 
 def main() -> int:
@@ -1496,6 +1597,8 @@ def main() -> int:
         return _serving_only()
     if "--resize-only" in sys.argv:
         return _resize_only()
+    if "--pipeline-only" in sys.argv:
+        return _pipeline_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

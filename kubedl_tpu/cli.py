@@ -357,6 +357,22 @@ def cmd_top(args) -> int:
     if cap:
         _print_capacity_tenants(cap)
         print()
+    pipe = vars_.get("pipeline")
+    if pipe and pipe.get("jobs"):
+        rows = [("PIPELINE_JOB", "SCHEDULE", "STAGES", "BUBBLE", "STEPS",
+                 "STAGE_STEP_MS")]
+        for job, rec in sorted(pipe["jobs"].items()):
+            per_stage = " ".join(
+                f"{s}:{t * 1e3:.0f}" for s, t in
+                # /debug/vars JSON turns the int stage keys into strings;
+                # sort numerically or stage 10 renders before stage 2
+                sorted((rec.get("stage_step_s") or {}).items(),
+                       key=lambda kv: int(kv[0])))
+            rows.append((job, rec.get("schedule", ""), rec.get("stages", 0),
+                         f"{rec.get('bubble_frac', 0.0):.3f}",
+                         rec.get("steps", 0), per_stage or "-"))
+        _print_table(rows)
+        print()
     rows = [("CONTROLLER", "RECONCILES", "ERRORS", "REQUEUES", "QUEUE", "MEAN_MS")]
     for name, c in sorted((vars_.get("controllers") or {}).items()):
         rows.append((name, c.get("reconciles", 0), c.get("errors", 0),

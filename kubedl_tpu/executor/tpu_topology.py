@@ -174,6 +174,30 @@ class Placement:
         }
 
 
+def pipeline_neighbor_env(
+    stage: int,
+    num_stages: int,
+    prev_addr: str = "",
+    next_addr: str = "",
+) -> Dict[str, str]:
+    """Env wiring for one MPMD pipeline stage: which stage this slice's
+    program is, and the coordinator addresses of its ring neighbors —
+    stage s streams activations to `next` and activation-gradients back
+    to `prev`, so each program only ever dials its two neighbors (the
+    DCN topology of the MPMD pipeline paper: a chain, not an all-to-all
+    Megascale mesh). Endpoint stages carry an empty addr on the missing
+    side. The JAXJob controller fills the addrs from the neighbor stage
+    worker-0 services (workloads/jaxjob.py set_cluster_spec)."""
+    if not (0 <= stage < num_stages):
+        raise ValueError(f"stage {stage} out of range [0, {num_stages})")
+    return {
+        "KUBEDL_PP_STAGE": str(stage),
+        "KUBEDL_PP_STAGES": str(num_stages),
+        "KUBEDL_PP_PREV_ADDR": prev_addr if stage > 0 else "",
+        "KUBEDL_PP_NEXT_ADDR": next_addr if stage < num_stages - 1 else "",
+    }
+
+
 @dataclass
 class SliceInfo:
     """One physical slice in the pool."""

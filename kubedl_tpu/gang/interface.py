@@ -52,6 +52,10 @@ class GangSnapshot:
     num_slices: int = 1
     requested_slice: str = ""
     admissible_slices: List[str] = field(default_factory=list)
+    # heterogeneous MPMD pipeline gang (JAXJob spec.pipeline.stageSlices):
+    # slice i of the reservation must match stage_slices[i]; admission
+    # stays all-or-nothing across the whole per-stage assignment
+    stage_slices: List[str] = field(default_factory=list)
     slice_names: List[str] = field(default_factory=list)
     reserved_chips: int = 0
     hold_until: float = 0.0  # monotonic; 0 = not held

@@ -102,6 +102,10 @@ class _GangState:
     # elastic: ordered admissible shapes, preferred first (requested_slice
     # is the CURRENT target and may be resized among these by directive)
     admissible_slices: List[str] = field(default_factory=list)
+    # heterogeneous MPMD pipeline gang (JAXJob spec.pipeline.stageSlices,
+    # len == num_slices): slice i of the reservation is STAGE i's and
+    # must match stage_slices[i]; empty = homogeneous (requested_slice)
+    stage_slices: List[str] = field(default_factory=list)
     hold_until: float = 0.0  # monotonic; preemption backoff — no reserving before
     preemptions: int = 0  # times this gang was evicted by directive
     waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
@@ -310,6 +314,21 @@ class TPUSliceAdmitter(GangScheduler):
                 )
                 num_slices = max(int(getattr(job.spec, "num_slices", 1) or 1), 1)
                 elastic = getattr(job.spec, "elastic", None)
+                # heterogeneous MPMD pipeline gang: per-stage slice
+                # shapes (validated at submit — unparseable/ragged lists
+                # are dropped here so the admitter never wedges on them)
+                pipe = getattr(job.spec, "pipeline", None)
+                stage_slices: List[str] = []
+                if (pipe is not None and getattr(pipe, "mpmd", False)
+                        and getattr(pipe, "stage_slices", None)):
+                    cand = [str(s) for s in pipe.stage_slices]
+                    try:
+                        for s in cand:
+                            parse_slice_type(s)
+                        if len(cand) == num_slices:
+                            stage_slices = cand
+                    except ValueError:
+                        stage_slices = []
                 self._seq += 1
                 state = _GangState(
                     min_member=min_member, tpu_chips=chips,
@@ -319,6 +338,7 @@ class TPUSliceAdmitter(GangScheduler):
                     kind=getattr(job, "kind", "") or "",
                     tenant=(tenancy.tenant if tenancy else "") or "default",
                     admissible_slices=admissible,
+                    stage_slices=stage_slices,
                     waiting_since=time.monotonic(),
                     live_reshard=bool(getattr(elastic, "live_reshard", False)),
                     quiesce_s=float(
@@ -865,6 +885,7 @@ class TPUSliceAdmitter(GangScheduler):
             num_slices=state.num_slices,
             requested_slice=state.requested_slice,
             admissible_slices=list(state.admissible_slices),
+            stage_slices=list(state.stage_slices),
             slice_names=list(state.slice_names),
             reserved_chips=sum(
                 self._slices[s].type.chips
@@ -965,7 +986,13 @@ class TPUSliceAdmitter(GangScheduler):
     def _feasible(self, state: _GangState) -> bool:
         """Could this gang EVER be satisfied by the current pool (counting
         busy slices as eventually freeable)? Gates the anti-starvation
-        shield so an impossible request doesn't wedge the queue."""
+        shield so an impossible request doesn't wedge the queue. A
+        heterogeneous gang needs a FULL per-stage assignment to exist,
+        not just enough union-matching slices."""
+        if state.stage_slices:
+            return self._hetero_assign(
+                state, list(self._slices.values()), use_director=False
+            ) is not None
         return len(self._matching_slices(state, self._slices.values())) >= max(
             state.num_slices, 1
         )
@@ -1019,18 +1046,74 @@ class TPUSliceAdmitter(GangScheduler):
                  or director.may_reserve(s, usage, total_chips))
         ]
 
+    @staticmethod
+    def _stage_matching(shape: str, pool) -> List[SliceInfo]:
+        want = parse_slice_type(shape)
+        return [
+            s for s in pool
+            if s.type.generation == want.generation
+            and s.type.chips >= want.chips
+        ]
+
     def _matching_slices(self, state: _GangState, pool) -> List[SliceInfo]:
         """Slices that satisfy the gang's PER-SLICE demand (explicit slice
         type, or chips: the job's total divides over its slices; ceil keeps
-        ragged specs safe)."""
+        ragged specs safe). A heterogeneous gang (stage_slices) matches the
+        UNION of its per-stage shapes — probes and shields count every
+        slice any stage could take; the actual per-stage assignment is
+        _hetero_assign's job."""
+        if state.stage_slices:
+            seen, out = set(), []
+            for shape in state.stage_slices:
+                for s in self._stage_matching(shape, pool):
+                    if s.name not in seen:
+                        seen.add(s.name)
+                        out.append(s)
+            return out
         per_slice_chips = -(-state.tpu_chips // max(state.num_slices, 1))
         if state.requested_slice:
-            want = parse_slice_type(state.requested_slice)
-            return [
-                s for s in pool
-                if s.type.generation == want.generation and s.type.chips >= want.chips
-            ]
+            return self._stage_matching(state.requested_slice, pool)
         return [s for s in pool if s.type.chips >= per_slice_chips]
+
+    def _hetero_assign(
+        self,
+        state: _GangState,
+        candidates: List[SliceInfo],
+        use_director: bool = True,
+    ) -> Optional[List[SliceInfo]]:
+        """Assign one DISTINCT candidate per stage shape, returned in
+        STAGE order (slice_names[i] is stage i's slice — the pod
+        slice-id label indexes it). Greedy: most demanding stage first,
+        tightest fit per stage unless the director (gavel pricing)
+        proposes a cheaper adequate slice. None = no full assignment —
+        all-or-nothing, a partial match reserves NOTHING."""
+        wants = [parse_slice_type(s) for s in state.stage_slices]
+        order = sorted(range(len(wants)), key=lambda i: -wants[i].chips)
+        taken: set = set()
+        chosen: List[Optional[SliceInfo]] = [None] * len(wants)
+        for i in order:
+            cands = [
+                s for s in self._stage_matching(state.stage_slices[i], candidates)
+                if s.name not in taken
+            ]
+            if not cands:
+                return None
+            pick = None
+            if use_director and self._director is not None:
+                probe = _GangState(
+                    tpu_chips=state.tpu_chips,
+                    requested_slice=state.stage_slices[i],
+                    num_slices=1, tenant=state.tenant)
+                picked = self._director.choose_slices(probe, list(cands), 1)
+                if picked and len(picked) == 1 and picked[0].name in {
+                    s.name for s in cands
+                }:
+                    pick = picked[0]
+            if pick is None:
+                pick = min(cands, key=lambda s: s.type.chips)
+            chosen[i] = pick
+            taken.add(pick.name)
+        return chosen  # complete by construction
 
     def _headroom(self, state: _GangState, usage=None, total_chips=0):
         """The gang's tenant-cap headroom per the director; None = no cap.
@@ -1101,7 +1184,24 @@ class TPUSliceAdmitter(GangScheduler):
         returns a valid subset, else tightest fits first (keep big
         slices free for big gangs); the cap binds on the SUM of the
         actual grant (multislice), retrying with the minimal-chips
-        subset before giving up. None = no cap-fitting choice."""
+        subset before giving up. None = no cap-fitting choice.
+
+        Heterogeneous gangs (stage_slices) route through _hetero_assign:
+        one distinct slice per stage shape, stage-ordered, all-or-
+        nothing; when the gavel-priced pick breaches the tenant cap the
+        tightest-per-stage assignment is retried before giving up."""
+        if state.stage_slices:
+            chosen = self._hetero_assign(state, candidates)
+            if chosen is not None and headroom is not None and sum(
+                s.type.chips for s in chosen
+            ) > headroom:
+                chosen = self._hetero_assign(
+                    state, candidates, use_director=False)
+            if chosen is not None and headroom is not None and sum(
+                s.type.chips for s in chosen
+            ) > headroom:
+                return None
+            return chosen
         chosen = None
         if self._director is not None:
             picked = self._director.choose_slices(state, list(candidates), n)

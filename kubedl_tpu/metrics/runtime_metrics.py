@@ -46,6 +46,56 @@ class _Histogram:
         self.counts[-1] += 1
 
 
+class PipelineMetrics:
+    """Pipeline-schedule health per job: bubble fraction and per-stage
+    step seconds (kubedl_pipeline_* series). Fed by the MPMD runtime's
+    in-process lane (train/pipeline_runtime.py MPMDPipeline) and by
+    tests/bench; the module-level `pipeline_metrics` singleton is what
+    the operator registers (RuntimeMetrics.register_pipeline)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, Dict] = {}
+
+    def observe_step(
+        self,
+        job: str,
+        schedule: str,
+        n_stages: int,
+        bubble_frac: float,
+        stage_step_s: Dict[int, float],
+        loss: Optional[float] = None,
+    ) -> None:
+        with self._lock:
+            rec = self._jobs.setdefault(job, {"steps": 0})
+            rec["steps"] += 1
+            rec.update({
+                "schedule": schedule,
+                "stages": int(n_stages),
+                "bubble_frac": float(bubble_frac),
+                "stage_step_s": {
+                    int(s): float(t) for s, t in stage_step_s.items()},
+            })
+            if loss is not None:
+                rec["loss"] = float(loss)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"jobs": {
+                job: {**rec,
+                      "stage_step_s": dict(rec.get("stage_step_s", {}))}
+                for job, rec in self._jobs.items()
+            }}
+
+    def reset(self) -> None:
+        """Test isolation — drop every recorded job."""
+        with self._lock:
+            self._jobs.clear()
+
+
+pipeline_metrics = PipelineMetrics()
+
+
 class RuntimeMetrics:
     """Thread-safe collector for the reconcile engine."""
 
@@ -60,6 +110,8 @@ class RuntimeMetrics:
         self._slice_pool: Optional[Callable[[], Dict]] = None
         # capacity-scheduler snapshot callable (CapacityScheduler.snapshot)
         self._capacity: Optional[Callable[[], Dict]] = None
+        # pipeline-schedule snapshot callable (PipelineMetrics.snapshot)
+        self._pipeline: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -88,6 +140,12 @@ class RuntimeMetrics:
         (per-tenant quota/usage + the waiting queue)."""
         with self._lock:
             self._capacity = snapshot_fn
+
+    def register_pipeline(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns PipelineMetrics.snapshot()-shaped dicts
+        (per-job schedule, bubble fraction, per-stage step seconds)."""
+        with self._lock:
+            self._pipeline = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -239,6 +297,43 @@ class RuntimeMetrics:
                     lines.append(
                         f"kubedl_resize_downtime_seconds_count "
                         f"{downtime['count']}")
+        with self._lock:
+            pipe_fn = self._pipeline
+        if pipe_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                pipe = pipe_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                pipe = None
+            if pipe is not None and pipe.get("jobs"):
+                lines.append("# HELP kubedl_pipeline_bubble_frac Pipeline "
+                             "schedule fill/drain bubble fraction per job")
+                lines.append("# TYPE kubedl_pipeline_bubble_frac gauge")
+                jobs = sorted(pipe["jobs"].items())
+                for job, rec in jobs:
+                    # job names come from user manifests — escape them
+                    lines.append(
+                        f'kubedl_pipeline_bubble_frac{{job="{_label(job)}"'
+                        f',schedule="{_label(rec.get("schedule", ""))}"}} '
+                        f'{rec.get("bubble_frac", 0.0):.4f}')
+                lines.append("# HELP kubedl_pipeline_stage_step_seconds "
+                             "Last train-step wall time per pipeline stage")
+                lines.append(
+                    "# TYPE kubedl_pipeline_stage_step_seconds gauge")
+                for job, rec in jobs:
+                    for stage, secs in sorted(
+                            (rec.get("stage_step_s") or {}).items()):
+                        lines.append(
+                            f'kubedl_pipeline_stage_step_seconds'
+                            f'{{job="{_label(job)}",stage="{stage}"}} '
+                            f'{secs:.6f}')
+                lines.append("# HELP kubedl_pipeline_steps_total Pipeline "
+                             "train steps observed per job")
+                lines.append("# TYPE kubedl_pipeline_steps_total counter")
+                for job, rec in jobs:
+                    lines.append(
+                        f'kubedl_pipeline_steps_total{{job="{_label(job)}"}} '
+                        f'{rec.get("steps", 0)}')
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -261,6 +356,12 @@ class RuntimeMetrics:
                 out["controllers"].setdefault(name, {})["queue_depth"] = depth
             slice_fn = self._slice_pool
             cap_fn = self._capacity
+            pipe_fn = self._pipeline
+        if pipe_fn is not None:
+            try:
+                out["pipeline"] = pipe_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["pipeline"] = None
         if slice_fn is not None:
             try:
                 out["slice_pool"] = slice_fn()  # outside the lock, see render()

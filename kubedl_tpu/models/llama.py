@@ -719,34 +719,15 @@ def stack_params(params: Dict) -> Dict:
     return out
 
 
-def forward_pipelined_and_aux(
-    params: Dict,  # stacked layout (stack_params)
-    tokens: jax.Array,
-    config: LlamaConfig,
-    mesh: Mesh,
-    rules: Optional[ShardingRules] = None,
-    n_microbatches: int = 4,
-) -> Tuple[jax.Array, jax.Array]:
-    """GPipe forward over the mesh's "stage" axis; returns (logits,
-    summed MoE aux loss — 0 when dense). Composes with data parallelism
-    AND MoE (experts replicated per stage: _mlp_block runs the local
-    dropless gmm route inside the stage body, aux accumulated per valid
-    microbatch window — parallel/pipeline.py); tensor/context/expert
-    must be size 1 on a pipelined mesh (those shardings need manual
-    collectives inside shard_map)."""
-    if config.layer_windows is not None:
-        # the pipeline scans ONE compiled layer program over stacked
-        # params; a per-layer static mask can't vary inside the scan
-        raise ValueError("pipelined path requires a uniform window "
-                         "(layer_windows unsupported)")
-    for ax in ("tensor", "context", "expert"):
-        if mesh.shape.get(ax, 1) != 1:
-            raise ValueError(f"pipelined mesh must have {ax}=1, got {mesh.shape[ax]}")
+def pipeline_layer_fn(config: LlamaConfig, t: int,
+                      rules: Optional[ShardingRules] = None):
+    """The ONE per-layer body every pipelined path applies — the GPipe
+    oracle, the interleaved 1F1B schedule, and the MPMD stage programs
+    (train/pipeline_runtime.py) all run this closure, so schedule parity
+    can never drift into layer-math drift. `layer_fn(act, layer) ->
+    (act, aux_scalar)`; `t` is the (static) sequence length."""
     rules = rules or ShardingRules()
-    b, t = tokens.shape
     positions1 = jnp.arange(t, dtype=jnp.int32)[None]
-
-    x = params["embed"][tokens].astype(config.dtype)
 
     def layer_fn(a, layer):
         pos = jnp.broadcast_to(positions1, (a.shape[0], t))
@@ -755,10 +736,61 @@ def forward_pipelined_and_aux(
         a, aux = _mlp_block(a, layer, config)
         return a, aux
 
+    return layer_fn
+
+
+def forward_pipelined_and_aux(
+    params: Dict,  # stacked layout (stack_params)
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    n_microbatches: int = 4,
+    schedule: str = "gpipe",
+    interleave: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipelined forward over the mesh's "stage" axis; returns (logits,
+    summed MoE aux loss — 0 when dense). `schedule` picks the loop:
+    "gpipe" (parallel/pipeline.py pipeline_apply — the parity oracle) or
+    "1f1b" (pipeline_apply_1f1b, interleaved circular schedule with
+    `interleave` virtual stages per rank; interleave > 1 requires it).
+    Composes with data parallelism AND MoE (experts replicated per
+    stage: _mlp_block runs the local dropless gmm route inside the stage
+    body, aux accumulated per valid microbatch window);
+    tensor/context/expert must be size 1 on a pipelined mesh (those
+    shardings need manual collectives inside shard_map)."""
+    if config.layer_windows is not None:
+        # the pipeline scans ONE compiled layer program over stacked
+        # params; a per-layer static mask can't vary inside the scan
+        raise ValueError("pipelined path requires a uniform window "
+                         "(layer_windows unsupported)")
+    for ax in ("tensor", "context", "expert"):
+        if mesh.shape.get(ax, 1) != 1:
+            raise ValueError(f"pipelined mesh must have {ax}=1, got {mesh.shape[ax]}")
+    from kubedl_tpu.api.validation import validate_pipeline_shapes
+
+    # the schedule-name/interleave pairing rules live in the SHARED
+    # validator (api/validation.py) so submit-time and runtime can't
+    # drift; the shape rules re-check inside the schedule builders
+    sched_errs = validate_pipeline_shapes(
+        mesh.shape["stage"], n_microbatches, interleave,
+        schedule=schedule, path="forward_pipelined")
+    if sched_errs:
+        raise ValueError("; ".join(sched_errs))
+    rules = rules or ShardingRules()
+    layer_fn = pipeline_layer_fn(config, tokens.shape[1], rules)
+
+    x = params["embed"][tokens].astype(config.dtype)
     x = pipeline.microbatch(x, n_microbatches)
-    y, aux = pipeline.pipeline_apply(
-        params["layers"], x, layer_fn, mesh=mesh, remat=config.remat,
-    )
+    if schedule == "1f1b":
+        y, aux = pipeline.pipeline_apply_1f1b(
+            params["layers"], x, layer_fn, mesh=mesh,
+            interleave=interleave, remat=config.remat,
+        )
+    else:
+        y, aux = pipeline.pipeline_apply(
+            params["layers"], x, layer_fn, mesh=mesh, remat=config.remat,
+        )
     x = pipeline.unmicrobatch(y)
     return _lm_head(x, params, config), aux
 
@@ -770,16 +802,22 @@ def forward_pipelined(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     n_microbatches: int = 4,
+    schedule: str = "gpipe",
+    interleave: int = 1,
 ) -> jax.Array:
     return forward_pipelined_and_aux(
         params, tokens, config, mesh, rules=rules,
-        n_microbatches=n_microbatches)[0]
+        n_microbatches=n_microbatches, schedule=schedule,
+        interleave=interleave)[0]
 
 
 def loss_fn_pp(
-    params, tokens, config: LlamaConfig, mesh: Mesh, rules=None, n_microbatches: int = 4
+    params, tokens, config: LlamaConfig, mesh: Mesh, rules=None,
+    n_microbatches: int = 4, schedule: str = "gpipe", interleave: int = 1,
 ):
     logits, aux = forward_pipelined_and_aux(
-        params, tokens[:, :-1], config, mesh, rules=rules, n_microbatches=n_microbatches
+        params, tokens[:, :-1], config, mesh, rules=rules,
+        n_microbatches=n_microbatches, schedule=schedule,
+        interleave=interleave,
     )
     return _next_token_ce(logits, tokens[:, 1:]) + config.moe_aux_coef * aux

@@ -111,6 +111,12 @@ class Operator:
             # the cluster's kubelets run pods; no local executor
             self.config.run_executor = False
         self.runtime_metrics = RuntimeMetrics()
+        # pipeline-schedule health (kubedl_pipeline_*): the in-process
+        # MPMD lane feeds the module singleton; register unconditionally
+        # (renders nothing until a pipeline job reports)
+        from kubedl_tpu.metrics.runtime_metrics import pipeline_metrics
+
+        self.runtime_metrics.register_pipeline(pipeline_metrics.snapshot)
         self.manager = Manager(self.store, runtime_metrics=self.runtime_metrics)
         self.recorder = EventRecorder(self.store)
         self.metrics_registry = MetricsRegistry()

@@ -1,0 +1,358 @@
+"""MPMD cross-slice pipeline plumbing — stage plans, the serialized DCN
+boundary, and the transports that join stage programs.
+
+The single-program pipeline (parallel/pipeline.py) is SPMD: one compiled
+program, activations rotated by ppermute, bounded by one slice's HBM. The
+MPMD plane (the pipeline-parallelism paper, PAPERS.md arxiv 2412.14374)
+breaks that ceiling: each pipeline stage is its OWN program on its own
+slice, holding only its layer chunk + optimizer state, joined by async
+send/recv of activations (forward) and activation-gradients (backward)
+over DCN. This module is the program-independent half:
+
+  * StagePlan / split_stage_params — which layers (and which of the
+    embed / lm-head endcaps) each stage program owns;
+  * encode_boundary / decode_boundary — the wire form of one boundary
+    tensor batch: a JSON header recording dtype + shapes and a raw-uint8
+    payload. dtype is RECORDED, never inferred: npz round-trips bf16 as
+    an opaque |V2 void (the PR 6 serving handoff / PR 8 staged-reshard
+    lesson), so the wire carries raw bytes + the dtype string and the
+    decoder views them back. Mixed-dtype batches are refused — one
+    buffer, one dtype, no silent casts;
+  * Channel implementations — QueueChannel (in-process, tests/bench) and
+    DirChannel (atomic file-per-message over a shared dir: the local
+    executor's DCN analog, same discipline as the PR 8 control channel);
+  * AsyncSender / Prefetcher — double-buffered transfers so stage s
+    computes microbatch i while its send of i-1 and recv of i+1 are in
+    flight (the barrier-free steady state).
+
+The schedule that drives these lives in train/pipeline_runtime.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kubedl_tpu.api.validation import validate_pipeline_shapes
+
+# ---------------------------------------------------------------------------
+# stage plans
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """How one model splits into stage programs: contiguous equal layer
+    chunks, embed on stage 0, final-norm + lm-head on the last stage."""
+
+    n_layers: int
+    n_stages: int
+    n_microbatches: int
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.n_layers // self.n_stages
+
+    def layer_range(self, stage: int) -> Tuple[int, int]:
+        if not (0 <= stage < self.n_stages):
+            raise ValueError(f"stage {stage} out of range [0, {self.n_stages})")
+        per = self.layers_per_stage
+        return stage * per, (stage + 1) * per
+
+
+def make_stage_plan(
+    n_layers: int, n_stages: int, n_microbatches: int
+) -> StagePlan:
+    """Validated plan (shared shape rules: api/validation.py). The MPMD
+    runtime implements plain 1F1B (interleave=1) — interleaving virtual
+    stages is the intra-slice schedule's job (pipeline_apply_1f1b)."""
+    errs = validate_pipeline_shapes(
+        n_stages, n_microbatches, 1, n_layers=n_layers, path="pipeline_mpmd")
+    if errs:
+        raise ValueError("; ".join(errs))
+    return StagePlan(
+        n_layers=n_layers, n_stages=n_stages, n_microbatches=n_microbatches)
+
+
+def split_stage_params(params: Dict, plan: StagePlan, stage: int) -> Dict:
+    """Stage-local param subtree: this stage's layer list, plus the embed
+    table (stage 0) and final norm + LM head (last stage). Works on the
+    param pytree AND on a matching PartitionSpec pytree (it only slices
+    the layer list and copies endcap leaves)."""
+    lo, hi = plan.layer_range(stage)
+    out: Dict[str, Any] = {"layers": list(params["layers"][lo:hi])}
+    if stage == 0:
+        out["embed"] = params["embed"]
+    if stage == plan.n_stages - 1:
+        out["final_norm"] = params["final_norm"]
+        if "lm_head" not in params:
+            # tied embeddings put the head's weights on stage 0 — a
+            # cross-stage parameter the MPMD split cannot represent
+            raise ValueError(
+                "tie_embeddings is unsupported in the MPMD pipeline (the "
+                "tied LM head lives on stage 0, the final norm on the "
+                "last stage); use a separate lm_head")
+        out["lm_head"] = params["lm_head"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# serialized DCN boundary
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"kdlpp1"
+
+
+def encode_boundary(
+    arrays: Sequence[np.ndarray], meta: Optional[Dict] = None
+) -> bytes:
+    """One boundary message: JSON header (dtype string, shapes, optional
+    scalar meta) + raw-uint8 payload. All arrays must share ONE dtype —
+    mixed-dtype batches are refused rather than silently upcast (the
+    decoder views one flat buffer back through one recorded dtype)."""
+    arrays = [np.asarray(a) for a in arrays]
+    if not arrays:
+        raise ValueError("empty boundary message")
+    dtypes = {str(a.dtype) for a in arrays}
+    if len(dtypes) != 1:
+        raise ValueError(
+            f"mixed-dtype boundary refused: {sorted(dtypes)} — the raw "
+            f"uint8 payload records ONE dtype; send separate messages")
+    header = {
+        "dtype": dtypes.pop(),
+        "shapes": [list(a.shape) for a in arrays],
+    }
+    if meta:
+        header["meta"] = meta
+    hbytes = json.dumps(header).encode("utf-8")
+    payload = b"".join(np.ascontiguousarray(a).tobytes() for a in arrays)
+    return _MAGIC + len(hbytes).to_bytes(4, "big") + hbytes + payload
+
+
+def decode_boundary(data: bytes) -> Tuple[List[np.ndarray], Dict]:
+    """Inverse of encode_boundary: (arrays, meta). bf16 survives because
+    the dtype STRING was recorded and ml_dtypes registers "bfloat16"
+    with numpy — the payload is viewed, never re-interpreted."""
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("not a pipeline boundary message (bad magic)")
+    off = len(_MAGIC)
+    hlen = int.from_bytes(data[off:off + 4], "big")
+    off += 4
+    header = json.loads(data[off:off + hlen].decode("utf-8"))
+    off += hlen
+    import ml_dtypes  # noqa: F401 — registers bfloat16 et al with numpy
+
+    dtype = np.dtype(header["dtype"])
+    arrays = []
+    for shape in header["shapes"]:
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * dtype.itemsize
+        arrays.append(
+            np.frombuffer(data[off:off + nbytes], dtype=dtype).reshape(shape))
+        off += nbytes
+    if off != len(data):
+        raise ValueError(
+            f"boundary payload length mismatch: {len(data) - off} trailing "
+            f"bytes (truncated or corrupt message)")
+    return arrays, header.get("meta") or {}
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+
+class QueueChannel:
+    """In-process channel: tag -> bytes, delivered exactly once. Both
+    endpoints hold the same object (the in-process lane of the MPMD
+    harness; tests, bench, dryrun)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._msgs: Dict[str, bytes] = {}
+
+    def send(self, tag: str, data: bytes) -> None:
+        with self._cond:
+            if tag in self._msgs:
+                raise ValueError(f"duplicate boundary tag {tag!r}")
+            self._msgs[tag] = data
+            self._cond.notify_all()
+
+    def recv(self, tag: str, timeout: float = 60.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while tag not in self._msgs:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"boundary recv timed out waiting for {tag!r}")
+                self._cond.wait(left)
+            return self._msgs.pop(tag)
+
+
+_TAG_SAFE = re.compile(r"[^A-Za-z0-9._:-]")
+
+
+class DirChannel:
+    """File-per-message channel over a shared directory — the local
+    executor's stand-in for a DCN link (write-to-temp + atomic rename,
+    the same never-observe-a-partial-file discipline as the PR 8 reshard
+    control channel). Works across processes; the two-process parity
+    test rides it."""
+
+    def __init__(self, path: str, poll_s: float = 0.005) -> None:
+        self.path = path
+        self.poll_s = poll_s
+        os.makedirs(path, exist_ok=True)
+
+    def _fname(self, tag: str) -> str:
+        return os.path.join(self.path, _TAG_SAFE.sub("_", tag) + ".msg")
+
+    def purge(self) -> int:
+        """Delete every pending message — a RESTARTING receiver calls
+        this on the dirs it receives on, so messages a crashed previous
+        incarnation left behind cannot be consumed as current data
+        (tags restart from 1 after a restart). Returns the count."""
+        n = 0
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return 0
+        for name in names:
+            if name.endswith(".msg"):
+                try:
+                    os.unlink(os.path.join(self.path, name))
+                    n += 1
+                except OSError:
+                    pass  # a concurrent recv consumed it
+        return n
+
+    def send(self, tag: str, data: bytes) -> None:
+        final = self._fname(tag)
+        tmp = final + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    def recv(self, tag: str, timeout: float = 60.0) -> bytes:
+        fname = self._fname(tag)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                with open(fname, "rb") as f:
+                    data = f.read()
+                os.unlink(fname)
+                return data
+            except FileNotFoundError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"boundary recv timed out waiting for {tag!r} "
+                        f"in {self.path}") from None
+                time.sleep(self.poll_s)
+
+
+class AsyncSender:
+    """Double-buffered async send: `send` enqueues and returns, a worker
+    thread drains — compute of microbatch i overlaps the transfer of
+    i-1. `depth` bounds in-flight messages (2 = classic double buffer);
+    a full queue applies backpressure instead of unbounded host RAM.
+    Transport errors surface on the NEXT send/flush, never vanish."""
+
+    def __init__(self, channel, depth: int = 2) -> None:
+        self._channel = channel
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.sent_bytes = 0
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                tag, data = item
+                try:
+                    self._channel.send(tag, data)
+                except BaseException as e:  # noqa: BLE001 — reraised on send/flush
+                    self._err = e
+            finally:
+                self._q.task_done()
+
+    def _check(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(f"async boundary send failed: {err}") from err
+
+    def send(self, tag: str, data: bytes) -> None:
+        self._check()
+        self.sent_bytes += len(data)
+        self._q.put((tag, data))
+
+    def flush(self) -> None:
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=5)
+        self._check()
+
+
+class Prefetcher:
+    """Double-buffered async recv: given the (deterministic) tag order a
+    stage will consume, a worker thread keeps up to `depth` messages
+    fetched ahead — the recv of microbatch i+1 is in flight while i is
+    computing. `get(tag)` must be called in the expected order."""
+
+    def __init__(self, channel, depth: int = 2, timeout: float = 60.0) -> None:
+        self._channel = channel
+        self._timeout = timeout
+        self._pending: "queue.Queue" = queue.Queue()
+        self._ready: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.recv_bytes = 0
+
+    def _run(self) -> None:
+        while True:
+            tag = self._pending.get()
+            if tag is None:
+                return
+            try:
+                data = self._channel.recv(tag, timeout=self._timeout)
+                self._ready.put((tag, data, None))
+            except BaseException as e:  # noqa: BLE001 — delivered via get()
+                self._ready.put((tag, None, e))
+                return
+
+    def expect(self, tags: Sequence[str]) -> None:
+        for tag in tags:
+            self._pending.put(tag)
+
+    def get(self, tag: str) -> bytes:
+        got_tag, data, err = self._ready.get(timeout=self._timeout + 5)
+        if err is not None:
+            raise RuntimeError(f"async boundary recv failed: {err}") from err
+        if got_tag != tag:
+            raise RuntimeError(
+                f"boundary recv out of order: expected {tag!r}, got "
+                f"{got_tag!r} (Prefetcher.get must follow expect order)")
+        self.recv_bytes += len(data)
+        return data
+
+    def close(self) -> None:
+        self._pending.put(None)
+        self._thread.join(timeout=5)

@@ -1,0 +1,541 @@
+"""MPMD pipeline stage runtime — stage-local train_step under a 1F1B
+schedule, joined by the serialized DCN boundary (parallel/pipeline_mpmd.py).
+
+Each StageRuntime is ONE program owning one layer chunk + its optimizer
+state. Per microbatch it runs an explicit forward (send activation
+downstream) and an explicit backward (recv the activation-gradient,
+recompute the stage forward, vjp, send the input-gradient upstream) — so
+unlike the single-program pipeline, live activations are bounded by the
+IN-FLIGHT microbatches of the 1F1B schedule (<= S - stage per stage),
+not all M: the runtime stashes only each in-flight microbatch's INPUT
+and rematerializes the stage forward inside the backward program.
+
+Schedule (classic non-interleaved 1F1B): stage s runs
+    warmup  = min(S - 1 - s, M) forwards,
+    steady  = alternate one-forward-one-backward,
+    drain   = the remaining backwards;
+the last stage fuses each forward with its backward (loss + grads in one
+program). Sends are double-buffered/async and recvs prefetched
+(AsyncSender / Prefetcher), so the steady state is barrier-free: stage s
+computes microbatch i while its send of i-1 and recv of i+1 are in
+flight.
+
+Math parity with the single-program oracle (models/llama.py loss_fn_pp):
+per-microbatch objective L_i = CE_i/M + coef * aux_i/M, where aux_i sums
+every stage's MoE aux for that microbatch (the value rides the boundary
+header; its cotangent is the CONSTANT coef/M, applied at each stage for
+its own aux) — sum_i L_i equals the pipelined loss exactly, and the
+accumulated per-stage grads equal the sliced full-model grads.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel import pipeline
+from kubedl_tpu.parallel.mesh import ShardingRules
+from kubedl_tpu.parallel.pipeline_mpmd import (
+    AsyncSender,
+    Prefetcher,
+    QueueChannel,
+    StagePlan,
+    decode_boundary,
+    encode_boundary,
+    make_stage_plan,
+    split_stage_params,
+)
+
+
+class StageRuntime:
+    """One MPMD pipeline stage: local params + optimizer, jitted
+    forward/backward programs, and the 1F1B loop (`run_step`).
+
+    `act_in`/`grad_out` face the previous stage, `act_out`/`grad_in` the
+    next; stage 0 leaves the former None, the last stage the latter.
+    `mesh`/`rules` shard the stage's params and activations over ITS OWN
+    devices (each stage may run a different mesh — that is the point)."""
+
+    def __init__(
+        self,
+        stage: int,
+        plan: StagePlan,
+        config: llama.LlamaConfig,
+        stage_params: Dict,
+        tx,
+        *,
+        act_in=None,
+        act_out=None,
+        grad_in=None,
+        grad_out=None,
+        mesh=None,
+        rules: Optional[ShardingRules] = None,
+        recv_timeout: float = 60.0,
+    ) -> None:
+        import uuid
+
+        self.stage = stage
+        self.plan = plan
+        self.config = config
+        self.tx = tx
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        self._recv_timeout = recv_timeout
+        self._step = 0
+        # incarnation id, stamped on every boundary message: a receiver
+        # latches its peer's id and REFUSES a change, so data a crashed
+        # previous incarnation left on a durable transport can never be
+        # silently consumed as current activations/grads (it fails loud
+        # and retryable instead — the restart drains it)
+        self.boot_id = uuid.uuid4().hex[:12]
+        self._peer_boot: Dict[int, str] = {}
+        self.last_loss: Optional[float] = None
+        self.last_grads: Optional[Dict] = None
+        self.stats: Dict[str, float] = {
+            "steps": 0, "sent_bytes": 0, "recv_bytes": 0,
+            "step_s": 0.0, "wait_s": 0.0,
+        }
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec_tree = split_stage_params(
+                llama.param_specs(config, self.rules), plan, stage)
+            stage_params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                stage_params, spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+            self._act_sharding = NamedSharding(
+                mesh, self.rules.spec("batch", None, None))
+            self._tok_sharding = NamedSharding(
+                mesh, self.rules.spec("batch", None))
+        else:
+            self._act_sharding = self._tok_sharding = None
+        self.params = stage_params
+        self.opt_state = tx.init(stage_params)
+
+        self._senders: List[AsyncSender] = []
+        self._rx: List[Prefetcher] = []
+        self._act_tx = self._wrap_sender(act_out)
+        self._grad_tx = self._wrap_sender(grad_out)
+        self._act_rx = self._wrap_rx(act_in)
+        self._grad_rx = self._wrap_rx(grad_in)
+        self._build_programs()
+
+    def _wrap_sender(self, channel):
+        if channel is None:
+            return None
+        s = AsyncSender(channel)
+        self._senders.append(s)
+        return s
+
+    def _wrap_rx(self, channel):
+        if channel is None:
+            return None
+        r = Prefetcher(channel, timeout=self._recv_timeout)
+        self._rx.append(r)
+        return r
+
+    # -- stage programs -------------------------------------------------
+
+    def _build_programs(self) -> None:
+        config, plan, stage = self.config, self.plan, self.stage
+        S, M = plan.n_stages, plan.n_microbatches
+        first = stage == 0
+        last = stage == S - 1
+        aux_cot = jnp.asarray(config.moe_aux_coef / M, jnp.float32)
+
+        def apply_layers(params_s, x):
+            # ONE compiled layer body scanned over the stacked chunk —
+            # NOT a Python unroll: at the scale the MPMD plane targets
+            # (tens of layers per stage), unrolling would trace every
+            # layer into the forward, the vjp AND the fused last-stage
+            # program, blowing compile time linearly with depth (the
+            # single-program oracle scans for the same reason)
+            layer_fn = llama.pipeline_layer_fn(config, x.shape[1], self.rules)
+            if config.remat:
+                layer_fn = jax.checkpoint(layer_fn)
+            stacked = pipeline.stack_layers(params_s["layers"])
+
+            def body(carry, layer):
+                a, aux = carry
+                a, da = layer_fn(a, layer)
+                return (a, aux + da), None
+
+            (x, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)), stacked)
+            return x, aux
+
+        def fwd_body(params_s, x):
+            if first:
+                # same embed path as the single-program pipelined oracle
+                x = params_s["embed"][x].astype(config.dtype)
+            return apply_layers(params_s, x)
+
+        def loss_body(params_s, x, targets, aux_up):
+            x, aux = fwd_body(params_s, x)
+            logits = llama._lm_head(x, params_s, config)
+            ce = llama._next_token_ce(logits, targets)
+            return ce / M + config.moe_aux_coef * (aux + aux_up) / M
+
+        self._fwd = jax.jit(fwd_body)
+
+        if last:
+            if first:
+                # degenerate single-stage pipeline: grads w.r.t. params only
+                self._last_step = jax.jit(jax.value_and_grad(loss_body))
+            else:
+                # fused forward+backward: loss plus grads for (params, x)
+                self._last_step = jax.jit(
+                    jax.value_and_grad(loss_body, argnums=(0, 1)))
+        else:
+            def bwd_body(params_s, x, g_act):
+                # stage-level remat: recompute the forward from the
+                # stashed INPUT, then vjp — only inputs stay live
+                _, vjp = jax.vjp(fwd_body, params_s, x)
+                gp, gx = vjp((g_act, aux_cot))
+                return gp, gx
+
+            def bwd0_body(params_s, tokens_mb, g_act):
+                _, vjp = jax.vjp(lambda p: fwd_body(p, tokens_mb), params_s)
+                (gp,) = vjp((g_act, aux_cot))
+                return gp
+
+            self._bwd = jax.jit(bwd0_body if first else bwd_body)
+
+        def update_body(params_s, opt_state, grads):
+            import optax
+
+            updates, opt_state = self.tx.update(grads, opt_state, params_s)
+            return optax.apply_updates(params_s, updates), opt_state
+
+        self._update = jax.jit(update_body)
+
+    # -- wire helpers ---------------------------------------------------
+
+    def _put_act(self, arr: np.ndarray):
+        if self._act_sharding is not None:
+            return jax.device_put(arr, self._act_sharding)
+        return jnp.asarray(arr)
+
+    def _send_act(self, step: int, mb: int, act, aux_val: float) -> None:
+        data = encode_boundary(
+            [np.asarray(jax.device_get(act))],
+            meta={"mb": mb, "aux": float(aux_val), "boot": self.boot_id})
+        self._act_tx.send(f"a{step}.{mb}", data)
+
+    def _send_grad(self, step: int, mb: int, g) -> None:
+        data = encode_boundary(
+            [np.asarray(jax.device_get(g))],
+            meta={"mb": mb, "boot": self.boot_id})
+        self._grad_tx.send(f"g{step}.{mb}", data)
+
+    def _recv(self, rx: Prefetcher, tag: str):
+        t0 = time.perf_counter()
+        data = rx.get(tag)
+        self.stats["wait_s"] += time.perf_counter() - t0
+        arrays, meta = decode_boundary(data)
+        # incarnation guard (see boot_id): the peer's id must never
+        # change mid-run — a change means THIS message and the latched
+        # one straddle a peer restart, i.e. one of them is stale
+        boot = meta.get("boot", "")
+        latched = self._peer_boot.setdefault(id(rx), boot)
+        if boot != latched:
+            raise RuntimeError(
+                f"stage {self.stage}: boundary message {tag!r} carries "
+                f"peer incarnation {boot!r} != latched {latched!r} — a "
+                f"neighbor restarted (or stale pre-crash messages are "
+                f"draining); exiting for a clean gang restart")
+        return arrays, meta
+
+    # -- the 1F1B loop --------------------------------------------------
+
+    def run_step(self, tokens: Optional[np.ndarray] = None) -> Dict:
+        """One optimizer step over M microbatches. `tokens` [B, T] is
+        required on the FIRST stage (inputs) and the LAST stage
+        (targets) — in a real deployment both run the data loader, the
+        middle stages never see data. Returns stage-local metrics; the
+        loss is reported by the last stage (None elsewhere)."""
+        plan, stage = self.plan, self.stage
+        S, M = plan.n_stages, plan.n_microbatches
+        first, last = stage == 0, stage == S - 1
+        self._step += 1
+        step = self._step
+        t_start = time.perf_counter()
+        self.stats["wait_s"] = 0.0
+
+        inputs = targets = None
+        if first or last:
+            if tokens is None:
+                raise ValueError(
+                    f"stage {stage} (an endpoint) needs the token batch")
+            tokens = np.asarray(tokens)
+            if tokens.shape[0] % M:
+                raise ValueError(
+                    f"batch {tokens.shape[0]} not divisible by "
+                    f"{M} microbatches")
+        if first:
+            inputs = np.asarray(
+                pipeline.microbatch(jnp.asarray(tokens[:, :-1]), M))
+        if last:
+            targets = np.asarray(
+                pipeline.microbatch(jnp.asarray(tokens[:, 1:]), M))
+
+        if self._act_rx is not None:
+            self._act_rx.expect([f"a{step}.{i}" for i in range(M)])
+        if self._grad_rx is not None:
+            self._grad_rx.expect([f"g{step}.{i}" for i in range(M)])
+
+        grads = None
+        loss_total = 0.0
+        stash: Dict[int, Any] = {}
+
+        def accumulate(gp):
+            nonlocal grads
+            grads = gp if grads is None else jax.tree_util.tree_map(
+                jnp.add, grads, gp)
+
+        def fwd_in(i):
+            """This stage's forward input for microbatch i (+ upstream aux)."""
+            if first:
+                x = inputs[i]
+                if self._tok_sharding is not None:
+                    return jax.device_put(x, self._tok_sharding), 0.0
+                return jnp.asarray(x), 0.0
+            (arr,), meta = self._recv(self._act_rx, f"a{step}.{i}")
+            return self._put_act(arr), float(meta.get("aux", 0.0))
+
+        def put_targets(i):
+            if self._tok_sharding is not None:
+                return jax.device_put(targets[i], self._tok_sharding)
+            return jnp.asarray(targets[i])
+
+        def do_forward(i):
+            x, aux_up = fwd_in(i)
+            if last:
+                # fused F+B: loss, param grads, and the upstream grad in
+                # ONE program — the last stage never stashes activations
+                if first:
+                    loss_i, gp = self._last_step(
+                        self.params, x, put_targets(i),
+                        jnp.asarray(aux_up, jnp.float32))
+                else:
+                    loss_i, (gp, gx) = self._last_step(
+                        self.params, x, put_targets(i),
+                        jnp.asarray(aux_up, jnp.float32))
+                    self._send_grad(step, i, gx)
+                nonlocal loss_total
+                loss_total += float(loss_i)
+                accumulate(gp)
+                return
+            act, aux = self._fwd(self.params, x)
+            stash[i] = x
+            self._send_act(step, i, act, aux_up + float(aux))
+
+        def do_backward(i):
+            (g_arr,), _ = self._recv(self._grad_rx, f"g{step}.{i}")
+            g = self._put_act(g_arr)
+            x = stash.pop(i)
+            if first:
+                gp = self._bwd(self.params, x, g)
+            else:
+                gp, gx = self._bwd(self.params, x, g)
+                self._send_grad(step, i, gx)
+            accumulate(gp)
+
+        if last:
+            for i in range(M):
+                do_forward(i)
+        else:
+            warmup = min(S - 1 - stage, M)
+            for i in range(warmup):
+                do_forward(i)
+            for k in range(M - warmup):
+                do_forward(warmup + k)  # one forward...
+                do_backward(k)          # ...one backward
+            for k in range(max(M - warmup, 0), M):
+                do_backward(k)
+
+        assert not stash, f"stage {stage}: {len(stash)} unconsumed stashes"
+        self.last_grads = grads
+        self.params, self.opt_state = self._update(
+            self.params, self.opt_state, grads)
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.params))
+        for s in self._senders:
+            s.flush()
+
+        self.last_loss = loss_total if last else None
+        step_s = time.perf_counter() - t_start
+        self.stats["steps"] += 1
+        self.stats["step_s"] = step_s
+        self.stats["sent_bytes"] = sum(s.sent_bytes for s in self._senders)
+        self.stats["recv_bytes"] = sum(r.recv_bytes for r in self._rx)
+        return {
+            "stage": stage,
+            "loss": self.last_loss,
+            "step_s": step_s,
+            "wait_s": self.stats["wait_s"],
+            "sent_bytes": self.stats["sent_bytes"],
+            "recv_bytes": self.stats["recv_bytes"],
+        }
+
+    def close(self) -> None:
+        for s in self._senders:
+            s.close()
+        for r in self._rx:
+            r.close()
+
+
+class MPMDPipeline:
+    """In-process MPMD harness: S stage programs (optionally on DISJOINT
+    device meshes) joined by QueueChannels, each driven on its own
+    thread — the local lane of the cross-slice pipeline, used by the
+    parity tests, the bench record, and dryrun_multichip. Every boundary
+    crossing is SERIALIZED (the DCN wire discipline) even in-process."""
+
+    def __init__(
+        self,
+        config: llama.LlamaConfig,
+        params: Dict,
+        tx,
+        *,
+        n_stages: int,
+        n_microbatches: int,
+        meshes: Optional[List] = None,
+        rules: Optional[ShardingRules] = None,
+        job: str = "",
+        recv_timeout: float = 60.0,
+    ) -> None:
+        self.plan = make_stage_plan(
+            config.n_layers, n_stages, n_microbatches)
+        self.job = job
+        self.config = config
+        if meshes is not None and len(meshes) != n_stages:
+            raise ValueError(
+                f"need one mesh per stage, got {len(meshes)} for {n_stages}")
+        act_ch = [QueueChannel() for _ in range(n_stages - 1)]
+        grad_ch = [QueueChannel() for _ in range(n_stages - 1)]
+        self.stages: List[StageRuntime] = []
+        for s in range(n_stages):
+            self.stages.append(StageRuntime(
+                s, self.plan, config,
+                split_stage_params(params, self.plan, s), tx,
+                act_in=act_ch[s - 1] if s > 0 else None,
+                act_out=act_ch[s] if s < n_stages - 1 else None,
+                grad_in=grad_ch[s] if s < n_stages - 1 else None,
+                grad_out=grad_ch[s - 1] if s > 0 else None,
+                mesh=meshes[s] if meshes is not None else None,
+                rules=rules,
+                recv_timeout=recv_timeout,
+            ))
+
+    def step(self, tokens: np.ndarray) -> Dict:
+        """One synchronized train step across every stage program; the
+        stages run concurrently on their own threads (the processes of a
+        real deployment) and meet only at the boundary channels."""
+        S = self.plan.n_stages
+        results: List[Optional[Dict]] = [None] * S
+        errors: List[BaseException] = []
+
+        def run(s: int) -> None:
+            try:
+                need_tokens = s == 0 or s == S - 1
+                results[s] = self.stages[s].run_step(
+                    tokens if need_tokens else None)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(s,)) for s in range(S)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        out = {
+            "loss": results[S - 1]["loss"],
+            "stage_step_s": [r["step_s"] for r in results],
+            "stage_wait_s": [r["wait_s"] for r in results],
+            "serialized_bytes": sum(
+                r["sent_bytes"] for r in results),
+            "bubble_frac_analytic": pipeline.bubble_fraction(
+                self.plan.n_microbatches, S, 1),
+        }
+        from kubedl_tpu.metrics.runtime_metrics import pipeline_metrics
+
+        pipeline_metrics.observe_step(
+            job=self.job or "mpmd-local", schedule="1f1b-mpmd",
+            n_stages=S,
+            bubble_frac=out["bubble_frac_analytic"],
+            stage_step_s={s: r["step_s"] for s, r in enumerate(results)},
+            loss=out["loss"])
+        return out
+
+    def close(self) -> None:
+        for s in self.stages:
+            s.close()
+
+
+def runtime_from_env(
+    config: llama.LlamaConfig,
+    params: Dict,
+    tx,
+    *,
+    mesh=None,
+    rules: Optional[ShardingRules] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> StageRuntime:
+    """Build THIS pod's stage runtime from the operator-injected
+    KUBEDL_PP_* environment (workloads/jaxjob.py set_cluster_spec +
+    executor/tpu_topology.py pipeline_neighbor_env): stage id, shape
+    knobs, and the per-edge boundary directories under
+    KUBEDL_PP_BOUNDARY_DIR (the local executor's DCN analog; the
+    kube-mode socket transport dials KUBEDL_PP_PREV_ADDR /
+    KUBEDL_PP_NEXT_ADDR instead and is not implemented yet —
+    docs/pipeline.md "Transports")."""
+    import os
+
+    from kubedl_tpu.parallel.pipeline_mpmd import DirChannel
+
+    env = os.environ if env is None else env
+    stage = int(env.get("KUBEDL_PP_STAGE", "0"))
+    n_stages = int(env.get("KUBEDL_PP_STAGES", "1"))
+    n_micro = int(env.get("KUBEDL_PP_MICROBATCHES", str(n_stages)))
+    bdir = env.get("KUBEDL_PP_BOUNDARY_DIR", "")
+    if n_stages > 1 and not bdir:
+        raise ValueError(
+            "KUBEDL_PP_BOUNDARY_DIR is required for a multi-stage MPMD "
+            "pipeline on the local executor")
+    plan = make_stage_plan(config.n_layers, n_stages, n_micro)
+
+    def edge(i: int, kind: str):
+        return DirChannel(os.path.join(bdir, f"{kind}{i}"))
+
+    act_in = edge(stage - 1, "act") if stage > 0 else None
+    grad_in = edge(stage, "grad") if stage < n_stages - 1 else None
+    # purge the dirs THIS stage receives on: a crashed previous
+    # incarnation's undelivered messages must not be replayed as current
+    # data (tags restart from 1). Races with a fast peer that already
+    # sent fresh messages degrade to a recv timeout — loud + retryable,
+    # never silent; the boot-id guard in StageRuntime._recv catches
+    # whatever slips past the purge.
+    for ch in (act_in, grad_in):
+        if ch is not None:
+            purged = ch.purge()
+            if purged:
+                print(f"stage {stage}: purged {purged} stale boundary "
+                      f"message(s) from {ch.path}", flush=True)
+
+    return StageRuntime(
+        stage, plan, config, split_stage_params(params, plan, stage), tx,
+        act_in=act_in,
+        act_out=edge(stage, "act") if stage < n_stages - 1 else None,
+        grad_in=grad_in,
+        grad_out=edge(stage - 1, "grad") if stage > 0 else None,
+        mesh=mesh, rules=rules,
+    )

@@ -143,6 +143,41 @@ def main(argv=None) -> int:
     if args.ce_chunks > 1:
         config = dataclasses.replace(config, ce_chunks=args.ce_chunks)
 
+    # Pipeline parallelism (operator-injected KUBEDL_PP_*, docs/pipeline.md).
+    # MPMD mode means THIS program is wrong — each stage runs its own
+    # program (train/pipeline_trainer.py), not the SPMD trainer; fail
+    # permanent rather than silently train un-pipelined.
+    if os.environ.get("KUBEDL_PP_MPMD") == "1":
+        print("spec.pipeline.mpmd pods must run the stage program: "
+              "python -m kubedl_tpu.train.pipeline_trainer (this SPMD "
+              "trainer would train the full model un-pipelined)",
+              file=sys.stderr)
+        return 2  # permanent config error (utils/exit_codes.py)
+    pp_stages = int(os.environ.get("KUBEDL_PP_STAGES", "1"))
+    pipelined = pp_stages > 1
+    pp_micro = int(os.environ.get("KUBEDL_PP_MICROBATCHES", str(pp_stages)))
+    pp_schedule = os.environ.get("KUBEDL_PP_SCHEDULE", "1f1b")
+    pp_interleave = int(os.environ.get("KUBEDL_PP_INTERLEAVE", "1"))
+    if pipelined:
+        from kubedl_tpu.api.validation import validate_pipeline_shapes
+
+        errs = validate_pipeline_shapes(
+            pp_stages, pp_micro, pp_interleave, n_layers=config.n_layers)
+        if args.batch % pp_micro:
+            errs.append(f"--batch {args.batch} not divisible by "
+                        f"{pp_micro} microbatches")
+        if args.lora_rank > 0:
+            errs.append("--lora-rank is unsupported on the pipelined "
+                        "path (adapters target unstacked projections)")
+        if info.live_reshard:
+            errs.append("spec.elastic.liveReshard is unsupported with "
+                        "spec.pipeline (the reshard planner does not "
+                        "cover stage-stacked layouts)")
+        if errs:
+            print("pipeline config invalid: " + "; ".join(errs),
+                  file=sys.stderr)
+            return 2  # permanent config error
+
     # Live-reshard plumbing (train/reshard_runtime.py): control channel +
     # staging dir, active only when the operator opted the job in
     # (spec.elastic.liveReshard -> KUBEDL_LIVE_RESHARD=1).
@@ -246,8 +281,31 @@ def main(argv=None) -> int:
 
     params = (hf_base if hf_base is not None
               else llama.init(config, jax.random.PRNGKey(0)))
+    if pipelined:
+        # stacked-layer layout for the stage-axis schedule; the mesh must
+        # carry the stage axis the operator validated at submit
+        if mesh.shape.get("stage", 1) != pp_stages:
+            print(f"KUBEDL_PP_STAGES={pp_stages} but the mesh stage axis "
+                  f"is {mesh.shape.get('stage', 1)} (spec.mesh.stage must "
+                  f"match spec.pipeline.stages)", file=sys.stderr)
+            return 2
+        from kubedl_tpu.parallel import pipeline as _pipeline
+
+        params = llama.stack_params(params)
+        print(f"pipeline: {pp_schedule} stages={pp_stages} "
+              f"microbatches={pp_micro} interleave={pp_interleave} "
+              f"(bubble {_pipeline.bubble_fraction(pp_micro, pp_stages, pp_interleave):.3f})",
+              flush=True)
 
     def loss_on(a_mesh):
+        if pipelined:
+            def loss(params, batch):
+                return llama.loss_fn_pp(
+                    params, batch, config, a_mesh, rules=rules,
+                    n_microbatches=pp_micro, schedule=pp_schedule,
+                    interleave=pp_interleave)
+            return loss
+
         def loss(params, batch):
             return llama.loss_fn(params, batch, config, mesh=a_mesh, rules=rules)
         return loss
@@ -293,7 +351,8 @@ def main(argv=None) -> int:
         else:
             def build_step(a_mesh):
                 """Mesh-dependent compute, rebuilt after a live reshard."""
-                spec_tree = llama.param_specs(config, rules)
+                spec_tree = (llama.param_specs_pp(config, rules) if pipelined
+                             else llama.param_specs(config, rules))
                 return make_train_step(
                     loss_on(a_mesh), tx, a_mesh, spec_tree,
                     rules.spec("batch", None), rules,

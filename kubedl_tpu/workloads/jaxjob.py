@@ -50,6 +50,7 @@ class MeshSpec:
 
     data: int = 1
     fsdp: int = 1
+    stage: int = 1  # pipeline stages (spec.pipeline picks the schedule)
     tensor: int = 1
     context: int = 1
     expert: int = 1
@@ -58,6 +59,7 @@ class MeshSpec:
         return {
             "data": self.data,
             "fsdp": self.fsdp,
+            "stage": self.stage,
             "tensor": self.tensor,
             "context": self.context,
             "expert": self.expert,
@@ -127,6 +129,33 @@ class ServingSpec:
 
 
 @dataclass
+class PipelineSpec:
+    """Pipeline parallelism (docs/pipeline.md). Intra-slice, the trainer
+    runs the stacked-layer schedule over the mesh's "stage" axis
+    (parallel/pipeline.py): "gpipe" (the parity oracle) or "1f1b" (the
+    interleaved circular schedule; `interleave` virtual stages per rank
+    cut the fill/drain bubble ~1/interleave). With `mpmd: true` the job
+    instead becomes `stages` SEPARATE programs, one per slice
+    (spec.numSlices == stages), joined by the serialized DCN activation
+    boundary (train/pipeline_runtime.py) — the shape that trains a model
+    bigger than one slice's HBM. `stageSlices` optionally names a
+    different slice type PER STAGE (heterogeneous gang; admitted
+    all-or-nothing, gavel-priced); `layers` optionally declares the
+    model's layer count so divisibility is rejected at submit."""
+
+    stages: int = 1
+    microbatches: int = 0  # 0 = stages (the minimum that fills the pipe)
+    interleave: int = 1
+    schedule: str = "1f1b"  # gpipe | 1f1b (intra-slice loop)
+    mpmd: bool = False
+    layers: int = 0  # 0 = unknown at submit (runtime re-validates)
+    stage_slices: List[str] = field(default_factory=list)
+
+    def resolved_microbatches(self) -> int:
+        return self.microbatches or self.stages
+
+
+@dataclass
 class JAXJobSpec:
     replica_specs: Dict[str, ReplicaSpec] = field(
         default_factory=dict, metadata={"name": "jaxReplicaSpecs"}
@@ -153,6 +182,9 @@ class JAXJobSpec:
     # Elastic behavior (live resharding opt-in); the admissible shapes
     # themselves live in runPolicy.schedulingPolicy.tpuSliceFallbacks.
     elastic: Optional[ElasticSpec] = None
+    # Pipeline parallelism: intra-slice schedule knobs, or (mpmd) the
+    # cross-slice multi-program mode where each stage owns a slice.
+    pipeline: Optional[PipelineSpec] = None
 
 
 @dataclass
@@ -275,6 +307,78 @@ class JAXJobController(BaseWorkloadController):
                     f"{srv.decode_router!r} (supported: least-blocks)")
         sched = (job.spec.run_policy.scheduling_policy
                  if job.spec.run_policy else None)
+        pipe = job.spec.pipeline
+        if pipe is not None:
+            from kubedl_tpu.api.validation import validate_pipeline_shapes
+            from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+            errs.extend(validate_pipeline_shapes(
+                int(pipe.stages), pipe.resolved_microbatches(),
+                int(pipe.interleave),
+                n_layers=int(pipe.layers) or None,
+                schedule=pipe.schedule))
+            if pipe.mpmd:
+                if ns <= 1:
+                    errs.append(
+                        "spec.pipeline.mpmd requires spec.numSlices > 1 "
+                        "(each stage program owns its own slice — one "
+                        "slice has nothing to span)")
+                elif ns != int(pipe.stages):
+                    errs.append(
+                        f"spec.pipeline.mpmd needs spec.numSlices "
+                        f"({ns}) == spec.pipeline.stages ({pipe.stages}) "
+                        f"(one stage program per slice)")
+                if job.spec.dcn_mesh is not None:
+                    errs.append(
+                        "spec.pipeline.mpmd is incompatible with "
+                        "spec.dcnMesh (the stage dimension IS the "
+                        "cross-slice dimension; there is no Megascale "
+                        "mesh to declare)")
+                if int(pipe.interleave) > 1:
+                    errs.append(
+                        "spec.pipeline.mpmd supports interleave=1 only "
+                        "(virtual stages are the intra-slice schedule's "
+                        "optimization; the MPMD runtime runs plain 1F1B)")
+                if srv is not None:
+                    errs.append(
+                        "spec.pipeline.mpmd is incompatible with "
+                        "spec.serving")
+                if sched is not None and sched.tpu_slice_fallbacks:
+                    errs.append(
+                        "spec.pipeline.mpmd is incompatible with "
+                        "schedulingPolicy.tpuSliceFallbacks (per-stage "
+                        "programs cannot resize through the elastic "
+                        "ladder; declare per-stage shapes in "
+                        "spec.pipeline.stageSlices instead)")
+                if job.spec.checkpoint is None or not job.spec.checkpoint.path:
+                    errs.append(
+                        "spec.pipeline.mpmd requires spec.checkpoint "
+                        "(the stage boundary channel rides the shared "
+                        "checkpoint volume on the local executor)")
+            elif int(pipe.stages) > 1:
+                mesh_stage = job.spec.mesh.stage if job.spec.mesh else 1
+                if int(mesh_stage) != int(pipe.stages):
+                    errs.append(
+                        f"spec.pipeline.stages={pipe.stages} without mpmd "
+                        f"needs spec.mesh.stage == stages (the SPMD "
+                        f"schedule runs over the mesh's stage axis), got "
+                        f"{mesh_stage}")
+            if pipe.stage_slices:
+                if not pipe.mpmd:
+                    errs.append(
+                        "spec.pipeline.stageSlices requires "
+                        "spec.pipeline.mpmd (per-stage slice shapes only "
+                        "make sense when each stage owns a slice)")
+                elif len(pipe.stage_slices) != int(pipe.stages):
+                    errs.append(
+                        f"spec.pipeline.stageSlices has "
+                        f"{len(pipe.stage_slices)} entries for "
+                        f"{pipe.stages} stages")
+                for alt in pipe.stage_slices:
+                    try:
+                        parse_slice_type(alt)
+                    except ValueError as e:
+                        errs.append(f"spec.pipeline.stageSlices: {e}")
         el = job.spec.elastic
         if el is not None and el.live_reshard:
             if job.spec.checkpoint is None or not job.spec.checkpoint.path:
@@ -321,27 +425,70 @@ class JAXJobController(BaseWorkloadController):
         if job.spec.mesh is not None:
             env["KUBEDL_MESH"] = job.spec.mesh.encode()
         ns = int(job.spec.num_slices or 1)
+        pipe = job.spec.pipeline
+        # validation requires numSlices > 1 for mpmd; the guard keeps an
+        # unvalidated job from hitting the slice-group math below
+        mpmd = pipe is not None and pipe.mpmd and ns > 1
         if ns > 1:
             # Multislice: per-slice worker groups by index; libtpu's
             # Megascale DCN transport bootstraps from MEGASCALE_* the way
             # single-slice jobs bootstrap from the coordination service.
+            # An MPMD pipeline job skips Megascale entirely: its slices
+            # are SEPARATE programs chained by the activation boundary,
+            # not one SPMD program over a DCN mesh.
             workers = int(
                 (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec())
                 .replicas or 0
             )
             slice_id, _, _ = slice_group(workers, ns, index)
-            dcn = job.spec.dcn_mesh
-            dcn_encoded = dcn.encode_sparse() if dcn is not None else f"data={ns}"
             env["KUBEDL_NUM_SLICES"] = str(ns)
             env["KUBEDL_SLICE_ID"] = str(slice_id)
-            env["KUBEDL_DCN_MESH"] = dcn_encoded
-            env["MEGASCALE_NUM_SLICES"] = str(ns)
-            env["MEGASCALE_SLICE_ID"] = str(slice_id)
-            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
-                f"{common.service_dns(job, REPLICA_WORKER, 0)}"
-                f":{common.MEGASCALE_PORT}"
-            )
+            if not mpmd:
+                dcn = job.spec.dcn_mesh
+                dcn_encoded = (dcn.encode_sparse() if dcn is not None
+                               else f"data={ns}")
+                env["KUBEDL_DCN_MESH"] = dcn_encoded
+                env["MEGASCALE_NUM_SLICES"] = str(ns)
+                env["MEGASCALE_SLICE_ID"] = str(slice_id)
+                env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                    f"{common.service_dns(job, REPLICA_WORKER, 0)}"
+                    f":{common.MEGASCALE_PORT}"
+                )
             pod_template.metadata.labels[LABEL_SLICE_ID] = str(slice_id)
+        if pipe is not None:
+            env["KUBEDL_PP_STAGES"] = str(pipe.stages)
+            env["KUBEDL_PP_MICROBATCHES"] = str(pipe.resolved_microbatches())
+            env["KUBEDL_PP_INTERLEAVE"] = str(pipe.interleave)
+            env["KUBEDL_PP_SCHEDULE"] = pipe.schedule
+            if mpmd:
+                # validation guarantees ns > 1 here, so the multislice
+                # block above already computed workers + this pod's
+                # slice id — which IS its stage (one stage per slice)
+                from kubedl_tpu.executor.tpu_topology import (
+                    pipeline_neighbor_env,
+                )
+
+                stage = slice_id
+                per_stage = workers // max(ns, 1)
+
+                def stage_addr(s: int) -> str:
+                    return (f"{common.service_dns(job, REPLICA_WORKER, s * per_stage)}"
+                            f":{common.PIPELINE_PORT}")
+
+                env["KUBEDL_PP_MPMD"] = "1"
+                env.update(pipeline_neighbor_env(
+                    stage, ns,
+                    prev_addr=stage_addr(stage - 1) if stage > 0 else "",
+                    next_addr=(stage_addr(stage + 1)
+                               if stage < ns - 1 else "")))
+                ckpt_path = (job.spec.checkpoint.path
+                             if job.spec.checkpoint else "")
+                if ckpt_path:
+                    # local-executor DCN analog: the boundary channel is
+                    # a shared dir on the (already required) checkpoint
+                    # volume — same discipline as the reshard staging dir
+                    env["KUBEDL_PP_BOUNDARY_DIR"] = os.path.join(
+                        ckpt_path, ".pipeline")
         ckpt = job.spec.checkpoint
         if ckpt is not None and ckpt.path:
             env["KUBEDL_CHECKPOINT_PATH"] = ckpt.path

@@ -585,3 +585,109 @@ def test_operator_wires_capacity_scheduler():
         assert "capacity" in op.runtime_metrics.debug_vars()
     finally:
         op.stop()
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous MPMD pipeline gangs (ISSUE 9: spec.pipeline.stageSlices)
+# ---------------------------------------------------------------------------
+
+
+def _mpmd_job(name, stage_slices, ns=2, tenant=""):
+    """A JAXJob MPMD pipeline gang: one slice PER STAGE, each with its
+    own declared shape."""
+    import json as _json
+
+    from kubedl_tpu.utils.serde import from_dict
+    from kubedl_tpu.workloads.jaxjob import JAXJob
+
+    manifest = {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "jaxReplicaSpecs": {"Worker": {"replicas": ns, "template": {
+                "spec": {"containers": [{
+                    "name": "jax", "image": "x",
+                    "resources": {"limits": {"google.com/tpu": "4"}}}]}}}},
+            "numSlices": ns,
+            "pipeline": {"stages": ns, "microbatches": 2 * ns,
+                         "mpmd": True, "stageSlices": list(stage_slices)},
+            "checkpoint": {"path": "/ckpt"},
+        }}
+    job = from_dict(JAXJob, manifest)
+    if tenant:
+        job.metadata.annotations[ANNOTATION_TENANCY] = _json.dumps(
+            {"tenant": tenant})
+    return job
+
+
+def test_hetero_gang_admits_in_stage_order():
+    adm, sched = _setup(["v5e-4", "v5e-16", "v5e-8"], policy="gavel")
+    job = _mpmd_job("het", ["v5e-16", "v5e-4"])
+    state = adm.create_gang(job, job.spec.replica_specs)
+    assert len(state.slice_names) == 2
+    # slice_names[i] is STAGE i's slice (the pod slice-id label indexes
+    # it): stage 0 got the 16-chip slice, stage 1 the tightest 4-chip fit
+    assert state.slice_names[0].endswith("v5e-16")
+    assert state.slice_names[1].endswith("v5e-4")
+
+
+def test_hetero_gang_all_or_nothing_never_partial():
+    adm, sched = _setup(["v5e-16", "v5e-8"], policy="gavel")
+    big = _job("big", chips=16, tpu_slice="v5e-16")
+    adm.create_gang(big, big.spec.replica_specs)
+    assert _reserved(adm, "big")  # the 16 is taken
+    het = _mpmd_job("het", ["v5e-16", "v5e-8"])
+    st = adm.create_gang(het, het.spec.replica_specs)
+    # stage 0's shape has no free match -> the gang reserves NOTHING;
+    # the free v5e-8 must NOT be partially taken
+    assert st.slice_names == []
+    free = [s for s in adm.utilization()["slices"] if not s["reserved_by"]]
+    assert [s["type"] for s in free] == ["v5e-8"]
+    # the blocked hetero gang is feasible -> it SHIELDS its matching
+    # slices: a later solo-ish gang wanting the v5e-8 must not starve it
+    # forever, but the immediate grant goes to nobody yet
+    adm.delete_gang(big)
+    adm.kick()
+    st = adm.get_gang("default", "het")
+    assert sorted(st.slice_names) == sorted(
+        [s for s in ("slice-0-v5e-16", "slice-1-v5e-8")])
+    assert st.slice_names[0].endswith("v5e-16")
+
+
+def test_hetero_gang_infeasible_shape_never_wedges():
+    # no v5p slice exists at all -> the gang is INFEASIBLE: it must not
+    # shield anything or block other admissions
+    adm, sched = _setup(["v5e-16", "v5e-8"], policy="gavel")
+    het = _mpmd_job("het", ["v5e-16", "v5p-8"])
+    st = adm.create_gang(het, het.spec.replica_specs)
+    assert st.slice_names == []
+    other = _job("other", chips=8, tpu_slice="v5e-8")
+    adm.create_gang(other, other.spec.replica_specs)
+    assert _reserved(adm, "other"), (
+        "an infeasible hetero gang must not shield the pool")
+
+
+def test_hetero_gang_same_shape_distinct_slices():
+    # two stages wanting the SAME shape need two DISTINCT slices
+    adm, sched = _setup(["v5e-8", "v5e-8"], policy="gavel")
+    het = _mpmd_job("het", ["v5e-8", "v5e-8"])
+    st = adm.create_gang(het, het.spec.replica_specs)
+    assert len(st.slice_names) == 2
+    assert len(set(st.slice_names)) == 2
+
+
+def test_hetero_gang_snapshot_carries_stage_slices():
+    adm, sched = _setup(["v5e-16", "v5e-8"], policy="gavel")
+    het = _mpmd_job("het", ["v5e-16", "v5e-8"])
+    adm.create_gang(het, het.spec.replica_specs)
+    snap = [g for g in adm.gang_snapshots() if g.key == "default/het"][0]
+    assert snap.stage_slices == ["v5e-16", "v5e-8"]
+
+
+def test_hetero_gang_respects_tenant_cap():
+    # cap the tenant below the assignment's chip SUM -> no reservation
+    # at all (all-or-nothing holds against the cap too)
+    adm, sched = _setup(["v5e-16", "v5e-8"], policy="gavel",
+                        tenant_caps={"t1": 8})
+    het = _mpmd_job("het", ["v5e-16", "v5e-8"], tenant="t1")
+    st = adm.create_gang(het, het.spec.replica_specs)
+    assert st.slice_names == []

@@ -1,0 +1,698 @@
+"""Interleaved-1F1B schedule + MPMD cross-slice pipeline parity matrix
+(ISSUE 9 / ROADMAP item 5).
+
+Three executions of the SAME model must agree: the non-pipelined
+forward, the single-program GPipe pipeline (the parity oracle), and the
+interleaved 1F1B schedule — plus the MPMD runtime, where each stage is a
+separate program joined by the serialized DCN boundary. The jax-0.4.x
+grad-of-shard_map MoE quirk (see test_pipeline_moe.py) is avoided, not
+xfailed: MoE grads here go through the MPMD runtime, which uses no
+shard_map at all.
+"""
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubedl_tpu.api.validation import validate_pipeline_shapes
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel import pipeline, pipeline_mpmd
+from kubedl_tpu.parallel.mesh import build_mesh
+from kubedl_tpu.train.pipeline_runtime import MPMDPipeline
+
+
+def tiny(**kw):
+    return llama.LlamaConfig.tiny(dtype=jnp.float32, use_flash=False,
+                                  remat=False, **kw)
+
+
+def tokens_for(config, batch, seq, seed=1):
+    return jax.random.randint(
+        jax.random.PRNGKey(seed), (batch, seq), 0, config.vocab_size)
+
+
+# ---------------------------------------------------------------------------
+# schedule math + layer-order helpers
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_steps_and_bubble():
+    # GPipe at the bench shape: (S-1)/(M+S-1)
+    assert pipeline.schedule_steps(8, 4, 1) == 11
+    assert pipeline.bubble_fraction(8, 4, 1) == pytest.approx(3 / 11)
+    # interleave v=2 cuts the fraction ~1/v: (S-1)/(M*v+S-1)
+    assert pipeline.schedule_steps(8, 4, 2) == 19
+    assert pipeline.bubble_fraction(8, 4, 2) == pytest.approx(3 / 19)
+    # the ISSUE 9 acceptance bound at the bench shape
+    ratio = pipeline.bubble_fraction(8, 4, 2) / pipeline.bubble_fraction(8, 4, 1)
+    assert ratio <= 0.6
+
+
+def test_interleaved_layer_order():
+    # S=2, v=2, 8 layers -> chunks of 2: rank 0 holds chunks 0,2
+    # (layers 0,1,4,5), rank 1 chunks 1,3 (layers 2,3,6,7)
+    order = pipeline.interleaved_layer_order(8, 2, 2)
+    np.testing.assert_array_equal(order, [0, 1, 4, 5, 2, 3, 6, 7])
+    # v=1 is the identity (GPipe's contiguous blocks)
+    np.testing.assert_array_equal(
+        pipeline.interleaved_layer_order(8, 4, 1), np.arange(8))
+    # every layer appears exactly once
+    order = pipeline.interleaved_layer_order(24, 4, 3)
+    assert sorted(order.tolist()) == list(range(24))
+
+
+def test_shared_shape_validation():
+    assert validate_pipeline_shapes(4, 8, 2, n_layers=8) == []
+    errs = validate_pipeline_shapes(4, 2, 1)
+    assert any("microbatches" in e for e in errs)
+    errs = validate_pipeline_shapes(4, 8, 2, n_layers=6)
+    assert any("not divisible" in e for e in errs)
+    errs = validate_pipeline_shapes(0, 0, 0)
+    assert len(errs) >= 2
+
+
+# ---------------------------------------------------------------------------
+# 1F1B vs GPipe vs non-pipelined (single-program, shard_map)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("interleave,n_micro", [(1, 4), (2, 4), (2, 8)])
+def test_1f1b_forward_matches_sequential(interleave, n_micro):
+    config = tiny(n_layers=8)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = tokens_for(config, 16, 16)
+    ref = llama.forward(params, tokens, config)
+    out = jax.jit(lambda p, t: llama.forward_pipelined(
+        p, t, config, mesh, n_microbatches=n_micro,
+        schedule="1f1b", interleave=interleave))(
+            llama.stack_params(params), tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
+
+
+def test_1f1b_matches_gpipe_oracle_exactly():
+    """Same mesh, same microbatching — the two schedules are the same
+    math in a different order, so the losses agree to float roundoff."""
+    config = tiny(n_layers=8)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    stacked = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+    tokens = tokens_for(config, 8, 17)
+    loss_g = jax.jit(lambda p: llama.loss_fn_pp(
+        p, tokens, config, mesh, n_microbatches=4))(stacked)
+    loss_f = jax.jit(lambda p: llama.loss_fn_pp(
+        p, tokens, config, mesh, n_microbatches=4,
+        schedule="1f1b", interleave=2))(stacked)
+    assert abs(float(loss_g) - float(loss_f)) < 1e-6
+
+
+def test_1f1b_loss_and_grads_match_reference():
+    config = tiny(n_layers=4)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = tokens_for(config, 8, 17, seed=2)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, tokens, config))(params)
+    pp_loss, pp_grads = jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn_pp(
+            p, tokens, config, mesh, n_microbatches=4,
+            schedule="1f1b", interleave=1)))(llama.stack_params(params))
+    assert abs(float(pp_loss) - float(ref_loss)) < 1e-5
+    ref_stacked = llama.stack_params(ref_grads)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_stacked),
+                    jax.tree_util.tree_leaves(pp_grads)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=2e-4, rtol=2e-3)
+
+
+@pytest.mark.slow
+def test_1f1b_interleaved_grads_match_reference():
+    """interleave=2: grads flow back through the layer-order gather to
+    the natural stacked layout."""
+    config = tiny(n_layers=8)
+    mesh = build_mesh({"stage": 4, "data": 2})
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = tokens_for(config, 8, 17, seed=2)
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, tokens, config))(params)
+    pp_loss, pp_grads = jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn_pp(
+            p, tokens, config, mesh, n_microbatches=4,
+            schedule="1f1b", interleave=2)))(llama.stack_params(params))
+    assert abs(float(pp_loss) - float(ref_loss)) < 1e-5
+    ref_stacked = llama.stack_params(ref_grads)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_stacked),
+                    jax.tree_util.tree_leaves(pp_grads)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=2e-4, rtol=2e-3)
+
+
+def test_1f1b_moe_forward_and_aux():
+    """MoE layers under the interleaved schedule: logits match the
+    sequential forward (routing is per-token); aux is microbatch-
+    granular like the GPipe oracle (same order of magnitude, not
+    equality — see test_pipeline_moe.py)."""
+    config = tiny(n_layers=4, n_experts=4, expert_top_k=2)
+    mesh = build_mesh({"stage": 2, "data": 4})
+    params = llama.init(config, jax.random.PRNGKey(3))
+    tokens = tokens_for(config, 16, 16, seed=4)
+    ref = llama.forward(params, tokens, config)
+    out, aux = jax.jit(lambda p, t: llama.forward_pipelined_and_aux(
+        p, t, config, mesh, n_microbatches=4,
+        schedule="1f1b", interleave=2))(llama.stack_params(params), tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+    _, aux_ref = llama.forward_and_aux(params, tokens, config)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+    assert 0.3 < float(aux) / float(aux_ref) < 3.0
+
+
+def test_1f1b_degenerate_and_rejects():
+    config = tiny(n_layers=4)
+    params = llama.stack_params(llama.init(config, jax.random.PRNGKey(0)))
+    tokens = tokens_for(config, 8, 16)
+    # M == S (minimum fill) works
+    mesh = build_mesh({"stage": 4, "data": 2})
+    ref = llama.forward(llama.init(config, jax.random.PRNGKey(0)),
+                        tokens, config)
+    out = jax.jit(lambda p, t: llama.forward_pipelined(
+        p, t, config, mesh, n_microbatches=4, schedule="1f1b"))(
+            params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+    # M < S refused
+    with pytest.raises(ValueError, match="microbatches"):
+        llama.forward_pipelined(params, tokens, config, mesh,
+                                n_microbatches=2, schedule="1f1b")
+    # layer count not divisible by stages * interleave refused
+    with pytest.raises(ValueError, match="not divisible"):
+        llama.forward_pipelined(params, tokens, config, mesh,
+                                n_microbatches=4, schedule="1f1b",
+                                interleave=3)
+    # interleave>1 on the gpipe schedule refused
+    with pytest.raises(ValueError, match="interleave"):
+        llama.forward_pipelined(params, tokens, config, mesh,
+                                n_microbatches=4, schedule="gpipe",
+                                interleave=2)
+    with pytest.raises(ValueError, match="schedule"):
+        llama.forward_pipelined(params, tokens, config, mesh,
+                                n_microbatches=4, schedule="pipedream")
+
+
+# ---------------------------------------------------------------------------
+# serialized DCN boundary
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_bf16_roundtrip():
+    import ml_dtypes
+
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4) / 7.0
+    bf = a.astype(ml_dtypes.bfloat16)
+    data = pipeline_mpmd.encode_boundary([bf], meta={"mb": 3, "aux": 0.25})
+    (back,), meta = pipeline_mpmd.decode_boundary(data)
+    assert back.dtype == bf.dtype
+    assert back.tobytes() == bf.tobytes()  # BYTE-identical, not just close
+    assert meta == {"mb": 3, "aux": 0.25}
+
+
+def test_boundary_mixed_dtype_refused():
+    a = np.zeros((2,), np.float32)
+    b = np.zeros((2,), np.int32)
+    with pytest.raises(ValueError, match="mixed-dtype"):
+        pipeline_mpmd.encode_boundary([a, b])
+
+
+def test_boundary_corrupt_refused():
+    data = pipeline_mpmd.encode_boundary([np.zeros((4,), np.float32)])
+    with pytest.raises(ValueError, match="magic"):
+        pipeline_mpmd.decode_boundary(b"nonsense" + data)
+    with pytest.raises(ValueError, match="length mismatch"):
+        pipeline_mpmd.decode_boundary(data + b"trailing")
+
+
+def test_two_process_boundary_roundtrip(tmp_path):
+    """A REAL second process echoes a bf16 boundary message back over
+    the DirChannel (the local executor's DCN analog): bf16 must survive
+    the cross-process hop byte-identically — the PR 6/PR 8 npz |V2
+    lesson, pinned at the pipeline boundary."""
+    import ml_dtypes
+
+    chan_dir = str(tmp_path / "edge")
+    child_src = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from kubedl_tpu.parallel.pipeline_mpmd import (DirChannel,"
+        " decode_boundary, encode_boundary)\n"
+        "ch = DirChannel(%r)\n"
+        "arrs, meta = decode_boundary(ch.recv('ping', timeout=30))\n"
+        "ch.send('pong', encode_boundary(arrs, meta={**meta, 'echo': 1}))\n"
+    ) % (str(__import__("pathlib").Path(__file__).parent.parent), chan_dir)
+    ch = pipeline_mpmd.DirChannel(chan_dir)
+    proc = subprocess.Popen([sys.executable, "-c", child_src])
+    try:
+        act = (np.arange(64, dtype=np.float32) / 9.0).astype(
+            ml_dtypes.bfloat16).reshape(4, 16)
+        ch.send("ping", pipeline_mpmd.encode_boundary([act], meta={"mb": 0}))
+        (back,), meta = pipeline_mpmd.decode_boundary(
+            ch.recv("pong", timeout=30))
+        assert back.dtype == act.dtype and back.tobytes() == act.tobytes()
+        assert meta == {"mb": 0, "echo": 1}
+    finally:
+        assert proc.wait(timeout=30) == 0
+
+
+# ---------------------------------------------------------------------------
+# MPMD runtime parity (separate stage programs, no shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _mpmd_reference(config, params, tokens, M):
+    """The MPMD objective without any pipeline: mean over microbatches of
+    the full-model per-microbatch loss — CE and aux at exactly the
+    runtime's granularity, no shard_map anywhere (usable for MoE grads
+    on jax 0.4.x)."""
+    mb = tokens.shape[0] // M
+
+    def loss(p):
+        total = 0.0
+        for i in range(M):
+            total = total + llama.loss_fn(
+                p, tokens[i * mb:(i + 1) * mb], config) / M
+        return total
+
+    return loss
+
+
+def test_mpmd_two_stage_loss_and_grads():
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(tokens_for(config, 8, 17))
+    M = 4
+    loss_ref, g_ref = jax.value_and_grad(
+        _mpmd_reference(config, params, jnp.asarray(tokens), M))(params)
+    mp = MPMDPipeline(config, params, optax.sgd(0.0),
+                      n_stages=2, n_microbatches=M)
+    try:
+        out = mp.step(tokens)
+        assert abs(out["loss"] - float(loss_ref)) < 1e-5
+        assert out["serialized_bytes"] > 0, "boundary must serialize"
+        plan = mp.plan
+        for s in range(2):
+            ref_slice = pipeline_mpmd.split_stage_params(g_ref, plan, s)
+            for a, b in zip(
+                    jax.tree_util.tree_leaves(ref_slice),
+                    jax.tree_util.tree_leaves(mp.stages[s].last_grads)):
+                np.testing.assert_allclose(
+                    np.asarray(b), np.asarray(a), atol=2e-4, rtol=2e-3)
+    finally:
+        mp.close()
+
+
+def test_mpmd_matches_single_program_pipeline():
+    """Step loss matches the single-program pipeline at matching aux
+    granularity (data=1 stage mesh) — the acceptance criterion's
+    'two separate programs, step-loss matching' in-process."""
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(tokens_for(config, 8, 17))
+    mesh = build_mesh({"stage": 2}, devices=jax.devices()[:2])
+    oracle = float(jax.jit(lambda p: llama.loss_fn_pp(
+        p, jnp.asarray(tokens), config, mesh, n_microbatches=4))(
+            llama.stack_params(params)))
+    mp = MPMDPipeline(config, params, optax.sgd(0.0),
+                      n_stages=2, n_microbatches=4)
+    try:
+        out = mp.step(tokens)
+        assert abs(out["loss"] - oracle) < 1e-4
+    finally:
+        mp.close()
+
+
+def test_mpmd_moe_aux_threads_through_schedule():
+    """MoE aux reaches the last stage's loss AND every stage's router
+    grads — through the 1F1B schedule, no shard_map (so this runs the
+    grads jax-0.4.x refuses in the SPMD pipeline)."""
+    config = tiny(n_layers=4, n_experts=4, expert_top_k=2)
+    params = llama.init(config, jax.random.PRNGKey(3))
+    tokens = np.asarray(tokens_for(config, 8, 17, seed=4))
+    M = 4
+    loss_ref, g_ref = jax.value_and_grad(
+        _mpmd_reference(config, params, jnp.asarray(tokens), M))(params)
+    mp = MPMDPipeline(config, params, optax.sgd(0.0),
+                      n_stages=2, n_microbatches=M)
+    try:
+        out = mp.step(tokens)
+        assert abs(out["loss"] - float(loss_ref)) < 1e-4
+        plan = mp.plan
+        for s in range(2):
+            ref_slice = pipeline_mpmd.split_stage_params(g_ref, plan, s)
+            got = mp.stages[s].last_grads
+            for a, b in zip(jax.tree_util.tree_leaves(ref_slice),
+                            jax.tree_util.tree_leaves(got)):
+                np.testing.assert_allclose(
+                    np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-3)
+            router_g = got["layers"][0]["moe"]["router"]
+            assert float(jnp.abs(router_g).max()) > 0.0, (
+                "router must receive grads through the boundary")
+    finally:
+        mp.close()
+
+
+def test_mpmd_degenerate_single_stage_and_m_eq_s():
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(tokens_for(config, 8, 17))
+    loss_ref = float(jax.value_and_grad(
+        _mpmd_reference(config, params, jnp.asarray(tokens), 4))(params)[0])
+    # 1 stage: the whole model in one program, no channels at all
+    mp1 = MPMDPipeline(config, params, optax.sgd(0.0),
+                       n_stages=1, n_microbatches=4)
+    try:
+        out = mp1.step(tokens)
+        assert abs(out["loss"] - loss_ref) < 1e-5
+        assert out["serialized_bytes"] == 0
+    finally:
+        mp1.close()
+    # M == S: zero steady-state, pure fill/drain — still correct
+    loss_ref2 = float(_mpmd_reference(
+        config, params, jnp.asarray(tokens), 2)(params))
+    mp2 = MPMDPipeline(config, params, optax.sgd(0.0),
+                       n_stages=2, n_microbatches=2)
+    try:
+        out = mp2.step(tokens)
+        assert abs(out["loss"] - loss_ref2) < 1e-5
+    finally:
+        mp2.close()
+
+
+def test_mpmd_trains_and_feeds_metrics():
+    from kubedl_tpu.metrics.runtime_metrics import (
+        RuntimeMetrics,
+        pipeline_metrics,
+    )
+
+    pipeline_metrics.reset()
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(tokens_for(config, 8, 17))
+    mp = MPMDPipeline(config, params, optax.adamw(1e-3),
+                      n_stages=2, n_microbatches=4, job="unit-pp")
+    try:
+        l0 = mp.step(tokens)["loss"]
+        l1 = None
+        for _ in range(3):
+            l1 = mp.step(tokens)["loss"]
+        assert l1 < l0, "per-stage optimizers must actually train"
+    finally:
+        mp.close()
+    snap = pipeline_metrics.snapshot()
+    rec = snap["jobs"]["unit-pp"]
+    assert rec["steps"] == 4 and rec["stages"] == 2
+    assert 0.0 < rec["bubble_frac"] < 1.0
+    assert set(rec["stage_step_s"]) == {0, 1}
+    rm = RuntimeMetrics()
+    rm.register_pipeline(pipeline_metrics.snapshot)
+    text = rm.render()
+    assert 'kubedl_pipeline_bubble_frac{job="unit-pp"' in text
+    assert 'kubedl_pipeline_stage_step_seconds{job="unit-pp",stage="1"}' in text
+    assert 'kubedl_pipeline_steps_total{job="unit-pp"} 4' in text
+    assert rm.debug_vars()["pipeline"]["jobs"]["unit-pp"]["steps"] == 4
+
+
+def test_mpmd_split_refuses_tied_embeddings():
+    plan = pipeline_mpmd.make_stage_plan(4, 2, 4)
+    config = tiny(n_layers=4, tie_embeddings=True)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="tie_embeddings"):
+        pipeline_mpmd.split_stage_params(params, plan, 1)
+
+
+# ---------------------------------------------------------------------------
+# JAXJob submit-time validation (shared api/validation path)
+# ---------------------------------------------------------------------------
+
+
+def _jax_job(spec_extra, workers=4):
+    from kubedl_tpu.utils.serde import from_dict
+    from kubedl_tpu.workloads.jaxjob import JAXJob
+
+    return from_dict(JAXJob, {
+        "metadata": {"name": "j1"},
+        "spec": {
+            "jaxReplicaSpecs": {"Worker": {"replicas": workers, "template": {
+                "spec": {"containers": [{"name": "jax", "image": "x"}]}}}},
+            **spec_extra,
+        }})
+
+
+def test_jaxjob_pipeline_validation():
+    from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+    ctrl = JAXJobController()
+
+    def errs(spec_extra):
+        return ctrl.validate_job(_jax_job(spec_extra))
+
+    # a valid MPMD manifest
+    ok = {"numSlices": 2,
+          "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True},
+          "checkpoint": {"path": "/ckpt"}}
+    assert errs(ok) == []
+    # microbatches < stages — rejected at SUBMIT, not minutes into the job
+    assert any("microbatches" in e for e in errs({
+        "numSlices": 2, "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 1, "mpmd": True}}))
+    # declared layer count not divisible by stages * interleave
+    assert any("not divisible" in e for e in errs({
+        "mesh": {"stage": 2},
+        "pipeline": {"stages": 2, "microbatches": 4, "interleave": 2,
+                     "layers": 6}}))
+    # mpmd without numSlices > 1
+    assert any("numSlices" in e for e in errs({
+        "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True}}))
+    # mpmd stage/slice count mismatch
+    assert any("numSlices" in e for e in errs({
+        "numSlices": 4, "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True}}))
+    # stageSlices without mpmd / ragged / unparseable
+    assert any("stageSlices" in e for e in errs({
+        "mesh": {"stage": 2},
+        "pipeline": {"stages": 2, "microbatches": 4,
+                     "stageSlices": ["v5e-8", "v5e-8"]}}))
+    assert any("entries" in e for e in errs({
+        "numSlices": 2, "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True,
+                     "stageSlices": ["v5e-8"]}}))
+    assert any("unrecognized" in e for e in errs({
+        "numSlices": 2, "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True,
+                     "stageSlices": ["v5e-8", "wat-9"]}}))
+    # mpmd needs a checkpoint (boundary dir rides that volume)
+    assert any("checkpoint" in e for e in errs({
+        "numSlices": 2,
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True}}))
+    # mpmd is its own cross-slice transport: no dcnMesh, no elastic ladder
+    assert any("dcnMesh" in e for e in errs({
+        "numSlices": 2, "checkpoint": {"path": "/c"},
+        "dcnMesh": {"data": 2},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True}}))
+    # SPMD pipeline needs the mesh stage axis to match
+    assert any("mesh.stage" in e for e in errs({
+        "pipeline": {"stages": 2, "microbatches": 4}}))
+    # interleave>1 under mpmd (the runtime is plain 1F1B)
+    assert any("interleave" in e for e in errs({
+        "numSlices": 2, "checkpoint": {"path": "/c"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True,
+                     "interleave": 2}}))
+
+
+def test_jaxjob_mpmd_env_wiring():
+    """The operator env-wires each stage its neighbors' addresses and the
+    boundary dir (executor/tpu_topology.py pipeline_neighbor_env)."""
+    import copy
+
+    from kubedl_tpu.workloads.jaxjob import JAXJobController
+
+    ctrl = JAXJobController()
+    job = _jax_job({
+        "numSlices": 2, "checkpoint": {"path": "/ckpt"},
+        "pipeline": {"stages": 2, "microbatches": 4, "mpmd": True}})
+    envs = {}
+    for idx in (0, 3):
+        pt = copy.deepcopy(job.spec.replica_specs["Worker"].template)
+        ctrl.set_cluster_spec(job, pt, "Worker", idx)
+        envs[idx] = dict(pt.spec.containers[0].env or {})
+    env0, env3 = envs[0], envs[3]
+    assert env0["KUBEDL_PP_STAGE"] == "0" and env3["KUBEDL_PP_STAGE"] == "1"
+    assert env0["KUBEDL_PP_PREV_ADDR"] == ""
+    assert env0["KUBEDL_PP_NEXT_ADDR"].startswith("j1-worker-2.")
+    assert env3["KUBEDL_PP_PREV_ADDR"].startswith("j1-worker-0.")
+    assert env3["KUBEDL_PP_NEXT_ADDR"] == ""
+    assert env0["KUBEDL_PP_BOUNDARY_DIR"] == "/ckpt/.pipeline"
+    assert env0["KUBEDL_PP_MICROBATCHES"] == "4"
+    # MPMD slices are separate programs: NO Megascale transport env
+    assert "MEGASCALE_COORDINATOR_ADDRESS" not in env0
+    assert "KUBEDL_DCN_MESH" not in env0
+    # ...but a non-mpmd multislice job still gets it
+    job2 = _jax_job({"numSlices": 2})
+    pt = copy.deepcopy(job2.spec.replica_specs["Worker"].template)
+    ctrl.set_cluster_spec(job2, pt, "Worker", 0)
+    assert "MEGASCALE_COORDINATOR_ADDRESS" in dict(
+        pt.spec.containers[0].env or {})
+
+
+def test_runtime_from_env_builds_stage(tmp_path):
+    """KUBEDL_PP_* -> a working StageRuntime over DirChannels."""
+    from kubedl_tpu.train.pipeline_runtime import runtime_from_env
+
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    env = {
+        "KUBEDL_PP_STAGE": "0", "KUBEDL_PP_STAGES": "2",
+        "KUBEDL_PP_MICROBATCHES": "4",
+        "KUBEDL_PP_BOUNDARY_DIR": str(tmp_path / "pp"),
+    }
+    rt = runtime_from_env(config, params, optax.sgd(0.0), env=env)
+    try:
+        assert rt.stage == 0 and rt.plan.n_stages == 2
+        assert "embed" in rt.params and "lm_head" not in rt.params
+    finally:
+        rt.close()
+    with pytest.raises(ValueError, match="KUBEDL_PP_BOUNDARY_DIR"):
+        runtime_from_env(config, params, optax.sgd(0.0), env={
+            "KUBEDL_PP_STAGE": "0", "KUBEDL_PP_STAGES": "2"})
+
+
+# ---------------------------------------------------------------------------
+# trainer integration (the env actually drives a schedule)
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_runs_spmd_pipelined_schedule(monkeypatch, tmp_path):
+    """KUBEDL_PP_* on the SPMD trainer: the mesh's stage axis runs the
+    1F1B schedule (stacked params + loss_fn_pp) instead of silently
+    training un-pipelined."""
+    from kubedl_tpu.train import trainer
+
+    monkeypatch.setenv("KUBEDL_MESH", "stage=2,data=4")
+    monkeypatch.setenv("KUBEDL_PP_STAGES", "2")
+    monkeypatch.setenv("KUBEDL_PP_MICROBATCHES", "4")
+    monkeypatch.setenv("KUBEDL_PP_SCHEDULE", "1f1b")
+    monkeypatch.setenv("KUBEDL_PP_INTERLEAVE", "1")
+    rc = trainer.main(["--model", "tiny", "--steps", "2", "--batch", "16",
+                       "--seq-len", "33", "--log-every", "1"])
+    assert rc == 0
+
+
+def test_trainer_refuses_mpmd_and_bad_shapes(monkeypatch):
+    from kubedl_tpu.train import trainer
+
+    monkeypatch.setenv("KUBEDL_PP_MPMD", "1")
+    assert trainer.main(["--model", "tiny", "--steps", "1"]) == 2
+    monkeypatch.delenv("KUBEDL_PP_MPMD")
+    # microbatches < stages dies at startup, permanent
+    monkeypatch.setenv("KUBEDL_MESH", "stage=2,data=4")
+    monkeypatch.setenv("KUBEDL_PP_STAGES", "2")
+    monkeypatch.setenv("KUBEDL_PP_MICROBATCHES", "1")
+    assert trainer.main(["--model", "tiny", "--steps", "1"]) == 2
+
+
+@pytest.mark.slow
+def test_pipeline_trainer_two_process_e2e(tmp_path):
+    """The REAL MPMD deployment shape: two pipeline_trainer PROCESSES,
+    one per stage, joined only by the DirChannel boundary dir — train a
+    few steps, checkpoint stage-locally, and exit 0."""
+    import os
+
+    from tests.conftest import CPU_ENV
+
+    ckpt = str(tmp_path / "ckpt")
+    bdir = str(tmp_path / "ckpt" / ".pipeline")
+    base_env = {**os.environ, **CPU_ENV,
+                "KUBEDL_PP_STAGES": "2", "KUBEDL_PP_MICROBATCHES": "4",
+                "KUBEDL_PP_BOUNDARY_DIR": bdir,
+                "KUBEDL_CHECKPOINT_PATH": ckpt}
+    cmd = [sys.executable, "-m", "kubedl_tpu.train.pipeline_trainer",
+           "--model", "tiny", "--steps", "3", "--batch", "8",
+           "--seq-len", "33", "--log-every", "1"]
+    procs = []
+    for stage in ("0", "1"):
+        procs.append(subprocess.Popen(
+            cmd, env={**base_env, "KUBEDL_PP_STAGE": stage},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert "loss=" in outs[1], outs[1]  # the last stage reports the loss
+    # stage-local checkpoints landed
+    assert os.path.isdir(os.path.join(ckpt, "stage-0"))
+    assert os.path.isdir(os.path.join(ckpt, "stage-1"))
+
+
+# ---------------------------------------------------------------------------
+# restart-path hardening (stale boundary data can never train silently)
+# ---------------------------------------------------------------------------
+
+
+def test_stale_boundary_message_fails_loud_not_silent():
+    """A message from a DEAD incarnation (different boot id) sitting on
+    the transport must raise, not be consumed as current activations."""
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.asarray(tokens_for(config, 8, 17))
+    # short recv timeout: after stage 1 dies on the stale message,
+    # stage 0 must not sit out the default 60s waiting for grads
+    mp = MPMDPipeline(config, params, optax.sgd(0.0),
+                      n_stages=2, n_microbatches=4, recv_timeout=5)
+    try:
+        # forge step 1's first activation as if a crashed previous
+        # incarnation had left it behind
+        stale = pipeline_mpmd.encode_boundary(
+            [np.zeros((2, 16, 128), np.float32)],
+            meta={"mb": 0, "aux": 0.0, "boot": "dead-incarnation"})
+        mp.stages[1]._act_rx._channel.send("a1.0", stale)
+        with pytest.raises(RuntimeError, match="incarnation"):
+            mp.step(tokens)
+    finally:
+        mp.close()
+
+
+def test_runtime_from_env_purges_stale_messages(tmp_path, capsys):
+    from kubedl_tpu.train.pipeline_runtime import runtime_from_env
+
+    config = tiny(n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    bdir = str(tmp_path / "pp")
+    # stage 1 receives on act0 and (as non-last it would on grad1, but
+    # for S=2 stage 1 IS last) — leave a stale act file behind
+    ch = pipeline_mpmd.DirChannel(str(tmp_path / "pp" / "act0"))
+    ch.send("a7.0", pipeline_mpmd.encode_boundary(
+        [np.zeros((2,), np.float32)], meta={"boot": "dead"}))
+    env = {"KUBEDL_PP_STAGE": "1", "KUBEDL_PP_STAGES": "2",
+           "KUBEDL_PP_MICROBATCHES": "4", "KUBEDL_PP_BOUNDARY_DIR": bdir}
+    rt = runtime_from_env(config, params, optax.sgd(0.0), env=env)
+    try:
+        import os
+        assert not [f for f in os.listdir(str(tmp_path / "pp" / "act0"))
+                    if f.endswith(".msg")]
+        assert "purged 1 stale" in capsys.readouterr().out
+    finally:
+        rt.close()
+
+
+def test_common_restore_step(tmp_path):
+    from kubedl_tpu.train.pipeline_trainer import _common_restore_step
+
+    ckpt = str(tmp_path)
+    # stage 0 saved 80,90,100; stage 1 crashed before 100 landed
+    for s, steps in ((0, (80, 90, 100)), (1, (70, 80, 90))):
+        for st in steps:
+            (tmp_path / f"stage-{s}" / str(st)).mkdir(parents=True)
+    assert _common_restore_step(ckpt, 2) == 90
+    # a stage with no checkpoints at all -> fresh start for the gang
+    assert _common_restore_step(ckpt, 3) is None

@@ -285,7 +285,8 @@ def test_jaxjob_coordinator_and_mesh_env():
     assert env["KUBEDL_COORDINATOR_ADDRESS"] == "job1-worker-0.default.svc:8471"
     assert env["KUBEDL_NUM_PROCESSES"] == "4"
     assert env["KUBEDL_PROCESS_ID"] == "2"
-    assert env["KUBEDL_MESH"] == "data=2,fsdp=2,tensor=1,context=1,expert=1"
+    assert env["KUBEDL_MESH"] == ("data=2,fsdp=2,stage=1,tensor=1,"
+                                  "context=1,expert=1")
     assert env["KUBEDL_CHECKPOINT_PATH"] == "/ckpt/job1"
     assert env["KUBEDL_CHECKPOINT_INTERVAL"] == "100"
     # preemption-recovery cost: restarted slices replay XLA compiles
