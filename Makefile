@@ -23,7 +23,8 @@ presubmit:
 	  --total tests/test_serving_disagg.py=120 \
 	  --total tests/test_serving_fleet.py=60 \
 	  --total tests/test_reshard.py=45 \
-	  --total tests/test_pipeline_1f1b.py=100
+	  --total tests/test_pipeline_1f1b.py=100 \
+	  --total tests/test_obs.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench

@@ -1512,6 +1512,35 @@ def _collect_results(results_path: str):
     return extras
 
 
+def _lane_trace(name, lane_s, records):
+    """Flight-recorder pairing for the bench lanes: one span covering the
+    lane's wall time plus an instant span per produced record (scalar
+    fields as attrs), written to a committed JSONL under .bench_trace/.
+    Returns the repo-relative path to stamp into the records, or "" when
+    the recorder could not write (bench evidence still lands)."""
+    try:
+        from kubedl_tpu.obs.trace import Tracer, trace_id_for
+
+        trace_dir = os.path.join(REPO, ".bench_trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        path = os.path.join(trace_dir, f"{name}.jsonl")
+        open(path, "w").close()  # the lane's trace, not an append log
+        tracer = Tracer(service=f"bench-{name}",
+                        trace_id=trace_id_for("bench", name),
+                        export_path=path)
+        tracer.record(f"bench.{name}", duration_s=lane_s)
+        for key, rec in sorted(records.items()):
+            if isinstance(rec, dict):
+                tracer.record(
+                    f"bench.{key}",
+                    **{k: v for k, v in rec.items()
+                       if isinstance(v, (int, float, str, bool))})
+        tracer.close()
+        return os.path.relpath(path, REPO)
+    except Exception:  # noqa: BLE001 — tracing must not sink the bench
+        return ""
+
+
 def _single_lane(name, milestones, merge_keys=(), small_devices=0):
     """Shared body of the `--*-only` fast loops (bench-moe / bench-serving /
     bench-resize / bench-pp): run ONLY the named milestones in-process,
@@ -1533,8 +1562,17 @@ def _single_lane(name, milestones, merge_keys=(), small_devices=0):
                 f"{small_devices}").strip()
     results_path = os.path.join(REPO, f".bench_results_{name}.jsonl")
     open(results_path, "w").close()
+    t_lane0 = time.monotonic()
     rc = _tpu_child(results_path)
+    lane_s = time.monotonic() - t_lane0
     records = _parse_results(results_path)
+    # bench evidence and trace evidence stay paired: every record this
+    # lane merges (or prints) names the span JSONL that timed it
+    trace_rel = _lane_trace(name, lane_s, records)
+    if trace_rel:
+        for rec in records.values():
+            if isinstance(rec, dict):
+                rec["trace_jsonl"] = trace_rel
     if merge_keys:
         extras_path = os.path.join(REPO, ".bench_extras.json")
         try:

@@ -357,6 +357,36 @@ def cmd_top(args) -> int:
     if cap:
         _print_capacity_tenants(cap)
         print()
+    gp = vars_.get("goodput")
+    if gp and gp.get("jobs"):
+        rows = [("JOB", "GOODPUT", "WALL_S", "STEPS_S", "QUEUE_S", "INIT_S",
+                 "CKPT_S", "RESHARD_S", "EVICT_S", "OTHER_S")]
+        for job, rec in sorted(gp["jobs"].items()):
+            b = rec.get("buckets") or {}
+            rows.append((
+                job, f"{rec.get('ratio', 0.0):.0%}",
+                f"{rec.get('wall_s', 0.0):.2f}",
+                f"{b.get('steps', 0.0):.2f}", f"{b.get('queue_wait', 0.0):.2f}",
+                f"{b.get('init_compile', 0.0):.2f}",
+                f"{b.get('checkpoint', 0.0):.2f}",
+                f"{b.get('reshard', 0.0):.2f}", f"{b.get('eviction', 0.0):.2f}",
+                f"{b.get('other', 0.0):.2f}",
+            ))
+        _print_table(rows)
+        print()
+    steps = vars_.get("steps")
+    if steps and steps.get("jobs"):
+        rows = [("STEP_JOB", "PODS", "MEDIAN_STEP_MS", "STRAGGLERS",
+                 "COMPILES")]
+        for job, rec in sorted(steps["jobs"].items()):
+            rows.append((
+                job, len(rec.get("pods") or {}),
+                f"{rec.get('median_step_s', 0.0) * 1e3:.1f}",
+                ",".join(rec.get("stragglers") or []) or "-",
+                rec.get("compile_events", 0),
+            ))
+        _print_table(rows)
+        print()
     pipe = vars_.get("pipeline")
     if pipe and pipe.get("jobs"):
         rows = [("PIPELINE_JOB", "SCHEDULE", "STAGES", "BUBBLE", "STEPS",
@@ -436,6 +466,62 @@ def cmd_queue(args) -> int:
             ",".join(q.get("draining") or []) or "-",
             q.get("waiting_seconds", 0.0), q.get("preemptions", 0),
         ))
+    _print_table(rows)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Flight-recorder view of one job (docs/observability.md): the
+    merged cross-plane span timeline, the goodput breakdown computed from
+    the same spans, and optional Chrome-trace export for Perfetto.
+    Reads the operator's /trace endpoint, or a trace dir directly with
+    --dir (offline evidence, e.g. a committed bench artifact)."""
+    from kubedl_tpu.obs import chrome_trace, goodput, load_spans
+
+    if args.dir:
+        spans = load_spans(args.dir)
+        gp = goodput(spans)
+        trace_ids = gp.get("trace_ids") or []
+    else:
+        out = _client_request(
+            args, "GET", f"/trace/{args.namespace}/{args.job}")
+        if out is None:
+            return 1
+        spans = out.get("spans") or []
+        gp = out.get("goodput") or goodput(spans)
+        trace_ids = [out.get("trace_id", "")]
+    if not spans:
+        print(f"no spans recorded for {args.namespace}/{args.job}",
+              file=sys.stderr)
+        return 1
+    if args.chrome_trace:
+        with open(args.chrome_trace, "w") as f:
+            json.dump(chrome_trace(spans), f)
+        print(f"chrome trace ({len(spans)} spans) written to "
+              f"{args.chrome_trace} — load in Perfetto / chrome://tracing")
+    t0 = gp.get("t0") or min(s.get("ts", 0.0) for s in spans)
+    print(f"trace {args.job}: {len(spans)} spans, "
+          f"wall {gp.get('wall_s', 0.0):.3f}s, "
+          f"trace_id {' '.join(trace_ids) or '?'}")
+    rows = [("T+S", "DUR_S", "SERVICE", "SPAN", "DETAIL")]
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        detail = " ".join(
+            f"{k}={attrs[k]}" for k in
+            ("step", "stage", "cause", "outcome", "shape", "reason", "error")
+            if k in attrs)
+        rows.append((
+            f"{s.get('ts', 0.0) - t0:+.3f}",
+            f"{s.get('dur', 0.0):.3f}",
+            s.get("service", ""), s.get("name", ""), detail or "-"))
+    _print_table(rows)
+    print()
+    print(f"goodput: {gp.get('ratio', 0.0):.1%} "
+          f"(productive step time / wall time)")
+    rows = [("BUCKET", "SECONDS", "SHARE")]
+    wall = gp.get("wall_s", 0.0) or 1.0
+    for bucket, secs in (gp.get("buckets") or {}).items():
+        rows.append((bucket, f"{secs:.3f}", f"{secs / wall:.1%}"))
     _print_table(rows)
     return 0
 
@@ -697,6 +783,16 @@ def main(argv=None) -> int:
     p_queue = client_parser(
         "queue", "capacity-scheduler gang queue + tenant quota state")
     p_queue.set_defaults(fn=cmd_queue)
+
+    p_trace = client_parser(
+        "trace", "flight-recorder span timeline + goodput for one job")
+    p_trace.add_argument("job")
+    p_trace.add_argument("--chrome-trace", default="", metavar="OUT.json",
+                         help="also export Chrome trace JSON (Perfetto)")
+    p_trace.add_argument("--dir", default="",
+                         help="read spans from a local trace dir instead "
+                              "of the operator server")
+    p_trace.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     return args.fn(args)

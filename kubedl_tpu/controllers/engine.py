@@ -121,6 +121,10 @@ class JobReconciler:
         self.config = config or EngineConfig()
         self.expectations = ControllerExpectations()
         self.runner: Optional[ControllerRunner] = None
+        # flight recorder (obs/trace.py Tracer), wired by the operator:
+        # each reconcile becomes a span on the job's timeline, keyed by
+        # the same gang-level trace id the executor injects into pods
+        self.tracer = None
         # Dedicated failure-backoff states (ref job_controller.go:85-88
         # BackoffStatesQueue) — counts only observed pod failures, never
         # status-write conflicts, so conflict churn can't burn the
@@ -202,6 +206,19 @@ class JobReconciler:
     # ------------------------------------------------------------------
 
     def reconcile(self, key: str) -> Result:
+        if self.tracer is None:
+            return self._reconcile(key)
+        namespace, name = key.split("/", 1)
+        from kubedl_tpu.obs.trace import trace_id_for
+
+        with self.tracer.span(
+            "operator.reconcile",
+            trace_id=trace_id_for(namespace, name),
+            job=name, namespace=namespace, kind=self.controller.kind,
+        ):
+            return self._reconcile(key)
+
+    def _reconcile(self, key: str) -> Result:
         namespace, name = key.split("/", 1)
         try:
             job = self.store.get(self.controller.kind, namespace, name)

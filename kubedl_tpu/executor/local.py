@@ -60,6 +60,7 @@ class LocalPodExecutor:
         restart_backoff: float = 0.05,
         launch_hook=None,
         log_dir: Optional[str] = None,
+        trace_root: Optional[str] = None,
     ) -> None:
         self.store = store
         # Optional TPU-slice scheduler (gang admission): pod stays Pending
@@ -70,6 +71,12 @@ class LocalPodExecutor:
         # container stdout/stderr land here (kubectl-logs equivalent),
         # appended across in-place restarts, removed when the pod is deleted
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="kubedl-logs-")
+        # flight recorder (obs/): per-JOB trace dirs under this root,
+        # injected as KUBEDL_TRACE_DIR/_ID the same way KUBEDL_CONTROL_DIR
+        # travels. Job-scoped, NOT removed with the pod — the recorder's
+        # whole point is that the timeline survives the pods (the operator
+        # exports its control-plane spans into the same dirs).
+        self.trace_root = trace_root or tempfile.mkdtemp(prefix="kubedl-trace-")
         # per-pod control channel (the local analog of a sidecar/ConfigMap
         # watch): the scheduler posts JSON messages (live-reshard RESIZE,
         # sched/capacity.py) into the pod's dir, injected as
@@ -143,6 +150,33 @@ class LocalPodExecutor:
         except OSError:
             return None
         return os.path.join(d, msg["reply"])
+
+    def read_heartbeats(self) -> List[Dict]:
+        """Latest step-telemetry heartbeat of every pod that wrote one
+        (obs/steps.py StepStream writes ``heartbeat.json`` into the pod's
+        control dir, atomic-replaced each step). Pull model: the operator's
+        StepAggregator calls this on each metrics scrape."""
+        import json
+
+        out: List[Dict] = []
+        try:
+            entries = sorted(os.listdir(self.control_root))
+        except OSError:
+            return out
+        for entry in entries:
+            path = os.path.join(self.control_root, entry, "heartbeat.json")
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if not isinstance(rec, dict):
+                continue
+            namespace, _, pod = entry.partition("_")
+            rec.setdefault("namespace", namespace)
+            rec.setdefault("pod", pod)
+            out.append(rec)
+        return out
 
     # -- lifecycle -------------------------------------------------------
 
@@ -362,6 +396,23 @@ class LocalPodExecutor:
         env["POD_NAMESPACE"] = pod.metadata.namespace
         env["KUBEDL_CONTROL_DIR"] = self.control_dir(
             pod.metadata.namespace, pod.metadata.name)
+        # flight-recorder correlation (obs/trace.py): one gang-level trace
+        # id + a shared per-job trace dir for every pod of the job, so the
+        # control-plane and compute-plane spans merge into one timeline.
+        # setdefault: a manifest that pins its own KUBEDL_TRACE_* wins.
+        from kubedl_tpu.obs.trace import job_trace_dir, trace_id_for
+
+        job_name = pod.metadata.labels.get("job-name") or pod.metadata.name
+        trace_dir = job_trace_dir(
+            self.trace_root, pod.metadata.namespace, job_name)
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            env.setdefault("KUBEDL_TRACE_DIR", trace_dir)
+            env.setdefault(
+                "KUBEDL_TRACE_ID",
+                trace_id_for(pod.metadata.namespace, job_name))
+        except OSError:
+            pass  # recorder unavailable; the pod still runs
         for k, v in pod.metadata.labels.items():
             env[f"KUBEDL_LABEL_{k.upper().replace('-', '_')}"] = v
         if placement is not None:

@@ -166,6 +166,15 @@ class TPUSliceAdmitter(GangScheduler):
         # from the pool once their drain completes — the chips release
         # exactly once, through the same accounting as an eviction
         self._dead: set = set()
+        # flight recorder (obs/trace.py Tracer), wired by the operator:
+        # each grant retro-records the gang's queue wait as a span, so
+        # the goodput accountant can tell scheduling delay (and, via
+        # cause=requeue, preemption downtime) from training time.
+        # Grants happen under the admitter lock, but the span's file
+        # write must not: records queue here and drain at the public
+        # entry points — a slow trace volume must never stall scheduling.
+        self.tracer = None
+        self._span_queue: List = []
 
     @staticmethod
     def _drain_marker(gang_key: str) -> str:
@@ -237,6 +246,7 @@ class TPUSliceAdmitter(GangScheduler):
             changed_keys.extend(self._reserve_waiting())
         for key in changed_keys:
             self._remirror_podgroup_status(key)
+        self._drain_spans()
 
     def _remirror_podgroup_status(self, gang_key: str) -> None:
         """Refresh the PodGroup mirror's status after a pool-driven
@@ -346,6 +356,7 @@ class TPUSliceAdmitter(GangScheduler):
                 )
                 self._gangs[key] = state
             self._reserve_waiting()
+        self._drain_spans()
         self._mirror_podgroup(job, state)
         return state
 
@@ -388,6 +399,11 @@ class TPUSliceAdmitter(GangScheduler):
     # ------------------------------------------------------------------
 
     def assign(self, pod) -> Optional[Placement]:
+        placement = self._assign(pod)
+        self._drain_spans()  # a poll that granted exports its span now
+        return placement
+
+    def _assign(self, pod) -> Optional[Placement]:
         chips = pod.spec.tpu_chips()
         gang_key = pod.metadata.annotations.get(ANNOTATION_GANG_NAME)
         if gang_key is None:
@@ -441,6 +457,7 @@ class TPUSliceAdmitter(GangScheduler):
                     changed = self._finish_drain(gang_key)
         for k in changed:
             self._remirror_podgroup_status(k)
+        self._drain_spans()
         # Gang reservations outlive individual pods (restarts keep the
         # slice); they free on delete_gang.
 
@@ -489,6 +506,7 @@ class TPUSliceAdmitter(GangScheduler):
             changed = self._finish_drain(gang_key)
         for k in changed:
             self._remirror_podgroup_status(k)
+        self._drain_spans()
 
     def slice_failed(self, slice_name: str) -> Optional[str]:
         """Executor/inventory report: a pool slice died mid-run. The dead
@@ -549,6 +567,7 @@ class TPUSliceAdmitter(GangScheduler):
             changed.extend(self._reserve_waiting())
         for k in changed:
             self._remirror_podgroup_status(k)
+        self._drain_spans()
         return gang_key
 
     def draining(self) -> Dict[str, List[str]]:
@@ -624,6 +643,7 @@ class TPUSliceAdmitter(GangScheduler):
             granted = self._reserve_waiting()
         for key in granted:
             self._remirror_podgroup_status(key)
+        self._drain_spans()
         return granted
 
     def gang_snapshots(self) -> List[GangSnapshot]:
@@ -849,9 +869,11 @@ class TPUSliceAdmitter(GangScheduler):
                     s.reserved_by = key
                 state.slice_names = [s.name for s in grow_chosen]
                 state.granted_at = time.monotonic()
+                self._record_admission(key, state)
             changed = [key] + self._reserve_waiting()
         for k in changed:
             self._remirror_podgroup_status(k)
+        self._drain_spans()
         return released
 
     def resize_gang(self, namespace: str, name: str, slice_type: str) -> bool:
@@ -872,6 +894,7 @@ class TPUSliceAdmitter(GangScheduler):
             changed = [key] + self._reserve_waiting()
         for k in changed:
             self._remirror_podgroup_status(k)
+        self._drain_spans()
         return True
 
     def _snapshot(self, key: str, state: _GangState) -> GangSnapshot:
@@ -1169,6 +1192,48 @@ class TPUSliceAdmitter(GangScheduler):
             s.reserved_by = key
         state.slice_names = [s.name for s in chosen]
         state.granted_at = time.monotonic()
+        self._record_admission(key, state)
+
+    def _record_admission(self, key: str, state: _GangState) -> None:
+        """Queue the just-ended wait as a gang.queue_wait span (runs
+        under the admitter lock: no I/O here, only an append — the file
+        write happens in _drain_spans outside the lock). cause=requeue
+        marks a post-eviction re-grant — the goodput accountant books
+        that wait as preemption downtime, a first admission as ordinary
+        queue wait."""
+        if self.tracer is None:
+            return
+        from kubedl_tpu.obs.trace import trace_id_for
+
+        namespace, _, name = key.partition("/")
+        waited = max(time.monotonic() - state.waiting_since, 0.0)
+        self._span_queue.append(("gang.queue_wait", dict(
+            duration_s=waited,
+            trace_id=trace_id_for(namespace, name),
+            job=name,
+            namespace=namespace,
+            cause="requeue" if state.preemptions > 0 else "initial",
+            shape=state.requested_slice,
+            slices=list(state.slice_names),
+            preemptions=state.preemptions,
+            tenant=state.tenant,
+        )))
+
+    def _drain_spans(self) -> None:
+        """Export queued admission spans OUTSIDE the admitter lock —
+        called from the public entry points whose passes can grant."""
+        tracer = self.tracer
+        if tracer is None:
+            return
+        with self._lock:
+            if not self._span_queue:
+                return
+            pending, self._span_queue = self._span_queue, []
+        for name, kwargs in pending:
+            try:
+                tracer.record(name, **kwargs)
+            except Exception:  # noqa: BLE001 — tracing never blocks grants
+                pass
 
     def _pick_slices(
         self,

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from kubedl_tpu.api.common import JobStatus, is_created, is_failed, is_running, is_succeeded
 from kubedl_tpu.api.pod import Pod
+from kubedl_tpu.metrics.prom import escape_label_value
 
 
 class JobMetrics:
@@ -161,5 +162,9 @@ class MetricsRegistry:
             lines.append(f"# TYPE {hname} histogram")
             for kind, jm in sorted(self._metrics.items()):
                 for name, delay in getattr(jm, attr):
-                    lines.append(f'{hname}{{kind="{kind}",name="{name}"}} {delay:.6f}')
+                    # job names come from user manifests — escape them
+                    # through the shared discipline (metrics/prom.py)
+                    lines.append(
+                        f'{hname}{{kind="{kind}",'
+                        f'name="{escape_label_value(name)}"}} {delay:.6f}')
         return "\n".join(lines) + "\n"

@@ -1,0 +1,40 @@
+"""Shared Prometheus text-exposition helpers.
+
+Label VALUES reach the exposition from user-controlled places — tenant
+annotations, job/pod names from manifests, slice names from node-pool
+labels — and one stray quote or newline invalidates the WHOLE scrape,
+blanking every series at once. The escaping discipline therefore lives
+here exactly once; every renderer (runtime, pipeline, reshard, goodput,
+job metrics) formats through these helpers instead of re-stating the
+three replace() calls per call site, where one drifted copy would break
+exposition silently.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def escape_label_value(value) -> str:
+    """Escape a Prometheus label VALUE per the text-format spec
+    (backslash first, or it would re-escape the other escapes)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def format_labels(labels: Optional[Dict[str, object]]) -> str:
+    """``{a="x",b="y"}`` with escaped values; "" for no labels."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in labels.items()
+    )
+    return "{" + inner + "}"
+
+
+def sample(name: str, value, labels: Optional[Dict[str, object]] = None) -> str:
+    """One exposition line: ``name{labels} value``."""
+    return f"{name}{format_labels(labels)} {value}"

@@ -13,19 +13,16 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from kubedl_tpu.metrics.prom import escape_label_value, sample
+
 BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 
-
-def _label(value: str) -> str:
-    """Escape a Prometheus label VALUE (tenant names come from a
-    user-controlled annotation — one stray quote must not invalidate the
-    whole exposition and blank every series for the scrape)."""
-    return (
-        str(value)
-        .replace("\\", "\\\\")
-        .replace('"', '\\"')
-        .replace("\n", "\\n")
-    )
+# tenant names come from a user-controlled annotation, slice/job names
+# from manifests and node-pool labels — one stray quote must not
+# invalidate the whole exposition. The discipline lives in metrics/prom.py
+# (shared with the job-metrics and goodput renderers); this alias keeps
+# the call sites short.
+_label = escape_label_value
 
 
 class _Histogram:
@@ -112,6 +109,11 @@ class RuntimeMetrics:
         self._capacity: Optional[Callable[[], Dict]] = None
         # pipeline-schedule snapshot callable (PipelineMetrics.snapshot)
         self._pipeline: Optional[Callable[[], Dict]] = None
+        # flight-recorder snapshots (obs/): per-job step telemetry /
+        # straggler detection (StepAggregator.snapshot) and goodput
+        # accounting over the span timeline (GoodputReporter.snapshot)
+        self._steps: Optional[Callable[[], Dict]] = None
+        self._goodput: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -146,6 +148,18 @@ class RuntimeMetrics:
         (per-job schedule, bubble fraction, per-stage step seconds)."""
         with self._lock:
             self._pipeline = snapshot_fn
+
+    def register_steps(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns StepAggregator.snapshot()-shaped dicts
+        (per-job per-pod step time, stragglers, compile events)."""
+        with self._lock:
+            self._steps = snapshot_fn
+
+    def register_goodput(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns GoodputReporter.snapshot()-shaped dicts
+        (per-job goodput ratio + bucket breakdown)."""
+        with self._lock:
+            self._goodput = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -334,6 +348,65 @@ class RuntimeMetrics:
                     lines.append(
                         f'kubedl_pipeline_steps_total{{job="{_label(job)}"}} '
                         f'{rec.get("steps", 0)}')
+        with self._lock:
+            steps_fn = self._steps
+        if steps_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                steps = steps_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                steps = None
+            if steps is not None and steps.get("jobs"):
+                jobs = sorted(steps["jobs"].items())
+                lines.append("# HELP kubedl_step_time_seconds Last train-"
+                             "step wall time per pod (heartbeat stream)")
+                lines.append("# TYPE kubedl_step_time_seconds gauge")
+                for job, rec in jobs:
+                    for pod, p in sorted((rec.get("pods") or {}).items()):
+                        lines.append(sample(
+                            "kubedl_step_time_seconds",
+                            f'{p.get("step_s", 0.0):.6f}',
+                            {"job": job, "pod": pod}))
+                lines.append("# HELP kubedl_straggler_pods Pods whose last "
+                             "step time exceeds k x the job median")
+                lines.append("# TYPE kubedl_straggler_pods gauge")
+                for job, rec in jobs:
+                    lines.append(sample(
+                        "kubedl_straggler_pods",
+                        len(rec.get("stragglers") or []), {"job": job}))
+                lines.append("# HELP kubedl_compile_events_total XLA "
+                             "compile events observed across the job's pods")
+                lines.append("# TYPE kubedl_compile_events_total counter")
+                for job, rec in jobs:
+                    lines.append(sample(
+                        "kubedl_compile_events_total",
+                        rec.get("compile_events", 0), {"job": job}))
+        with self._lock:
+            goodput_fn = self._goodput
+        if goodput_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                gp = goodput_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                gp = None
+            if gp is not None and gp.get("jobs"):
+                jobs = sorted(gp["jobs"].items())
+                lines.append("# HELP kubedl_goodput_ratio Productive step "
+                             "time / wall time over the job's span timeline")
+                lines.append("# TYPE kubedl_goodput_ratio gauge")
+                for job, rec in jobs:
+                    lines.append(sample(
+                        "kubedl_goodput_ratio",
+                        f'{rec.get("ratio", 0.0):.4f}', {"job": job}))
+                lines.append("# HELP kubedl_goodput_seconds Wall-time "
+                             "breakdown by goodput bucket")
+                lines.append("# TYPE kubedl_goodput_seconds gauge")
+                for job, rec in jobs:
+                    for bucket, secs in sorted(
+                            (rec.get("buckets") or {}).items()):
+                        lines.append(sample(
+                            "kubedl_goodput_seconds", f"{secs:.6f}",
+                            {"job": job, "bucket": bucket}))
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -357,11 +430,23 @@ class RuntimeMetrics:
             slice_fn = self._slice_pool
             cap_fn = self._capacity
             pipe_fn = self._pipeline
+            steps_fn = self._steps
+            goodput_fn = self._goodput
         if pipe_fn is not None:
             try:
                 out["pipeline"] = pipe_fn()  # outside the lock, see render()
             except Exception:  # noqa: BLE001 — callback raced shutdown
                 out["pipeline"] = None
+        if steps_fn is not None:
+            try:
+                out["steps"] = steps_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["steps"] = None
+        if goodput_fn is not None:
+            try:
+                out["goodput"] = goodput_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["goodput"] = None
         if slice_fn is not None:
             try:
                 out["slice_pool"] = slice_fn()  # outside the lock, see render()

@@ -1,0 +1,213 @@
+"""Goodput accounting — fold a job's span timeline into "where did the
+time go" buckets.
+
+Wall time is the window from the first span's start to the last span's
+end. Every instant inside the window is attributed to exactly ONE bucket
+(overlaps resolve by precedence — e.g. an async checkpoint save that
+overlaps a train step counts as checkpoint, not double-counted), so the
+breakdown sums to wall time exactly:
+
+  queue_wait    first gang admission wait (gang.queue_wait, cause=initial)
+  eviction      preemption downtime: requeue waits + drain after eviction
+  reshard       RESIZE ladder rungs (live / staged / fallback), both planes
+  checkpoint    Orbax save/restore stalls
+  init_compile  process bootstrap + first-step XLA compile
+  steps         productive train-step time — the goodput numerator
+  other         window time no span covers (process spawn, scheduler gaps)
+
+``kubedl_goodput_ratio{job}`` = steps / wall.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from kubedl_tpu.obs.trace import job_trace_dir, load_spans
+
+# attribution precedence, highest first: an instant covered by several
+# categories lands in the earliest listed one
+BUCKETS = ("queue_wait", "eviction", "reshard", "checkpoint",
+           "init_compile", "steps")
+OTHER = "other"
+
+
+def classify(span: Dict) -> Optional[str]:
+    """Map one span to its goodput bucket (None = uncategorized)."""
+    name = span.get("name", "")
+    attrs = span.get("attrs", {}) or {}
+    if name == "gang.queue_wait":
+        return "eviction" if attrs.get("cause") == "requeue" else "queue_wait"
+    if name.startswith("reshard.") or name == "sched.reshard":
+        return "reshard"
+    if name in ("ckpt.save", "ckpt.restore"):
+        return "checkpoint"
+    if name in ("trainer.init", "train.compile"):
+        return "init_compile"
+    if name in ("train.step", "pipeline.step"):
+        return "steps"
+    return None
+
+
+def goodput(spans: List[Dict]) -> Dict:
+    """Sweep-line attribution over categorized span intervals.
+
+    Returns ``{"wall_s", "ratio", "buckets": {...bucket: seconds...,
+    "other": seconds}, "trace_ids", "t0", "t1", "spans"}``; the bucket
+    values partition ``wall_s`` exactly.
+    """
+    empty = {
+        "wall_s": 0.0, "ratio": 0.0,
+        "buckets": {b: 0.0 for b in (*BUCKETS, OTHER)},
+        "trace_ids": [], "t0": 0.0, "t1": 0.0, "spans": 0,
+    }
+    if not spans:
+        return empty
+    # The wall window spans the CATEGORIZED timeline (queue wait through
+    # the last step/checkpoint/reshard), falling back to all spans only
+    # when nothing classifies. Uncategorized spans must not stretch it:
+    # the operator keeps appending reconcile spans to a Succeeded job's
+    # dir until its TTL, and a window that grew with them would make a
+    # finished job's goodput ratio decay depending on WHEN you scrape.
+    windowed = [s for s in spans if classify(s) is not None] or spans
+    t0 = min(float(s.get("ts", 0.0)) for s in windowed)
+    t1 = max(float(s.get("ts", 0.0)) + max(float(s.get("dur", 0.0)), 0.0)
+             for s in windowed)
+    wall = max(t1 - t0, 0.0)
+    if wall <= 0.0:
+        out = dict(empty)
+        out.update({"t0": t0, "t1": t1, "spans": len(spans),
+                    "trace_ids": sorted({s.get("trace_id", "")
+                                         for s in spans} - {""})})
+        return out
+    # boundary events: (time, +1/-1, bucket index)
+    events: List[tuple] = []
+    for s in spans:
+        bucket = classify(s)
+        dur = max(float(s.get("dur", 0.0)), 0.0)
+        if bucket is None or dur <= 0.0:
+            continue
+        start = max(float(s.get("ts", 0.0)), t0)
+        end = min(start + dur, t1)
+        if end <= start:
+            continue
+        idx = BUCKETS.index(bucket)
+        events.append((start, 1, idx))
+        events.append((end, -1, idx))
+    buckets = {b: 0.0 for b in BUCKETS}
+    buckets[OTHER] = 0.0
+    active = [0] * len(BUCKETS)
+    covered = 0.0
+    events.sort(key=lambda e: e[0])
+    prev = t0
+    i = 0
+    while i < len(events):
+        t = events[i][0]
+        if t > prev:
+            # attribute [prev, t) to the highest-precedence active bucket
+            for idx, n in enumerate(active):
+                if n > 0:
+                    buckets[BUCKETS[idx]] += t - prev
+                    covered += t - prev
+                    break
+            prev = t
+        while i < len(events) and events[i][0] == t:
+            _, delta, idx = events[i]
+            active[idx] += delta
+            i += 1
+    # tail after the last event (only when uncategorized spans extend t1)
+    if t1 > prev:
+        for idx, n in enumerate(active):
+            if n > 0:
+                buckets[BUCKETS[idx]] += t1 - prev
+                covered += t1 - prev
+                break
+        prev = t1
+    buckets[OTHER] = max(wall - covered, 0.0)
+    return {
+        "wall_s": wall,
+        "ratio": buckets["steps"] / wall,
+        "buckets": {b: round(v, 6) for b, v in buckets.items()},
+        "trace_ids": sorted({s.get("trace_id", "") for s in spans} - {""}),
+        "t0": t0,
+        "t1": t1,
+        "spans": len(spans),
+    }
+
+
+class GoodputReporter:
+    """Per-job goodput over a flight-recorder root, for the metrics
+    scrape (RuntimeMetrics.register_goodput) and ``/debug/vars``.
+
+    Each job dir is recomputed only when its span files changed (size
+    fingerprint) — a scrape over a quiet recorder costs a few stats.
+    ``snapshot()`` covers at most ``max_jobs`` dirs (most recently
+    modified first), so series cardinality and scrape cost stay bounded
+    on an operator that has run thousands of jobs; ``job()`` still reads
+    any dir directly (the /trace endpoint has no such cap)."""
+
+    def __init__(self, root: str, max_jobs: int = 200) -> None:
+        self.root = root
+        self.max_jobs = int(max_jobs)
+        self._lock = threading.Lock()
+        self._cache: Dict[str, tuple] = {}  # dir -> (fingerprint, result)
+
+    def _fingerprint(self, d: str) -> tuple:
+        total = 0
+        n = 0
+        try:
+            for entry in os.scandir(d):
+                if entry.name.endswith(".jsonl"):
+                    try:
+                        total += entry.stat().st_size
+                        n += 1
+                    except OSError:
+                        continue
+        except OSError:
+            return (0, 0)
+        return (n, total)
+
+    def job(self, namespace: str, name: str) -> Dict:
+        return self._for_dir(job_trace_dir(self.root, namespace, name))
+
+    def _for_dir(self, d: str) -> Dict:
+        fp = self._fingerprint(d)
+        with self._lock:
+            cached = self._cache.get(d)
+            if cached is not None and cached[0] == fp:
+                return cached[1]
+        result = goodput(load_spans(d))
+        with self._lock:
+            self._cache[d] = (fp, result)
+        return result
+
+    def snapshot(self) -> Dict:
+        """{"jobs": {"ns/name": goodput dict}} over the most recently
+        active ``max_jobs`` recorded jobs."""
+        out: Dict = {"jobs": {}}
+        try:
+            entries = [e for e in os.scandir(self.root) if e.is_dir()]
+        except OSError:
+            return out
+
+        def mtime(e):
+            try:
+                return e.stat().st_mtime
+            except OSError:
+                return 0.0
+
+        entries.sort(key=mtime, reverse=True)
+        stale = entries[self.max_jobs:]
+        entries = entries[:self.max_jobs]
+        if stale:
+            with self._lock:
+                for e in stale:  # keep the cache bounded too
+                    self._cache.pop(os.path.join(self.root, e.name), None)
+        for entry in sorted(entries, key=lambda e: e.name):
+            namespace, _, job = entry.name.partition("_")
+            if not job:
+                continue
+            gp = self._for_dir(os.path.join(self.root, entry.name))
+            if gp["spans"]:
+                out["jobs"][f"{namespace}/{job}"] = gp
+        return out

@@ -1,0 +1,259 @@
+"""Per-step telemetry stream + cross-pod straggler aggregation.
+
+Compute-plane side (StepStream): the trainer emits one bounded record per
+train step — step time, data wait, compile events, checkpoint stall, loss
+— to its pod's ``<KUBEDL_TRACE_DIR>/<pod>.steps.jsonl`` AND, as a
+latest-value heartbeat, to ``<KUBEDL_CONTROL_DIR>/heartbeat.json``
+(atomic tmp+rename, the reshard control channel's write discipline).
+
+Control-plane side (StepAggregator): the operator scans the executor's
+control dirs for heartbeats on each metrics scrape (pull model — no extra
+loop to race) and folds them into per-job step-time series and straggler
+detection: a pod whose last step time exceeds ``k``x the job median is
+flagged. Rendered as ``kubedl_step_time_seconds`` /
+``kubedl_straggler_pods`` / ``kubedl_compile_events_total``
+(metrics/runtime_metrics.py) and under ``steps`` in ``/debug/vars``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from kubedl_tpu.obs.trace import ENV_TRACE_DIR, STEP_SUFFIX
+
+HEARTBEAT_FILE = "heartbeat.json"
+
+
+class StepStream:
+    """Bounded per-pod step-record stream + heartbeat writer."""
+
+    def __init__(
+        self,
+        jsonl_path: Optional[str] = None,
+        heartbeat_path: Optional[str] = None,
+        job: str = "",
+        namespace: str = "",
+        pod: str = "",
+        max_records: int = 100_000,
+    ) -> None:
+        self.jsonl_path = jsonl_path
+        self.heartbeat_path = heartbeat_path
+        self.job = job
+        self.namespace = namespace
+        self.pod = pod
+        self.max_records = max_records
+        self.written = 0
+        self.dropped = 0
+        self.compiles = 0  # cumulative compile events this incarnation
+        self._fh = None
+
+    @classmethod
+    def from_env(cls, pod: str = "") -> Optional["StepStream"]:
+        """Build from the operator-injected env; None when neither a
+        trace dir nor a control dir was injected (nothing to write to)."""
+        trace_dir = os.environ.get(ENV_TRACE_DIR, "")
+        control_dir = os.environ.get("KUBEDL_CONTROL_DIR", "")
+        if not trace_dir and not control_dir:
+            return None
+        pod = pod or os.environ.get("POD_NAME", "") or f"pid-{os.getpid()}"
+        jsonl = None
+        if trace_dir:
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                jsonl = os.path.join(trace_dir, f"{pod}{STEP_SUFFIX}")
+            except OSError:
+                jsonl = None
+        heartbeat = (os.path.join(control_dir, HEARTBEAT_FILE)
+                     if control_dir else None)
+        return cls(
+            jsonl_path=jsonl,
+            heartbeat_path=heartbeat,
+            job=os.environ.get("KUBEDL_LABEL_JOB_NAME", ""),
+            namespace=os.environ.get("POD_NAMESPACE", ""),
+            pod=pod,
+        )
+
+    def record(
+        self,
+        step: int,
+        step_s: float,
+        data_s: float = 0.0,
+        loss: Optional[float] = None,
+        compile: bool = False,
+        ckpt_s: float = 0.0,
+    ) -> Dict:
+        if compile:
+            self.compiles += 1
+        rec = {
+            "job": self.job,
+            "namespace": self.namespace,
+            "pod": self.pod,
+            "step": int(step),
+            "step_s": round(float(step_s), 6),
+            "data_s": round(float(data_s), 6),
+            "ckpt_s": round(float(ckpt_s), 6),
+            "compile": bool(compile),
+            "compiles": self.compiles,
+            "t": time.time(),
+        }
+        if loss is not None:
+            rec["loss"] = float(loss)
+        line = json.dumps(rec)
+        if self.jsonl_path:
+            if self.written >= self.max_records:
+                self.dropped += 1
+            else:
+                try:
+                    if self._fh is None:
+                        self._fh = open(self.jsonl_path, "a")
+                    self._fh.write(line + "\n")
+                    self._fh.flush()
+                    self.written += 1
+                except OSError:
+                    self.dropped += 1
+        if self.heartbeat_path:
+            # latest-value heartbeat: atomic replace so the operator's
+            # scan never parses a half-written record
+            tmp = self.heartbeat_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    f.write(line)
+                os.replace(tmp, self.heartbeat_path)
+            except OSError:
+                pass
+        return rec
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+def load_step_records(jsonl_path: str) -> List[Dict]:
+    """Read one pod's step stream back (unparseable tail lines skipped)."""
+    out: List[Dict] = []
+    try:
+        with open(jsonl_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "step" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+class StepAggregator:
+    """Cross-pod step aggregation + straggler detection.
+
+    ``scan_fn`` (e.g. LocalPodExecutor.read_heartbeats) supplies the live
+    heartbeat records on each snapshot; ``observe`` feeds records
+    directly (tests, in-process lanes). A pod is a straggler when its
+    last step time exceeds ``k`` x the median of its PEERS' step times
+    (leave-one-out: including the candidate in the median would make a
+    2-pod gang's straggler mathematically undetectable for k >= 2), with
+    at least ``min_pods`` reporting pods (a lone pod has no peer
+    baseline to straggle against).
+    """
+
+    def __init__(
+        self,
+        scan_fn: Optional[Callable[[], List[Dict]]] = None,
+        k: float = 2.0,
+        min_pods: int = 2,
+        max_age_s: float = 3600.0,
+    ) -> None:
+        self.scan_fn = scan_fn
+        self.k = float(k)
+        self.min_pods = int(min_pods)
+        # records older than this fall off the snapshot: deleted jobs'
+        # heartbeats (their control dirs are rmtree'd with the pod) must
+        # not export stale series forever. 0 disables pruning.
+        self.max_age_s = float(max_age_s)
+        self._lock = threading.Lock()
+        # job key -> pod -> latest record
+        self._jobs: Dict[str, Dict[str, Dict]] = {}
+
+    @staticmethod
+    def _job_key(rec: Dict) -> str:
+        return f"{rec.get('namespace') or 'default'}/{rec.get('job') or '?'}"
+
+    def observe(self, rec: Dict) -> None:
+        if not isinstance(rec, dict) or "step_s" not in rec:
+            return
+        pod = str(rec.get("pod") or "?")
+        with self._lock:
+            pods = self._jobs.setdefault(self._job_key(rec), {})
+            prev = pods.get(pod)
+            # heartbeats are latest-value; never regress to an older one
+            if prev is None or rec.get("t", 0.0) >= prev.get("t", 0.0):
+                pods[pod] = dict(rec)
+
+    def snapshot(self) -> Dict:
+        if self.scan_fn is not None:
+            try:
+                for rec in self.scan_fn() or []:
+                    self.observe(rec)
+            except Exception:  # noqa: BLE001 — scan racing shutdown
+                pass
+        out: Dict = {"jobs": {}, "k": self.k, "min_pods": self.min_pods}
+        with self._lock:
+            if self.max_age_s > 0:
+                # prune in place: the cardinality of /metrics and this
+                # cache must not grow with every job ever run
+                cutoff = time.time() - self.max_age_s
+                for j in list(self._jobs):
+                    pods = self._jobs[j]
+                    for pod in [p for p, r in pods.items()
+                                if r.get("t", 0.0) < cutoff]:
+                        del pods[pod]
+                    if not pods:
+                        del self._jobs[j]
+            jobs = {j: dict(pods) for j, pods in self._jobs.items()}
+        for job, pods in sorted(jobs.items()):
+            times = [p["step_s"] for p in pods.values()]
+            median = statistics.median(times) if times else 0.0
+            stragglers = []
+            if len(pods) >= self.min_pods:
+                for name, p in sorted(pods.items()):
+                    peers = [t for n, t in
+                             ((n2, p2["step_s"]) for n2, p2 in pods.items())
+                             if n != name]
+                    baseline = statistics.median(peers) if peers else 0.0
+                    if baseline > 0 and p["step_s"] > self.k * baseline:
+                        stragglers.append(name)
+            out["jobs"][job] = {
+                "pods": {
+                    name: {
+                        "step": p.get("step", 0),
+                        "step_s": p.get("step_s", 0.0),
+                        "data_s": p.get("data_s", 0.0),
+                        "compiles": p.get("compiles", 0),
+                        "loss": p.get("loss"),
+                        "age_s": round(max(time.time() - p.get("t", 0.0), 0.0), 3),
+                    }
+                    for name, p in sorted(pods.items())
+                },
+                "median_step_s": median,
+                "stragglers": stragglers,
+                "compile_events": sum(p.get("compiles", 0) for p in pods.values()),
+            }
+        return out
+
+    def forget(self, namespace: str, job: str) -> None:
+        with self._lock:
+            self._jobs.pop(f"{namespace}/{job}", None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._jobs.clear()

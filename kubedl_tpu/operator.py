@@ -63,6 +63,10 @@ class OperatorConfig:
     enable_elastic: bool = True
     elastic_shrink_delay: float = 0.5
     elastic_grow_delay: float = 2.0
+    # flight recorder root (docs/observability.md): per-job trace dirs
+    # land under it ("" = a fresh temp dir). Control-plane spans and the
+    # executor-injected KUBEDL_TRACE_DIR both resolve against this root.
+    trace_dir: str = ""
     # workload gate expression, ref pkg/util/workloadgate: "*", "tf,pytorch", "*,-xdl"
     workloads: str = "*"
     cluster_domain: str = ""
@@ -117,12 +121,29 @@ class Operator:
         from kubedl_tpu.metrics.runtime_metrics import pipeline_metrics
 
         self.runtime_metrics.register_pipeline(pipeline_metrics.snapshot)
+        # flight recorder (docs/observability.md): control-plane tracer
+        # routing spans into per-job dirs under trace_root, plus the
+        # goodput accountant that folds those dirs into
+        # kubedl_goodput_ratio on each scrape
+        import tempfile
+
+        from kubedl_tpu.obs import GoodputReporter, Tracer
+
+        self.trace_root = self.config.trace_dir or tempfile.mkdtemp(
+            prefix="kubedl-trace-")
+        self.tracer = Tracer(service="operator", export_root=self.trace_root)
+        self.goodput = GoodputReporter(self.trace_root)
+        self.runtime_metrics.register_goodput(self.goodput.snapshot)
+        self.step_aggregator = None  # set with the executor below
         self.manager = Manager(self.store, runtime_metrics=self.runtime_metrics)
         self.recorder = EventRecorder(self.store)
         self.metrics_registry = MetricsRegistry()
         self.gang_registry = GangRegistry()
         self.gang_registry.register(TPUSliceAdmitter.with_pool(self.store, self.config.tpu_slices))
         self._gang = self.gang_registry.get(self.config.gang_scheduler_name)
+        if isinstance(self._gang, TPUSliceAdmitter):
+            # admission grants retro-record the gang's queue wait as spans
+            self._gang.tracer = self.tracer
         if self.config.tpu_slices and isinstance(self._gang, TPUSliceAdmitter):
             # BASELINE.md "slice utilization" gauge: /metrics + /debug/vars
             self.runtime_metrics.register_slice_pool(self._gang.utilization)
@@ -145,6 +166,7 @@ class Operator:
                     grow_delay=self.config.elastic_grow_delay,
                 ),
             )
+            self.capacity_scheduler.tracer = self.tracer
             self.runtime_metrics.register_capacity(self.capacity_scheduler.snapshot)
             self.manager.add_loop(
                 "capacity-scheduler",
@@ -154,7 +176,16 @@ class Operator:
         self.executor: Optional[LocalPodExecutor] = None
         if self.config.run_executor:
             scheduler = self._gang if self.config.tpu_slices else None
-            self.executor = LocalPodExecutor(self.store, scheduler=scheduler)
+            self.executor = LocalPodExecutor(
+                self.store, scheduler=scheduler, trace_root=self.trace_root)
+            # per-step telemetry: pods heartbeat into their control dirs;
+            # the aggregator scans them on each metrics scrape (straggler
+            # detection + kubedl_step_time_seconds)
+            from kubedl_tpu.obs import StepAggregator
+
+            self.step_aggregator = StepAggregator(
+                scan_fn=self.executor.read_heartbeats)
+            self.runtime_metrics.register_steps(self.step_aggregator.snapshot)
         if self.capacity_scheduler is not None and self.executor is not None:
             # live-reshard control channel: the scheduler posts RESIZE
             # messages into running pods through the executor (kube mode
@@ -198,6 +229,7 @@ class Operator:
             ),
         )
         controller.engine = engine
+        engine.tracer = self.tracer  # reconcile spans on the job timeline
         runner = self.manager.add_controller(
             controller.controller_name, engine.reconcile, workers=self.config.max_reconciles
         )
@@ -349,6 +381,7 @@ class Operator:
             self.elector.release()
         if self.executor is not None:
             self.executor.stop()
+        self.tracer.close()
         if self.object_backend is not None:
             self.object_backend.close()
         if self.event_backend is not None and self.event_backend is not self.object_backend:

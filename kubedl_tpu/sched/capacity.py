@@ -121,6 +121,10 @@ class CapacityScheduler(CapacityDirector):
         self._downtime_sum = 0.0
         self._downtime_n = 0
         self._downtime_last = 0.0
+        # flight recorder (obs/trace.py Tracer), wired by the operator:
+        # preemptions and RESIZE-ladder outcomes become spans on the
+        # victim/target gang's timeline
+        self.tracer = None
         if hasattr(admitter, "drain_timeout"):
             admitter.drain_timeout = self.config.drain_timeout
         admitter.set_director(self)
@@ -302,6 +306,17 @@ class CapacityScheduler(CapacityDirector):
                         break
                 else:
                     self._downtime_counts[-1] += 1
+        # the ladder rung as a span: issue -> resolution, outcome attr
+        # (the trainer's reshard.live/staged/fallback spans are the
+        # compute-plane half of the same story)
+        self._record_span(
+            p.gang_key, "sched.reshard",
+            duration_s=max(time.monotonic() - p.issued_at, 0.0),
+            direction=p.direction, outcome=outcome,
+            **({"downtime_s": round(downtime, 4)} if downtime is not None
+               else {}),
+            **({"reason": str(reason)[:200]} if reason else {}),
+        )
         namespace, _, name = p.gang_key.partition("/")
         if outcome == "ok":
             log.info("live reshard (%s) of gang %s complete: downtime %.3fs",
@@ -374,7 +389,28 @@ class CapacityScheduler(CapacityDirector):
             # reachable: that IS a reshard fallback for the metric
             with self._lock:
                 self._reshards_total["fallback"] += 1
+            self._record_span(
+                gang_key, "sched.reshard", direction="dead-slice",
+                outcome="fallback", reason=f"slice {slice_name} died with "
+                                           f"no attainable fallback shape")
         self._delete_gang_pods(g)
+
+    def _record_span(self, gang_key: str, name: str,
+                     duration_s: float = 0.0, **attrs) -> None:
+        """Record one flight-recorder span on a gang's timeline (no-op
+        without a tracer; recording must never block scheduling)."""
+        if self.tracer is None:
+            return
+        from kubedl_tpu.obs.trace import trace_id_for
+
+        namespace, _, job = gang_key.partition("/")
+        try:
+            self.tracer.record(
+                name, duration_s=duration_s,
+                trace_id=trace_id_for(namespace, job),
+                job=job, namespace=namespace, **attrs)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _usage(self, snaps: Optional[List[GangSnapshot]] = None):
         """(tenant -> reserved chips, total pool chips). Pass `snaps`
@@ -458,6 +494,10 @@ class CapacityScheduler(CapacityDirector):
         with self._lock:
             self._preemptions_total += 1
         self.quotas.note_preemption(victim.tenant)
+        self._record_span(
+            victim.key, "sched.preempt",
+            demander=demander.key, slices=list(released),
+            hold_s=round(hold, 3), tenant=victim.tenant)
         log.info(
             "preempted gang %s (tenant=%s prio=%d, slices %s) for %s "
             "(tenant=%s prio=%d); requeued with %.1fs backoff",

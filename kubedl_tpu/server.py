@@ -11,6 +11,7 @@ object CRUD. Standalone, this server provides both:
   POST /apis/<kind>                 apply a manifest (create-or-update)
   DELETE /apis/<kind>/<ns>/<name>   delete a job
   GET  /events/<ns>                 recent events in a namespace
+  GET  /trace/<ns>/<job>            flight-recorder span timeline + goodput
   GET  /serving/fleet               serving-fleet pods by role (JSON)
   POST /serving/drain/<ns>/<pod>    annotate a serving pod for drain
 
@@ -153,6 +154,34 @@ class OperatorHTTPServer:
                 elif len(parts) == 2 and parts[0] == "events":
                     evs = op.store.list("Event", namespace=parts[1])
                     self._json(200, {"items": [to_dict(e) for e in evs]})
+                elif len(parts) == 3 and parts[0] == "trace":
+                    # flight recorder (docs/observability.md): the merged
+                    # cross-plane span timeline of one job + its goodput
+                    # breakdown, computed from the SAME spans — what
+                    # `kubedl-tpu trace <job>` renders
+                    from kubedl_tpu.obs import (
+                        goodput as compute_goodput,
+                        job_trace_dir,
+                        load_spans,
+                        trace_id_for,
+                    )
+
+                    root = getattr(op, "trace_root", "")
+                    d = (job_trace_dir(root, parts[1], parts[2])
+                         if root else "")
+                    if not d or not os.path.isdir(d):
+                        self._json(404, {
+                            "error": f"no trace recorded for "
+                                     f"{parts[1]}/{parts[2]}"})
+                        return
+                    spans = load_spans(d)
+                    self._json(200, {
+                        "namespace": parts[1],
+                        "job": parts[2],
+                        "trace_id": trace_id_for(parts[1], parts[2]),
+                        "spans": spans,
+                        "goodput": compute_goodput(spans),
+                    })
                 elif split.path == "/serving/fleet":
                     # the serving-fleet view the router and operators
                     # watch: every pod carrying a serving role label,

@@ -48,6 +48,13 @@ def parse_args(argv=None):
                    default=os.environ.get("KUBEDL_CHECKPOINT_PATH", ""))
     p.add_argument("--checkpoint-interval", type=int,
                    default=int(os.environ.get("KUBEDL_CHECKPOINT_INTERVAL", 0)))
+    # JAX profiler window, same contract as the SPMD trainer
+    # (train/profile_window.py): N steps after the compile step, stopped
+    # cleanly on preemption too
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("KUBEDL_PROFILE_DIR", ""))
+    p.add_argument("--profile-steps", type=int,
+                   default=int(os.environ.get("KUBEDL_PROFILE_STEPS", 5)))
     return p.parse_args(argv)
 
 
@@ -70,6 +77,9 @@ def _common_restore_step(ckpt_path: str, n_stages: int):
 
 
 def main(argv=None) -> int:
+    import time
+
+    t_main0 = time.perf_counter()
     args = parse_args(argv)
     if args.data_path:
         print("pipeline_trainer supports synthetic data only for now "
@@ -92,6 +102,14 @@ def main(argv=None) -> int:
     config = llama.LlamaConfig.config_for(args.model)
     stage = int(os.environ.get("KUBEDL_PP_STAGE", "0"))
     n_stages = int(os.environ.get("KUBEDL_PP_STAGES", "1"))
+
+    # flight recorder (docs/observability.md): per-stage step spans +
+    # telemetry stream, correlated by the injected gang trace id — the
+    # MPMD plane's pods share the job's KUBEDL_TRACE_DIR
+    from kubedl_tpu.obs import StepStream, tracer_from_env
+
+    tracer = tracer_from_env()
+    step_stream = StepStream.from_env()
     tx = optax.adamw(args.lr, weight_decay=0.01)
     try:
         rt = pipeline_runtime.runtime_from_env(
@@ -125,42 +143,81 @@ def main(argv=None) -> int:
         restore = _common_restore_step(args.checkpoint_path, n_stages)
         if restore is not None and os.environ.get(
                 "KUBEDL_CHECKPOINT_RESTORE", "1") == "1":
+            t_restore0 = time.perf_counter()
             target = {"params": rt.params, "opt_state": rt.opt_state}
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
             restored = mngr.restore(
                 restore, args=ocp.args.StandardRestore(abstract))
             rt.params, rt.opt_state = restored["params"], restored["opt_state"]
             start_step = restore
+            tracer.record("ckpt.restore",
+                          duration_s=time.perf_counter() - t_restore0,
+                          step=restore, stage=stage)
             own = mngr.latest_step()
             note = f" (own latest {own})" if own != restore else ""
             print(f"stage {stage}: restored gang-common checkpoint at "
                   f"step {restore}{note}", flush=True)
+
+    ckpt_stall = {"v": 0.0}
 
     def save(step, final=False):
         if mngr is None:
             return
         import orbax.checkpoint as ocp
 
+        t_save0 = time.perf_counter()
         mngr.save(step, args=ocp.args.StandardSave(
             {"params": rt.params, "opt_state": rt.opt_state}))
         if final:
             mngr.wait_until_finished()
             print(f"stage {stage}: saved final checkpoint at step {step}",
                   flush=True)
+        stall = time.perf_counter() - t_save0
+        ckpt_stall["v"] += stall
+        tracer.record("ckpt.save", duration_s=stall, step=step, stage=stage,
+                      final=final)
 
     preempted = {"flag": False}
     signal.signal(signal.SIGTERM, lambda *_: preempted.update(flag=True))
+
+    # the SPMD trainer's profiler window, previously missing here
+    # entirely: N steps after the compile step, stopped idempotently on
+    # the preemption path and the finally backstop
+    from kubedl_tpu.train.profile_window import window_from_args
+
+    prof = window_from_args(args, start_step)
+
+    tracer.record("trainer.init",
+                  duration_s=time.perf_counter() - t_main0,
+                  step=start_step, stage=stage, model=args.model)
 
     rng = np.random.default_rng(1234)  # same stream on BOTH endpoints
     step = start_step
     try:
         for step in range(start_step, args.steps):
+            if prof is not None:
+                prof.maybe_start(step)
             tokens = None
             if endpoint:
                 tokens = rng.integers(
                     0, config.vocab_size,
                     (args.batch, args.seq_len), dtype=np.int32)
             out = rt.run_step(tokens)
+            if tracer.exporting or step_stream is not None:
+                tracer.record(
+                    "train.compile" if step == start_step else "pipeline.step",
+                    duration_s=out["step_s"], step=step + 1, stage=stage,
+                    wait_s=round(out["wait_s"], 6),
+                    **({"loss": out["loss"]} if out["loss"] is not None
+                       else {}))
+                if step_stream is not None:
+                    step_stream.record(
+                        step + 1, out["step_s"], data_s=out["wait_s"],
+                        loss=out["loss"], compile=step == start_step,
+                        ckpt_s=ckpt_stall["v"])
+                    ckpt_stall["v"] = 0.0
+            if prof is not None and prof.should_stop(step):
+                prof.stop()
             if out["loss"] is not None and (
                     step % args.log_every == 0 or step == args.steps - 1):
                 print(f"step {step}: loss={out['loss']:.4f} "
@@ -170,13 +227,24 @@ def main(argv=None) -> int:
                     and (step + 1) % args.checkpoint_interval == 0):
                 save(step + 1)
             if preempted["flag"]:
+                if prof is not None:
+                    prof.stop()
                 save(step + 1, final=True)
+                tracer.record("trainer.preempted", step=step + 1, stage=stage)
                 print(f"stage {stage}: preempted at step {step + 1}; "
                       f"exiting retryable", flush=True)
                 return EXIT_TPU_PREEMPTED
     finally:
+        # SIGTERM/raise DURING the traced window must still stop the
+        # profiler (idempotent: the paths above may have stopped already)
+        if prof is not None:
+            prof.stop()
         rt.close()
     save(args.steps, final=True)
+    tracer.record("trainer.done", step=args.steps, stage=stage)
+    if step_stream is not None:
+        step_stream.close()
+    tracer.close()
     print(f"stage {stage}: done at step {args.steps}", flush=True)
     return 0
 

@@ -106,12 +106,23 @@ def parse_args(argv=None):
 
 
 def main(argv=None) -> int:
+    t_main0 = time.perf_counter()
     args = parse_args(argv)
 
     from kubedl_tpu.train import coordinator
     from kubedl_tpu.utils.exit_codes import EXIT_TPU_PREEMPTED, EXIT_XLA_COMPILE_ERROR
 
     info = coordinator.initialize()
+
+    # flight recorder (docs/observability.md): spans to the pod's JSONL in
+    # the injected KUBEDL_TRACE_DIR + a bounded per-step telemetry stream
+    # with a control-dir heartbeat the operator aggregates for straggler
+    # detection. Without the env both stay inert (ring-only / None) and
+    # the step loop keeps its plain async-dispatch behavior.
+    from kubedl_tpu.obs import StepStream, tracer_from_env
+
+    tracer = tracer_from_env()
+    step_stream = StepStream.from_env()
 
     import jax
     import jax.numpy as jnp
@@ -414,9 +425,13 @@ def main(argv=None) -> int:
             # as the abstract target, so each leaf comes back with its
             # param_specs sharding instead of landing replicated on one
             # device (mandatory for models that only fit sharded).
+            t_restore0 = time.perf_counter()
             abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state)
             state = mngr.restore(latest, args=ocp.args.StandardRestore(abstract))
             start_step = int(state.step)
+            tracer.record("ckpt.restore",
+                          duration_s=time.perf_counter() - t_restore0,
+                          step=start_step)
             print(f"restored checkpoint at step {start_step}", flush=True)
 
     # interval saves are ASYNC: orbax's save() blocks only for the
@@ -426,11 +441,17 @@ def main(argv=None) -> int:
     # durability. last-saved is tracked here, not via latest_step(),
     # which lags while a save is in flight.
     saved_step = {"v": mngr.latest_step() if mngr else None}
+    # checkpoint stall the step loop actually felt since the last step
+    # record (the async save's device->host copy + any final wait);
+    # folded into the next heartbeat's ckpt_s
+    ckpt_stall = {"v": 0.0}
 
     def save(step, final=False):
         if mngr is None:
             return
-        if saved_step["v"] != step:  # else: interval hook already saved it
+        t_save0 = time.perf_counter()
+        did_save = saved_step["v"] != step
+        if did_save:  # else: interval hook already saved it
             import orbax.checkpoint as ocp
 
             mngr.save(step, args=ocp.args.StandardSave(state))
@@ -438,6 +459,11 @@ def main(argv=None) -> int:
         if final:
             mngr.wait_until_finished()
             print(f"saved final checkpoint at step {step}", flush=True)
+        if did_save or final:
+            stall = time.perf_counter() - t_save0
+            ckpt_stall["v"] += stall
+            tracer.record("ckpt.save", duration_s=stall, step=step,
+                          final=final)
 
     # -- live resize protocol (train/reshard_runtime.py ladder) ----------
 
@@ -453,6 +479,8 @@ def main(argv=None) -> int:
             save(at_step, final=True)
         except Exception:  # noqa: BLE001 — last interval save still holds
             pass
+        tracer.record("reshard.fallback", step=at_step,
+                      reason=str(reason)[:200])
         if ctl is not None:
             ctl.reply(msg, outcome="fallback", step=at_step,
                       error=str(reason)[:300])
@@ -466,6 +494,7 @@ def main(argv=None) -> int:
         and restarts onto the new topology (reassembly at startup). The
         manifest publishes only when every pod staged with a matching
         plan digest; any gap falls back closed."""
+        t_stage0 = time.perf_counter()
         try:
             if not reshard_dir:
                 raise reshard_runtime.ReshardError("no KUBEDL_RESHARD_DIR")
@@ -490,6 +519,9 @@ def main(argv=None) -> int:
                 raise reshard_runtime.ReshardError("manifest aborted")
         except Exception as e:  # noqa: BLE001 — fallback closed
             _resize_fallback(msg, at_step, f"staged lane: {e}")
+        tracer.record("reshard.staged",
+                      duration_s=time.perf_counter() - t_stage0,
+                      step=at_step, chips=new_chips)
         ctl.reply(msg, outcome="staged", step=at_step)
         print(f"staged reshard at step {at_step}: restarting onto the new "
               f"topology", flush=True)
@@ -527,6 +559,9 @@ def main(argv=None) -> int:
         # deferred past it would blow reshard_reply_timeout and turn every
         # successful reshard into a spurious pod teardown.
         downtime = time.perf_counter() - t0
+        tracer.record("reshard.live", duration_s=downtime, step=at_step,
+                      chips=new_chips, outcome="ok",
+                      moved_mb=round(plan.moved_bytes / 2**20, 3))
         ctl.reply(msg, outcome="ok", step=at_step,
                   downtime_s=round(downtime, 4), chips=new_chips,
                   moved_mb=round(plan.moved_bytes / 2**20, 3))
@@ -631,71 +666,116 @@ def main(argv=None) -> int:
         print(f"eval step {step}: loss={ev:.4f} "
               f"({args.eval_batches} {tag} batches)", flush=True)
 
-    # profiler window: [start+1, start+1+profile_steps) — skips the compile step
-    prof_start = start_step + 1 if args.profile_dir else -1
-    prof_stop = prof_start + args.profile_steps
-    tracing = False
+    # profiler window: [start+1, start+1+profile_steps) — skips the
+    # compile step. Shared with the MPMD stage trainer
+    # (train/profile_window.py): stop() is idempotent and runs from the
+    # preemption path AND the finally backstop, so SIGTERM (or a raise)
+    # DURING the traced window still lands the trace on disk.
+    from kubedl_tpu.train.profile_window import window_from_args
 
-    def stop_trace():
-        nonlocal tracing
-        if tracing:
-            jax.profiler.stop_trace()
-            print(f"profile written to {args.profile_dir}", flush=True)
-            tracing = False
+    prof = window_from_args(args, start_step)
+
+    # flight-recorder step loop: with the injected trace env the loss is
+    # synced EVERY step so step/data-wait times are wall-true — the
+    # documented overhead of the recorder. KUBEDL_TRACE_STEP_SYNC=0 keeps
+    # the async-dispatch loop on real accelerators: steps still record,
+    # but durations are DISPATCH times (synced=False attr) and the loss
+    # only materializes at log boundaries.
+    recording = tracer.exporting or step_stream is not None
+    sync_steps = os.environ.get("KUBEDL_TRACE_STEP_SYNC", "1") == "1"
+    compile_pending = {"v": True}  # first step after (re)build compiles
+
+    tracer.record("trainer.init",
+                  duration_s=time.perf_counter() - t_main0,
+                  step=start_step, model=model_name,
+                  devices=len(jax.devices()))
 
     t_start = time.perf_counter()
     last_log = t_start
-    for step in range(start_step, args.steps):
-        if step == prof_start:
-            jax.profiler.start_trace(args.profile_dir)
-            tracing = True
-        batch = next_batch(step)
-        state, metrics = train_step(state, batch)
-        if tracing and step + 1 >= prof_stop:
-            jax.block_until_ready(metrics["loss"])
-            stop_trace()
-        if preempted["flag"]:
-            jax.block_until_ready(metrics["loss"])
-            stop_trace()
-            save(step + 1, final=True)
-            print("preempted: checkpoint saved, exiting retryable", flush=True)
-            # A clean interpreter exit would block in jax.distributed's
-            # shutdown barrier (atexit) while peers are still mid-collective
-            # — the exact deadlock slice restart exists to break. The
-            # checkpoint is durable; exit immediately.
-            sys.stdout.flush()
-            sys.stderr.flush()
-            os._exit(EXIT_TPU_PREEMPTED)
-        if ctl is not None:
-            cmsg = ctl.poll()
-            if cmsg is not None:
-                if cmsg.get("type") == "RESIZE":
-                    handle_resize(cmsg, step + 1)
-                else:
-                    ctl.reply(cmsg, outcome="failed",
-                              error=f"unknown control message "
-                                    f"{cmsg.get('type')!r}")
-        if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
-            jax.block_until_ready(metrics["loss"])
-            save(step + 1)
-        if args.eval_every and (step + 1) % args.eval_every == 0:
-            eval_pass(step + 1)
-        if (step + 1) % args.log_every == 0:
-            loss_v = float(metrics["loss"])
-            now = time.perf_counter()
-            sps = args.log_every / (now - last_log)
-            last_log = now
-            print(f"step {step + 1}: loss={loss_v:.4f} "
-                  f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
+    try:
+        for step in range(start_step, args.steps):
+            if prof is not None:
+                prof.maybe_start(step)
+            t_step0 = time.perf_counter()
+            batch = next_batch(step)
+            data_s = time.perf_counter() - t_step0
+            state, metrics = train_step(state, batch)
+            loss_v = None
+            if recording:
+                if sync_steps:
+                    loss_v = float(metrics["loss"])  # sync: true step time
+                step_s = time.perf_counter() - t_step0
+                was_compile = compile_pending["v"]
+                compile_pending["v"] = False
+                tracer.record(
+                    "train.compile" if was_compile else "train.step",
+                    duration_s=step_s, step=step + 1,
+                    data_wait_s=round(data_s, 6),
+                    **({"loss": loss_v} if loss_v is not None
+                       else {"synced": False}))
+                if step_stream is not None:
+                    step_stream.record(
+                        step + 1, step_s, data_s=data_s, loss=loss_v,
+                        compile=was_compile, ckpt_s=ckpt_stall["v"])
+                    ckpt_stall["v"] = 0.0
+            if prof is not None and prof.should_stop(step):
+                jax.block_until_ready(metrics["loss"])
+                prof.stop()
+            if preempted["flag"]:
+                jax.block_until_ready(metrics["loss"])
+                if prof is not None:
+                    prof.stop()
+                save(step + 1, final=True)
+                tracer.record("trainer.preempted", step=step + 1)
+                print("preempted: checkpoint saved, exiting retryable", flush=True)
+                # A clean interpreter exit would block in jax.distributed's
+                # shutdown barrier (atexit) while peers are still mid-collective
+                # — the exact deadlock slice restart exists to break. The
+                # checkpoint is durable; exit immediately.
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(EXIT_TPU_PREEMPTED)
+            if ctl is not None:
+                cmsg = ctl.poll()
+                if cmsg is not None:
+                    if cmsg.get("type") == "RESIZE":
+                        handle_resize(cmsg, step + 1)
+                        # the rebuilt step compiles on the next dispatch
+                        compile_pending["v"] = True
+                    else:
+                        ctl.reply(cmsg, outcome="failed",
+                                  error=f"unknown control message "
+                                        f"{cmsg.get('type')!r}")
+            if args.checkpoint_interval and (step + 1) % args.checkpoint_interval == 0:
+                jax.block_until_ready(metrics["loss"])
+                save(step + 1)
+            if args.eval_every and (step + 1) % args.eval_every == 0:
+                eval_pass(step + 1)
+            if (step + 1) % args.log_every == 0:
+                loss_v = float(metrics["loss"])
+                now = time.perf_counter()
+                sps = args.log_every / (now - last_log)
+                last_log = now
+                print(f"step {step + 1}: loss={loss_v:.4f} "
+                      f"step/s={sps:.2f} tok/s={sps * tokens_per_step:.0f}", flush=True)
+    finally:
+        # SIGTERM or an exception DURING the traced window must not leave
+        # the profiler open (stop is idempotent: re-stop is a no-op)
+        if prof is not None:
+            prof.stop()
 
     jax.device_get(state.step)  # full sync (remote platforms)
-    stop_trace()
     total = time.perf_counter() - t_start
     steps_done = args.steps - start_step
     print(f"done: {steps_done} steps in {total:.1f}s "
           f"({steps_done / total:.2f} step/s, "
           f"{steps_done * tokens_per_step / total:.0f} tok/s)", flush=True)
     save(args.steps, final=True)
+    tracer.record("trainer.done", step=args.steps, steps_done=steps_done,
+                  wall_s=round(total, 3))
+    if step_stream is not None:
+        step_stream.close()
+    tracer.close()
     return 0
 
 
