@@ -24,7 +24,8 @@ presubmit:
 	  --total tests/test_serving_fleet.py=60 \
 	  --total tests/test_reshard.py=45 \
 	  --total tests/test_pipeline_1f1b.py=100 \
-	  --total tests/test_obs.py=60
+	  --total tests/test_obs.py=60 \
+	  --total tests/test_transport.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -58,6 +59,14 @@ bench-resize:
 .PHONY: bench-pp
 bench-pp:
 	$(PY) bench.py --pipeline-only
+
+# Transport-only fast loop: the transport_roundtrip record — socket
+# plane vs DirChannel msg/s + MB/s at control-sized and boundary-sized
+# (8MB) payloads (merges ONLY the transport_roundtrip key into
+# .bench_extras.json; span file at .bench_trace/transport.jsonl).
+.PHONY: bench-transport
+bench-transport:
+	$(PY) bench.py --transport-only
 
 .PHONY: manifests
 manifests:

@@ -1249,6 +1249,78 @@ def _tpu_child(results_path: str) -> int:
                            "device halves with every boundary serialized",
         })
 
+    # -- transport plane: socket vs DirChannel round-trip throughput at
+    # control-sized and boundary-sized payloads (docs/transport.md) ------
+    def transport_roundtrip_milestone():
+        import shutil
+        import tempfile
+
+        from kubedl_tpu.parallel.pipeline_mpmd import DirChannel
+        from kubedl_tpu.transport import TransportPlane
+
+        rng = np.random.default_rng(0)
+        payloads = {
+            # a RESIZE/control message and an ~8MB pipeline boundary
+            # activation — the two ends of the plane's traffic spectrum
+            "control_1kb": rng.integers(0, 256, 1024, np.uint8).tobytes(),
+            "boundary_8mb": rng.integers(
+                0, 256, 8 * 2**20, np.uint8).tobytes(),
+        }
+        reps = {"control_1kb": 300, "boundary_8mb": 24}
+
+        def timed(send_recv, payload, n, prefix):
+            # tags are globally unique: the socket plane's exactly-once
+            # dedup drops a reused tag by design
+            for i in range(min(n // 10 + 1, 5)):  # warm
+                send_recv(f"{prefix}.w{i}", payload)
+            t0 = time.perf_counter()
+            for i in range(n):
+                send_recv(f"{prefix}.m{i}", payload)
+            return time.perf_counter() - t0
+
+        rec = {}
+        # socket lane: a REAL TCP loopback hop through the full frame +
+        # auth + ack path
+        rx = TransportPlane(token="bench-tok", service="bench-rx")
+        addr = rx.listen("127.0.0.1:0")
+        tx = TransportPlane(token="bench-tok", service="bench-tx")
+        ch = tx.channel("bench", peer_addr=addr)
+
+        def sock_rt(tag, payload):
+            ch.send(tag, payload)
+            rx.recv("bench", tag, timeout=60)
+
+        dir_root = tempfile.mkdtemp(prefix="kubedl-bench-transport-")
+        dch = DirChannel(os.path.join(dir_root, "edge"))
+
+        def dir_rt(tag, payload):
+            dch.send(tag, payload)
+            dch.recv(tag, timeout=60)
+
+        try:
+            for size_name, payload in payloads.items():
+                n = reps[size_name]
+                for lane, fn in (("socket", sock_rt), ("dir", dir_rt)):
+                    elapsed = timed(fn, payload, n, f"{lane}.{size_name}")
+                    rec[f"{lane}_{size_name}"] = {
+                        "msgs": n,
+                        "msg_s": round(n / elapsed, 1),
+                        "mb_s": round(n * len(payload) / 2**20 / elapsed, 2),
+                    }
+        finally:
+            rx.close()
+            tx.close()
+            shutil.rmtree(dir_root, ignore_errors=True)
+        for size_name in payloads:
+            s, d = rec[f"socket_{size_name}"], rec[f"dir_{size_name}"]
+            rec[f"socket_vs_dir_{size_name}"] = round(
+                s["mb_s"] / max(d["mb_s"], 1e-9), 3)
+        rec["environment"] = (
+            "loopback TCP (full frame+auth+ack path) vs DirChannel on "
+            "local disk, single in-flight message per lane — AsyncSender "
+            "pipelining excluded so the number is the per-hop floor")
+        _emit(out, "transport_roundtrip", rec)
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -1264,6 +1336,7 @@ def _tpu_child(results_path: str) -> int:
         ("serving_latency", serving_latency_milestone, 150),
         ("resize_downtime", resize_downtime_milestone, 120),
         ("pipeline_schedule", pipeline_schedule_milestone, 150),
+        ("transport_roundtrip", transport_roundtrip_milestone, 60),
         ("grpo", grpo_milestone, 150),
     ]
     # -- 6. MoE dispatch-overhead breakdown: per-stage timing of the
@@ -1626,6 +1699,17 @@ def _pipeline_only() -> int:
         merge_keys=("pipeline_schedule",), small_devices=8)
 
 
+def _transport_only() -> int:
+    """`bench.py --transport-only` (make bench-transport): ONLY the
+    transport_roundtrip record — socket-plane vs DirChannel msg/s and
+    MB/s at control-sized and boundary-sized (8MB) payloads, merged
+    into .bench_extras.json with the paired .bench_trace/transport.jsonl
+    span file (no devices needed — the plane is pure host I/O)."""
+    return _single_lane(
+        "transport", ("transport_roundtrip",),
+        merge_keys=("transport_roundtrip",))
+
+
 def main() -> int:
     if len(sys.argv) > 2 and sys.argv[1] == "--tpu-child":
         return _tpu_child(sys.argv[2])
@@ -1637,6 +1721,8 @@ def main() -> int:
         return _resize_only()
     if "--pipeline-only" in sys.argv:
         return _pipeline_only()
+    if "--transport-only" in sys.argv:
+        return _transport_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

@@ -84,6 +84,14 @@ class LocalPodExecutor:
         # Survives in-place restarts, removed with the pod.
         self.control_root = tempfile.mkdtemp(prefix="kubedl-ctl-")
         self._control_seq = 0
+        # transport plane selection + auth (docs/transport.md), injected
+        # the same way KUBEDL_CONTROL_DIR travels: the local executor
+        # defaults to the dir transport (shared filesystem IS the local
+        # analog of DCN); kube manifests pin KUBEDL_TRANSPORT=socket.
+        # The auth token is per JOB — every pod of a gang shares it, two
+        # jobs never do — minted lazily on first launch.
+        self.transport = os.environ.get("KUBEDL_TRANSPORT", "dir")
+        self._job_tokens: Dict[str, str] = {}
         self._running: Dict[str, _RunningPod] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -150,6 +158,20 @@ class LocalPodExecutor:
         except OSError:
             return None
         return os.path.join(d, msg["reply"])
+
+    def job_transport_token(self, namespace: str, job: str) -> str:
+        """The job's shared transport auth token (KUBEDL_TRANSPORT_TOKEN)
+        — one random secret per job, every pod of the gang gets the same
+        one, so pods of DIFFERENT jobs cannot speak on each other's
+        planes even on a shared host."""
+        import secrets
+
+        key = f"{namespace}/{job}"
+        with self._lock:
+            tok = self._job_tokens.get(key)
+            if tok is None:
+                tok = self._job_tokens[key] = secrets.token_hex(16)
+            return tok
 
     def read_heartbeats(self) -> List[Dict]:
         """Latest step-telemetry heartbeat of every pod that wrote one
@@ -403,6 +425,11 @@ class LocalPodExecutor:
         from kubedl_tpu.obs.trace import job_trace_dir, trace_id_for
 
         job_name = pod.metadata.labels.get("job-name") or pod.metadata.name
+        # transport selection + per-job auth token (docs/transport.md);
+        # setdefault — a manifest that pins its own transport env wins
+        env.setdefault("KUBEDL_TRANSPORT", self.transport)
+        env.setdefault("KUBEDL_TRANSPORT_TOKEN", self.job_transport_token(
+            pod.metadata.namespace, job_name))
         trace_dir = job_trace_dir(
             self.trace_root, pod.metadata.namespace, job_name)
         try:

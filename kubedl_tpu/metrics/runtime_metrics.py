@@ -114,6 +114,8 @@ class RuntimeMetrics:
         # accounting over the span timeline (GoodputReporter.snapshot)
         self._steps: Optional[Callable[[], Dict]] = None
         self._goodput: Optional[Callable[[], Dict]] = None
+        # transport-plane snapshot callable (transport_metrics.snapshot)
+        self._transport: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -160,6 +162,12 @@ class RuntimeMetrics:
         (per-job goodput ratio + bucket breakdown)."""
         with self._lock:
             self._goodput = snapshot_fn
+
+    def register_transport(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns transport_metrics.snapshot()-shaped dicts
+        (per-channel message/byte counters, reconnects, auth failures)."""
+        with self._lock:
+            self._transport = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -407,6 +415,49 @@ class RuntimeMetrics:
                         lines.append(sample(
                             "kubedl_goodput_seconds", f"{secs:.6f}",
                             {"job": job, "bucket": bucket}))
+        with self._lock:
+            transport_fn = self._transport
+        if transport_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                tp = transport_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                tp = None
+            if tp is not None:
+                lines.append("# HELP kubedl_transport_messages_total "
+                             "Messages carried per channel and direction")
+                lines.append("# TYPE kubedl_transport_messages_total counter")
+                for key, n in sorted((tp.get("messages_total") or {}).items()):
+                    ch, _, d = key.rpartition("/")
+                    lines.append(sample(
+                        "kubedl_transport_messages_total", n,
+                        {"channel": ch, "dir": d}))
+                lines.append("# HELP kubedl_transport_bytes_total Payload "
+                             "bytes carried per channel and direction")
+                lines.append("# TYPE kubedl_transport_bytes_total counter")
+                for key, n in sorted((tp.get("bytes_total") or {}).items()):
+                    ch, _, d = key.rpartition("/")
+                    lines.append(sample(
+                        "kubedl_transport_bytes_total", n,
+                        {"channel": ch, "dir": d}))
+                for metric, key, help_ in (
+                    ("kubedl_transport_reconnects_total", "reconnects_total",
+                     "Outbound connections re-established after a drop"),
+                    ("kubedl_transport_connects_total", "connects_total",
+                     "Outbound connections established"),
+                    ("kubedl_transport_auth_failures_total",
+                     "auth_failures_total",
+                     "Connections refused for a bad/missing token"),
+                    ("kubedl_transport_torn_frames_total",
+                     "torn_frames_total",
+                     "Connections dropped on a partial frame"),
+                    ("kubedl_transport_stale_boot_refusals_total",
+                     "stale_boot_refusals_total",
+                     "Messages/dials refused for a changed peer incarnation"),
+                ):
+                    lines.append(f"# HELP {metric} {help_}")
+                    lines.append(f"# TYPE {metric} counter")
+                    lines.append(sample(metric, tp.get(key, 0)))
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -432,6 +483,7 @@ class RuntimeMetrics:
             pipe_fn = self._pipeline
             steps_fn = self._steps
             goodput_fn = self._goodput
+            transport_fn = self._transport
         if pipe_fn is not None:
             try:
                 out["pipeline"] = pipe_fn()  # outside the lock, see render()
@@ -447,6 +499,11 @@ class RuntimeMetrics:
                 out["goodput"] = goodput_fn()  # outside the lock, see render()
             except Exception:  # noqa: BLE001 — callback raced shutdown
                 out["goodput"] = None
+        if transport_fn is not None:
+            try:
+                out["transport"] = transport_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["transport"] = None
         if slice_fn is not None:
             try:
                 out["slice_pool"] = slice_fn()  # outside the lock, see render()

@@ -121,6 +121,12 @@ class Operator:
         from kubedl_tpu.metrics.runtime_metrics import pipeline_metrics
 
         self.runtime_metrics.register_pipeline(pipeline_metrics.snapshot)
+        # transport-plane counters (kubedl_transport_*): every plane in
+        # the process folds into the module singleton; register
+        # unconditionally (renders zeros until a plane carries traffic)
+        from kubedl_tpu.transport.metrics import transport_metrics
+
+        self.runtime_metrics.register_transport(transport_metrics.snapshot)
         # flight recorder (docs/observability.md): control-plane tracer
         # routing spans into per-job dirs under trace_root, plus the
         # goodput accountant that folds those dirs into

@@ -110,9 +110,11 @@ class CapacityScheduler(CapacityDirector):
         self._preemptions_total = 0
         self._resizes_total = 0
         # live-reshard plane: control channel into running pods (the
-        # operator wires the executor's post_control; None = no channel,
-        # every resize takes the checkpoint path), pending RESIZEs, and
-        # the kubedl_reshards_total / resize-downtime series
+        # operator wires the executor's post_control on the local
+        # executor, or a transport/control.SocketControlRouter.post over
+        # the socket plane in kube mode; None = no channel, every resize
+        # takes the checkpoint path), pending RESIZEs, and the
+        # kubedl_reshards_total / resize-downtime series
         self._control: Optional[Callable[[str, str, Dict], Optional[str]]] = None
         self._pending_reshards: Dict[str, _PendingReshard] = {}
         self._reshards_total = {"ok": 0, "staged": 0, "fallback": 0,
@@ -174,7 +176,11 @@ class CapacityScheduler(CapacityDirector):
 
     def attach_control(self, post_fn) -> None:
         """Wire the pod control channel: post_fn(namespace, pod_name,
-        message) -> reply path or None (executor.post_control). Without
+        message) -> reply path or None. Backends: executor.post_control
+        (local executor, files in the pod's control dir) or
+        transport/control.SocketControlRouter.post (kube mode — the
+        message rides the socket plane and the reply is spooled to a
+        local file, so this polling loop is transport-blind). Without
         one, every resize falls back to checkpoint-then-evict."""
         with self._lock:
             self._control = post_fn

@@ -21,12 +21,16 @@ those pods and the front-end share:
 In-process the handoff travels by reference; with `cross_pod=True`
 every prefill->decode hop round-trips through `serialize_item`/
 `deserialize_item` — the DCN wire discipline, exercised in tests and
-the multichip dryrun so the byte path can't rot.
+the multichip dryrun so the byte path can't rot. Passing `transport=`
+(a send/recv channel — `transport.SocketChannel` over the
+authenticated plane, or `DirChannel` in local tests) sends the
+serialized payload over a REAL hop instead of the in-memory round
+trip; the parity matrix pins exact-token outputs on both.
 
-This module is deliberately transport-agnostic: pods here are
-in-process objects (one engine each), which is both the test harness
-and the single-host deployment; a networked deployment keeps this
-routing logic and swaps the pod handles for HTTP clients.
+This module is otherwise transport-agnostic: pods here are in-process
+objects (one engine each), which is both the test harness and the
+single-host deployment; a networked deployment keeps this routing
+logic and swaps the pod handles for HTTP clients.
 """
 from __future__ import annotations
 
@@ -201,13 +205,27 @@ class ServingRouter:
 
     def __init__(self, prefill_pods: List[PrefillPod],
                  decode_pods: List[DecodePod],
-                 cross_pod: bool = False) -> None:
+                 cross_pod: bool = False, transport=None) -> None:
         if not prefill_pods or not decode_pods:
             raise ValueError("a serving fleet needs >= 1 prefill and "
                              ">= 1 decode pod")
         self.prefill_pods = list(prefill_pods)
         self.decode_pods = list(decode_pods)
         self.cross_pod = cross_pod
+        # cross_pod transport: any send(tag, bytes)/recv(tag, timeout)
+        # channel (transport.SocketChannel over the authenticated plane,
+        # or DirChannel in local tests). The ALREADY-SERIALIZED npz
+        # payload rides it verbatim; None keeps the in-memory serialize
+        # round trip (the wire discipline without the wire).
+        if transport is not None and not cross_pod:
+            raise ValueError("a handoff transport requires cross_pod=True "
+                             "(by-reference items cannot ride a wire)")
+        self.transport = transport
+        # per-HOP sequence: a request re-prefilled after a drain/eviction
+        # crosses the transport again, and the socket plane dedups by
+        # tag — a tag built from the request id alone would make every
+        # migration's second payload vanish into the dedup
+        self._hop_seq = 0
         # the tightest pod bounds every request (any pod may serve it)
         self.max_len = min(p.engine.max_len
                            for p in self.prefill_pods + self.decode_pods)
@@ -288,7 +306,8 @@ class ServingRouter:
 
     def pump_prefill(self) -> int:
         """One prefill from every eligible pod's queue -> handoff queue
-        (serialized round trip in cross_pod mode)."""
+        (serialized round trip in cross_pod mode; over the transport
+        channel when one is wired — the real pod-to-pod hop)."""
         moved = 0
         for pod in self._eligible(self.prefill_pods):
             item = pod.pump_one()
@@ -297,6 +316,16 @@ class ServingRouter:
             if self.cross_pod:
                 payload = serialize_item(item)
                 self.serialized_bytes += len(payload)
+                if self.transport is not None:
+                    # the serialized KV payload rides the message plane
+                    # byte-for-byte; the tag must be unique per HOP, not
+                    # per request — migrations re-prefill the same id
+                    with self._lock:
+                        self._hop_seq += 1
+                        hop = self._hop_seq
+                    tag = f"kv-{int(item.meta['request_id'])}-{hop}"
+                    self.transport.send(tag, payload)
+                    payload = self.transport.recv(tag, timeout=60.0)
                 item = deserialize_item(payload)
                 item.request = self._by_id[int(item.meta["request_id"])]
             self.handoffs.put(item)

@@ -94,6 +94,8 @@ class StageRuntime:
         # and retryable instead — the restart drains it)
         self.boot_id = uuid.uuid4().hex[:12]
         self._peer_boot: Dict[int, str] = {}
+        # socket mode: the plane the channels ride, closed with close()
+        self.transport_plane = None
         self.last_loss: Optional[float] = None
         self.last_grads: Optional[Dict] = None
         self.stats: Dict[str, float] = {
@@ -389,6 +391,8 @@ class StageRuntime:
             s.close()
         for r in self._rx:
             r.close()
+        if self.transport_plane is not None:
+            self.transport_plane.close()
 
 
 class MPMDPipeline:
@@ -493,11 +497,14 @@ def runtime_from_env(
     """Build THIS pod's stage runtime from the operator-injected
     KUBEDL_PP_* environment (workloads/jaxjob.py set_cluster_spec +
     executor/tpu_topology.py pipeline_neighbor_env): stage id, shape
-    knobs, and the per-edge boundary directories under
-    KUBEDL_PP_BOUNDARY_DIR (the local executor's DCN analog; the
-    kube-mode socket transport dials KUBEDL_PP_PREV_ADDR /
-    KUBEDL_PP_NEXT_ADDR instead and is not implemented yet —
-    docs/pipeline.md "Transports")."""
+    knobs, and the boundary transport. ``KUBEDL_TRANSPORT=socket``
+    (kube mode / any cluster) runs the edges over the authenticated
+    socket plane (kubedl_tpu/transport/), dialing
+    ``KUBEDL_PP_PREV_ADDR``/``KUBEDL_PP_NEXT_ADDR`` and listening on
+    ``KUBEDL_TRANSPORT_BIND``; the default rides ``DirChannel`` over the
+    per-edge directories under ``KUBEDL_PP_BOUNDARY_DIR`` — the local
+    executor's test transport (docs/transport.md, docs/pipeline.md
+    "Transports"). The boundary encoding is byte-identical on both."""
     import os
 
     from kubedl_tpu.parallel.pipeline_mpmd import DirChannel
@@ -506,12 +513,42 @@ def runtime_from_env(
     stage = int(env.get("KUBEDL_PP_STAGE", "0"))
     n_stages = int(env.get("KUBEDL_PP_STAGES", "1"))
     n_micro = int(env.get("KUBEDL_PP_MICROBATCHES", str(n_stages)))
+    plan = make_stage_plan(config.n_layers, n_stages, n_micro)
+
+    if env.get("KUBEDL_TRANSPORT", "") == "socket" and n_stages > 1:
+        from kubedl_tpu.transport import plane_from_env
+
+        prev = env.get("KUBEDL_PP_PREV_ADDR", "")
+        next_ = env.get("KUBEDL_PP_NEXT_ADDR", "")
+        if (stage > 0 and not prev) or (stage < n_stages - 1 and not next_):
+            raise ValueError(
+                "KUBEDL_TRANSPORT=socket needs KUBEDL_PP_PREV_ADDR/"
+                "NEXT_ADDR for this stage's ring neighbors")
+        plane = plane_from_env(service=f"pp-stage-{stage}", env=env)
+        # socket inboxes start empty in a fresh process (no durable
+        # backlog to purge); a RESTARTED neighbor's leftover stream is
+        # refused by the plane's boot-id latch — the same loud failure
+        # the DirChannel purge + meta guard provide
+        rt = StageRuntime(
+            stage, plan, config, split_stage_params(params, plan, stage), tx,
+            act_in=plane.channel(f"act{stage - 1}") if stage > 0 else None,
+            act_out=(plane.channel(f"act{stage}", peer_addr=next_)
+                     if stage < n_stages - 1 else None),
+            grad_in=(plane.channel(f"grad{stage}")
+                     if stage < n_stages - 1 else None),
+            grad_out=(plane.channel(f"grad{stage - 1}", peer_addr=prev)
+                      if stage > 0 else None),
+            mesh=mesh, rules=rules,
+        )
+        rt.transport_plane = plane  # closed with the runtime
+        return rt
+
     bdir = env.get("KUBEDL_PP_BOUNDARY_DIR", "")
     if n_stages > 1 and not bdir:
         raise ValueError(
             "KUBEDL_PP_BOUNDARY_DIR is required for a multi-stage MPMD "
-            "pipeline on the local executor")
-    plan = make_stage_plan(config.n_layers, n_stages, n_micro)
+            "pipeline on the dir transport (or set KUBEDL_TRANSPORT="
+            "socket with neighbor addresses)")
 
     def edge(i: int, kind: str):
         return DirChannel(os.path.join(bdir, f"{kind}{i}"))

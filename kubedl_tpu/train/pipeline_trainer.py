@@ -19,9 +19,15 @@ the SPMD trainer, per stage.
 Usage (as a pod command):
     python -m kubedl_tpu.train.pipeline_trainer --model tiny --steps 100
 
+The boundary transport is env-selected (docs/transport.md): DirChannel
+over KUBEDL_PP_BOUNDARY_DIR on the local executor, the authenticated
+socket plane (KUBEDL_TRANSPORT=socket + KUBEDL_PP_PREV/NEXT_ADDR) in
+kube mode — byte-identical boundary payloads either way.
+
 Limitations (documented in docs/pipeline.md): one process per stage
-(multi-host stages need the kube-mode socket transport), synthetic data
-only (--data-path is refused rather than silently ignored).
+(a stage spanning multiple hosts would need per-stage jax.distributed
+wiring on top), synthetic data only (--data-path is refused rather
+than silently ignored).
 """
 from __future__ import annotations
 

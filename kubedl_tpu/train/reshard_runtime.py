@@ -12,7 +12,10 @@ trainer polls it at step boundaries and runs the reshard ladder:
   2. staged restart (multi-process gangs, where jax.distributed pins the
      world size): every pod quiesces, writes the shard blocks the new
      topology needs (parallel/reshard.py plan) into the shared staging dir
-     — the local-executor analog of the DCN stream — plus a digest marker;
+     — the local-executor analog of the DCN stream; a pod without the
+     shared volume can pull a peer's published staging over the socket
+     plane instead (transport/blocks.py fetch_staging, sha-checked, same
+     validation below) — plus a digest marker;
      worker 0 publishes the manifest only after every pod's marker lands
      with a MATCHING plan digest; pods exit retryable and reassemble from
      the staging on restart, skipping the Orbax round trip.
@@ -70,7 +73,8 @@ class ReshardError(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# control channel (file-based: the local-executor analog of a sidecar watch)
+# control channel — dir backend (local executor) or socket backend (kube
+# mode, kubedl_tpu/transport/control.py), selected by control_from_env()
 # ---------------------------------------------------------------------------
 
 
@@ -129,6 +133,30 @@ class ReshardControl:
             os.replace(tmp, os.path.join(self.dir, name))
         except OSError:
             log.warning("could not write reshard reply %s", name)
+
+
+def control_from_env():
+    """The pod's control endpoint, selected by where the operator can
+    actually post: a ``KUBEDL_CONTROL_DIR`` means the LOCAL executor is
+    running this pod and writes msg files there (post_control) — the
+    dir backend wins even when the socket plane is also configured,
+    because that dir is the channel the scheduler is wired to. Without
+    one (kube mode — no shared filesystem), ``KUBEDL_TRANSPORT=socket``
+    listens on the authenticated plane and the scheduler dials the pod
+    (transport/control.SocketControlRouter). Both expose the same
+    poll()/reply() surface and the same reply schema, so the trainer's
+    reshard ladder is transport-blind. Returns None when neither is
+    configured (resizes then take the checkpoint path)."""
+    ctl = ReshardControl.from_env()
+    if ctl is not None:
+        return ctl
+    if os.environ.get("KUBEDL_TRANSPORT", "") == "socket":
+        from kubedl_tpu.transport import SocketReshardControl, plane_from_env
+
+        plane = plane_from_env(service="reshard-control", latch=False)
+        if plane is not None:
+            return SocketReshardControl(plane)
+    return None
 
 
 # ---------------------------------------------------------------------------

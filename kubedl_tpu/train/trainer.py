@@ -197,8 +197,9 @@ def main(argv=None) -> int:
 
     reshard_on = info.live_reshard
     reshard_dir = info.reshard_dir
-    ctl = (reshard_runtime.ReshardControl(info.control_dir)
-           if reshard_on and info.control_dir else None)
+    # transport-selected control endpoint: socket plane in kube mode,
+    # KUBEDL_CONTROL_DIR polling on the local executor (same surface)
+    ctl = reshard_runtime.control_from_env() if reshard_on else None
 
     # Staged-restart lane: a valid staging (written by the PREVIOUS
     # incarnation's quiesce) beats both the env mesh and the checkpoint —

@@ -1,0 +1,40 @@
+"""The authenticated socket transport plane (docs/transport.md).
+
+ONE message plane for everything that used to ride files-on-a-volume:
+RESIZE control messages (sched/capacity.py), MPMD pipeline boundary
+activations/grads (train/pipeline_runtime.py), serving KV handoffs
+(serving/router.py), and staged-reshard block fetches
+(train/reshard_runtime.py). Dependency-free (stdlib sockets), token
+authenticated, length-prefix framed; `DirChannel` survives as the
+local-executor test transport, selected via ``KUBEDL_TRANSPORT``.
+"""
+from kubedl_tpu.transport.blocks import fetch_staging, serve_staging
+from kubedl_tpu.transport.control import (
+    SocketControlRouter,
+    SocketReshardControl,
+)
+from kubedl_tpu.transport.metrics import transport_metrics
+from kubedl_tpu.transport.plane import (
+    ENV_BIND,
+    ENV_TOKEN,
+    ENV_TRANSPORT,
+    SocketChannel,
+    TransportError,
+    TransportPlane,
+    plane_from_env,
+)
+
+__all__ = [
+    "ENV_BIND",
+    "ENV_TOKEN",
+    "ENV_TRANSPORT",
+    "SocketChannel",
+    "SocketControlRouter",
+    "SocketReshardControl",
+    "TransportError",
+    "TransportPlane",
+    "fetch_staging",
+    "plane_from_env",
+    "serve_staging",
+    "transport_metrics",
+]

@@ -27,11 +27,12 @@ COORDINATOR_PORT = 8471
 # coordination-service envs for numSlices > 1 jobs (workloads/jaxjob.py).
 MEGASCALE_PORT = 8080
 
-# Port each MPMD pipeline stage's boundary endpoint would listen on (the
-# neighbor addresses injected as KUBEDL_PP_PREV_ADDR/NEXT_ADDR point at
-# the neighbor stage's worker-0 service on this port). The local
-# executor's DirChannel lane doesn't dial it — see docs/pipeline.md
-# "Transports".
+# Port each MPMD pipeline stage's transport plane listens on in kube
+# mode (KUBEDL_TRANSPORT=socket): the neighbor addresses injected as
+# KUBEDL_PP_PREV_ADDR/NEXT_ADDR point at the neighbor stage's worker-0
+# service on this port, and the stage's own plane binds it via
+# KUBEDL_TRANSPORT_BIND. The local executor's DirChannel lane doesn't
+# dial it — see docs/transport.md and docs/pipeline.md "Transports".
 PIPELINE_PORT = 8476
 
 ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"

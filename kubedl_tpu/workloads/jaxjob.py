@@ -481,6 +481,24 @@ class JAXJobController(BaseWorkloadController):
                     prev_addr=stage_addr(stage - 1) if stage > 0 else "",
                     next_addr=(stage_addr(stage + 1)
                                if stage < ns - 1 else "")))
+                # socket-plane listen endpoint (docs/transport.md): the
+                # neighbor addrs above dial this port, so the stage's
+                # plane must bind it when KUBEDL_TRANSPORT=socket (kube
+                # mode; the local executor defaults to the dir lane)
+                env["KUBEDL_TRANSPORT_BIND"] = (
+                    f"0.0.0.0:{common.PIPELINE_PORT}")
+                # per-job auth token, derived from the job UID so every
+                # pod of the gang — across operator restarts — gets the
+                # SAME secret and no other job can forge it (the UID is
+                # an unguessable uuid4 internal to the cluster; a
+                # production deployment can still pin its own token via
+                # a mounted Secret, which wins over this default)
+                if job.metadata.uid:
+                    import hashlib
+
+                    env["KUBEDL_TRANSPORT_TOKEN"] = hashlib.sha256(
+                        f"kubedl-transport:{job.metadata.uid}".encode()
+                    ).hexdigest()
                 ckpt_path = (job.spec.checkpoint.path
                              if job.spec.checkpoint else "")
                 if ckpt_path:

@@ -319,3 +319,124 @@ def test_pod_kill_mid_reshard_falls_back_to_checkpoint(tmp_path):
         assert _latest_step(ckpt) == RESIZE_STEPS
     finally:
         op.stop()
+
+
+# ---------------------------------------------------------------------------
+# transport-plane chaos (ISSUE 11): peer SIGKILL across REAL processes
+# ---------------------------------------------------------------------------
+
+
+def test_transport_peer_sigkill_then_restart_is_refused(tmp_path):
+    """SIGKILL a real listener PROCESS mid-stream: the sender reconnects
+    (bounded backoff) once the peer is back — but the restarted
+    incarnation is REFUSED via the boot-id latch, mirroring the PR 9
+    DirChannel purge guarantee: data can never silently straddle a peer
+    restart; the failure is loud and the gang restart drains it."""
+    import json
+    import socket as pysocket
+    import subprocess
+
+    from kubedl_tpu.transport import TransportPlane, TransportError
+
+    s = pysocket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    child_src = (
+        "import sys, time, json\n"
+        "sys.path.insert(0, %r)\n"
+        "from kubedl_tpu.transport import TransportPlane\n"
+        "p = TransportPlane(token='chaos-tok', service='listener')\n"
+        "p.listen('127.0.0.1:%d')\n"
+        "print('LISTENING', flush=True)\n"
+        "data = p.recv('c', 'm1', timeout=60)\n"
+        "print('GOT', len(data), flush=True)\n"
+        "time.sleep(60)\n"  # hold the port until killed
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), port)
+
+    def spawn():
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child_src],
+            stdout=subprocess.PIPE, text=True)
+        assert "LISTENING" in proc.stdout.readline()
+        return proc
+
+    sender = TransportPlane(
+        token="chaos-tok", service="sender",
+        dial_budget_s=30, reconnect_budget_s=30)
+    ch = sender.channel("c", peer_addr=f"127.0.0.1:{port}")
+    child = spawn()
+    try:
+        ch.send("m1", b"x" * 1024)  # delivered: the child prints GOT
+        assert "GOT" in child.stdout.readline()
+        child.kill()  # SIGKILL mid-stream — no FIN discipline
+        child.wait(timeout=10)
+        child = spawn()  # the restart: same port, NEW incarnation
+        with pytest.raises(TransportError, match="incarnation"):
+            ch.send("m2", b"y" * 1024)
+    finally:
+        child.kill()
+        child.wait(timeout=10)
+        sender.close()
+
+
+def test_transport_resize_reply_survives_scheduler_poll(tmp_path):
+    """The socket RESIZE path end-to-end against a REAL pod process:
+    operator-side SocketControlRouter posts, the pod process polls and
+    replies over the plane, and the spooled reply parses with the dir
+    backend's schema — the capacity scheduler's _reshard_pass file
+    polling works unchanged over sockets."""
+    import json
+    import subprocess
+
+    from kubedl_tpu.transport import SocketControlRouter, TransportPlane
+
+    child_src = (
+        "import sys, time, json, os\n"
+        "sys.path.insert(0, %r)\n"
+        "os.environ.update({'KUBEDL_TRANSPORT': 'socket',\n"
+        "                   'KUBEDL_TRANSPORT_TOKEN': 'chaos-tok',\n"
+        "                   'KUBEDL_TRANSPORT_BIND': '127.0.0.1:0'})\n"
+        "from kubedl_tpu.train.reshard_runtime import control_from_env\n"
+        "ctl = control_from_env()\n"
+        "print('ADDR', ctl.plane.bound_addr, flush=True)\n"
+        "deadline = time.monotonic() + 60\n"
+        "while time.monotonic() < deadline:\n"
+        "    msg = ctl.poll()\n"
+        "    if msg is not None:\n"
+        "        ctl.reply(msg, outcome='ok',\n"
+        "                  downtime_s=0.5, step=9)\n"
+        "        break\n"
+        "    time.sleep(0.05)\n"
+        "time.sleep(2)\n"  # let the reply flush before exit
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src], stdout=subprocess.PIPE, text=True)
+    op_plane = TransportPlane(
+        token="chaos-tok", service="operator", latch=False)
+    op_plane.listen("127.0.0.1:0")
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("ADDR "), line
+        pod_addr = line.split()[1]
+        router = SocketControlRouter(
+            op_plane, str(tmp_path / "spool"),
+            addr_for=lambda ns, n: pod_addr)
+        path = router.post("default", "w0", {
+            "type": "RESIZE", "chips": 4, "slice": "v5e-4",
+            "quiesce_timeout_s": 5.0})
+        assert path is not None
+        deadline = time.monotonic() + 30
+        while not os.path.exists(path):
+            assert time.monotonic() < deadline, "reply never spooled"
+            time.sleep(0.05)
+        with open(path) as f:
+            reply = json.load(f)
+        # the dir backend's reply schema, byte-for-byte
+        assert reply == {"outcome": "ok", "downtime_s": 0.5, "step": 9}
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        op_plane.close()

@@ -468,9 +468,9 @@ def test_runtime_metrics_render_goodput_and_step_series():
 
 
 def test_debug_vars_has_every_newer_family():
-    """Satellite: pipeline + reshard + goodput + step snapshots must all
-    be on the debug surface (a family silently missing from /debug/vars
-    is invisible to `kubedl-tpu top`)."""
+    """Satellite: pipeline + reshard + goodput + step + transport
+    snapshots must all be on the debug surface (a family silently
+    missing from /debug/vars is invisible to `kubedl-tpu top`)."""
     from kubedl_tpu.operator import Operator, OperatorConfig
 
     op = Operator(OperatorConfig(
@@ -483,6 +483,7 @@ def test_debug_vars_has_every_newer_family():
         assert "pipeline" in dv
         assert "steps" in dv
         assert "goodput" in dv
+        assert "transport" in dv and "reconnects_total" in dv["transport"]
     finally:
         op.stop()
 

@@ -603,28 +603,52 @@ def test_trainer_refuses_mpmd_and_bad_shapes(monkeypatch):
     assert trainer.main(["--model", "tiny", "--steps", "1"]) == 2
 
 
+_E2E_LOSSES = {}  # transport -> per-step losses (cross-param parity pin)
+
+
 @pytest.mark.slow
-def test_pipeline_trainer_two_process_e2e(tmp_path):
+@pytest.mark.parametrize("transport", ["dir", "socket"])
+def test_pipeline_trainer_two_process_e2e(tmp_path, transport):
     """The REAL MPMD deployment shape: two pipeline_trainer PROCESSES,
-    one per stage, joined only by the DirChannel boundary dir — train a
-    few steps, checkpoint stage-locally, and exit 0."""
+    one per stage, joined only by the boundary transport — train a few
+    steps, checkpoint stage-locally, and exit 0. Runs on BOTH the
+    DirChannel dir (local executor) and the authenticated SocketChannel
+    plane (kube mode), with the same final loss: the boundary bytes are
+    transport-opaque, so the two lanes must converge identically."""
     import os
+    import re
+    import socket as pysocket
 
     from tests.conftest import CPU_ENV
 
     ckpt = str(tmp_path / "ckpt")
-    bdir = str(tmp_path / "ckpt" / ".pipeline")
     base_env = {**os.environ, **CPU_ENV,
                 "KUBEDL_PP_STAGES": "2", "KUBEDL_PP_MICROBATCHES": "4",
-                "KUBEDL_PP_BOUNDARY_DIR": bdir,
                 "KUBEDL_CHECKPOINT_PATH": ckpt}
+    stage_env = {"0": {}, "1": {}}
+    if transport == "dir":
+        base_env["KUBEDL_PP_BOUNDARY_DIR"] = str(tmp_path / "ckpt" / ".pipeline")
+    else:
+        ports = []
+        for _ in range(2):
+            s = pysocket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            s.close()
+        base_env.update({"KUBEDL_TRANSPORT": "socket",
+                         "KUBEDL_TRANSPORT_TOKEN": "e2e-job-token"})
+        stage_env["0"] = {"KUBEDL_TRANSPORT_BIND": f"127.0.0.1:{ports[0]}",
+                          "KUBEDL_PP_NEXT_ADDR": f"127.0.0.1:{ports[1]}"}
+        stage_env["1"] = {"KUBEDL_TRANSPORT_BIND": f"127.0.0.1:{ports[1]}",
+                          "KUBEDL_PP_PREV_ADDR": f"127.0.0.1:{ports[0]}"}
     cmd = [sys.executable, "-m", "kubedl_tpu.train.pipeline_trainer",
            "--model", "tiny", "--steps", "3", "--batch", "8",
            "--seq-len", "33", "--log-every", "1"]
     procs = []
     for stage in ("0", "1"):
         procs.append(subprocess.Popen(
-            cmd, env={**base_env, "KUBEDL_PP_STAGE": stage},
+            cmd, env={**base_env, **stage_env[stage],
+                      "KUBEDL_PP_STAGE": stage},
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = [p.communicate(timeout=300)[0] for p in procs]
     assert all(p.returncode == 0 for p in procs), outs
@@ -632,6 +656,12 @@ def test_pipeline_trainer_two_process_e2e(tmp_path):
     # stage-local checkpoints landed
     assert os.path.isdir(os.path.join(ckpt, "stage-0"))
     assert os.path.isdir(os.path.join(ckpt, "stage-1"))
+    # cross-transport parity pin: same seeds, same schedule => the
+    # per-step losses must match the other lane's exactly (identical
+    # boundary bytes). Both params run in one process, so stash here.
+    _E2E_LOSSES[transport] = re.findall(r"loss=([0-9.]+)", outs[1])
+    if len(_E2E_LOSSES) == 2:
+        assert _E2E_LOSSES["dir"] == _E2E_LOSSES["socket"], _E2E_LOSSES
 
 
 # ---------------------------------------------------------------------------

@@ -209,16 +209,45 @@ def test_handoff_serialization_bf16_rows():
         serialize_item(item)
 
 
-def test_router_cross_pod_parity(model, baseline):
+@pytest.fixture
+def handoff_transport(request, tmp_path):
+    """The cross_pod hop's transport matrix: in-memory round trip (the
+    wire discipline without a wire), DirChannel (local-executor analog),
+    and SocketChannel over the authenticated plane (the kube-mode hop,
+    a real TCP loopback)."""
+    kind = request.param
+    if kind == "memory":
+        yield None
+        return
+    if kind == "dir":
+        from kubedl_tpu.parallel.pipeline_mpmd import DirChannel
+
+        yield DirChannel(str(tmp_path / "kv-hop"))
+        return
+    from kubedl_tpu.transport import TransportPlane
+
+    plane = TransportPlane(token="serve-tok", service="router", latch=False)
+    addr = plane.listen("127.0.0.1:0")
+    try:
+        yield plane.channel("kv", peer_addr=addr)
+    finally:
+        plane.close()
+
+
+@pytest.mark.parametrize(
+    "handoff_transport", ["memory", "dir", "socket"], indirect=True)
+def test_router_cross_pod_parity(model, baseline, handoff_transport):
     """1 prefill pod + 2 decode pods with every handoff serialized (the
-    DCN wire path): tokens match the monolithic engine exactly."""
+    DCN wire path): tokens match the monolithic engine exactly — on the
+    in-memory round trip AND with the payload carried over a real
+    DirChannel / SocketChannel hop (byte-identical npz both ways)."""
     params, config = model
     prompts, want = baseline
     router = ServingRouter(
         [PrefillPod("p0", params, config, max_len=64)],
         [DecodePod("d0", params, config, slots=2, max_len=64, block_size=8),
          DecodePod("d1", params, config, slots=2, max_len=64, block_size=8)],
-        cross_pod=True)
+        cross_pod=True, transport=handoff_transport)
     # k=2 keeps streams in flight across rounds so admissions overlap —
     # that's what makes least-outstanding-blocks routing observable
     got = router.serve_all(prompts, max_new_tokens=8, k=2)
@@ -228,6 +257,16 @@ def test_router_cross_pod_parity(model, baseline):
     assert st["handoffs_total"] == len(prompts)
     # least-outstanding-blocks routing actually spread the load
     assert all(p["admitted"] > 0 for p in st["decode_pods"])
+
+
+def test_router_transport_requires_cross_pod(model):
+    params, config = model
+    with pytest.raises(ValueError, match="cross_pod"):
+        ServingRouter(
+            [PrefillPod("p0", params, config, max_len=64)],
+            [DecodePod("d0", params, config, slots=2, max_len=64,
+                       block_size=8)],
+            cross_pod=False, transport=object())
 
 
 def test_router_drain_migrates_mid_stream(model, baseline):

@@ -1,0 +1,549 @@
+"""The authenticated socket transport plane (kubedl_tpu/transport/).
+
+Four guarantee families, mirroring the PR 9 DirChannel discipline:
+framing (a message is fully delivered or absent — torn frames commit
+nothing), auth (constant-time token check at accept, refusals counted
+and loud), exactly-once under reconnect (a dropped connection resends;
+the accept side dedups by tag), and stale-incarnation refusal (boot-id
+latch on BOTH sides). Plus the consumer ports: byte-identical pipeline
+boundary payloads vs DirChannel, an in-process two-stage MPMD parity
+run over SocketChannels, the RESIZE round trip with the dir backend's
+reply schema, and the staged-reshard block fetch (sha-checked)."""
+import json
+import os
+import socket as pysocket
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubedl_tpu.parallel.pipeline_mpmd import (
+    DirChannel,
+    decode_boundary,
+    encode_boundary,
+)
+from kubedl_tpu.transport import (
+    SocketControlRouter,
+    SocketReshardControl,
+    TransportError,
+    TransportPlane,
+    fetch_staging,
+    plane_from_env,
+    serve_staging,
+    transport_metrics,
+)
+
+TOKEN = "test-job-token"
+
+
+@pytest.fixture
+def planes():
+    """A listening plane + a dialer sharing one token; closed after."""
+    made = []
+
+    def make(**kw):
+        kw.setdefault("token", TOKEN)
+        p = TransportPlane(**kw)
+        made.append(p)
+        return p
+
+    try:
+        yield make
+    finally:
+        for p in made:
+            p.close()
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# framing + payload parity
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_payload_byte_identical_on_both_transports(planes, tmp_path):
+    """The SAME encode_boundary bytes (bf16 included) arrive
+    byte-identically over SocketChannel AND DirChannel — the transport
+    carries the boundary encoding opaquely, so the PR 9 |V2 lesson
+    cannot regress per transport."""
+    import ml_dtypes
+
+    act = (np.arange(64, dtype=np.float32) / 9.0).astype(
+        ml_dtypes.bfloat16).reshape(4, 16)
+    wire = encode_boundary([act], meta={"mb": 0, "aux": 0.5, "boot": "b1"})
+
+    a = planes(service="recv")
+    addr = a.listen("127.0.0.1:0")
+    b = planes(service="send")
+    b.channel("act0", peer_addr=addr).send("a1.0", wire)
+    via_socket = a.recv("act0", "a1.0", timeout=5)
+
+    dch = DirChannel(str(tmp_path / "edge"))
+    dch.send("a1.0", wire)
+    via_dir = dch.recv("a1.0", timeout=5)
+
+    assert via_socket == wire == via_dir
+    (back,), meta = decode_boundary(via_socket)
+    assert back.dtype == act.dtype and back.tobytes() == act.tobytes()
+    assert meta == {"mb": 0, "aux": 0.5, "boot": "b1"}
+
+
+def test_large_boundary_sized_payload(planes):
+    """A >=8MB activation-sized message survives intact."""
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b = planes()
+    blob = np.random.default_rng(0).integers(
+        0, 256, 9 * 2**20, dtype=np.uint8).tobytes()
+    b.channel("act0", peer_addr=addr).send("big", blob)
+    assert a.recv("act0", "big", timeout=30) == blob
+
+
+def test_channel_poll_and_purge(planes):
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b = planes()
+    tx = b.channel("ctl", peer_addr=addr)
+    tx.send("t1", b"one")
+    tx.send("t2", b"two")
+    rx = a.channel("ctl")
+    assert rx.poll() == ("t1", b"one")  # insertion order
+    assert rx.purge() == 1
+    assert rx.poll() is None
+
+
+def test_torn_frame_commits_nothing(planes):
+    """A frame that stops mid-payload is dropped whole — no partial
+    message ever reaches an inbox — and the plane keeps serving."""
+    transport_metrics.reset()
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    host, _, port = addr.rpartition(":")
+
+    raw = pysocket.create_connection((host, int(port)), timeout=5)
+    hello = json.dumps({"token": TOKEN, "boot": "x"}).encode()
+    raw.sendall(b"KDTP" + bytes([1]) + struct.pack(">I", len(hello)) + hello
+                + struct.pack(">Q", 0))
+    raw.recv(4096)  # WELCOME
+    header = json.dumps(
+        {"channel": "act0", "tag": "torn", "boot": "x", "seq": 1}).encode()
+    # claim a 1000-byte payload, deliver 10 bytes, die
+    raw.sendall(b"KDTP" + bytes([3]) + struct.pack(">I", len(header))
+                + header + struct.pack(">Q", 1000) + b"x" * 10)
+    raw.close()
+
+    assert _wait_for(
+        lambda: transport_metrics.snapshot()["torn_frames_total"] >= 1)
+    assert a.channel("act0").poll() is None  # nothing committed
+    # the plane still serves fresh, whole messages
+    b = planes()
+    b.channel("act0", peer_addr=addr).send("good", b"whole")
+    assert a.recv("act0", "good", timeout=5) == b"whole"
+
+
+# ---------------------------------------------------------------------------
+# auth
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bad_token", ["WRONG", ""])
+def test_bad_or_missing_token_rejected_loudly(planes, bad_token):
+    transport_metrics.reset()
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    intruder = planes(token=bad_token)
+    with pytest.raises(TransportError, match="rejected"):
+        intruder.channel("act0", peer_addr=addr).send("t", b"sneak")
+    snap = transport_metrics.snapshot()
+    assert snap["auth_failures_total"] >= 1
+    assert a.channel("act0").poll() is None  # the frame was dropped
+
+
+# ---------------------------------------------------------------------------
+# reconnect + incarnations
+# ---------------------------------------------------------------------------
+
+
+def test_connection_drop_resends_exactly_once(planes):
+    """A dropped connection (peer process alive) reconnects with backoff
+    and resends; the accept-side tag dedup makes delivery exactly-once
+    — no loss, no duplication."""
+    transport_metrics.reset()
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b = planes(reconnect_budget_s=5)
+    ch = b.channel("c", peer_addr=addr)
+    ch.send("m1", b"one")
+    b._peer(addr).sock.close()  # simulate a network blip mid-stream
+    ch.send("m2", b"two")
+    ch.send("m2", b"two")  # an explicit resend: deduped, still ACKed
+    assert a.recv("c", "m1", timeout=5) == b"one"
+    assert a.recv("c", "m2", timeout=5) == b"two"
+    with pytest.raises(TimeoutError):
+        a.recv("c", "m2", timeout=0.2)  # no duplicate delivery
+    assert transport_metrics.snapshot()["reconnects_total"] >= 1
+
+
+def test_restarted_sender_refused_by_receiver(planes):
+    """Receiver-side boot latch: a NEW sender incarnation's message is
+    REJECTed (its send raises — the ACK is the commit point and nothing
+    committed) AND poisons the channel so recv fails loud too — data
+    can never straddle a peer restart (the PR 9 guarantee)."""
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b1 = planes(service="sender-1")
+    b1.channel("c", peer_addr=addr).send("n1", b"x")
+    assert a.recv("c", "n1", timeout=5) == b"x"
+    b2 = planes(service="sender-2")  # the restart: fresh boot id
+    with pytest.raises(TransportError, match="stale-incarnation"):
+        b2.channel("c", peer_addr=addr).send("n2", b"y")
+    with pytest.raises(TransportError, match="incarnation"):
+        a.recv("c", "n2", timeout=5)
+
+
+def test_restarted_listener_refused_by_dialer(planes):
+    """Dialer-side boot latch: reconnecting to a listener that came back
+    as a NEW incarnation is refused (the WELCOME boot echo changed)."""
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    port = addr.rsplit(":", 1)[1]
+    b = planes(reconnect_budget_s=5)
+    ch = b.channel("c", peer_addr=addr)
+    ch.send("m1", b"one")
+    a.close()
+    a2 = planes()
+    assert _wait_for(lambda: _try_listen(a2, port), timeout=10), \
+        "could not rebind the freed port"
+    with pytest.raises(TransportError, match="incarnation"):
+        ch.send("m2", b"two")
+
+
+def _try_listen(plane, port) -> bool:
+    try:
+        plane.listen(f"127.0.0.1:{port}")
+        return True
+    except OSError:
+        return False
+
+
+def test_latch_false_tolerates_restarts(planes):
+    """Control planes (latch=False): pods legitimately restart between
+    RESIZEs, so a new incarnation is accepted, not refused."""
+    a = planes(latch=False)
+    addr = a.listen("127.0.0.1:0")
+    b1 = planes(latch=False)
+    b1.channel("c", peer_addr=addr).send("n1", b"x")
+    assert a.recv("c", "n1", timeout=5) == b"x"
+    b2 = planes(latch=False)
+    b2.channel("c", peer_addr=addr).send("n2", b"y")
+    assert a.recv("c", "n2", timeout=5) == b"y"
+
+
+def test_heartbeats_flow(planes):
+    transport_metrics.reset()
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b = planes(heartbeat_s=0.05)
+    b.listen("127.0.0.1:0")  # heartbeat thread rides the listen side
+    b.channel("c", peer_addr=addr).send("t", b"x")
+    assert _wait_for(
+        lambda: transport_metrics.snapshot()["heartbeats_total"] >= 2)
+
+
+def test_plane_from_env(planes):
+    env = {"KUBEDL_TRANSPORT": "dir"}
+    assert plane_from_env(env=env) is None
+    # an empty token would be an UNAUTHENTICATED plane — refused loudly
+    with pytest.raises(ValueError, match="TOKEN"):
+        plane_from_env(env={"KUBEDL_TRANSPORT": "socket"})
+    env = {"KUBEDL_TRANSPORT": "socket", "KUBEDL_TRANSPORT_TOKEN": TOKEN,
+           "KUBEDL_TRANSPORT_BIND": "127.0.0.1:0"}
+    p = plane_from_env(service="t", env=env)
+    try:
+        assert p is not None and p.bound_addr.rsplit(":", 1)[1] != "0"
+        b = planes()
+        b.channel("c", peer_addr=p.bound_addr).send("t", b"x")
+        assert p.recv("c", "t", timeout=5) == b"x"
+    finally:
+        p.close()
+
+
+# ---------------------------------------------------------------------------
+# RESIZE control round trip: socket backend == dir backend reply schema
+# ---------------------------------------------------------------------------
+
+
+def _dir_resize_roundtrip(tmp_path):
+    """The dir-backend baseline: post a RESIZE the way the executor
+    does, answer it the way the trainer does, return the reply dict."""
+    from kubedl_tpu.train.reshard_runtime import ReshardControl
+
+    d = str(tmp_path / "ctl")
+    os.makedirs(d)
+    msg = {"type": "RESIZE", "chips": 4, "slice": "v5e-4",
+           "quiesce_timeout_s": 5.0, "reply": "reply-000001.json"}
+    with open(os.path.join(d, "msg-000001.json"), "w") as f:
+        json.dump(msg, f)
+    ctl = ReshardControl(d)
+    got = ctl.poll()
+    ctl.reply(got, outcome="ok", downtime_s=0.25, step=7)
+    with open(os.path.join(d, got["reply"])) as f:
+        return got, json.load(f)
+
+
+def test_resize_over_socket_matches_dir_reply_schema(planes, tmp_path):
+    """The acceptance pin: a RESIZE round trip over SocketChannel
+    produces the same message fields pod-side and the same reply schema
+    operator-side as the dir backend — capacity.py's polling loop
+    cannot tell the transports apart."""
+    dir_msg, dir_reply = _dir_resize_roundtrip(tmp_path)
+
+    op = planes(service="operator", latch=False)
+    op.listen("127.0.0.1:0")
+    pod = planes(service="pod", latch=False)
+    pod_addr = pod.listen("127.0.0.1:0")
+    router = SocketControlRouter(
+        op, str(tmp_path / "spool"), addr_for=lambda ns, n: pod_addr)
+    path = router.post("default", "w0", {
+        "type": "RESIZE", "chips": 4, "slice": "v5e-4",
+        "quiesce_timeout_s": 5.0})
+    assert path is not None and not os.path.exists(path)
+
+    ctl = SocketReshardControl(pod)
+    msg = None
+    deadline = time.monotonic() + 5
+    while msg is None and time.monotonic() < deadline:
+        msg = ctl.poll()
+        time.sleep(0.01)
+    assert msg is not None
+    # the pod sees the same RESIZE fields on both transports
+    for key in ("type", "chips", "slice", "quiesce_timeout_s"):
+        assert msg[key] == dir_msg[key]
+    ctl.reply(msg, outcome="ok", downtime_s=0.25, step=7)
+    assert _wait_for(lambda: os.path.exists(path))
+    with open(path) as f:
+        sock_reply = json.load(f)
+    assert sock_reply == dir_reply  # byte-for-byte schema parity
+
+    # an unreachable pod returns None — the scheduler's checkpoint path
+    router2 = SocketControlRouter(
+        op, str(tmp_path / "spool2"), addr_for=lambda ns, n: None)
+    assert router2.post("default", "gone", {"type": "RESIZE"}) is None
+
+
+# ---------------------------------------------------------------------------
+# staged-reshard block fetch
+# ---------------------------------------------------------------------------
+
+
+def _make_staging(d):
+    os.makedirs(d, exist_ok=True)
+    manifest = {"old_pods": 2, "new_pods": 1, "digest": "dg", "step": 3}
+    files = {"manifest.json": json.dumps(manifest).encode()}
+    rng = np.random.default_rng(1)
+    for pod in range(2):
+        files[f"src-{pod}.json"] = json.dumps(
+            {"digest": "dg", "step": 3}).encode()
+        files[f"src-{pod}.npz"] = rng.integers(
+            0, 256, 2048, dtype=np.uint8).tobytes()
+    for name, blob in files.items():
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(blob)
+    return files
+
+
+def test_staged_blocks_fetch_over_plane(planes, tmp_path):
+    """A restarting pod can pull a peer's published staging over the
+    plane (sha-checked per file) and run the unchanged restore_staged
+    validation against the local copy — the ckpt volume is no longer
+    the only path for the staged lane's bytes."""
+    src = str(tmp_path / "peer-staging")
+    files = _make_staging(src)
+    peer = planes(service="peer", latch=False)
+    peer_addr = peer.listen("127.0.0.1:0")
+    serve_staging(peer, src)
+
+    me = planes(service="restarter", latch=False)
+    me.listen("127.0.0.1:0")
+    dst = str(tmp_path / "local-staging")
+    assert fetch_staging(me, peer_addr, dst, timeout=10) == len(files)
+    for name, blob in files.items():
+        with open(os.path.join(dst, name), "rb") as f:
+            assert f.read() == blob
+
+    # arbitrary file names are NOT servable (the fetch protocol must not
+    # be a generic file server on the pod)
+    from kubedl_tpu.transport.blocks import _fetch_one
+
+    open(os.path.join(src, "secrets.txt"), "w").write("no")
+    assert _fetch_one(me, peer_addr, "secrets.txt", 5) is None
+    assert _fetch_one(me, peer_addr, "../secrets.txt", 5) is None
+
+    # a peer with no published staging fails loud (-> checkpoint restore)
+    empty = planes(service="empty", latch=False)
+    empty_addr = empty.listen("127.0.0.1:0")
+    serve_staging(empty, str(tmp_path / "nothing"))
+    with pytest.raises(TransportError, match="no published staging"):
+        fetch_staging(me, empty_addr, str(tmp_path / "d2"), timeout=5)
+
+
+def test_staged_fetch_refuses_corrupt_transfer(planes, tmp_path, monkeypatch):
+    """A blob whose bytes do not match the advertised sha256 (corrupted
+    in flight) is refused loudly — restore_staged never sees it."""
+    src = str(tmp_path / "peer-staging")
+    _make_staging(src)
+    peer = planes(service="peer", latch=False)
+    peer_addr = peer.listen("127.0.0.1:0")
+    serve_staging(peer, src)
+    me = planes(service="restarter", latch=False)
+    me.listen("127.0.0.1:0")
+
+    orig_recv = me.recv
+
+    def corrupting_recv(channel, tag, timeout=60.0):
+        payload = orig_recv(channel, tag, timeout)
+        hlen = int.from_bytes(payload[:4], "big")
+        if len(payload) > 4 + hlen:  # flip a blob byte, keep the header
+            body = bytearray(payload)
+            body[4 + hlen] ^= 0xFF
+            return bytes(body)
+        return payload
+
+    monkeypatch.setattr(me, "recv", corrupting_recv)
+    with pytest.raises(TransportError, match="corrupt"):
+        fetch_staging(me, peer_addr, str(tmp_path / "local"), timeout=10)
+    # nothing half-fetched was committed as a usable staging
+    assert not os.path.exists(
+        os.path.join(str(tmp_path / "local"), "manifest.json"))
+
+
+# ---------------------------------------------------------------------------
+# metrics exposition
+# ---------------------------------------------------------------------------
+
+
+def test_transport_families_render_and_debug_vars(planes):
+    from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
+
+    transport_metrics.reset()
+    a = planes()
+    addr = a.listen("127.0.0.1:0")
+    b = planes()
+    b.channel("act0", peer_addr=addr).send("t", b"payload")
+    a.recv("act0", "t", timeout=5)
+
+    rm = RuntimeMetrics()
+    rm.register_transport(transport_metrics.snapshot)
+    text = rm.render()
+    assert 'kubedl_transport_messages_total{channel="act0",dir="send"} 1' in text
+    assert 'kubedl_transport_messages_total{channel="act0",dir="recv"} 1' in text
+    assert 'kubedl_transport_bytes_total{channel="act0",dir="recv"} 7' in text
+    assert "kubedl_transport_reconnects_total 0" in text
+    assert "kubedl_transport_auth_failures_total 0" in text
+    dv = rm.debug_vars()
+    assert dv["transport"]["connects_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# in-process two-stage MPMD parity over SocketChannels
+# ---------------------------------------------------------------------------
+
+
+def test_mpmd_two_stage_parity_socket_vs_dir(tmp_path):
+    """The same two-stage MPMD step — identical init, identical tokens —
+    run once over DirChannels and once over SocketChannels must produce
+    the SAME loss (the boundary bytes are transport-opaque)."""
+    import optax
+
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.train.pipeline_runtime import runtime_from_env
+
+    config = llama.LlamaConfig.tiny(
+        use_flash=False, n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    tokens = np.random.default_rng(0).integers(
+        0, config.vocab_size, (8, 17), dtype=np.int32)
+
+    def run(env_extra):
+        base = {"KUBEDL_PP_STAGES": "2", "KUBEDL_PP_MICROBATCHES": "4"}
+        rts = [
+            runtime_from_env(
+                config, params, optax.sgd(0.0),
+                env={**base, **env_extra(stage), "KUBEDL_PP_STAGE": str(stage)})
+            for stage in (0, 1)
+        ]
+        results = [None, None]
+        errs = []
+
+        def drive(i):
+            try:
+                results[i] = rts[i].run_step(tokens)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=drive, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for rt in rts:
+            rt.close()
+        if errs:
+            raise errs[0]
+        return results[1]["loss"]
+
+    loss_dir = run(lambda s: {
+        "KUBEDL_PP_BOUNDARY_DIR": str(tmp_path / "pp")})
+
+    # socket lane: each stage listens on its own port; neighbors dial it
+    ports = []
+    for _ in range(2):
+        s = pysocket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+
+    def sock_env(stage):
+        env = {"KUBEDL_TRANSPORT": "socket",
+               "KUBEDL_TRANSPORT_TOKEN": TOKEN,
+               "KUBEDL_TRANSPORT_BIND": f"127.0.0.1:{ports[stage]}"}
+        if stage > 0:
+            env["KUBEDL_PP_PREV_ADDR"] = f"127.0.0.1:{ports[stage - 1]}"
+        if stage < 1:
+            env["KUBEDL_PP_NEXT_ADDR"] = f"127.0.0.1:{ports[stage + 1]}"
+        return env
+
+    loss_sock = run(sock_env)
+    assert loss_sock == pytest.approx(loss_dir, abs=1e-6)
+
+
+def test_runtime_from_env_socket_requires_neighbor_addrs():
+    import optax
+
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.train.pipeline_runtime import runtime_from_env
+
+    config = llama.LlamaConfig.tiny(use_flash=False, n_layers=4)
+    params = llama.init(config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="PREV_ADDR"):
+        runtime_from_env(config, params, optax.sgd(0.0), env={
+            "KUBEDL_PP_STAGE": "1", "KUBEDL_PP_STAGES": "2",
+            "KUBEDL_PP_MICROBATCHES": "4",
+            "KUBEDL_TRANSPORT": "socket",
+            "KUBEDL_TRANSPORT_BIND": "127.0.0.1:0"})
