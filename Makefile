@@ -25,7 +25,8 @@ presubmit:
 	  --total tests/test_reshard.py=45 \
 	  --total tests/test_pipeline_1f1b.py=100 \
 	  --total tests/test_obs.py=60 \
-	  --total tests/test_transport.py=60
+	  --total tests/test_transport.py=60 \
+	  --total tests/test_rl.py=150
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -67,6 +68,15 @@ bench-pp:
 .PHONY: bench-transport
 bench-transport:
 	$(PY) bench.py --transport-only
+
+# RL-only fast loop: the rl_throughput record — actor/learner fleet
+# rollout tok/s, learner step/s, weight-sync latency, and the
+# actor-starved vs learner-starved queue-wait split (merges ONLY the
+# rl_throughput key into .bench_extras.json; fleet span timeline at
+# .bench_trace/rl_fleet.jsonl).
+.PHONY: bench-rl
+bench-rl:
+	$(PY) bench.py --rl-only
 
 .PHONY: manifests
 manifests:

@@ -1321,6 +1321,112 @@ def _tpu_child(results_path: str) -> int:
             "pipelining excluded so the number is the per-hop floor")
         _emit(out, "transport_roundtrip", rec)
 
+    def rl_throughput_milestone():
+        """Actor/learner fleet throughput (docs/rl.md): the in-process
+        RLFleet (real ActorRuntime + LearnerRuntime over QueueChannels)
+        with its spans captured, so the record carries rollout tok/s,
+        learner step/s, weight-sync latency, AND the queue-wait split —
+        actor-starved vs learner-starved seconds in separate goodput
+        buckets (the ROADMAP coupling-claim evidence)."""
+        import optax  # noqa: F401 — learner builds its own tx
+
+        from kubedl_tpu.models import llama
+        from kubedl_tpu.obs.goodput import goodput
+        from kubedl_tpu.obs.trace import Tracer, trace_id_for
+        from kubedl_tpu.rl.actor import ActorConfig
+        from kubedl_tpu.rl.fleet import RLFleet, fleet_goodput_split
+        from kubedl_tpu.rl.learner import LearnerConfig
+
+        config = (llama.LlamaConfig.tiny(dtype=jnp.bfloat16) if small
+                  else llama.LlamaConfig.bench_150m(
+                      max_seq_len=512, remat=False))
+        params = llama.init(config, jax.random.PRNGKey(0))
+        B, G, P, K, steps = (2, 2, 8, 4, 2) if small else (2, 8, 64, 64, 4)
+        rng = np.random.default_rng(0)
+        prompts = [list(rng.integers(1, config.vocab_size, P))
+                   for _ in range(max(B * 4, 8))]
+
+        def reward(prompt_ids, completion_ids):
+            if not completion_ids:
+                return 0.0
+            return sum(1 for t in completion_ids if t == 5) / len(
+                completion_ids)
+
+        trace_dir = os.path.join(REPO, ".bench_trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        fleet_trace = os.path.join(trace_dir, "rl_fleet.jsonl")
+        open(fleet_trace, "w").close()
+        tracer = Tracer(service="bench-rl-fleet",
+                        trace_id=trace_id_for("bench", "rl"),
+                        export_path=fleet_trace)
+        fleet = RLFleet(
+            params, config, prompts, reward,
+            ActorConfig(seed=0, group_size=G, prompts_per_step=B,
+                        max_new_tokens=K, temperature=1.0,
+                        max_weight_lag=1),
+            LearnerConfig(prompts_per_step=B, group_size=G,
+                          max_weight_lag=1, lr=1e-6,
+                          take_timeout_s=600.0),
+            n_actors=1, tracer=tracer)
+        t0 = time.perf_counter()
+        stats = fleet.run(steps)
+        wall = time.perf_counter() - t0
+        split = fleet_goodput_split(stats, fleet.actors)
+        gp = goodput(tracer.spans())
+        # second regime: strict on-policy lockstep (maxWeightLag=0) —
+        # the actor PARKS for every new version, so the waiting time
+        # flips into the learner_starved bucket; together the two
+        # records show the split distinguishing actor-bound from
+        # learner-bound fleets
+        fleet2 = RLFleet(
+            params, config, prompts, reward,
+            ActorConfig(seed=1, group_size=G, prompts_per_step=B,
+                        max_new_tokens=K, temperature=1.0,
+                        max_weight_lag=0, lockstep=True),
+            LearnerConfig(prompts_per_step=B, group_size=G,
+                          max_weight_lag=0, lr=1e-6,
+                          take_timeout_s=600.0),
+            n_actors=1, tracer=tracer)
+        stats2 = fleet2.run(steps)
+        split2 = fleet_goodput_split(stats2, fleet2.actors)
+        tracer.close()
+        rec = {
+            "rollout_tokens_per_sec": round(
+                split["rollout_tokens"] / max(split["rollout_s"], 1e-9), 0),
+            "learner_steps_per_sec": round(
+                stats.steps / max(split["learn_s"], 1e-9), 3),
+            "learner_step_s": round(
+                split["learn_s"] / max(stats.steps, 1), 4),
+            "weight_sync_latency_s": round(
+                split["weight_sync_s"] / max(stats.steps, 1), 5),
+            "queue_wait_split": {
+                "actor_starved_s": split["actor_starved_s"],
+                "learner_starved_s": split["learner_starved_s"],
+            },
+            "queue_wait_split_lockstep": {
+                "actor_starved_s": split2["actor_starved_s"],
+                "learner_starved_s": split2["learner_starved_s"],
+                "max_weight_lag_observed": split2[
+                    "max_weight_lag_observed"],
+            },
+            "goodput_buckets": {
+                k: gp["buckets"].get(k, 0.0)
+                for k in ("rollout", "steps", "actor_starved",
+                          "learner_starved", "weight_sync")},
+            "stale_dropped": split["stale_dropped"],
+            "max_weight_lag_observed": split["max_weight_lag_observed"],
+            "wall_s": round(wall, 3),
+            "batch": B, "group": G, "prompt_len": P, "new_tokens": K,
+            "learner_steps": stats.steps,
+            "fleet_trace_jsonl": os.path.relpath(fleet_trace, REPO),
+            "environment": (
+                "in-process fleet (1 actor + learner threads sharing the "
+                "host devices, QueueChannels) — protocol and starvation "
+                "accounting are real, device contention is not the pod "
+                "topology's"),
+        }
+        _emit(out, "rl_throughput", rec)
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -1338,6 +1444,7 @@ def _tpu_child(results_path: str) -> int:
         ("pipeline_schedule", pipeline_schedule_milestone, 150),
         ("transport_roundtrip", transport_roundtrip_milestone, 60),
         ("grpo", grpo_milestone, 150),
+        ("rl_throughput", rl_throughput_milestone, 200),
     ]
     # -- 6. MoE dispatch-overhead breakdown: per-stage timing of the
     # dropless hot path (models/moe.py stages) so a moe_mfu move is
@@ -1710,6 +1817,16 @@ def _transport_only() -> int:
         merge_keys=("transport_roundtrip",))
 
 
+def _rl_only() -> int:
+    """`bench.py --rl-only` (make bench-rl): ONLY the rl_throughput
+    record — rollout tok/s, learner step/s, weight-sync latency, and the
+    actor-starved vs learner-starved queue-wait split, merged into
+    .bench_extras.json with the paired .bench_trace/rl.jsonl lane spans
+    AND the fleet's own .bench_trace/rl_fleet.jsonl span timeline."""
+    return _single_lane(
+        "rl", ("rl_throughput",), merge_keys=("rl_throughput",))
+
+
 def main() -> int:
     if len(sys.argv) > 2 and sys.argv[1] == "--tpu-child":
         return _tpu_child(sys.argv[2])
@@ -1723,6 +1840,8 @@ def main() -> int:
         return _pipeline_only()
     if "--transport-only" in sys.argv:
         return _transport_only()
+    if "--rl-only" in sys.argv:
+        return _rl_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

@@ -41,6 +41,10 @@ LABEL_SLICE_ID = "kubedl-tpu.io/slice-id"
 # ("prefill" | "decode"); workloads/jaxjob.py stamps it, server.py's
 # /serving/fleet endpoint groups by it, and the router drains by it.
 LABEL_SERVING_ROLE = "kubedl-tpu.io/serving-role"
+# RL fleet: a pod's role in an actor/learner JAXJob ("actor" |
+# "learner"); workloads/jaxjob.py stamps it by worker index (actors
+# first), matching the mixed-role gang's slice order.
+LABEL_RL_ROLE = "kubedl-tpu.io/rl-role"
 # Drain request: the operator (POST /serving/drain) annotates the pod;
 # the pod's router loop notices and migrates its streams.
 ANNOTATION_SERVING_DRAIN = "kubedl-tpu.io/serving-drain"

@@ -359,18 +359,47 @@ def cmd_top(args) -> int:
         print()
     gp = vars_.get("goodput")
     if gp and gp.get("jobs"):
-        rows = [("JOB", "GOODPUT", "WALL_S", "STEPS_S", "QUEUE_S", "INIT_S",
-                 "CKPT_S", "RESHARD_S", "EVICT_S", "OTHER_S")]
+        # RL-fleet columns render only when some job has them — the
+        # table stays narrow for training/serving-only operators
+        has_rl = any(
+            (rec.get("buckets") or {}).get(k)
+            for rec in gp["jobs"].values()
+            for k in ("rollout", "actor_starved", "learner_starved",
+                      "weight_sync"))
+        header = ["JOB", "GOODPUT", "WALL_S", "STEPS_S", "QUEUE_S", "INIT_S",
+                  "CKPT_S", "RESHARD_S", "EVICT_S"]
+        if has_rl:
+            header += ["ROLLOUT_S", "ASTARVE_S", "LSTARVE_S", "WSYNC_S"]
+        rows = [tuple(header + ["OTHER_S"])]
         for job, rec in sorted(gp["jobs"].items()):
             b = rec.get("buckets") or {}
-            rows.append((
+            row = [
                 job, f"{rec.get('ratio', 0.0):.0%}",
                 f"{rec.get('wall_s', 0.0):.2f}",
                 f"{b.get('steps', 0.0):.2f}", f"{b.get('queue_wait', 0.0):.2f}",
                 f"{b.get('init_compile', 0.0):.2f}",
                 f"{b.get('checkpoint', 0.0):.2f}",
                 f"{b.get('reshard', 0.0):.2f}", f"{b.get('eviction', 0.0):.2f}",
-                f"{b.get('other', 0.0):.2f}",
+            ]
+            if has_rl:
+                row += [f"{b.get('rollout', 0.0):.2f}",
+                        f"{b.get('actor_starved', 0.0):.2f}",
+                        f"{b.get('learner_starved', 0.0):.2f}",
+                        f"{b.get('weight_sync', 0.0):.2f}"]
+            rows.append(tuple(row + [f"{b.get('other', 0.0):.2f}"]))
+        _print_table(rows)
+        print()
+    rl = vars_.get("rl")
+    if rl and rl.get("jobs"):
+        rows = [("RL_JOB", "QUEUE", "WLAG", "PRODUCED", "CONSUMED",
+                 "STALE_DROP", "STEPS", "STEP_MS", "LOSS")]
+        for job, rec in sorted(rl["jobs"].items()):
+            rows.append((
+                job, rec.get("queue_depth", 0), rec.get("weight_lag", 0),
+                rec.get("produced", 0), rec.get("consumed", 0),
+                rec.get("stale_dropped", 0), rec.get("learn_steps", 0),
+                f"{rec.get('learn_step_s', 0.0) * 1e3:.1f}",
+                (f"{rec['loss']:.4f}" if "loss" in rec else "-"),
             ))
         _print_table(rows)
         print()

@@ -198,6 +198,37 @@ def pipeline_neighbor_env(
     }
 
 
+def rl_fleet_env(
+    role: str,
+    index: int,
+    n_actors: int,
+    learner_addr: str = "",
+    actor_addrs: str = "",
+) -> Dict[str, str]:
+    """Env wiring for one RL-fleet pod: its role, which actor it is, and
+    the transport addresses of its peers — actors dial ONLY the learner
+    (trajectories), the learner dials every actor (weight broadcast); a
+    hub-and-spoke, not a mesh (the Sebulba topology: PAPERS.md,
+    Podracer). `index` is the pod's worker index; actors occupy
+    [0, n_actors), so an actor's KUBEDL_RL_ACTOR_INDEX is its worker
+    index and the learner carries -1. The JAXJob controller fills the
+    addrs from the peer pods' worker services (workloads/jaxjob.py
+    set_cluster_spec); the local executor's DirChannel lane ignores
+    them and rides KUBEDL_RL_QUEUE_DIR."""
+    if role not in ("actor", "learner"):
+        raise ValueError(f"RL role must be actor|learner, got {role!r}")
+    if role == "actor" and not (0 <= index < n_actors):
+        raise ValueError(
+            f"actor index {index} out of range [0, {n_actors})")
+    return {
+        "KUBEDL_RL_ROLE": role,
+        "KUBEDL_RL_ACTORS": str(n_actors),
+        "KUBEDL_RL_ACTOR_INDEX": str(index if role == "actor" else -1),
+        "KUBEDL_RL_LEARNER_ADDR": learner_addr if role == "actor" else "",
+        "KUBEDL_RL_ACTOR_ADDRS": actor_addrs if role == "learner" else "",
+    }
+
+
 @dataclass
 class SliceInfo:
     """One physical slice in the pool."""

@@ -56,6 +56,11 @@ class GangSnapshot:
     # slice i of the reservation must match stage_slices[i]; admission
     # stays all-or-nothing across the whole per-stage assignment
     stage_slices: List[str] = field(default_factory=list)
+    # mixed-ROLE gang (JAXJob spec.rl): roles[i] names what slice i runs
+    # ("actor" | "learner"); the shapes ride stage_slices, so the actor
+    # gang and learner gang admit as ONE all-or-nothing unit — an actor
+    # fleet without a learner (or vice versa) reserves nothing
+    roles: List[str] = field(default_factory=list)
     slice_names: List[str] = field(default_factory=list)
     reserved_chips: int = 0
     hold_until: float = 0.0  # monotonic; 0 = not held

@@ -106,6 +106,10 @@ class _GangState:
     # len == num_slices): slice i of the reservation is STAGE i's and
     # must match stage_slices[i]; empty = homogeneous (requested_slice)
     stage_slices: List[str] = field(default_factory=list)
+    # mixed-role RL gang (JAXJob spec.rl): roles[i] labels slice i
+    # ("actor" | "learner"); the per-role shapes ride stage_slices so
+    # the actor and learner gangs admit as one all-or-nothing unit
+    roles: List[str] = field(default_factory=list)
     hold_until: float = 0.0  # monotonic; preemption backoff — no reserving before
     preemptions: int = 0  # times this gang was evicted by directive
     waiting_since: float = 0.0  # monotonic; when the gang last lost/lacked slices
@@ -329,6 +333,7 @@ class TPUSliceAdmitter(GangScheduler):
                 # are dropped here so the admitter never wedges on them)
                 pipe = getattr(job.spec, "pipeline", None)
                 stage_slices: List[str] = []
+                roles: List[str] = []
                 if (pipe is not None and getattr(pipe, "mpmd", False)
                         and getattr(pipe, "stage_slices", None)):
                     cand = [str(s) for s in pipe.stage_slices]
@@ -339,6 +344,31 @@ class TPUSliceAdmitter(GangScheduler):
                             stage_slices = cand
                     except ValueError:
                         stage_slices = []
+                # mixed-ROLE RL gang (JAXJob spec.rl): per-role shapes
+                # ride the same hetero machinery as stageSlices — one
+                # distinct slice per entry, STAGE-ordered (actors first,
+                # matching the pod slice-id labels), all-or-nothing: an
+                # actor fleet without a learner slice reserves NOTHING
+                # (a feasible-but-blocked fleet still shields its
+                # matching slices; an infeasible one shields nothing).
+                # Validated at submit; unparseable or ragged specs are
+                # dropped here so the admitter never wedges on them
+                rl = getattr(job.spec, "rl", None)
+                if (rl is not None and getattr(rl, "actor_slice", "")
+                        and getattr(rl, "learner_slice", "")):
+                    n_act = int(getattr(rl, "actor_replicas", 0) or 0)
+                    n_lrn = int(getattr(rl, "learner_replicas", 0) or 0)
+                    cand = ([str(rl.actor_slice)] * n_act
+                            + [str(rl.learner_slice)] * n_lrn)
+                    try:
+                        for s in cand:
+                            parse_slice_type(s)
+                        if cand and len(cand) == num_slices:
+                            stage_slices = cand
+                            roles = (["actor"] * n_act
+                                     + ["learner"] * n_lrn)
+                    except ValueError:
+                        pass
                 self._seq += 1
                 state = _GangState(
                     min_member=min_member, tpu_chips=chips,
@@ -349,6 +379,7 @@ class TPUSliceAdmitter(GangScheduler):
                     tenant=(tenancy.tenant if tenancy else "") or "default",
                     admissible_slices=admissible,
                     stage_slices=stage_slices,
+                    roles=roles,
                     waiting_since=time.monotonic(),
                     live_reshard=bool(getattr(elastic, "live_reshard", False)),
                     quiesce_s=float(
@@ -909,6 +940,7 @@ class TPUSliceAdmitter(GangScheduler):
             requested_slice=state.requested_slice,
             admissible_slices=list(state.admissible_slices),
             stage_slices=list(state.stage_slices),
+            roles=list(state.roles),
             slice_names=list(state.slice_names),
             reserved_chips=sum(
                 self._slices[s].type.chips

@@ -116,6 +116,8 @@ class RuntimeMetrics:
         self._goodput: Optional[Callable[[], Dict]] = None
         # transport-plane snapshot callable (transport_metrics.snapshot)
         self._transport: Optional[Callable[[], Dict]] = None
+        # RL-fleet snapshot callable (rl_metrics.snapshot)
+        self._rl: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -168,6 +170,13 @@ class RuntimeMetrics:
         (per-channel message/byte counters, reconnects, auth failures)."""
         with self._lock:
             self._transport = snapshot_fn
+
+    def register_rl(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns rl_metrics.snapshot()-shaped dicts
+        (per-job trajectory queue depth, weight lag, produced/consumed/
+        stale-dropped counters)."""
+        with self._lock:
+            self._rl = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -458,6 +467,36 @@ class RuntimeMetrics:
                     lines.append(f"# HELP {metric} {help_}")
                     lines.append(f"# TYPE {metric} counter")
                     lines.append(sample(metric, tp.get(key, 0)))
+        with self._lock:
+            rl_fn = self._rl
+        if rl_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                rl = rl_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                rl = None
+            if rl is not None and rl.get("jobs"):
+                jobs = sorted(rl["jobs"].items())
+                for metric, key, mtype, help_ in (
+                    ("kubedl_rl_trajectory_queue_depth", "queue_depth",
+                     "gauge", "Trajectory groups produced but not yet "
+                     "consumed (RL fleet)"),
+                    ("kubedl_rl_weight_lag_steps", "weight_lag", "gauge",
+                     "Weight versions between the learner and the last "
+                     "consumed trajectory"),
+                    ("kubedl_rl_trajectories_produced_total", "produced",
+                     "counter", "Trajectory groups emitted by actors"),
+                    ("kubedl_rl_trajectories_consumed_total", "consumed",
+                     "counter", "Trajectory groups folded into updates"),
+                    ("kubedl_rl_trajectories_stale_dropped_total",
+                     "stale_dropped", "counter",
+                     "Trajectory groups dropped past maxWeightLag"),
+                ):
+                    lines.append(f"# HELP {metric} {help_}")
+                    lines.append(f"# TYPE {metric} {mtype}")
+                    for job, rec in jobs:
+                        lines.append(sample(metric, rec.get(key, 0),
+                                            {"job": job}))
         return "\n".join(lines) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -484,6 +523,12 @@ class RuntimeMetrics:
             steps_fn = self._steps
             goodput_fn = self._goodput
             transport_fn = self._transport
+            rl_fn = self._rl
+        if rl_fn is not None:
+            try:
+                out["rl"] = rl_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["rl"] = None
         if pipe_fn is not None:
             try:
                 out["pipeline"] = pipe_fn()  # outside the lock, see render()

@@ -641,6 +641,7 @@ def generate(
     key: Optional[jax.Array] = None,
     lengths: Optional[jax.Array] = None,  # [b] unpadded prompt lengths
     kv_dtype: Optional[str] = None,  # None (model dtype) | "int8"
+    with_logprobs: bool = False,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled continuation: [b, max_new_tokens].
 
@@ -649,7 +650,17 @@ def generate(
     `lengths` the batch is uniform and the cache takes the scalar-length
     fast path (single-slice writes instead of per-row scatters).
     kv_dtype="int8" halves KV-cache memory and read traffic (per-position
-    scales fold exactly into the attention einsums)."""
+    scales fold exactly into the attention einsums).
+
+    with_logprobs=True also returns [b, max_new_tokens] f32 behavior
+    log-probs of each emitted token under the model's UNTEMPERED
+    distribution (log_softmax of the raw logits — the same convention as
+    train/preference.sequence_logprobs and the serving engines'
+    chosen_logprob), captured from the logits that sampled the token.
+    They are free at sample time — one gather next to the sampling op —
+    where recomputing them later costs a full forward; the RL actor
+    runtime ships them with each trajectory and train/rl.py's recompute
+    stays as the parity oracle (pinned in tests/test_rl.py)."""
     b, t = prompt.shape
     max_len = max_len or (t + max_new_tokens)
     cache = init_kv_cache(
@@ -667,12 +678,21 @@ def generate(
     def body(carry, k):
         logits, cache = carry
         tok = pick(logits, k).astype(jnp.int32)
+        ys = tok
+        if with_logprobs:  # static flag: the lp gather exists only when asked
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                tok[:, None], axis=-1)[:, 0]
+            ys = (tok, lp)
         logits, cache = decode_step(params, tok, cache, config)
-        return (logits, cache), tok
+        return (logits, cache), ys
 
     keys = jax.random.split(key, max_new_tokens)
-    (_, _), toks = jax.lax.scan(body, (logits, cache), keys)
-    return toks.T  # [b, max_new_tokens]
+    (_, _), ys = jax.lax.scan(body, (logits, cache), keys)
+    if with_logprobs:
+        toks, lps = ys
+        return toks.T, lps.T  # [b, max_new_tokens] each
+    return ys.T  # [b, max_new_tokens]
 
 
 def generate_speculative(

@@ -12,10 +12,25 @@ breakdown sums to wall time exactly:
   reshard       RESIZE ladder rungs (live / staged / fallback), both planes
   checkpoint    Orbax save/restore stalls
   init_compile  process bootstrap + first-step XLA compile
+  actor_starved   RL fleet: learner waiting on an empty trajectory queue
+  learner_starved RL fleet: actors parked waiting for a weight broadcast
+  weight_sync   RL fleet: weight broadcast publish/adopt time
+  rollout       RL fleet: actor generation time (rl.rollout spans)
   steps         productive train-step time — the goodput numerator
+                (rl.learn, the learner's update, lands here)
   other         window time no span covers (process spawn, scheduler gaps)
 
 ``kubedl_goodput_ratio{job}`` = steps / wall.
+
+The RL starvation buckets sit ABOVE rollout/steps in precedence on
+purpose: an actor/learner fleet runs two concurrent planes on one
+timeline, and the coupling evidence is exactly the instant where one
+side waits — a learner starving WHILE actors are mid-rollout is
+actor-starved time (the actors can't keep up), an actor parked for
+weights while the learner is mid-step is learner-starved time. A fleet
+whose wall time pools in actor_starved needs more/faster actors; one
+pooling in learner_starved needs a faster learner — distinguishable
+buckets, which is the ROADMAP item's acceptance evidence.
 """
 from __future__ import annotations
 
@@ -26,9 +41,11 @@ from typing import Dict, List, Optional
 from kubedl_tpu.obs.trace import job_trace_dir, load_spans
 
 # attribution precedence, highest first: an instant covered by several
-# categories lands in the earliest listed one
+# categories lands in the earliest listed one (see module docstring for
+# why the RL starvation buckets outrank rollout/steps)
 BUCKETS = ("queue_wait", "eviction", "reshard", "checkpoint",
-           "init_compile", "steps")
+           "init_compile", "actor_starved", "learner_starved",
+           "weight_sync", "rollout", "steps")
 OTHER = "other"
 
 
@@ -44,7 +61,16 @@ def classify(span: Dict) -> Optional[str]:
         return "checkpoint"
     if name in ("trainer.init", "train.compile"):
         return "init_compile"
-    if name in ("train.step", "pipeline.step"):
+    if name == "rl.idle":
+        cause = attrs.get("cause", "")
+        if cause in ("actor_starved", "learner_starved"):
+            return cause
+        return None
+    if name == "rl.weight_sync":
+        return "weight_sync"
+    if name == "rl.rollout":
+        return "rollout"
+    if name in ("train.step", "pipeline.step", "rl.learn"):
         return "steps"
     return None
 

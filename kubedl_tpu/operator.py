@@ -127,6 +127,12 @@ class Operator:
         from kubedl_tpu.transport.metrics import transport_metrics
 
         self.runtime_metrics.register_transport(transport_metrics.snapshot)
+        # RL-fleet health (kubedl_rl_*): actor/learner runtimes feed the
+        # module singleton; register unconditionally (renders nothing
+        # until an RL job reports)
+        from kubedl_tpu.rl.metrics import rl_metrics
+
+        self.runtime_metrics.register_rl(rl_metrics.snapshot)
         # flight recorder (docs/observability.md): control-plane tracer
         # routing spans into per-job dirs under trace_root, plus the
         # goodput accountant that folds those dirs into

@@ -1,0 +1,302 @@
+"""Actor runtime — batched rollouts on a dedicated slice.
+
+One actor = one generation loop: pull the newest broadcast weights at
+the GENERATION BOUNDARY (never mid-trajectory — every trajectory is
+sampled under exactly one policy version, stamped on it), roll out G
+completions per prompt with the behavior log-probs captured at sample
+time, score them with the reward, and emit each group as an
+exactly-once trajectory.
+
+Rollout engines:
+  * "decode" (default) — jitted models/decode.generate(with_logprobs):
+    one compiled dispatch per rollout batch, numerically the monolithic
+    train/grpo.py path (the learner-parity pin rides this);
+  * "serving" — serving/rollout.RolloutEngine over the paged-KV
+    DisaggregatedEngine: the group's G members SHARE their prompt K/V
+    through COW prefix sharing (the serving plane reused for rollouts).
+
+Off-policy guard: after ``max_weight_lag + 1`` generations at one
+version the actor PARKS until the next broadcast (rl.idle
+cause=learner_starved) — trajectories past the learner's staleness
+bound would be dropped on arrival, so generating them is pure waste.
+``lockstep=True`` (n_actors == 1) instead waits for version ``it - 1``
+before iteration ``it``: strictly on-policy, the exact schedule of the
+monolithic loop — the parity oracle configuration.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from kubedl_tpu.rl.metrics import rl_metrics
+from kubedl_tpu.rl.trajectory import Trajectory, TrajectoryProducer
+from kubedl_tpu.rl.weights import WeightReceiver
+
+
+@dataclass
+class ActorConfig:
+    actor_index: int = 0
+    n_actors: int = 1
+    seed: int = 0
+    group_size: int = 8
+    prompts_per_step: int = 4     # groups emitted per iteration
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    eos_id: int = -1              # >= 0: completions end at first occurrence
+    max_weight_lag: int = 1
+    lockstep: bool = False        # strict on-policy (parity oracle config)
+    engine: str = "decode"        # decode | serving
+    job: str = "rl"
+    weight_wait_s: float = 120.0  # park budget before failing loud
+
+    @property
+    def actor_id(self) -> str:
+        return f"actor-{self.actor_index}"
+
+
+class ActorRuntime:
+    """The rollout half of the fleet; see module docstring."""
+
+    def __init__(
+        self,
+        params,
+        config,
+        cfg: ActorConfig,
+        prompts: List[List[int]],
+        reward_fn: Callable[[list, list], float],
+        producer: TrajectoryProducer,
+        receiver: Optional[WeightReceiver] = None,
+        tracer=None,
+    ) -> None:
+        import jax
+
+        if cfg.temperature <= 0:
+            raise ValueError("actor temperature must be > 0 (greedy "
+                             "rollouts collapse every group)")
+        if cfg.group_size < 2:
+            raise ValueError("group_size must be >= 2 (the group mean is "
+                             "the baseline)")
+        if not prompts:
+            raise ValueError("actor needs >= 1 prompt")
+        self.config = config
+        self.cfg = cfg
+        self.prompts = prompts
+        self.reward_fn = reward_fn
+        self.producer = producer
+        self.receiver = receiver
+        self.tracer = tracer
+        self.weight_version = 0   # version the NEXT rollout samples from
+        self._gens_at_version = 0
+        self.tokens_generated = 0
+        self.rollout_s_total = 0.0
+        self.learner_starved_s = 0.0  # time parked waiting for weights
+        self._params = jax.tree.map(jax.numpy.asarray, params)
+        self._treedef = jax.tree_util.tree_structure(self._params)
+        self.pad_to = max(len(p) for p in prompts)
+        self._uniform = len({len(p) for p in prompts}) == 1
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        if cfg.engine == "serving":
+            from kubedl_tpu.serving.rollout import RolloutEngine
+
+            slots = cfg.group_size * cfg.prompts_per_step
+            self._serving = RolloutEngine(
+                self._params, config, slots=slots,
+                max_len=self.pad_to + cfg.max_new_tokens,
+                temperature=cfg.temperature,
+                # per-actor sampling stream, like _sample_key's fold —
+                # same-seed engines on two actors would emit duplicate
+                # groups whenever their prompt picks collide
+                seed=cfg.seed + cfg.actor_index)
+        elif cfg.engine == "decode":
+            self._serving = None
+            from kubedl_tpu.models import decode
+
+            K, temp = cfg.max_new_tokens, cfg.temperature
+
+            def _roll(p, toks, lengths, key):
+                return decode.generate(
+                    p, toks, config, K, temperature=temp, key=key,
+                    lengths=lengths, with_logprobs=True)
+
+            def _roll_uniform(p, toks, key):
+                return decode.generate(
+                    p, toks, config, K, temperature=temp, key=key,
+                    with_logprobs=True)
+
+            self._roll = jax.jit(_roll)
+            self._roll_uniform = jax.jit(_roll_uniform)
+        else:
+            raise ValueError(
+                f"unknown rollout engine {cfg.engine!r} (decode | serving)")
+
+    # -- weight sync -----------------------------------------------------
+
+    def _adopt(self, got) -> None:
+        import jax
+
+        leaves, version, _step = got
+        self._params = jax.tree_util.tree_unflatten(
+            self._treedef,
+            [jax.numpy.asarray(leaf) for leaf in leaves])
+        if self._serving is not None:
+            self._serving.swap_params(self._params)
+        self.weight_version = version
+        self._gens_at_version = 0
+
+    def _trace(self, name: str, dur: float, **attrs) -> None:
+        if self.tracer is not None:
+            try:
+                self.tracer.record(name, duration_s=dur,
+                                   actor=self.cfg.actor_id, **attrs)
+            except Exception:  # noqa: BLE001 — tracing never blocks rollouts
+                pass
+
+    def _sync_weights(self, it: int) -> None:
+        """Generation-boundary pull; parks when the off-policy guard (or
+        lockstep) demands a version that has not arrived yet."""
+        if self.receiver is None:
+            return
+        t0 = time.perf_counter()
+        got = self.receiver.poll(timeout=0.0)
+        if got is not None:
+            self._adopt(got)
+            self._trace("rl.weight_sync", time.perf_counter() - t0,
+                        side="actor", version=self.weight_version)
+        need = 0
+        if self.cfg.lockstep:
+            # strict on-policy: iteration it samples from the params
+            # after it-1 learner updates (the monolithic schedule)
+            need = it - 1
+        elif self._gens_at_version > self.cfg.max_weight_lag:
+            need = self.weight_version + 1
+        if self.receiver.version < need:
+            t0 = time.perf_counter()
+            got = self.receiver.wait_for(need, timeout=self.cfg.weight_wait_s)
+            waited = time.perf_counter() - t0
+            self.learner_starved_s += waited
+            self._trace("rl.idle", waited, cause="learner_starved",
+                        side="actor", waiting_for_version=need)
+            if got is not None:
+                t0 = time.perf_counter()
+                self._adopt(got)
+                self._trace("rl.weight_sync", time.perf_counter() - t0,
+                            side="actor", version=self.weight_version)
+
+    # -- rollouts --------------------------------------------------------
+
+    def _pick_prompts(self, it: int) -> np.ndarray:
+        """Prompt picks derive from the STEP index (and actor index when
+        the fleet has several) — the monolithic grpo.py discipline, so a
+        single-actor fleet replays the exact monolith data schedule."""
+        derive = ((self.cfg.seed, it) if self.cfg.n_actors == 1
+                  else (self.cfg.seed, self.cfg.actor_index, it))
+        rng = np.random.default_rng(derive)
+        B = self.cfg.prompts_per_step
+        return rng.choice(len(self.prompts), size=B,
+                          replace=len(self.prompts) < B)
+
+    def _sample_key(self, it: int):
+        import jax
+
+        key = self._base_key
+        if self.cfg.n_actors > 1:
+            key = jax.random.fold_in(key, 1000 + self.cfg.actor_index)
+        return jax.random.fold_in(key, it)
+
+    def _generate(self, tiled: np.ndarray, tiled_plens: np.ndarray, it: int):
+        """[(B*G), K] completions + sampling-time logprobs."""
+        import jax.numpy as jnp
+
+        if self._serving is not None:
+            B, G = self.cfg.prompts_per_step, self.cfg.group_size
+            prompts = [list(tiled[i * G][:tiled_plens[i * G]])
+                       for i in range(B)]
+            waves = self._serving.rollout(
+                prompts, G, self.cfg.max_new_tokens,
+                eos_id=self.cfg.eos_id if self.cfg.eos_id >= 0 else None)
+            K = self.cfg.max_new_tokens
+            comp = np.zeros((B * G, K), np.int32)
+            lps = np.zeros((B * G, K), np.float32)
+            for b, grp in enumerate(waves):
+                for g, (toks, lp) in enumerate(grp):
+                    row = b * G + g
+                    comp[row, :len(toks)] = toks
+                    lps[row, :len(lp)] = lp
+            return comp, lps
+        key = self._sample_key(it)
+        if self._uniform:
+            toks, lps = self._roll_uniform(
+                self._params, jnp.asarray(tiled), key)
+        else:
+            toks, lps = self._roll(
+                self._params, jnp.asarray(tiled),
+                jnp.asarray(tiled_plens), key)
+        return np.asarray(toks), np.asarray(lps)
+
+    def step(self, it: int) -> List[Trajectory]:
+        """One iteration: sync weights, roll B groups, emit trajectories."""
+        self._sync_weights(it)
+        B, G, K = (self.cfg.prompts_per_step, self.cfg.group_size,
+                   self.cfg.max_new_tokens)
+        pick = self._pick_prompts(it)
+        batch_prompts = [self.prompts[i] for i in pick]
+        plens = np.array([len(p) for p in batch_prompts], np.int32)
+        toks = np.zeros((B, self.pad_to), np.int32)
+        for i, p in enumerate(batch_prompts):
+            toks[i, :len(p)] = p
+        tiled = np.repeat(toks, G, axis=0)
+        tiled_plens = np.repeat(plens, G)
+        t0 = time.perf_counter()
+        comp, lps = self._generate(tiled, tiled_plens, it)
+        rollout_s = time.perf_counter() - t0
+        self.rollout_s_total += rollout_s
+        self.tokens_generated += int(comp.size)
+        rl_metrics.observe_rollout(
+            self.cfg.job, comp.size / max(rollout_s, 1e-9))
+        self._trace("rl.rollout", rollout_s, groups=B,
+                    tokens=int(comp.size), version=self.weight_version)
+        self._gens_at_version += 1
+
+        out: List[Trajectory] = []
+        T = self.pad_to + K
+        for b in range(B):
+            pl = int(plens[b])
+            full = np.zeros((G, T), np.int32)
+            seq_lens = np.zeros(G, np.int32)
+            rewards = np.zeros(G, np.float32)
+            grid = np.zeros((G, T - 1), np.float32)
+            for g in range(G):
+                row = b * G + g
+                c = comp[row]
+                if self.cfg.eos_id >= 0:
+                    hits = np.nonzero(c == self.cfg.eos_id)[0]
+                    # reward sees the text BEFORE the stop token;
+                    # training keeps the stop token itself (emitting EOS
+                    # is a creditable action — the grpo.py discipline)
+                    gen = c[: hits[0]] if len(hits) else c
+                    train_c = c[: hits[0] + 1] if len(hits) else c
+                else:
+                    gen = train_c = c
+                m = len(train_c)
+                full[g, :pl] = tiled[row, :pl]
+                full[g, pl:pl + m] = train_c
+                seq_lens[g] = pl + m
+                rewards[g] = self.reward_fn(
+                    list(tiled[row, :pl]), list(gen))
+                # sequence_logprobs grid: index i holds log p(token i+1)
+                grid[g, pl - 1:pl - 1 + m] = lps[row, :m]
+            traj = Trajectory(
+                tokens=full, prompt_len=pl, seq_lens=seq_lens,
+                rewards=rewards, behavior_logprobs=grid,
+                weight_version=self.weight_version,
+                rollout_s=rollout_s / B, step_hint=it)
+            self.producer.send(traj)
+            out.append(traj)
+        return out
+
+    def run(self, steps: int, start: int = 1) -> None:
+        for it in range(start, start + steps):
+            self.step(it)

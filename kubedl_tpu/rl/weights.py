@@ -1,0 +1,134 @@
+"""Versioned weight broadcast — learner -> actor fleet.
+
+The learner publishes its policy after (every ``broadcast_interval``)
+update steps as a version-stamped record: the param tree's leaves in
+``jax.tree_util.tree_flatten`` order, each with its dtype string and
+shape recorded, payload raw-uint8 (rl/wire.py) — bf16 params cross the
+socket hop BYTE-identically, pinned in tests. The receiver unflattens
+against its OWN treedef (actor and learner build the same model config),
+so no pytree structure ever travels.
+
+Versions are sequential from 1 and the delivery tag is deterministic
+(``w.{version:08d}``), so the receiver always knows the next message to
+look for: ``poll()`` drains every already-arrived version and decodes
+only the NEWEST (intermediate payloads are skipped bytes, not skipped
+messages — exactly-once delivery is preserved, decode work is not
+wasted on stale versions). Broadcast channels keep the plane's
+boot-id latch: a restarted learner's weights are refused loudly rather
+than silently adopted mid-stream (the PR 11 stale-incarnation
+guarantee) — the gang restarts from checkpoint instead.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from kubedl_tpu.rl.wire import decode_arrays, encode_arrays
+
+WEIGHT_CHANNEL = "rl-weights"
+
+
+def encode_weights(params, version: int, step: int = 0) -> bytes:
+    """Flattened-leaf record of one policy version. Leaves are named by
+    their flatten index — order IS the contract (tree_flatten is
+    deterministic for a fixed structure)."""
+    if version < 1:
+        raise ValueError(f"weight version must be >= 1, got {version}")
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        raise ValueError("empty param tree")
+    arrays = [(f"leaf{i:05d}", np.asarray(leaf))
+              for i, leaf in enumerate(leaves)]
+    return encode_arrays(
+        arrays, meta={"version": int(version), "step": int(step),
+                      "n_leaves": len(leaves)})
+
+
+def decode_weights(data: bytes) -> Tuple[List[np.ndarray], int, int]:
+    """(leaves in flatten order, version, step). Unflatten with the
+    receiver's own treedef:
+    ``jax.tree_util.tree_unflatten(treedef, leaves)``."""
+    arrays, meta = decode_arrays(data)
+    leaves = list(arrays.values())  # decode preserves header order
+    if len(leaves) != int(meta.get("n_leaves", -1)):
+        raise ValueError(
+            f"weight record leaf count mismatch: header says "
+            f"{meta.get('n_leaves')}, payload has {len(leaves)}")
+    return leaves, int(meta["version"]), int(meta.get("step", 0))
+
+
+class WeightBroadcaster:
+    """Learner-side publish half: one channel per actor, every actor
+    gets every version (the tag makes resends idempotent)."""
+
+    def __init__(self, channels: List[object]) -> None:
+        if not channels:
+            raise ValueError("weight broadcaster needs >= 1 actor channel")
+        self.channels = list(channels)
+        self.version = 0
+
+    def publish(self, params, step: int = 0) -> Tuple[int, float]:
+        """Encode once, send to every actor; returns (version, seconds)."""
+        self.version += 1
+        t0 = time.perf_counter()
+        payload = encode_weights(params, self.version, step)
+        tag = f"w.{self.version:08d}"
+        for ch in self.channels:
+            ch.send(tag, payload)
+        return self.version, time.perf_counter() - t0
+
+
+class WeightReceiver:
+    """Actor-side receive half: tracks the next expected version and
+    adopts the newest available at each generation boundary."""
+
+    def __init__(self, channel) -> None:
+        self.channel = channel
+        self.version = 0  # newest adopted (0 = still on the base policy)
+
+    def poll(self, timeout: float = 0.0) -> Optional[Tuple[List, int, int]]:
+        """Newest already-delivered (leaves, version, step), or None.
+        With a timeout, waits up to that long for version+1 to arrive
+        (then still drains anything newer that landed meanwhile)."""
+        newest = None
+        wait = timeout
+        while True:
+            tag = f"w.{self.version + 1:08d}"
+            try:
+                data = self.channel.recv(tag, timeout=wait)
+            except TimeoutError:
+                break
+            wait = 0.0  # only the FIRST recv blocks; the rest drain
+            self.version += 1
+            newest = data
+        if newest is None:
+            return None
+        leaves, version, step = decode_weights(newest)
+        if version != self.version:
+            raise ValueError(
+                f"weight record carries version {version} under tag for "
+                f"{self.version} — publisher/tag drift")
+        return leaves, version, step
+
+    def wait_for(self, version: int, timeout: float = 60.0):
+        """Block until at least `version` has been RECEIVED; returns the
+        newest (leaves, version, step) this call took delivery of, or
+        None when `version` was already adopted before the call (nothing
+        new to hand back). The actor's off-policy guard parks here when
+        it runs too far ahead of the learner — that wait is
+        learner-starved time (rl.idle)."""
+        deadline = time.monotonic() + timeout
+        newest = None
+        while self.version < version:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"weight version {version} not received within "
+                    f"{timeout:.1f}s (have {self.version})")
+            got = self.poll(timeout=left)
+            if got is not None:
+                newest = got
+        return newest

@@ -1,0 +1,80 @@
+"""Named-array record codec — the RL plane's wire form.
+
+The pipeline boundary codec (parallel/pipeline_mpmd.encode_boundary)
+carries ONE dtype per message; RL records are inherently mixed — a
+trajectory is int32 tokens next to f32 rewards and logprobs, a weight
+broadcast is a bf16/f32 param tree. This codec generalizes the same
+discipline instead of relaxing it: every array's dtype STRING and shape
+are RECORDED in the JSON header and the payload is the concatenation of
+raw bytes, viewed back through the recorded dtypes — bf16 survives
+byte-identically (ml_dtypes registers it with numpy; npz would round it
+through an opaque |V2 void, the PR 6/PR 8 lesson). Order is part of the
+contract: decode returns arrays in header order, which is how the weight
+receiver unflattens a param tree against its own treedef.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_MAGIC = b"kdlrl1"
+
+
+def encode_arrays(
+    arrays: Sequence[Tuple[str, np.ndarray]],
+    meta: Optional[Dict] = None,
+) -> bytes:
+    """One record: JSON header [{name, dtype, shape}...] + scalar meta,
+    then the raw payload. Names must be unique and non-empty (the decoder
+    returns a dict keyed by them)."""
+    if not arrays:
+        raise ValueError("empty RL record")
+    entries = []
+    chunks = []
+    seen = set()
+    for name, a in arrays:
+        if not name or name in seen:
+            raise ValueError(f"array name {name!r} empty or duplicate")
+        seen.add(name)
+        a = np.asarray(a)
+        entries.append(
+            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)})
+        chunks.append(np.ascontiguousarray(a).tobytes())
+    header = {"arrays": entries}
+    if meta:
+        header["meta"] = meta
+    hbytes = json.dumps(header).encode("utf-8")
+    return _MAGIC + len(hbytes).to_bytes(4, "big") + hbytes + b"".join(chunks)
+
+
+def decode_arrays(data: bytes) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """Inverse of encode_arrays: ({name: array} in header order, meta).
+    Trailing or missing bytes are refused — a record is whole or it is
+    an error, never a silent truncation."""
+    if data[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("not an RL record (bad magic)")
+    off = len(_MAGIC)
+    hlen = int.from_bytes(data[off:off + 4], "big")
+    off += 4
+    header = json.loads(data[off:off + hlen].decode("utf-8"))
+    off += hlen
+    import ml_dtypes  # noqa: F401 — registers bfloat16 et al with numpy
+
+    out: Dict[str, np.ndarray] = {}
+    for entry in header["arrays"]:
+        dtype = np.dtype(entry["dtype"])
+        shape: List[int] = entry["shape"]
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * dtype.itemsize
+        if off + nbytes > len(data):
+            raise ValueError(
+                f"RL record truncated inside array {entry['name']!r}")
+        out[entry["name"]] = np.frombuffer(
+            data[off:off + nbytes], dtype=dtype).reshape(shape)
+        off += nbytes
+    if off != len(data):
+        raise ValueError(
+            f"RL record length mismatch: {len(data) - off} trailing bytes")
+    return out, header.get("meta") or {}

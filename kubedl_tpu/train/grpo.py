@@ -225,14 +225,21 @@ def main(argv=None) -> int:
     K = args.max_new_tokens
     temp = args.temperature  # parse_args rejects <= 0 (group collapse)
 
+    # off-policy (inner epochs): the behavior log-probs ride OUT of the
+    # rollout itself — free at sample time (one gather next to the
+    # sampling op) where the old dedicated lp_fn pass cost a full
+    # forward per step. lp_fn stays available as the parity oracle
+    # (tests/test_rl.py pins emitted == recomputed within tolerance).
     @jax.jit
     def rollout_uniform(p, toks, key):
-        return decode.generate(p, toks, config, K, temperature=temp, key=key)
+        return decode.generate(p, toks, config, K, temperature=temp,
+                               key=key, with_logprobs=use_old)
 
     @jax.jit
     def rollout_ragged(p, toks, lengths, key):
         return decode.generate(p, toks, config, K, temperature=temp,
-                               key=key, lengths=lengths)
+                               key=key, lengths=lengths,
+                               with_logprobs=use_old)
 
     mngr = None
     start_step = 0
@@ -271,17 +278,26 @@ def main(argv=None) -> int:
         tiled_plens = np.repeat(plens, G)           # [B*G]
         sub = jax.random.fold_in(base_key, it)
         if uniform:
-            comp = rollout_uniform(state.params, jnp.asarray(tiled), sub)
+            rolled = rollout_uniform(state.params, jnp.asarray(tiled), sub)
         else:
-            comp = rollout_ragged(state.params, jnp.asarray(tiled),
-                                  jnp.asarray(tiled_plens), sub)
-        comp = np.asarray(comp)                     # [B*G, K]
+            rolled = rollout_ragged(state.params, jnp.asarray(tiled),
+                                    jnp.asarray(tiled_plens), sub)
+        if use_old:
+            comp, beh_lp = (np.asarray(rolled[0]), np.asarray(rolled[1]))
+        else:
+            comp = np.asarray(rolled)               # [B*G, K]
 
         # -- rewards + group-normalized advantages (host) -----------------
         n = B * G
         full = np.zeros((n, pad_to + K), np.int32)
         seq_lens = np.zeros(n, np.int32)
         rewards = np.zeros(n, np.float32)
+        if use_old:
+            # sampling-time logprobs into the sequence_logprobs grid:
+            # index i holds log p(token i+1), so completion token j of a
+            # row with prompt length pl lands at pl - 1 + j; positions
+            # outside the completion stay 0 and are masked by the loss
+            old_grid = np.zeros((n, pad_to + K - 1), np.float32)
         for i in range(n):
             pl = tiled_plens[i]
             c = comp[i]
@@ -299,6 +315,9 @@ def main(argv=None) -> int:
             full[i, pl:pl + len(train_c)] = train_c
             seq_lens[i] = pl + len(train_c)
             rewards[i] = reward_fn(list(tiled[i, :pl]), list(gen))
+            if use_old:
+                old_grid[i, pl - 1:pl - 1 + len(train_c)] = (
+                    beh_lp[i, :len(train_c)])
         adv = np.asarray(
             group_advantages(rewards.reshape(B, G))).reshape(n)
 
@@ -307,8 +326,10 @@ def main(argv=None) -> int:
                     jnp.asarray(seq_lens))
         ref_lp = ref_fn(lp_batch)
         if use_old:
-            old_lp, _ = lp_fn(state.params, lp_batch)
-            train_batch = (*lp_batch, jnp.asarray(adv), old_lp, ref_lp)
+            # old_lp comes from the rollout (sampling-time capture), not
+            # a second forward — lp_fn remains the parity oracle only
+            train_batch = (*lp_batch, jnp.asarray(adv),
+                           jnp.asarray(old_grid), ref_lp)
         else:
             train_batch = (*lp_batch, jnp.asarray(adv), ref_lp)
         for _ in range(args.inner_epochs):

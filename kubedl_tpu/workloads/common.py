@@ -35,6 +35,14 @@ MEGASCALE_PORT = 8080
 # dial it — see docs/transport.md and docs/pipeline.md "Transports".
 PIPELINE_PORT = 8476
 
+# Port each RL-fleet pod's transport plane listens on in kube mode
+# (KUBEDL_TRANSPORT=socket): actors dial the learner's service on this
+# port for trajectories, the learner dials each actor's for the weight
+# broadcast (KUBEDL_RL_LEARNER_ADDR / KUBEDL_RL_ACTOR_ADDRS). The local
+# executor's DirChannel lane rides KUBEDL_RL_QUEUE_DIR instead — see
+# docs/rl.md "Transports".
+RL_PORT = 8478
+
 ENV_COORDINATOR_ADDRESS = "KUBEDL_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "KUBEDL_NUM_PROCESSES"
 ENV_PROCESS_ID = "KUBEDL_PROCESS_ID"

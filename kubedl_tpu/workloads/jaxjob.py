@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kubedl_tpu.api.common import (
+    LABEL_RL_ROLE,
     LABEL_SERVING_ROLE,
     LABEL_SLICE_ID,
     ReplicaSpec,
@@ -41,6 +42,21 @@ API_VERSION = "kubedl-tpu.io/v1alpha1"
 REPLICA_WORKER = str(ReplicaType.WORKER.value)
 
 _CANONICAL = {"worker": REPLICA_WORKER}
+
+
+def _job_transport_token(job) -> str:
+    """Per-job transport auth token, derived sha256 from the job UID so
+    every pod of the gang — across operator restarts — gets the SAME
+    secret and no other job can forge it (the UID is an unguessable
+    uuid4 internal to the cluster; a production deployment can still pin
+    its own token via a mounted Secret, which wins over this default).
+    Empty when the job has no UID yet."""
+    if not job.metadata.uid:
+        return ""
+    import hashlib
+
+    return hashlib.sha256(
+        f"kubedl-transport:{job.metadata.uid}".encode()).hexdigest()
 
 
 @dataclass
@@ -156,6 +172,37 @@ class PipelineSpec:
 
 
 @dataclass
+class RLSpec:
+    """Disaggregated actor/learner RL fleet (kubedl_tpu/rl/, docs/rl.md):
+    the Worker replicas split into actor and learner ROLES by index —
+    workers [0, actorReplicas) are actors, the rest the learner — joined
+    by the trajectory queue and versioned weight broadcast over the
+    transport plane. ``maxWeightLag`` is the off-policy staleness bound:
+    the learner drops trajectories sampled more than that many weight
+    versions ago (counted), and actors park rather than generate
+    guaranteed-stale work. ``actorSlice``/``learnerSlice`` name the
+    per-role slice shapes of a mixed-role gang (admitted all-or-nothing
+    — an actor fleet without a learner shields nothing; requires
+    spec.numSlices == actorReplicas + learnerReplicas)."""
+
+    actor_replicas: int = 1
+    learner_replicas: int = 1
+    group_size: int = 8          # G completions sampled per prompt
+    max_weight_lag: int = 1      # off-policy staleness bound (versions)
+    prompts_per_step: int = 4    # trajectory groups per learner update
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    reward: str = "token-match"  # token-match | length | module.path:fn
+    reward_token: int = 5
+    target_len: int = 16
+    eos_id: int = -1
+    broadcast_interval: int = 1  # learner steps between weight publishes
+    rollout_engine: str = "decode"  # decode | serving (paged-KV reuse)
+    actor_slice: str = ""        # per-role gang shapes (both or neither)
+    learner_slice: str = ""
+
+
+@dataclass
 class JAXJobSpec:
     replica_specs: Dict[str, ReplicaSpec] = field(
         default_factory=dict, metadata={"name": "jaxReplicaSpecs"}
@@ -185,6 +232,9 @@ class JAXJobSpec:
     # Pipeline parallelism: intra-slice schedule knobs, or (mpmd) the
     # cross-slice multi-program mode where each stage owns a slice.
     pipeline: Optional[PipelineSpec] = None
+    # Actor/learner RL fleet: Worker replicas become rollout actors plus
+    # a learner joined by trajectory queue + weight broadcast.
+    rl: Optional[RLSpec] = None
 
 
 @dataclass
@@ -406,6 +456,77 @@ class JAXJobController(BaseWorkloadController):
                 errs.append(
                     f"spec.elastic.quiesceTimeoutS must be > 0, got "
                     f"{el.quiesce_timeout_s}")
+        rl = job.spec.rl
+        if rl is not None:
+            from kubedl_tpu.api.validation import validate_rl_shapes
+            from kubedl_tpu.executor.tpu_topology import parse_slice_type
+
+            errs.extend(validate_rl_shapes(
+                int(rl.actor_replicas), int(rl.learner_replicas),
+                int(rl.group_size), int(rl.max_weight_lag),
+                prompts_per_step=int(rl.prompts_per_step),
+                max_new_tokens=int(rl.max_new_tokens),
+                temperature=float(rl.temperature),
+                broadcast_interval=int(rl.broadcast_interval),
+                reward=str(rl.reward), eos_id=int(rl.eos_id),
+                rollout_engine=str(rl.rollout_engine)))
+            fleet = int(rl.actor_replicas) + int(rl.learner_replicas)
+            if fleet != workers:
+                errs.append(
+                    f"spec.rl actorReplicas {rl.actor_replicas} + "
+                    f"learnerReplicas {rl.learner_replicas} must equal "
+                    f"the Worker replica count {workers} (roles are "
+                    f"assigned by worker index, actors first)")
+            if bool(rl.actor_slice) != bool(rl.learner_slice):
+                errs.append(
+                    "spec.rl actorSlice and learnerSlice must be set "
+                    "together (a mixed-role gang needs BOTH role shapes "
+                    "to admit all-or-nothing) or both left empty")
+            elif rl.actor_slice:
+                for field_name, alt in (("actorSlice", rl.actor_slice),
+                                        ("learnerSlice", rl.learner_slice)):
+                    try:
+                        parse_slice_type(alt)
+                    except ValueError as e:
+                        errs.append(f"spec.rl.{field_name}: {e}")
+                if ns != fleet:
+                    errs.append(
+                        f"spec.rl with role slices needs spec.numSlices "
+                        f"({ns}) == actorReplicas + learnerReplicas "
+                        f"({fleet}) — each fleet pod owns one slice")
+            elif ns != 1:
+                errs.append(
+                    f"spec.rl without actorSlice/learnerSlice requires "
+                    f"spec.numSlices == 1 (got {ns}): a multi-slice RL "
+                    f"gang must declare its per-role shapes")
+            if job.spec.dcn_mesh is not None:
+                errs.append(
+                    "spec.rl is incompatible with spec.dcnMesh (actor "
+                    "and learner pods are SEPARATE programs joined by "
+                    "the trajectory/broadcast channels, not one SPMD "
+                    "program over a DCN mesh)")
+            if srv is not None:
+                errs.append("spec.rl is incompatible with spec.serving "
+                            "(the fleet runs its own rollout engines)")
+            if pipe is not None:
+                errs.append("spec.rl is incompatible with spec.pipeline")
+            if el is not None and el.live_reshard:
+                errs.append(
+                    "spec.rl is incompatible with spec.elastic."
+                    "liveReshard (fleet pods are separate programs; "
+                    "there is no single SPMD state to reshard)")
+            if sched is not None and sched.tpu_slice_fallbacks:
+                errs.append(
+                    "spec.rl is incompatible with schedulingPolicy."
+                    "tpuSliceFallbacks (a mixed-role gang cannot resize "
+                    "through the elastic ladder; size the roles via "
+                    "spec.rl.actorSlice/learnerSlice instead)")
+            if job.spec.checkpoint is None or not job.spec.checkpoint.path:
+                errs.append(
+                    "spec.rl requires spec.checkpoint (the trajectory "
+                    "queue and weight broadcast ride the shared "
+                    "checkpoint volume on the local executor, and the "
+                    "learner checkpoints the policy there)")
         if sched is not None and sched.tpu_slice_fallbacks and (
             job.spec.checkpoint is None or not job.spec.checkpoint.path
         ):
@@ -426,6 +547,7 @@ class JAXJobController(BaseWorkloadController):
             env["KUBEDL_MESH"] = job.spec.mesh.encode()
         ns = int(job.spec.num_slices or 1)
         pipe = job.spec.pipeline
+        rl = job.spec.rl
         # validation requires numSlices > 1 for mpmd; the guard keeps an
         # unvalidated job from hitting the slice-group math below
         mpmd = pipe is not None and pipe.mpmd and ns > 1
@@ -433,9 +555,10 @@ class JAXJobController(BaseWorkloadController):
             # Multislice: per-slice worker groups by index; libtpu's
             # Megascale DCN transport bootstraps from MEGASCALE_* the way
             # single-slice jobs bootstrap from the coordination service.
-            # An MPMD pipeline job skips Megascale entirely: its slices
-            # are SEPARATE programs chained by the activation boundary,
-            # not one SPMD program over a DCN mesh.
+            # An MPMD pipeline job — and an RL fleet — skips Megascale
+            # entirely: its slices are SEPARATE programs chained by the
+            # activation boundary (or the trajectory/broadcast
+            # channels), not one SPMD program over a DCN mesh.
             workers = int(
                 (job.spec.replica_specs.get(REPLICA_WORKER) or ReplicaSpec())
                 .replicas or 0
@@ -443,7 +566,7 @@ class JAXJobController(BaseWorkloadController):
             slice_id, _, _ = slice_group(workers, ns, index)
             env["KUBEDL_NUM_SLICES"] = str(ns)
             env["KUBEDL_SLICE_ID"] = str(slice_id)
-            if not mpmd:
+            if not mpmd and rl is None:
                 dcn = job.spec.dcn_mesh
                 dcn_encoded = (dcn.encode_sparse() if dcn is not None
                                else f"data={ns}")
@@ -487,18 +610,10 @@ class JAXJobController(BaseWorkloadController):
                 # mode; the local executor defaults to the dir lane)
                 env["KUBEDL_TRANSPORT_BIND"] = (
                     f"0.0.0.0:{common.PIPELINE_PORT}")
-                # per-job auth token, derived from the job UID so every
-                # pod of the gang — across operator restarts — gets the
-                # SAME secret and no other job can forge it (the UID is
-                # an unguessable uuid4 internal to the cluster; a
-                # production deployment can still pin its own token via
-                # a mounted Secret, which wins over this default)
-                if job.metadata.uid:
-                    import hashlib
-
-                    env["KUBEDL_TRANSPORT_TOKEN"] = hashlib.sha256(
-                        f"kubedl-transport:{job.metadata.uid}".encode()
-                    ).hexdigest()
+                # per-job auth token (see _job_transport_token)
+                token = _job_transport_token(job)
+                if token:
+                    env["KUBEDL_TRANSPORT_TOKEN"] = token
                 ckpt_path = (job.spec.checkpoint.path
                              if job.spec.checkpoint else "")
                 if ckpt_path:
@@ -537,6 +652,49 @@ class JAXJobController(BaseWorkloadController):
             env["KUBEDL_SERVING_SHARE_PREFIXES"] = (
                 "1" if srv.share_prefixes else "0")
             pod_template.metadata.labels[LABEL_SERVING_ROLE] = role
+        if rl is not None:
+            from kubedl_tpu.executor.tpu_topology import rl_fleet_env
+
+            n_act = int(rl.actor_replicas)
+            role = "actor" if index < n_act else "learner"
+
+            def rl_addr(i: int) -> str:
+                return (f"{common.service_dns(job, REPLICA_WORKER, i)}"
+                        f":{common.RL_PORT}")
+
+            env.update(rl_fleet_env(
+                role, index, n_act,
+                learner_addr=rl_addr(n_act),
+                actor_addrs=",".join(rl_addr(i) for i in range(n_act))))
+            env["KUBEDL_RL_GROUP_SIZE"] = str(rl.group_size)
+            env["KUBEDL_RL_PROMPTS_PER_STEP"] = str(rl.prompts_per_step)
+            env["KUBEDL_RL_MAX_NEW_TOKENS"] = str(rl.max_new_tokens)
+            env["KUBEDL_RL_TEMPERATURE"] = str(rl.temperature)
+            env["KUBEDL_RL_MAX_WEIGHT_LAG"] = str(rl.max_weight_lag)
+            env["KUBEDL_RL_BROADCAST_INTERVAL"] = str(rl.broadcast_interval)
+            env["KUBEDL_RL_REWARD"] = rl.reward
+            env["KUBEDL_RL_REWARD_TOKEN"] = str(rl.reward_token)
+            env["KUBEDL_RL_TARGET_LEN"] = str(rl.target_len)
+            env["KUBEDL_RL_EOS_ID"] = str(rl.eos_id)
+            env["KUBEDL_RL_ENGINE"] = rl.rollout_engine
+            # socket-plane listen endpoint (docs/transport.md): the peer
+            # addrs above dial this port, so every fleet pod's plane
+            # binds it when KUBEDL_TRANSPORT=socket (kube mode; the
+            # local executor defaults to the dir lane)
+            env["KUBEDL_TRANSPORT_BIND"] = f"0.0.0.0:{common.RL_PORT}"
+            token = _job_transport_token(job)
+            if token:
+                env["KUBEDL_TRANSPORT_TOKEN"] = token
+            ckpt_path = (job.spec.checkpoint.path
+                         if job.spec.checkpoint else "")
+            if ckpt_path:
+                # local-executor DCN analog: the trajectory queue and
+                # weight broadcast are shared dirs on the (already
+                # required) checkpoint volume — the KUBEDL_PP_BOUNDARY_DIR
+                # discipline
+                env["KUBEDL_RL_QUEUE_DIR"] = os.path.join(
+                    ckpt_path, ".rl")
+            pod_template.metadata.labels[LABEL_RL_ROLE] = role
         common.add_env(pod_template, env)
         common.inject_coordinator_env(
             job, pod_template, rtype, index, job.spec.replica_specs,

@@ -468,7 +468,7 @@ def test_runtime_metrics_render_goodput_and_step_series():
 
 
 def test_debug_vars_has_every_newer_family():
-    """Satellite: pipeline + reshard + goodput + step + transport
+    """Satellite: pipeline + reshard + goodput + step + transport + RL
     snapshots must all be on the debug surface (a family silently
     missing from /debug/vars is invisible to `kubedl-tpu top`)."""
     from kubedl_tpu.operator import Operator, OperatorConfig
@@ -484,6 +484,7 @@ def test_debug_vars_has_every_newer_family():
         assert "steps" in dv
         assert "goodput" in dv
         assert "transport" in dv and "reconnects_total" in dv["transport"]
+        assert "rl" in dv and "jobs" in dv["rl"]
     finally:
         op.stop()
 
