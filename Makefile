@@ -9,6 +9,16 @@ SHELL := /bin/bash
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# Fleet invariant analyzer (docs/static_analysis.md): AST lint passes
+# for the drifted-invariant classes (prom-escape, debug-vars-family,
+# shared-validation, payload-dtype, broad-except, bench-lane-merge)
+# plus lock-order/held-lock-I/O analysis over the concurrent planes.
+# Exit 0 = zero unallowlisted findings; every allowlist pragma must
+# carry a justification. Also: `kubedl-tpu analyze`.
+.PHONY: lint
+lint:
+	$(PY) -m kubedl_tpu.analysis
+
 # The FULL suite, slow lane included — run before every snapshot commit
 # and quote the tail in the commit message (VERDICT r4 directive 1).
 # The fast lane reports its slowest tests and FAILS if any single test
@@ -16,6 +26,7 @@ test:
 # tests `slow` instead of letting the fast lane grow silently.
 .PHONY: presubmit
 presubmit:
+	$(PY) -m kubedl_tpu.analysis
 	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee .presubmit-fast.log
 	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60 \
 	  --total tests/test_gmm_moe.py=60 \
@@ -26,7 +37,8 @@ presubmit:
 	  --total tests/test_pipeline_1f1b.py=100 \
 	  --total tests/test_obs.py=60 \
 	  --total tests/test_transport.py=60 \
-	  --total tests/test_rl.py=150
+	  --total tests/test_rl.py=150 \
+	  --total tests/test_analysis.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench

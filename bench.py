@@ -1576,7 +1576,7 @@ def _tpu_child(results_path: str) -> int:
                             steps=3 if small else 10, key="llama_150m")
         else:
             _emit(out, "llama_150m", {"skipped": f"budget exhausted ({left():.0f}s left)"})
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — failure recorded in the bench record
         _emit(out, "llama_150m", {"error": f"{type(e).__name__}: {e}"[:300]})
     try:
         if not _enabled("llama_1b"):
@@ -1589,7 +1589,7 @@ def _tpu_child(results_path: str) -> int:
         else:
             _emit(out, "llama_1b", {"skipped": f"budget exhausted ({left():.0f}s left)",
                                     "fallback": "llama_150m"})
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — failure recorded in the bench record
         _emit(out, "llama_1b", {"error": f"{type(e).__name__}: {e}"[:300]})
     try:
         if not _enabled("llama_moe"):
@@ -1601,7 +1601,7 @@ def _tpu_child(results_path: str) -> int:
                             steps=3 if small else 10, key="llama_moe")
         else:
             _emit(out, "llama_moe", {"skipped": f"budget exhausted ({left():.0f}s left)"})
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — failure recorded in the bench record
         _emit(out, "llama_moe", {"error": f"{type(e).__name__}: {e}"[:300]})
     try:
         if not _enabled("moe_breakdown"):
@@ -1612,7 +1612,7 @@ def _tpu_child(results_path: str) -> int:
         else:
             _emit(out, "moe_breakdown",
                   {"skipped": f"budget exhausted ({left():.0f}s left)"})
-    except Exception as e:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001 — failure recorded in the bench record
         _emit(out, "moe_breakdown", {"error": f"{type(e).__name__}: {e}"[:300]})
 
     _emit(out, "done", {"budget_left_s": round(left(), 1)})

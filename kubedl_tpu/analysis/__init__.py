@@ -1,0 +1,31 @@
+"""Fleet invariant analyzer — AST lint passes + lock-order analysis.
+
+Every review round since PR 5 has re-found the same invariant classes
+drifting by hand: unescaped Prometheus label renders, metric families
+missing from /debug/vars, validation rules forked between submit and
+runtime, array payloads serialized outside the bf16-safe codecs, broad
+``except Exception`` swallows in reconcile/consumer loops, and bench
+lanes clobbering each other's committed records. This package makes the
+machine enforce them (docs/static_analysis.md):
+
+  * ``framework``  — dependency-free (stdlib ``ast``) pass registry,
+    per-line/per-file allowlist pragmas that REQUIRE a justification
+    string, JSON + human report;
+  * ``passes``     — the repo-specific invariant passes
+    (prom-escape, debug-vars-family, shared-validation, payload-dtype,
+    broad-except, bench-lane-merge);
+  * ``lockorder``  — static lock-acquisition-order graph over the
+    concurrent planes (transport/gang/sched/serving/core): cycle
+    detection + held-lock I/O findings;
+  * ``witness``    — opt-in runtime lock witness (KUBEDL_LOCK_WITNESS)
+    recording real acquisition orders and failing loudly on inversions.
+
+Run it as ``make lint``, ``python -m kubedl_tpu.analysis``, or
+``kubedl-tpu analyze``. The package import stays light (no jax, no
+product modules) so ``witness.new_lock`` is importable from anywhere.
+"""
+from __future__ import annotations
+
+from kubedl_tpu.analysis.framework import Finding, Report, run_analysis
+
+__all__ = ["Finding", "Report", "run_analysis"]

@@ -1,0 +1,51 @@
+"""``python -m kubedl_tpu.analysis`` — the `make lint` / presubmit gate.
+
+Exit code 0 when the tree has zero unallowlisted findings, 1 otherwise
+(2 on usage errors). ``kubedl-tpu analyze`` is the same runner behind
+the operator CLI so the report is inspectable the way `top`/`trace`
+are.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kubedl_tpu.analysis.framework import run_analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kubedl_tpu.analysis", description=__doc__)
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detect from this file)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--no-tests", action="store_true",
+                    help="skip tests/ (the default scope includes it)")
+    ap.add_argument("--show-allowlisted", action="store_true",
+                    help="also print pragma-suppressed findings")
+    args = ap.parse_args(argv)
+    root = args.root
+    if root is None:
+        # kubedl_tpu/analysis/__main__.py -> repo root two levels up
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.isdir(os.path.join(root, "kubedl_tpu")):
+        print(f"error: {root} does not look like the repo root "
+              f"(no kubedl_tpu/)", file=sys.stderr)
+        return 2
+    report = run_analysis(root, include_tests=not args.no_tests)
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.to_text())
+        if args.show_allowlisted and report.allowlisted:
+            print("-- allowlisted --")
+            for f in report.allowlisted:
+                print(f.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

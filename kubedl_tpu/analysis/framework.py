@@ -1,0 +1,320 @@
+"""AST pass framework: pragmas, registry, report (docs/static_analysis.md).
+
+Design goals, in order:
+
+  1. *dependency-free* — stdlib ``ast`` only, so ``make lint`` runs on a
+     bare container before any of the jax stack imports;
+  2. *justified allowlists* — a pragma without a justification string is
+     itself a finding; reviewers stopped re-litigating a site exactly
+     when the "why" travels with the suppression;
+  3. *one report shape* — every pass emits ``Finding`` rows, the runner
+     renders them human-first and ``--json`` for tooling, and the exit
+     code is the presubmit gate.
+
+Pragma syntax (same line as the finding, or the line directly above;
+shown without the leading comment hash so this very docstring does not
+register as a pragma — the analyzer lints itself):
+
+    kubedl-analysis: allow[pass-id] why this site is intentional
+
+File-scoped (first 10 lines of the module, suppresses the whole file
+for that pass; anywhere lower it takes NO effect and is flagged):
+
+    kubedl-analysis: allow-file[pass-id] why this whole file is exempt
+
+The broad-except pass additionally honors the repo's existing
+``# noqa: BLE001 — justification`` idiom (see passes.BroadExceptPass).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+PRAGMA_RE = re.compile(
+    r"#\s*kubedl-analysis:\s*allow(?P<scope>-file)?\[(?P<pass>[a-z0-9-]+)\]"
+    r"\s*(?P<why>.*?)\s*$"
+)
+# how many leading lines may carry a file-scoped pragma
+_FILE_PRAGMA_WINDOW = 10
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    justification: str = ""  # set when allowlisted
+    allowlisted: bool = False
+
+    def to_dict(self) -> Dict:
+        return {
+            "pass": self.pass_id,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "allowlisted": self.allowlisted,
+            **({"justification": self.justification}
+               if self.allowlisted else {}),
+        }
+
+    def render(self) -> str:
+        tail = f"  [allowed: {self.justification}]" if self.allowlisted else ""
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}{tail}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed module the passes share (parse once, visit many)."""
+
+    path: str  # repo-relative posix path
+    abspath: str
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.text, node) or ""
+
+
+class Pragmas:
+    """Per-file pragma index: (pass_id, line) -> justification."""
+
+    def __init__(self, source: SourceFile) -> None:
+        # line -> {pass_id: justification}
+        self._by_line: Dict[int, Dict[str, str]] = {}
+        self._file_wide: Dict[str, str] = {}
+        self.bad_pragma_lines: List[int] = []  # pragma with empty why
+        # allow-file below the window: does NOT take effect file-wide,
+        # and silently degrading it to a line pragma would hide the
+        # author's mistake — flagged loudly instead
+        self.misplaced_file_pragma_lines: List[int] = []
+        for i, raw in enumerate(source.lines, start=1):
+            m = PRAGMA_RE.search(raw)
+            if not m:
+                continue
+            why = m.group("why").strip()
+            if not why:
+                # an unjustified pragma is NOT a suppression — it is a
+                # finding of its own (pragma-justification)
+                self.bad_pragma_lines.append(i)
+                continue
+            if m.group("scope"):
+                if i <= _FILE_PRAGMA_WINDOW:
+                    self._file_wide[m.group("pass")] = why
+                else:
+                    self.misplaced_file_pragma_lines.append(i)
+            else:
+                self._by_line.setdefault(i, {})[m.group("pass")] = why
+
+    def lookup(self, pass_id: str, line: int) -> Optional[str]:
+        """Justification when `line` is allowlisted for `pass_id`
+        (pragma on the line itself or the line directly above), else
+        None."""
+        if pass_id in self._file_wide:
+            return self._file_wide[pass_id]
+        for ln in (line, line - 1):
+            why = self._by_line.get(ln, {}).get(pass_id)
+            if why is not None:
+                return why
+        return None
+
+
+@dataclass
+class RepoContext:
+    """What a repo-level pass may need beyond the python files."""
+
+    root: str
+    docs: Dict[str, str] = field(default_factory=dict)  # relpath -> text
+
+    def doc_text(self, relpath: str) -> str:
+        if relpath not in self.docs:
+            try:
+                with open(os.path.join(self.root, relpath)) as f:
+                    self.docs[relpath] = f.read()
+            except OSError:
+                self.docs[relpath] = ""
+        return self.docs[relpath]
+
+
+class AnalysisPass:
+    """Base: run() over the full file set so repo-level passes (e.g.
+    debug-vars-family) can correlate across files; per-file passes just
+    loop. Pragma application happens in the runner, not here — passes
+    report everything they see."""
+
+    id = "base"
+    description = ""
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# file discovery / loading
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+def discover_files(root: str, include_tests: bool = True) -> List[str]:
+    """Repo-relative paths of every analyzable python file: the
+    ``kubedl_tpu`` package, ``bench.py``, ``hack/``, and ``tests/``
+    (pass-specific scoping happens inside each pass)."""
+    rels: List[str] = []
+    tops = ["kubedl_tpu", "hack"] + (["tests"] if include_tests else [])
+    for top in tops:
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    rels.append(
+                        os.path.relpath(os.path.join(dirpath, fn), root)
+                        .replace(os.sep, "/"))
+    for single in ("bench.py",):
+        if os.path.exists(os.path.join(root, single)):
+            rels.append(single)
+    return sorted(rels)
+
+
+def load_source(root: str, rel: str) -> Tuple[Optional[SourceFile], Optional[Finding]]:
+    abspath = os.path.join(root, rel)
+    try:
+        with open(abspath, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        return None, Finding("parse-error", rel, 0, f"unreadable: {e}")
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return None, Finding(
+            "parse-error", rel, e.lineno or 0, f"syntax error: {e.msg}")
+    return SourceFile(
+        path=rel, abspath=abspath, text=text, tree=tree,
+        lines=text.splitlines()), None
+
+
+# ---------------------------------------------------------------------------
+# runner + report
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Report:
+    findings: List[Finding]  # unallowlisted — these fail the gate
+    allowlisted: List[Finding]
+    files_analyzed: int = 0
+    passes: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "ok": self.ok,
+            "files_analyzed": self.files_analyzed,
+            "passes": self.passes,
+            "findings": [f.to_dict() for f in self.findings],
+            "allowlisted": [f.to_dict() for f in self.allowlisted],
+        }, indent=1, sort_keys=True)
+
+    def to_text(self) -> str:
+        out: List[str] = []
+        by_pass: Dict[str, List[Finding]] = {}
+        for f in self.findings:
+            by_pass.setdefault(f.pass_id, []).append(f)
+        for pass_id in sorted(by_pass):
+            out.append(f"== {pass_id} ({len(by_pass[pass_id])}) ==")
+            out.extend(f.render() for f in by_pass[pass_id])
+        out.append(
+            f"{len(self.findings)} finding(s), "
+            f"{len(self.allowlisted)} allowlisted, "
+            f"{self.files_analyzed} files, "
+            f"passes: {', '.join(self.passes)}")
+        return "\n".join(out)
+
+
+def default_passes() -> List[AnalysisPass]:
+    # imported lazily so framework stays importable without the passes
+    # (and the passes can import the framework)
+    from kubedl_tpu.analysis.lockorder import LockOrderPass
+    from kubedl_tpu.analysis.passes import (
+        BenchLaneMergePass,
+        BroadExceptPass,
+        DebugVarsFamilyPass,
+        PayloadDtypePass,
+        PromEscapePass,
+        SharedValidationPass,
+    )
+
+    return [
+        PromEscapePass(),
+        DebugVarsFamilyPass(),
+        SharedValidationPass(),
+        PayloadDtypePass(),
+        BroadExceptPass(),
+        BenchLaneMergePass(),
+        LockOrderPass(),
+    ]
+
+
+def run_analysis(
+    root: str,
+    passes: Optional[List[AnalysisPass]] = None,
+    files: Optional[List[str]] = None,
+    include_tests: bool = True,
+) -> Report:
+    """Run every pass over the tree; split findings into gate-failing vs
+    pragma-allowlisted. ``files`` overrides discovery (tests feed
+    fixture snippets through the real runner this way)."""
+    passes = default_passes() if passes is None else passes
+    rels = discover_files(root, include_tests) if files is None else files
+    sources: List[SourceFile] = []
+    findings: List[Finding] = []
+    pragmas: Dict[str, Pragmas] = {}
+    for rel in rels:
+        src, err = load_source(root, rel)
+        if err is not None:
+            findings.append(err)
+            continue
+        sources.append(src)
+        pragmas[src.path] = Pragmas(src)
+        for ln in pragmas[src.path].bad_pragma_lines:
+            findings.append(Finding(
+                "pragma-justification", src.path, ln,
+                "allowlist pragma carries no justification — say WHY the "
+                "site is intentional"))
+        for ln in pragmas[src.path].misplaced_file_pragma_lines:
+            findings.append(Finding(
+                "pragma-justification", src.path, ln,
+                f"allow-file pragma must appear in the first "
+                f"{_FILE_PRAGMA_WINDOW} lines of the module — here it "
+                f"would suppress NOTHING file-wide; move it up or use a "
+                f"line pragma"))
+    ctx = RepoContext(root=root)
+    for p in passes:
+        findings.extend(p.run(sources, ctx))
+    gate: List[Finding] = []
+    allowed: List[Finding] = []
+    for f in findings:
+        why = None
+        pr = pragmas.get(f.path)
+        if pr is not None and f.pass_id not in (
+                "pragma-justification", "parse-error"):
+            why = pr.lookup(f.pass_id, f.line)
+        if why is not None:
+            f.allowlisted, f.justification = True, why
+            allowed.append(f)
+        else:
+            gate.append(f)
+    gate.sort(key=lambda f: (f.pass_id, f.path, f.line))
+    allowed.sort(key=lambda f: (f.pass_id, f.path, f.line))
+    return Report(
+        findings=gate, allowlisted=allowed, files_analyzed=len(sources),
+        passes=[p.id for p in passes])

@@ -1,0 +1,596 @@
+"""Static lock-acquisition-order analysis over the concurrent planes.
+
+The operator/admitter/transport planes hold locks across five concurrent
+subsystems (admitter grants call director hooks, the scheduler drives
+the admitter, the transport plane's peers serialize sends, the serving
+router fans out to pod locks) and nothing checked acquisition order —
+the Go reference leans on ``go vet``/``-race``; this is the Python
+port's equivalent, the way PAPERS.md's Runtime Concurrency Control work
+argues ordering discipline must be checked by the system.
+
+What it does, per the target modules (transport/ gang/ sched/ serving/
+core/ by default):
+
+  1. index every lock: ``self.x = threading.Lock()/RLock()/Condition()``
+     and the witness wrappers ``new_lock()/new_rlock()``; a
+     ``Condition(self.other)`` aliases the lock it wraps;
+  2. walk every function tracking the held-lock stack through
+     ``with self.x:`` regions, resolving calls made under a held lock —
+     ``self.m()``, ``self.attr.m()`` via __init__ assignment/annotation,
+     module functions, module-level singletons, plus the explicit
+     bindings below for couplings the AST cannot see (the admitter's
+     director IS the capacity scheduler);
+  3. fixpoint the transitive effects (locks acquired, I/O performed) of
+     every function, then emit:
+       * ``lock-order`` — cycles in the acquired-while-holding graph
+         (and non-reentrant self-acquisition), each a potential
+         deadlock;
+       * ``lock-io`` — blocking I/O (socket send/accept/dial,
+         ``time.sleep``, file ``open``, ``post_control``, subprocess)
+         reachable while a lock is held: a stalled peer or slow volume
+         must never pin a plane-wide lock.
+
+Honest limits (documented in docs/static_analysis.md): calls through
+bare ``Callable`` values (the metrics snapshot callbacks, workqueue
+handlers) are invisible — the discipline there is "copy under the lock,
+call outside it", which the passes CAN see when violated via direct
+attribute calls. A pragma on the ``with`` line (or the flagged call
+line) suppresses a finding with a justification:
+
+    with self.lock:  # kubedl-analysis: allow[lock-io] one in-flight MSG per connection IS the serialization contract
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubedl_tpu.analysis.framework import (
+    AnalysisPass,
+    Finding,
+    RepoContext,
+    SourceFile,
+)
+
+DEFAULT_SCOPE = (
+    "kubedl_tpu/transport/",
+    "kubedl_tpu/gang/",
+    "kubedl_tpu/sched/",
+    "kubedl_tpu/serving/",
+    "kubedl_tpu/core/",
+)
+
+# interface class -> concrete implementation wired at runtime
+# (admitter.set_director(capacity_scheduler)); the AST alone sees only
+# the abstract hooks
+IMPLEMENTS = {
+    "CapacityDirector": "CapacityScheduler",
+    "GangScheduler": "TPUSliceAdmitter",
+}
+
+# (class, attr) -> concrete class, for couplings assigned from UNTYPED
+# constructor params (the scheduler's `admitter` arg carries no
+# annotation; the runtime wiring is operator.py's)
+EXTRA_ATTR_BINDINGS = {
+    ("CapacityScheduler", "admitter"): "TPUSliceAdmitter",
+    ("CapacityScheduler", "store"): "ObjectStore",
+}
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "new_lock": "lock",
+               "new_rlock": "rlock"}
+
+
+def _io_desc(call: ast.Call) -> Optional[str]:
+    """Non-None when this call IS a blocking-I/O primitive."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "open()"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    attr = fn.attr
+    base = fn.value.id if isinstance(fn.value, ast.Name) else ""
+    if attr in ("sendall", "accept", "makefile", "sendto"):
+        return f".{attr}()"
+    if attr == "connect" and ("sock" in base or "conn" in base):
+        return ".connect()"
+    if attr == "create_connection":
+        return "socket.create_connection"
+    if attr == "recv" and ("sock" in base or "conn" in base):
+        return ".recv()"
+    if attr == "sleep" and base == "time":
+        return "time.sleep"
+    if attr == "urlopen":
+        return "urlopen"
+    if attr in ("replace", "rename", "makedirs") and base == "os":
+        return f"os.{attr}"
+    if attr in ("run", "check_call", "check_output", "Popen") and (
+            base == "subprocess"):
+        return f"subprocess.{attr}"
+    if attr == "post_control":
+        return "post_control"
+    return None
+
+
+# a held lock: (lock key, line where THIS function acquired it) — the
+# line anchors findings so ONE pragma on the `with` covers the region
+Held = Tuple[str, int]
+
+
+@dataclass
+class _FuncInfo:
+    qual: str  # "module.py:Class.method" or "module.py:func"
+    module: str
+    cls: Optional[str]
+    node: ast.AST
+    # (held locks at that point, acquired lock key, line)
+    acquires: List[Tuple[Tuple[Held, ...], str, int]] = field(
+        default_factory=list)
+    # (held locks, call node, line) — every call, held or not
+    calls: List[Tuple[Tuple[Held, ...], ast.Call, int]] = field(
+        default_factory=list)
+    # (held locks, line, desc) — direct I/O primitives
+    io: List[Tuple[Tuple[Held, ...], int, str]] = field(default_factory=list)
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    module: str
+    line: int
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    aliases: Dict[str, str] = field(default_factory=dict)  # cond -> lock attr
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> class
+    methods: Dict[str, _FuncInfo] = field(default_factory=dict)
+
+    def lock_key(self, attr: str) -> str:
+        attr = self.aliases.get(attr, attr)
+        mod = (self.module.removeprefix("kubedl_tpu/")
+               .removesuffix(".py").replace("/", "."))
+        return f"{mod}.{self.name}.{attr}"
+
+
+class LockOrderAnalyzer:
+    def __init__(self, files: Sequence[SourceFile]) -> None:
+        self.files = list(files)
+        self.classes: Dict[str, List[_ClassInfo]] = {}  # name -> infos
+        self.mod_funcs: Dict[Tuple[str, str], _FuncInfo] = {}
+        self.singletons: Dict[Tuple[str, str], str] = {}  # (mod, name) -> cls
+        # per-module imported names:
+        # (mod, local name) -> (source module rel, original name)
+        self.imports: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self.lock_kind: Dict[str, str] = {}  # lock key -> lock|rlock
+        self._effects: Dict[str, Tuple[Set[str], Set[str]]] = {}
+        self._index()
+
+    # -- indexing --------------------------------------------------------
+
+    def _index(self) -> None:
+        for src in self.files:
+            for node in src.tree.body:
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    rel = node.module.replace(".", "/") + ".py"
+                    for alias in node.names:
+                        # keyed by the LOCAL name, resolving back to the
+                        # definition name (`import foo as bar` must find foo)
+                        self.imports[(src.path, alias.asname or alias.name)] = (
+                            rel, alias.name)
+                if isinstance(node, ast.ClassDef):
+                    info = self._index_class(src, node)
+                    self.classes.setdefault(info.name, []).append(info)
+                elif isinstance(node, ast.FunctionDef):
+                    fi = _FuncInfo(
+                        qual=f"{src.path}:{node.name}", module=src.path,
+                        cls=None, node=node)
+                    self._scan_func(fi, None, node)
+                    self.mod_funcs[(src.path, node.name)] = fi
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t, v = node.targets[0], node.value
+                    if (isinstance(t, ast.Name) and isinstance(v, ast.Call)
+                            and isinstance(v.func, ast.Name)):
+                        self.singletons[(src.path, t.id)] = v.func.id
+
+    def _index_class(self, src: SourceFile, node: ast.ClassDef) -> _ClassInfo:
+        info = _ClassInfo(name=node.name, module=src.path, line=node.lineno)
+        # first sweep: lock attrs + attr types from every method body
+        for meth in node.body:
+            if not isinstance(meth, ast.FunctionDef):
+                continue
+            ann: Dict[str, str] = {}
+            for a in meth.args.args + meth.args.kwonlyargs:
+                cls_name = _ann_class(a.annotation)
+                if cls_name:
+                    ann[a.arg] = cls_name
+            for sub in ast.walk(meth):
+                if not (isinstance(sub, ast.Assign) and len(sub.targets) == 1):
+                    continue
+                t, v = sub.targets[0], sub.value
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if isinstance(v, ast.Call):
+                    ctor = v.func
+                    ctor_name = (
+                        ctor.id if isinstance(ctor, ast.Name)
+                        else ctor.attr if isinstance(ctor, ast.Attribute)
+                        else "")
+                    if ctor_name in _LOCK_CTORS:
+                        info.locks[t.attr] = _LOCK_CTORS[ctor_name]
+                    elif ctor_name == "Condition":
+                        if (v.args and isinstance(v.args[0], ast.Attribute)
+                                and isinstance(v.args[0].value, ast.Name)
+                                and v.args[0].value.id == "self"):
+                            info.aliases[t.attr] = v.args[0].attr
+                        else:
+                            # bare Condition() wraps its own RLock
+                            info.locks[t.attr] = "rlock"
+                    elif ctor_name and ctor_name[0].isupper():
+                        info.attr_types[t.attr] = ctor_name
+                elif isinstance(v, ast.Name) and v.id in ann:
+                    info.attr_types[t.attr] = ann[v.id]
+        for attr, kind in info.locks.items():
+            # register keys now so kind lookups work during scans
+            self.lock_kind[info.lock_key(attr)] = kind
+        for meth in node.body:
+            if isinstance(meth, ast.FunctionDef):
+                fi = _FuncInfo(
+                    qual=f"{src.path}:{node.name}.{meth.name}",
+                    module=src.path, cls=node.name, node=meth)
+                self._scan_func(fi, info, meth)
+                info.methods[meth.name] = fi
+        return info
+
+    # -- per-function scan (structured, held-stack aware) ----------------
+
+    def _scan_func(self, fi: _FuncInfo, cls: Optional[_ClassInfo],
+                   fn: ast.FunctionDef) -> None:
+        self._scan_stmts(fi, cls, fn.body, held=())
+
+    def _scan_stmts(self, fi: _FuncInfo, cls: Optional[_ClassInfo],
+                    stmts: Sequence[ast.stmt],
+                    held: Tuple[Held, ...]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # deferred execution — not part of this flow
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in st.items:
+                    key = self._lock_expr_key(cls, item.context_expr)
+                    if key is not None:
+                        fi.acquires.append((new_held, key, st.lineno))
+                        new_held = new_held + ((key, st.lineno),)
+                    else:
+                        self._scan_expr(fi, item.context_expr, held)
+                self._scan_stmts(fi, cls, st.body, new_held)
+                continue
+            # every other statement: scan expressions at this held depth,
+            # then recurse into compound bodies
+            for expr in _stmt_exprs(st):
+                self._scan_expr(fi, expr, held)
+            for body in _stmt_bodies(st):
+                self._scan_stmts(fi, cls, body, held)
+
+    def _scan_expr(self, fi: _FuncInfo, expr: ast.AST,
+                   held: Tuple[Held, ...]) -> None:
+        # explicit traversal so DEFERRED bodies (lambdas, generator
+        # expressions) are pruned — ast.walk would descend into them and
+        # attribute their calls to the held region they merely close over
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Lambda, ast.GeneratorExp)):
+                continue
+            if isinstance(node, ast.Call):
+                desc = _io_desc(node)
+                if desc is not None:
+                    fi.io.append((held, node.lineno, desc))
+                else:
+                    fi.calls.append((held, node, node.lineno))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _lock_expr_key(self, cls: Optional[_ClassInfo],
+                       expr: ast.AST) -> Optional[str]:
+        """Lock key when `expr` is ``self.<lock-or-cond-attr>`` of the
+        enclosing class (or ``self.<attr>.lock`` style is NOT handled —
+        locks live on self by convention)."""
+        if cls is None:
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            attr = cls.aliases.get(expr.attr, expr.attr)
+            if attr in cls.locks:
+                return cls.lock_key(attr)
+        return None
+
+    # -- call resolution -------------------------------------------------
+
+    def _resolve_class(self, name: str) -> Optional[_ClassInfo]:
+        name = IMPLEMENTS.get(name, name)
+        infos = self.classes.get(name)
+        if infos and len(infos) == 1:
+            return infos[0]
+        return None
+
+    def _resolve_call(self, fi: _FuncInfo,
+                      call: ast.Call) -> Optional[_FuncInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            target = self.mod_funcs.get((fi.module, fn.id))
+            if target is not None:
+                return target
+            imp = self.imports.get((fi.module, fn.id))
+            if imp:
+                return self.mod_funcs.get(imp)
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and fi.cls is not None:
+                owner = self._resolve_class(fi.cls)
+                if owner is not None:
+                    target = owner.methods.get(fn.attr)
+                    if target is not None:
+                        return target
+                return None
+            # module-level singleton (e.g. transport_metrics.on_message):
+            # resolve in THIS module or through its imports only — a
+            # bare-name scan across all modules would bind same-named
+            # singletons in unrelated modules to the wrong class
+            cls_name = self.singletons.get((fi.module, base.id))
+            if cls_name is None:
+                imp = self.imports.get((fi.module, base.id))
+                if imp:
+                    cls_name = self.singletons.get(imp)
+            if cls_name is not None:
+                owner = self._resolve_class(cls_name)
+                if owner is not None:
+                    return owner.methods.get(fn.attr)
+            return None
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and fi.cls is not None):
+            owner = self._resolve_class(fi.cls)
+            if owner is not None:
+                attr_cls = (owner.attr_types.get(base.attr)
+                            or EXTRA_ATTR_BINDINGS.get((fi.cls, base.attr)))
+                if attr_cls:
+                    target_cls = self._resolve_class(attr_cls)
+                    if target_cls is not None:
+                        return target_cls.methods.get(fn.attr)
+        return None
+
+    # -- transitive effects ----------------------------------------------
+
+    def effects(self, fi: _FuncInfo) -> Tuple[Set[str], Set[str]]:
+        """(locks acquired anywhere in fi or its callees, I/O descs
+        reachable from fi). Computed as a TRUE fixpoint over the whole
+        call graph — a memoized DFS that cuts recursion cycles would
+        cache the cycle members' partial (often empty) effects and let
+        real deadlocks through the gate."""
+        if not self._effects:
+            self._fixpoint()
+        return self._effects.get(fi.qual, (set(), set()))
+
+    def _fixpoint(self) -> None:
+        funcs = list(self._all_funcs())
+        callees: Dict[str, List[str]] = {}
+        for fi in funcs:
+            self._effects[fi.qual] = (
+                {key for _, key, _ in fi.acquires},
+                {desc for _, _, desc in fi.io})
+            seen: Set[str] = set()
+            for _, call, _ in fi.calls:
+                target = self._resolve_call(fi, call)
+                if target is not None and target.qual not in seen:
+                    seen.add(target.qual)
+                    callees.setdefault(fi.qual, []).append(target.qual)
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                locks, io = self._effects[fi.qual]
+                for callee in callees.get(fi.qual, ()):
+                    t_locks, t_io = self._effects.get(callee, (set(), set()))
+                    if not (t_locks <= locks and t_io <= io):
+                        locks |= t_locks
+                        io |= t_io
+                        changed = True
+                self._effects[fi.qual] = (locks, io)
+
+    # -- analysis --------------------------------------------------------
+
+    def _all_funcs(self):
+        for infos in self.classes.values():
+            for info in infos:
+                yield from info.methods.values()
+        yield from self.mod_funcs.values()
+
+    def run(self) -> List[Finding]:
+        findings: List[Finding] = []
+        # edge: (src lock, dst lock) -> (path, line) of one witness site
+        edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for fi in self._all_funcs():
+            for held, key, line in fi.acquires:
+                for h, h_line in held:
+                    if h == key:
+                        if self.lock_kind.get(h) != "rlock":
+                            findings.append(Finding(
+                                "lock-order", fi.module, line,
+                                f"{fi.qual} re-acquires non-reentrant "
+                                f"lock {key} while holding it — "
+                                f"self-deadlock"))
+                        continue
+                    edges.setdefault((h, key), (fi.module, line))
+            # I/O findings anchor at the ACQUISITION line of the held
+            # lock so one justified pragma on the `with` covers the
+            # whole region
+            for held, line, desc in fi.io:
+                for h, h_line in held:
+                    findings.append(Finding(
+                        "lock-io", fi.module, h_line,
+                        f"{fi.qual} performs blocking I/O ({desc}, line "
+                        f"{line}) while holding {h} — a stalled "
+                        f"peer/volume pins the lock"))
+            for held, call, line in fi.calls:
+                if not held:
+                    continue
+                target = self._resolve_call(fi, call)
+                if target is None:
+                    continue
+                t_locks, t_io = self.effects(target)
+                for h, h_line in held:
+                    for t in t_locks:
+                        if t == h:
+                            if self.lock_kind.get(h) != "rlock":
+                                findings.append(Finding(
+                                    "lock-order", fi.module, line,
+                                    f"{fi.qual} holds {h} and calls "
+                                    f"{target.qual} which re-acquires it "
+                                    f"— self-deadlock (non-reentrant)"))
+                            continue
+                        edges.setdefault((h, t), (fi.module, line))
+                    for desc in sorted(t_io):
+                        findings.append(Finding(
+                            "lock-io", fi.module, h_line,
+                            f"{fi.qual} holds {h} across a call to "
+                            f"{target.qual} (line {line}), which reaches "
+                            f"blocking I/O ({desc})"))
+        findings.extend(self._cycles(edges))
+        return findings
+
+    @staticmethod
+    def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        # iterative Tarjan SCC
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    sccs.append(scc)
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        out: List[Finding] = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            # anchor the finding at one edge inside the cycle so a
+            # pragma there (with a justification) can suppress it
+            site = None
+            for a, b in edges:
+                if a in scc and b in scc:
+                    site = edges[(a, b)]
+                    break
+            path, line = site if site else ("", 0)
+            out.append(Finding(
+                "lock-order", path, line,
+                f"lock-order cycle (potential deadlock): "
+                f"{' -> '.join(cyc)} -> {cyc[0]} — acquisition order "
+                f"must be a DAG"))
+        return out
+
+
+def _ann_class(ann: Optional[ast.AST]) -> str:
+    """Class name out of an annotation: Name, 'String', Optional[X]."""
+    if ann is None:
+        return ""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        name = ann.value.strip('"\'')
+        return name if name and name[0].isupper() else ""
+    if isinstance(ann, ast.Name):
+        return ann.id if ann.id[0].isupper() else ""
+    if isinstance(ann, ast.Subscript):  # Optional[X] / List[X]
+        return _ann_class(ann.slice)
+    if isinstance(ann, ast.Attribute):
+        return ann.attr if ann.attr[0].isupper() else ""
+    return ""
+
+
+def _stmt_exprs(st: ast.stmt) -> List[ast.AST]:
+    """Expressions evaluated by this statement at its own nesting level
+    (compound bodies are recursed separately)."""
+    out: List[ast.AST] = []
+    for f in ("value", "test", "iter", "exc", "msg", "target", "targets"):
+        v = getattr(st, f, None)
+        if isinstance(v, ast.AST):
+            out.append(v)
+        elif isinstance(v, list):
+            out.extend(x for x in v if isinstance(x, ast.AST))
+    return out
+
+
+def _stmt_bodies(st: ast.stmt) -> List[List[ast.stmt]]:
+    out: List[List[ast.stmt]] = []
+    for f in ("body", "orelse", "finalbody"):
+        v = getattr(st, f, None)
+        if isinstance(v, list) and v and isinstance(v[0], ast.stmt):
+            out.append(v)
+    for h in getattr(st, "handlers", []) or []:
+        out.append(h.body)
+    return out
+
+
+class LockOrderPass(AnalysisPass):
+    """Framework adapter: run the analyzer over the concurrent-plane
+    modules (or an explicit scope for fixture tests)."""
+
+    id = "lock-order"  # emits lock-order AND lock-io findings
+    description = ("lock-acquisition cycles and held-lock blocking I/O "
+                   "across transport/gang/sched/serving/core")
+
+    def __init__(self, scope: Sequence[str] = DEFAULT_SCOPE) -> None:
+        self.scope = tuple(scope)
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        targets = [
+            s for s in files
+            if any(s.path.startswith(p) for p in self.scope)]
+        if not targets:
+            return []
+        return LockOrderAnalyzer(targets).run()

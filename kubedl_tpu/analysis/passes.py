@@ -1,0 +1,563 @@
+"""The repo-specific invariant passes (docs/static_analysis.md).
+
+Each pass encodes one invariant class CHANGES.md shows drifting by hand
+across review rounds — the pass is the reviewer's checklist item turned
+into a machine check. Scopes are deliberate and documented per pass:
+tests/ is excluded where tests legitimately violate the invariant (e.g.
+hand-building expected Prometheus lines, writing corrupt npz fixtures).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from kubedl_tpu.analysis.framework import (
+    AnalysisPass,
+    Finding,
+    RepoContext,
+    SourceFile,
+)
+
+# callables that render an ALREADY-ESCAPED label value; interpolating
+# one of these into a label position is the blessed discipline
+_ESCAPERS = {"escape_label_value", "_label"}
+# the one module allowed to state the escaping rules
+_PROM_HELPER = "kubedl_tpu/metrics/prom.py"
+
+
+def _in_tests(path: str) -> bool:
+    return path.startswith("tests/")
+
+
+# ---------------------------------------------------------------------------
+# prom-escape
+# ---------------------------------------------------------------------------
+
+
+class PromEscapePass(AnalysisPass):
+    """A ``kubedl_*`` exposition line rendered by hand must escape every
+    interpolated label VALUE through metrics/prom.py helpers — one stray
+    quote in a tenant/job/slice name blanks the whole scrape (the PR 10
+    lesson). %-format and .format() renders of label lines are flagged
+    outright: they cannot carry the escaping call at the value site."""
+
+    id = "prom-escape"
+    description = ("kubedl_* metric lines with unescaped interpolated "
+                   "label values outside metrics/prom.py")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.path == _PROM_HELPER or _in_tests(src.path):
+                # tests hand-build EXPECTED exposition lines; the helper
+                # module IS the escaping discipline
+                continue
+            # inner BinOps of an already-flagged concatenation chain
+            # (a + b + c parses as nested Adds) must not double-report
+            flagged_concat: set = set()
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.JoinedStr):
+                    out.extend(self._check_fstring(src, node))
+                elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                    lit = self._label_literal(node.left)
+                    if lit is not None:
+                        out.append(Finding(
+                            self.id, src.path, node.lineno,
+                            "%-format renders a kubedl_* label line — use "
+                            "an f-string with escape_label_value() or "
+                            "prom.sample()"))
+                elif (isinstance(node, ast.BinOp)
+                      and isinstance(node.op, ast.Add)
+                      and id(node) not in flagged_concat
+                      and self._concat_renders_labels(node)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.BinOp):
+                            flagged_concat.add(id(sub))
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        "string concatenation renders a kubedl_* label "
+                        "line — use an f-string with escape_label_value() "
+                        "or prom.sample()"))
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "format"):
+                    lit = self._label_literal(node.func.value)
+                    if lit is not None:
+                        out.append(Finding(
+                            self.id, src.path, node.lineno,
+                            ".format() renders a kubedl_* label line — use "
+                            "an f-string with escape_label_value() or "
+                            "prom.sample()"))
+        return out
+
+    @staticmethod
+    def _label_literal(node: ast.AST) -> Optional[str]:
+        """The string constant when `node` is a kubedl_* exposition
+        template with a label block, else None."""
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and "kubedl_" in node.value and '="' in node.value):
+            return node.value
+        return None
+
+    @classmethod
+    def _concat_renders_labels(cls, node: ast.BinOp) -> bool:
+        """True when an Add-chain splices dynamic values into a
+        kubedl_* label template (``'kubedl_x{job="' + job + '"} 1'``) —
+        the escape call cannot be checked at the value site, so the
+        whole construction is flagged like %-format."""
+        has_template = has_dynamic = False
+        for sub in ast.walk(node):
+            if cls._label_literal(sub) is not None:
+                has_template = True
+            elif isinstance(sub, (ast.Name, ast.Call, ast.Attribute,
+                                  ast.Subscript, ast.JoinedStr)):
+                has_dynamic = True
+        return has_template and has_dynamic
+
+    def _check_fstring(self, src: SourceFile, node: ast.JoinedStr) -> List[Finding]:
+        # Only f-strings that render a metric line WITH labels matter:
+        # some literal segment mentions kubedl_ and some segment opens a
+        # label value (ends with `="`). Values interpolated right after
+        # a `="` must be escape calls.
+        literals = [
+            v.value for v in node.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+        if not any("kubedl_" in s for s in literals):
+            return []
+        if not any(s.rstrip().endswith('="') or '="' in s for s in literals):
+            return []
+        out: List[Finding] = []
+        in_label_value = False
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                # walking the literal text tracks whether the NEXT
+                # interpolation lands between label-value quotes
+                for ch_idx in range(len(v.value)):
+                    if v.value[ch_idx] == '"':
+                        in_label_value = v.value[:ch_idx].endswith("=")
+                continue
+            if isinstance(v, ast.FormattedValue) and in_label_value:
+                if not self._is_escaped(v.value):
+                    out.append(Finding(
+                        self.id, src.path, v.value.lineno,
+                        f"label value interpolates "
+                        f"{{{src.segment(v.value) or '?'}}} unescaped — "
+                        f"wrap it in escape_label_value()/_label() or "
+                        f"render through prom.sample()"))
+        return out
+
+    @staticmethod
+    def _is_escaped(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        fn = value.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        return name in _ESCAPERS
+
+
+# ---------------------------------------------------------------------------
+# debug-vars-family
+# ---------------------------------------------------------------------------
+
+_RUNTIME_METRICS = "kubedl_tpu/metrics/runtime_metrics.py"
+_METRICS_DOC = "docs/metrics.md"
+_METRIC_NAME_RE = re.compile(r"kubedl_[a-z0-9_]+")
+
+
+def runtime_metric_families(src_text: Optional[str] = None,
+                            root: str = "") -> List[str]:
+    """The ``register_*`` family names on RuntimeMetrics, derived from
+    the AST — the machine-maintained half of what
+    test_debug_vars_has_every_newer_family used to hand-list."""
+    if src_text is None:
+        import os
+
+        with open(os.path.join(root or ".", _RUNTIME_METRICS)) as f:
+            src_text = f.read()
+    tree = ast.parse(src_text)
+    fams: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "RuntimeMetrics":
+            for item in node.body:
+                if (isinstance(item, ast.FunctionDef)
+                        and item.name.startswith("register_")):
+                    fams.append(item.name[len("register_"):])
+    return fams
+
+
+class DebugVarsFamilyPass(AnalysisPass):
+    """Every ``register_<family>`` snapshot hook on RuntimeMetrics must
+    be (a) read back in ``debug_vars()`` (or `kubedl-tpu top` can never
+    show it), (b) rendered in ``render()`` (or /metrics silently lacks
+    the family), and (c) every metric name that family renders must
+    appear in docs/metrics.md."""
+
+    id = "debug-vars-family"
+    description = ("RuntimeMetrics register_* families missing from "
+                   "/debug/vars, /metrics, or docs/metrics.md")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        src = next((s for s in files if s.path == _RUNTIME_METRICS), None)
+        if src is None:
+            return []
+        cls = next(
+            (n for n in ast.walk(src.tree)
+             if isinstance(n, ast.ClassDef) and n.name == "RuntimeMetrics"),
+            None)
+        if cls is None:
+            return [Finding(self.id, src.path, 1,
+                            "class RuntimeMetrics not found")]
+        registers: Dict[str, ast.FunctionDef] = {}
+        methods: Dict[str, ast.FunctionDef] = {}
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef):
+                methods[item.name] = item
+                if item.name.startswith("register_"):
+                    registers[item.name[len("register_"):]] = item
+        out: List[Finding] = []
+        dv = methods.get("debug_vars")
+        render = methods.get("render")
+        doc = ctx.doc_text(_METRICS_DOC)
+        for family, reg in sorted(registers.items()):
+            attrs = self._stored_attrs(reg)
+            if not attrs:
+                out.append(Finding(
+                    self.id, src.path, reg.lineno,
+                    f"register_{family} stores no self attribute — the "
+                    f"family cannot be rendered"))
+                continue
+            for method, surface in ((dv, "/debug/vars (debug_vars)"),
+                                    (render, "/metrics (render)")):
+                if method is None or not (attrs & self._read_attrs(method)):
+                    out.append(Finding(
+                        self.id, src.path, reg.lineno,
+                        f"register_{family} family is missing from "
+                        f"{surface} — a registered snapshot must be on "
+                        f"both surfaces"))
+            if render is not None:
+                for name in self._rendered_metric_names(src, render, attrs):
+                    base = re.sub(r"_(bucket|sum|count)$", "", name)
+                    if base not in doc and name not in doc:
+                        out.append(Finding(
+                            self.id, src.path, reg.lineno,
+                            f"metric {name} (family {family}) is not "
+                            f"documented in {_METRICS_DOC}"))
+        return out
+
+    @staticmethod
+    def _stored_attrs(fn: ast.FunctionDef) -> Set[str]:
+        """self attributes a register_* method assigns (plain or
+        subscripted: ``self._x = fn`` / ``self._x[k] = fn``)."""
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        t = t.value
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.add(t.attr)
+        return out
+
+    @staticmethod
+    def _read_attrs(fn: ast.FunctionDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and isinstance(node.ctx, ast.Load)):
+                out.add(node.attr)
+        return out
+
+    @staticmethod
+    def _rendered_metric_names(src: SourceFile, render: ast.FunctionDef,
+                               attrs: Set[str]) -> Set[str]:
+        """kubedl_* names rendered by the family's guarded block in
+        render(): find ``<var> = self.<attr>`` then the ``if <var> …``
+        statement using it, and regex the block's source. Families
+        rendered inline (no var-guard, e.g. the histogram core) fall
+        back to names near the attr's own statements — best-effort, the
+        docs check is advisory coverage, not a proof."""
+        names: Set[str] = set()
+        guard_vars: Set[str] = set()
+        for node in ast.walk(render):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+                if (isinstance(t, ast.Name) and isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self" and v.attr in attrs):
+                    guard_vars.add(t.id)
+        for node in ast.walk(render):
+            if isinstance(node, ast.If):
+                test_names = {
+                    n.id for n in ast.walk(node.test)
+                    if isinstance(n, ast.Name)}
+                if test_names & guard_vars:
+                    names.update(
+                        _METRIC_NAME_RE.findall(src.segment(node)))
+        return names
+
+
+# ---------------------------------------------------------------------------
+# shared-validation
+# ---------------------------------------------------------------------------
+
+
+class SharedValidationPass(AnalysisPass):
+    """Workload modules must not fork shape/validation rules away from
+    api/validation — submit-time and runtime checks drift apart exactly
+    when a workload grows a local ``validate_*`` (the PR 9/13 lesson:
+    validate_pipeline_shapes / validate_rl_shapes live in ONE place and
+    both sides call them). The controller hook ``validate_job`` is the
+    blessed entry point; everything else belongs in api/validation."""
+
+    id = "shared-validation"
+    description = ("local validate_* definitions in workload modules "
+                   "bypassing api/validation")
+
+    _ALLOWED = {"validate_job"}
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if not src.path.startswith("kubedl_tpu/workloads/"):
+                continue
+            for node in ast.walk(src.tree):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and re.match(r"^_?validate_", node.name)
+                        and node.name not in self._ALLOWED):
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        f"{node.name} defines validation rules locally — "
+                        f"move the rule into api/validation so submit and "
+                        f"runtime enforce one rule set"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# payload-dtype
+# ---------------------------------------------------------------------------
+
+# modules allowed to state an array-serialization format: each records
+# dtypes explicitly and round-trips bf16 as raw uint8 (the npz |V2
+# lesson from PR 6/8/9)
+_CODEC_MODULES = {
+    "kubedl_tpu/serving/handoff.py",     # serialized KV (rows_dtype)
+    "kubedl_tpu/train/reshard_runtime.py",  # staged shard blocks
+    "kubedl_tpu/rl/wire.py",             # named-array record codec
+}
+_NUMPY_SAVERS = {"save", "savez", "savez_compressed"}
+
+
+class PayloadDtypePass(AnalysisPass):
+    """Array payloads may be serialized only by the blessed codec
+    modules (wire/boundary/handoff): everything else must route through
+    them, because raw-uint8 + recorded dtype is the only discipline that
+    survives bf16 (np.savez alone void-types it to |V2, pickle pins the
+    producer's class layout)."""
+
+    id = "payload-dtype"
+    description = ("np.save/np.savez/pickle outside the blessed codec "
+                   "modules")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.path in _CODEC_MODULES or _in_tests(src.path):
+                # tests build corrupt/raw fixtures on purpose
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                base = fn.value
+                base_name = base.id if isinstance(base, ast.Name) else ""
+                if (base_name in ("np", "numpy")
+                        and fn.attr in _NUMPY_SAVERS):
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        f"{base_name}.{fn.attr} serializes arrays outside "
+                        f"the blessed codecs — bf16 dies in npz (|V2); "
+                        f"route through serving/handoff, rl/wire, or the "
+                        f"reshard staging codec"))
+                elif base_name == "pickle" and fn.attr in (
+                        "dump", "dumps", "load", "loads"):
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        f"pickle.{fn.attr} on payloads is forbidden — it "
+                        f"pins class layout and is unsafe across "
+                        f"incarnations; use an explicit dtype-recorded "
+                        f"codec"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# broad-except
+# ---------------------------------------------------------------------------
+
+_NOQA_BLE = re.compile(r"#\s*noqa:\s*BLE001\b\s*(?:[—–-]+\s*(?P<why>\S.*))?")
+_LOUD_ATTRS = {
+    # logging-ish routing: the failure is visible downstream
+    "exception", "error", "warning", "critical", "info", "debug",
+}
+
+
+class BroadExceptPass(AnalysisPass):
+    """``except Exception`` may not swallow silently: the handler must
+    re-raise, route the failure loudly (logger / recorder / print /
+    classified EXIT_* code from utils/exit_codes), or carry a justified
+    pragma. The repo's ``# noqa: BLE001 — why`` idiom on the except
+    line counts as the pragma; a BARE ``noqa: BLE001`` on a swallowing
+    handler is flagged — the why must travel with the suppression."""
+
+    id = "broad-except"
+    description = ("except Exception handlers that swallow without "
+                   "re-raise, loud routing, or a justified pragma")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not self._is_broad(node.type):
+                    continue
+                if self._handler_is_loud(node):
+                    continue
+                line_text = (src.lines[node.lineno - 1]
+                             if node.lineno - 1 < len(src.lines) else "")
+                m = _NOQA_BLE.search(line_text)
+                if m and m.group("why"):
+                    continue  # the justified-noqa idiom IS the pragma
+                if m:
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        "broad except swallows behind a BARE noqa: BLE001 "
+                        "— add the justification (`# noqa: BLE001 — why`)"))
+                else:
+                    out.append(Finding(
+                        self.id, src.path, node.lineno,
+                        "broad except swallows silently — re-raise, route "
+                        "through a logger/recorder or the exit taxonomy, "
+                        "or justify with `# noqa: BLE001 — why`"))
+        return out
+
+    @staticmethod
+    def _is_broad(type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True  # bare except
+        names = []
+        for n in ([type_node] if not isinstance(type_node, ast.Tuple)
+                  else list(type_node.elts)):
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @classmethod
+    def _handler_is_loud(cls, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Return):
+                # returning a classified exit code routes the failure
+                # through the retryable/permanent taxonomy
+                v = node.value
+                if isinstance(v, ast.Name) and v.id.startswith("EXIT_"):
+                    return True
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr in _LOUD_ATTRS:
+                        return True
+                    if fn.attr in ("exit", "_exit"):
+                        return True  # sys.exit / os._exit with a code
+                elif isinstance(fn, ast.Name) and fn.id == "print":
+                    # pod programs log via print; a printed failure is
+                    # not a silent one
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# bench-lane-merge
+# ---------------------------------------------------------------------------
+
+_EXTRAS_FILE = ".bench_extras.json"
+# functions allowed to touch .bench_extras.json directly: the shared
+# guarded-merge lane body and the full-run snapshot merge in main()
+_EXTRAS_BLESSED = {"_single_lane", "main"}
+
+
+class BenchLaneMergePass(AnalysisPass):
+    """Bench lanes must fold ONLY their own keys into .bench_extras.json
+    and only through ``_single_lane`` — a CPU smoke lane that clobbers
+    the chip's committed peak/probe records destroys acceptance
+    evidence (the PR 6 lesson, restated for every later lane)."""
+
+    id = "bench-lane-merge"
+    description = (".bench_extras.json touched outside _single_lane, or "
+                   "a lane merging keys it does not produce")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        out: List[Finding] = []
+        for src in files:
+            if src.path != "bench.py":
+                continue
+            func_of: Dict[int, str] = {}
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if hasattr(sub, "lineno"):
+                            func_of.setdefault(sub.lineno, node.name)
+            for node in ast.walk(src.tree):
+                if (isinstance(node, ast.Constant)
+                        and node.value == _EXTRAS_FILE):
+                    fn = func_of.get(node.lineno, "<module>")
+                    if fn not in _EXTRAS_BLESSED:
+                        out.append(Finding(
+                            self.id, src.path, node.lineno,
+                            f"{_EXTRAS_FILE} referenced in {fn}() — lanes "
+                            f"merge through _single_lane(merge_keys=...) "
+                            f"only"))
+                if isinstance(node, ast.Call):
+                    fn_name = (node.func.id
+                               if isinstance(node.func, ast.Name) else "")
+                    if fn_name != "_single_lane":
+                        continue
+                    milestones = self._str_tuple(
+                        node.args[1] if len(node.args) > 1 else None)
+                    merge_keys = None
+                    for kw in node.keywords:
+                        if kw.arg == "merge_keys":
+                            merge_keys = self._str_tuple(kw.value)
+                    if milestones is None or not merge_keys:
+                        continue
+                    extra = set(merge_keys) - set(milestones)
+                    if extra:
+                        out.append(Finding(
+                            self.id, src.path, node.lineno,
+                            f"lane merges keys it does not produce: "
+                            f"{sorted(extra)} not among milestones "
+                            f"{sorted(milestones)} — another lane's "
+                            f"committed record would be clobbered"))
+        return out
+
+    @staticmethod
+    def _str_tuple(node: Optional[ast.AST]) -> Optional[List[str]]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = []
+            for e in node.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    return None
+                vals.append(e.value)
+            return vals
+        return None

@@ -1,0 +1,234 @@
+"""Opt-in runtime lock witness — the dynamic half of the lock-order
+analysis (docs/static_analysis.md).
+
+The static pass (lockorder.py) proves which inversions are POSSIBLE;
+this witness records the acquisition orders a real run actually takes
+and fails LOUDLY the moment two locks are ever taken in both orders —
+the Python port's stand-in for the Go reference's ``-race`` habit,
+exercised by the chaos/e2e lanes.
+
+Product classes construct their locks through ``new_lock(name)`` /
+``new_rlock(name)``. With ``KUBEDL_LOCK_WITNESS`` unset (the default,
+and every production path) these return plain ``threading.Lock`` /
+``RLock`` — zero wrapping, zero overhead. With the env var set at lock
+construction time, locks are wrapped to:
+
+  * keep a per-thread stack of held witness locks;
+  * record every (held, acquired) NAME pair into a global order graph;
+  * on acquiring B while holding A when B->A was already observed
+    (any thread, any time in this process), record the inversion AND
+    raise RuntimeError at the acquisition site — an inverted order is a
+    deadlock waiting for the right interleaving, and the test must see
+    it even if this run got lucky;
+  * reentrant re-acquisition of the SAME lock object records nothing
+    (RLock semantics); two INSTANCES sharing a name record a self-edge
+    but never an inversion (instances are not statically orderable).
+
+``KUBEDL_LOCK_WITNESS_DIR`` makes the process dump its observed edges +
+inversions as JSON at exit (one file per pid), so the two-process
+transport/RL e2e tests can assert the fleet ran inversion-free.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+ENV_WITNESS = "KUBEDL_LOCK_WITNESS"
+ENV_WITNESS_DIR = "KUBEDL_LOCK_WITNESS_DIR"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_WITNESS, "") not in ("", "0")
+
+
+class LockInversion(RuntimeError):
+    """Two locks observed in both acquisition orders — a deadlock
+    waiting for the right interleaving."""
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # (first, then) -> times observed
+        self._edges: Dict[Tuple[str, str], int] = {}
+        self._inversions: List[Dict] = []
+        self._tls = threading.local()
+        self._dump_registered = False
+
+    def _held(self) -> List[Tuple[str, int]]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def on_acquired(self, name: str, obj_id: int) -> Optional[Dict]:
+        """Record the acquisition; returns the inversion record (also
+        stored) when this order contradicts one already observed. The
+        CALLER raises — after releasing the just-acquired inner lock,
+        so a failing background thread fails loudly instead of leaving
+        the lock held forever and hanging shutdown."""
+        held = self._held()
+        if any(oid == obj_id for _, oid in held):
+            # reentrant re-acquisition of the same object (RLock):
+            # still push so releases balance, but record no edges
+            held.append((name, obj_id))
+            return None
+        inversion: Optional[Dict] = None
+        with self._lock:
+            for h_name, h_oid in held:
+                if h_name == name:
+                    continue  # sibling instances are not orderable
+                self._edges[(h_name, name)] = (
+                    self._edges.get((h_name, name), 0) + 1)
+                if (name, h_name) in self._edges and inversion is None:
+                    inversion = {
+                        "first": h_name, "then": name,
+                        "thread": threading.current_thread().name,
+                    }
+                    self._inversions.append(inversion)
+        held.append((name, obj_id))
+        return inversion
+
+    def on_released(self, obj_id: int) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == obj_id:
+                del held[i]
+                return
+
+    def report(self) -> Dict:
+        with self._lock:
+            return {
+                "edges": sorted([a, b] for (a, b) in self._edges),
+                "inversions": list(self._inversions),
+            }
+
+    def reset(self) -> None:
+        """Test isolation: drop the graph AND this thread's held stack
+        (belt for tests that abandon locks mid-assertion)."""
+        with self._lock:
+            self._edges.clear()
+            self._inversions.clear()
+        self._tls.held = []
+
+    def maybe_register_dump(self) -> None:
+        out_dir = os.environ.get(ENV_WITNESS_DIR, "")
+        if not out_dir or self._dump_registered:
+            return
+        self._dump_registered = True
+        atexit.register(self._dump, out_dir)
+
+    def _dump(self, out_dir: str) -> None:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, f"witness-{os.getpid()}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.report(), f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # a lost report only weakens the assertion, the
+            # inversion itself already raised at the acquisition site
+
+
+registry = _Registry()
+
+
+class WitnessLock:
+    """Wraps a real lock; usable everywhere ``threading.Lock``/``RLock``
+    is (context manager, acquire/release, Condition-compatible)."""
+
+    def __init__(self, inner, name: str) -> None:
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            inv = registry.on_acquired(self._name, id(self))
+            if inv is not None:
+                # fail LOUDLY but not wedged: release what we just took
+                # (and its held-stack entry) before raising, or an
+                # inversion on a daemon thread would leave the lock held
+                # forever and turn the loud failure into a shutdown hang
+                registry.on_released(id(self))
+                self._inner.release()
+                self._raise(inv)
+        return got
+
+    @staticmethod
+    def _raise(inv: Dict) -> None:
+        raise LockInversion(
+            f"lock order inversion: acquired {inv['then']!r} while "
+            f"holding {inv['first']!r}, but the opposite order was also "
+            f"observed in this process — a deadlock waiting for the "
+            f"right interleaving")
+
+    def release(self) -> None:
+        self._inner.release()
+        registry.on_released(id(self))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition() interop: threading.Condition probes the lock for
+    # _release_save/_acquire_restore/_is_owned with try/except
+    # AttributeError and falls back to plain release()/acquire() when
+    # absent. These must therefore exist ONLY when the inner lock has
+    # them (RLock) — a method defined unconditionally would make a
+    # Condition over a witnessed plain Lock crash at wait() time, and
+    # only in the witness-enabled chaos lanes.
+    def __getattr__(self, name: str):
+        if name == "_is_owned":
+            return self._inner._is_owned  # AttributeError on plain Lock
+        if name == "_release_save":
+            inner_rs = self._inner._release_save
+
+            def _release_save():
+                state = inner_rs()
+                registry.on_released(id(self))
+                return state
+
+            return _release_save
+        if name == "_acquire_restore":
+            inner_ar = self._inner._acquire_restore
+
+            def _acquire_restore(state):
+                inner_ar(state)
+                inv = registry.on_acquired(self._name, id(self))
+                if inv is not None:
+                    registry.on_released(id(self))
+                    self._inner.release()
+                    self._raise(inv)
+
+            return _acquire_restore
+        raise AttributeError(name)
+
+
+def new_lock(name: str):
+    """A ``threading.Lock`` — witness-wrapped when KUBEDL_LOCK_WITNESS
+    is set at construction time. `name` identifies the lock CLASS-wide
+    (``module.Class.attr``), matching the static pass's lock keys."""
+    if not enabled():
+        return threading.Lock()
+    registry.maybe_register_dump()
+    return WitnessLock(threading.Lock(), name)
+
+
+def new_rlock(name: str):
+    """A ``threading.RLock`` — witness-wrapped when KUBEDL_LOCK_WITNESS
+    is set at construction time."""
+    if not enabled():
+        return threading.RLock()
+    registry.maybe_register_dump()
+    return WitnessLock(threading.RLock(), name)

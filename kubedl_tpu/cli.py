@@ -555,6 +555,24 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    """Fleet invariant analyzer (docs/static_analysis.md): run the AST
+    lint passes + lock-order analysis and print the report — the same
+    gate `make lint`/presubmit runs, inspectable like `top`/`trace`."""
+    from kubedl_tpu.analysis.__main__ import main as analysis_main
+
+    argv = []
+    if args.json:
+        argv.append("--json")
+    if args.no_tests:
+        argv.append("--no-tests")
+    if args.show_allowlisted:
+        argv.append("--show-allowlisted")
+    if args.root:
+        argv += ["--root", args.root]
+    return analysis_main(argv)
+
+
 def cmd_run(args) -> int:
     op = _mk_operator(args)
     op.register_all()
@@ -822,6 +840,20 @@ def main(argv=None) -> int:
                          help="read spans from a local trace dir instead "
                               "of the operator server")
     p_trace.set_defaults(fn=cmd_trace)
+
+    p_an = sub.add_parser(
+        "analyze",
+        help="fleet invariant analyzer: AST lint passes + lock-order "
+             "report (docs/static_analysis.md)")
+    p_an.add_argument("--json", action="store_true",
+                      help="machine-readable report")
+    p_an.add_argument("--no-tests", action="store_true",
+                      help="skip tests/ (default scope includes it)")
+    p_an.add_argument("--show-allowlisted", action="store_true",
+                      help="also print pragma-suppressed findings")
+    p_an.add_argument("--root", default="",
+                      help="repo root (default: auto-detect)")
+    p_an.set_defaults(fn=cmd_analyze)
 
     args = parser.parse_args(argv)
     return args.fn(args)

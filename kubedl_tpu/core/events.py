@@ -8,11 +8,15 @@ event-persistence controller consumes (ref controllers/persist/event/).
 """
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from kubedl_tpu.api.meta import ObjectMeta, now
+from kubedl_tpu.analysis.witness import new_lock
+
+log = logging.getLogger("kubedl_tpu.events")
 
 EVENT_TYPE_NORMAL = "Normal"
 EVENT_TYPE_WARNING = "Warning"
@@ -57,7 +61,7 @@ class EventRecorder:
 
     def __init__(self, store) -> None:
         self._store = store
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.events.EventRecorder._lock")
         self._seq = 0
         # correlator cache: (ns, name, kind, reason, message) -> event name.
         # Like client-go's EventCorrelator this is per-recorder in-memory
@@ -90,8 +94,12 @@ class EventRecorder:
                 ev.last_timestamp = ts
                 self._store.update(ev)
                 return
-            except Exception:
-                pass  # event expired/conflicted: fall through to a new one
+            except Exception as e:  # noqa: BLE001 — expired/conflicted:
+                # fall through to a new event, but say so — a silently
+                # failing coalesce path looks like healthy dedup
+                log.debug("event coalesce for %s/%s failed (%s); "
+                          "emitting a fresh event", ref.namespace,
+                          cached_name, e)
         ev = Event(
             metadata=ObjectMeta(name=name, namespace=ref.namespace),
             involved_object=ref,
@@ -107,8 +115,11 @@ class EventRecorder:
                 while len(self._names) >= self._names_cap:
                     self._names.pop(next(iter(self._names)))
                 self._names[key] = name
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — events are best-effort,
+            # but a store that refuses them should be VISIBLE in the
+            # operator log, not silently eventless
+            log.warning("could not record event %s %s for %s/%s: %s",
+                        etype, reason, ref.namespace, ref.name, e)
 
     def normal(self, obj, reason: str, message: str) -> None:
         self.event(obj, EVENT_TYPE_NORMAL, reason, message)

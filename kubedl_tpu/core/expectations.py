@@ -10,6 +10,8 @@ Expectations expire after a TTL so a lost watch event cannot wedge a job.
 from __future__ import annotations
 
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 import time
 from dataclasses import dataclass
 from typing import Dict
@@ -40,7 +42,7 @@ class _Entry:
 
 class ControllerExpectations:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.expectations.ControllerExpectations._lock")
         self._entries: Dict[str, _Entry] = {}
 
     def expect_creations(self, key: str, count: int) -> None:

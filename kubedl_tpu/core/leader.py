@@ -13,6 +13,8 @@ from __future__ import annotations
 import fcntl
 import os
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 import time
 from typing import Callable, Optional
 
@@ -30,7 +32,7 @@ class FileLeaseElector:
         self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
         self.retry_period = retry_period
         self._fd: Optional[int] = None
-        self._lock = threading.Lock()
+        self._lock = new_lock("core.leader.FileLeaseElector._lock")
 
     @property
     def is_leader(self) -> bool:

@@ -16,6 +16,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from kubedl_tpu.analysis.witness import new_rlock
 from kubedl_tpu.api.meta import (
     DELETE_BACKGROUND,
     DELETE_FOREGROUND,
@@ -103,7 +104,7 @@ def write_status(store, obj):
 
 class ObjectStore:
     def __init__(self, gc: bool = True) -> None:
-        self._lock = threading.RLock()
+        self._lock = new_rlock("core.store.ObjectStore._lock")
         # kind -> "ns/name" -> object
         self._objects: Dict[str, Dict[str, Any]] = {}
         self._rv = 0

@@ -36,6 +36,7 @@ from kubedl_tpu.api.pod import (
     PodRestartPolicy,
 )
 from kubedl_tpu.core.store import ADDED, DELETED, Conflict, NotFound, ObjectStore, write_status
+from kubedl_tpu.analysis.witness import new_lock
 
 log = logging.getLogger("kubedl_tpu.executor")
 
@@ -93,7 +94,7 @@ class LocalPodExecutor:
         self.transport = os.environ.get("KUBEDL_TRANSPORT", "dir")
         self._job_tokens: Dict[str, str] = {}
         self._running: Dict[str, _RunningPod] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("executor.local.LocalPodExecutor._lock")
         self._stop = threading.Event()
         self._watch = None
         self._thread: Optional[threading.Thread] = None

@@ -49,6 +49,7 @@ from kubedl_tpu.gang.interface import (
     GangSnapshot,
 )
 from kubedl_tpu.utils.tenancy import get_tenancy
+from kubedl_tpu.analysis.witness import new_rlock
 
 
 @dataclass
@@ -153,7 +154,7 @@ class TPUSliceAdmitter(GangScheduler):
         drain_timeout: float = 30.0,
     ) -> None:
         self.store = store
-        self._lock = threading.RLock()
+        self._lock = new_rlock("gang.slice_admitter.TPUSliceAdmitter._lock")
         self._slices: Dict[str, SliceInfo] = {s.name: s for s in (slices or [])}
         self._gangs: Dict[str, _GangState] = {}
         # implicit single-pod reservations: pod key -> slice name

@@ -18,13 +18,14 @@ from typing import Dict, List, Optional, Tuple
 from kubedl_tpu.api.common import JobStatus, is_created, is_failed, is_running, is_succeeded
 from kubedl_tpu.api.pod import Pod
 from kubedl_tpu.metrics.prom import escape_label_value
+from kubedl_tpu.analysis.witness import new_lock
 
 
 class JobMetrics:
     def __init__(self, kind: str, registry: Optional["MetricsRegistry"] = None) -> None:
         self.kind = kind
         self.registry = registry
-        self._lock = threading.Lock()
+        self._lock = new_lock("metrics.job_metrics.JobMetrics._lock")
         self.created = 0
         self.deleted = 0
         self.successful = 0
@@ -116,7 +117,7 @@ class MetricsRegistry:
     """Aggregates per-kind JobMetrics; renders Prometheus text exposition."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("metrics.job_metrics.MetricsRegistry._lock")
         self._metrics: Dict[str, JobMetrics] = {}
 
     def register(self, jm: JobMetrics) -> None:

@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from kubedl_tpu.metrics.prom import escape_label_value, sample
+from kubedl_tpu.analysis.witness import new_lock
 
 BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
 
@@ -51,7 +52,7 @@ class PipelineMetrics:
     the operator registers (RuntimeMetrics.register_pipeline)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("metrics.runtime_metrics.PipelineMetrics._lock")
         self._jobs: Dict[str, Dict] = {}
 
     def observe_step(
@@ -97,7 +98,7 @@ class RuntimeMetrics:
     """Thread-safe collector for the reconcile engine."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("metrics.runtime_metrics.RuntimeMetrics._lock")
         self._durations: Dict[str, _Histogram] = {}
         self._errors: Dict[str, int] = {}
         self._requeues: Dict[str, int] = {}
@@ -193,33 +194,33 @@ class RuntimeMetrics:
                 for b, c in zip(BUCKETS, h.counts):
                     cum += c
                     lines.append(
-                        f'kubedl_reconcile_duration_seconds_bucket{{controller="{name}",le="{b}"}} {cum}'
+                        f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="{_label(b)}"}} {cum}'
                     )
                 lines.append(
-                    f'kubedl_reconcile_duration_seconds_bucket{{controller="{name}",le="+Inf"}} {h.total}'
+                    f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="+Inf"}} {h.total}'
                 )
                 lines.append(
-                    f'kubedl_reconcile_duration_seconds_sum{{controller="{name}"}} {h.sum:.6f}'
+                    f'kubedl_reconcile_duration_seconds_sum{{controller="{_label(name)}"}} {h.sum:.6f}'
                 )
                 lines.append(
-                    f'kubedl_reconcile_duration_seconds_count{{controller="{name}"}} {h.total}'
+                    f'kubedl_reconcile_duration_seconds_count{{controller="{_label(name)}"}} {h.total}'
                 )
             lines.append("# HELP kubedl_reconcile_errors_total Reconcile errors per controller")
             lines.append("# TYPE kubedl_reconcile_errors_total counter")
             for name, n in sorted(self._errors.items()):
-                lines.append(f'kubedl_reconcile_errors_total{{controller="{name}"}} {n}')
+                lines.append(f'kubedl_reconcile_errors_total{{controller="{_label(name)}"}} {n}')
             lines.append("# HELP kubedl_reconcile_requeues_total Rate-limited requeues per controller")
             lines.append("# TYPE kubedl_reconcile_requeues_total counter")
             for name, n in sorted(self._requeues.items()):
-                lines.append(f'kubedl_reconcile_requeues_total{{controller="{name}"}} {n}')
+                lines.append(f'kubedl_reconcile_requeues_total{{controller="{_label(name)}"}} {n}')
             lines.append("# HELP kubedl_workqueue_depth Current workqueue depth per controller")
             lines.append("# TYPE kubedl_workqueue_depth gauge")
             for name, fn in sorted(self._queue_depth.items()):
                 try:
                     depth = fn()
-                except Exception:
+                except Exception:  # noqa: BLE001 — callback raced shutdown
                     depth = -1
-                lines.append(f'kubedl_workqueue_depth{{controller="{name}"}} {depth}')
+                lines.append(f'kubedl_workqueue_depth{{controller="{_label(name)}"}} {depth}')
             slice_fn = self._slice_pool
         # Call the pool snapshot OUTSIDE the metrics lock: it takes the
         # admitter's lock, and holding both would pin a lock order that a
@@ -318,7 +319,7 @@ class RuntimeMetrics:
                         cum += n
                         lines.append(
                             f'kubedl_resize_downtime_seconds_bucket'
-                            f'{{le="{le}"}} {cum}')
+                            f'{{le="{_label(le)}"}} {cum}')
                     lines.append(
                         f'kubedl_resize_downtime_seconds_bucket{{le="+Inf"}} '
                         f'{downtime["count"]}')
@@ -356,7 +357,7 @@ class RuntimeMetrics:
                             (rec.get("stage_step_s") or {}).items()):
                         lines.append(
                             f'kubedl_pipeline_stage_step_seconds'
-                            f'{{job="{_label(job)}",stage="{stage}"}} '
+                            f'{{job="{_label(job)}",stage="{_label(stage)}"}} '
                             f'{secs:.6f}')
                 lines.append("# HELP kubedl_pipeline_steps_total Pipeline "
                              "train steps observed per job")

@@ -24,6 +24,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from kubedl_tpu.obs.trace import ENV_TRACE_DIR, STEP_SUFFIX
+from kubedl_tpu.analysis.witness import new_lock
 
 HEARTBEAT_FILE = "heartbeat.json"
 
@@ -180,7 +181,7 @@ class StepAggregator:
         # heartbeats (their control dirs are rmtree'd with the pod) must
         # not export stale series forever. 0 disables pruning.
         self.max_age_s = float(max_age_s)
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.steps.StepAggregator._lock")
         # job key -> pod -> latest record
         self._jobs: Dict[str, Dict[str, Dict]] = {}
 

@@ -13,6 +13,8 @@ spans instead.
 from __future__ import annotations
 
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 from typing import Dict
 
 
@@ -20,7 +22,7 @@ class RLMetrics:
     """Thread-safe per-job RL fleet health."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("rl.metrics.RLMetrics._lock")
         self._jobs: Dict[str, Dict] = {}
 
     def _job(self, job: str) -> Dict:

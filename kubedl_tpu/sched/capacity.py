@@ -41,6 +41,7 @@ from kubedl_tpu.gang.interface import (
 )
 from kubedl_tpu.sched.policy import make_policy
 from kubedl_tpu.sched.quota import TenantQuotas
+from kubedl_tpu.analysis.witness import new_lock
 
 log = logging.getLogger("kubedl_tpu.sched")
 
@@ -105,7 +106,7 @@ class CapacityScheduler(CapacityDirector):
             weights=self.config.tenant_weights, caps=self.config.tenant_caps
         )
         self.policy = make_policy(self.config.policy, self.quotas)
-        self._lock = threading.Lock()
+        self._lock = new_lock("sched.capacity.CapacityScheduler._lock")
         self._last_tick: Optional[float] = None
         self._preemptions_total = 0
         self._resizes_total = 0
@@ -415,7 +416,7 @@ class CapacityScheduler(CapacityDirector):
                 name, duration_s=duration_s,
                 trace_id=trace_id_for(namespace, job),
                 job=job, namespace=namespace, **attrs)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — recording must never block scheduling
             pass
 
     def _usage(self, snaps: Optional[List[GangSnapshot]] = None):

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import math
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 from typing import Dict, Iterable, Optional
 
 DEFAULT_TENANT = "default"
@@ -46,7 +48,7 @@ class TenantQuotas:
         self._weights = {normalize_tenant(k): float(v) for k, v in (weights or {}).items()}
         self._caps = {normalize_tenant(k): int(v) for k, v in (caps or {}).items()}
         self.default_weight = float(default_weight)
-        self._lock = threading.Lock()
+        self._lock = new_lock("sched.quota.TenantQuotas._lock")
         self._chip_seconds: Dict[str, float] = {}
         self._preemptions: Dict[str, int] = {}
 

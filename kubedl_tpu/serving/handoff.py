@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import io
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -53,7 +55,7 @@ class HandoffQueue:
 
     def __init__(self, maxlen: Optional[int] = None) -> None:
         self._q: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.handoff.HandoffQueue._lock")
         self.maxlen = maxlen
         self.put_count = 0
 

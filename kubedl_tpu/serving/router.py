@@ -51,6 +51,7 @@ from kubedl_tpu.serving.handoff import (
     serialize_item,
 )
 from kubedl_tpu.serving.kv_pool import PoolExhausted
+from kubedl_tpu.analysis.witness import new_lock
 
 import jax
 
@@ -68,7 +69,7 @@ class PrefillPod:
         self.healthy = True
         self.draining = False
         self._queue: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.router.PrefillPod._lock")
         self._key = jax.random.PRNGKey(seed)
 
     def queue_len(self) -> int:
@@ -144,7 +145,7 @@ class DecodePod:
             max_top_k=max_top_k, share_prefixes=share_prefixes)
         self.healthy = True
         self.draining = False
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.router.DecodePod._lock")
 
     def free_slots(self) -> int:
         with self._lock:
@@ -235,7 +236,7 @@ class ServingRouter:
         # a long-running router never accumulates dead prompt arrays
         self._by_id: Dict[int, Request] = {}
         self._next_id = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("serving.router.ServingRouter._lock")
         self.migrations = 0
         self.serialized_bytes = 0
 

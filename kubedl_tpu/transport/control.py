@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from kubedl_tpu.transport.plane import TransportError, TransportPlane
+from kubedl_tpu.analysis.witness import new_lock
 
 log = logging.getLogger("kubedl_tpu.transport")
 
@@ -58,7 +59,7 @@ class SocketControlRouter:
         # pending entry (and a very late stale reply's spool write)
         # would outlive the scheduler's own deadline forever
         self.reply_ttl_s = reply_ttl_s
-        self._lock = threading.Lock()
+        self._lock = new_lock("transport.control.SocketControlRouter._lock")
         self._seq = 0
         self._pending: Dict[str, tuple] = {}  # tag -> (spool path, deadline)
         os.makedirs(spool_dir, exist_ok=True)

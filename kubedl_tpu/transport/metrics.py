@@ -9,6 +9,8 @@ Counters only — the plane must never block on its own accounting.
 from __future__ import annotations
 
 import threading
+
+from kubedl_tpu.analysis.witness import new_lock
 from typing import Dict, Tuple
 
 
@@ -16,7 +18,7 @@ class TransportMetrics:
     """Thread-safe counters for every transport plane in the process."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("transport.metrics.TransportMetrics._lock")
         # (channel, dir) -> count/bytes; dir is "send" | "recv"
         self._messages: Dict[Tuple[str, str], int] = {}
         self._bytes: Dict[Tuple[str, str], int] = {}

@@ -56,6 +56,7 @@ import uuid
 from typing import Callable, Dict, Optional, Tuple
 
 from kubedl_tpu.transport.metrics import transport_metrics
+from kubedl_tpu.analysis.witness import new_lock
 
 ENV_TRANSPORT = "KUBEDL_TRANSPORT"  # socket | dir
 ENV_TOKEN = "KUBEDL_TRANSPORT_TOKEN"
@@ -195,7 +196,7 @@ class _Peer:
     def __init__(self, plane: "TransportPlane", addr: str) -> None:
         self.plane = plane
         self.addr = addr
-        self.lock = threading.Lock()
+        self.lock = new_lock("transport.plane._Peer.lock")
         self.sock: Optional[socket.socket] = None
         self.boot: Optional[str] = None  # latched listener incarnation
         self._seq = 0
@@ -277,6 +278,7 @@ class _Peer:
         """Send one message and wait for its ACK; on a dropped
         connection, reconnect and RESEND (the accept side dedups)."""
         timeout = self.plane.io_timeout if timeout is None else timeout
+        # kubedl-analysis: allow[lock-io] one in-flight MSG->ACK per connection IS this lock's contract: it serializes the socket, never guards shared state, and send timeouts bound the hold
         with self.lock:
             self._seq += 1
             seq = self._seq
@@ -317,6 +319,7 @@ class _Peer:
                             f"after {resend + 1} attempts") from None
 
     def ping(self) -> None:
+        # kubedl-analysis: allow[lock-io] heartbeats ride the same per-connection serialization lock as send_msg; io_timeout bounds the hold
         with self.lock:
             if self.sock is None:
                 return  # nothing to keep alive
@@ -402,7 +405,7 @@ class TransportPlane:
         self._peers: Dict[str, _Peer] = {}
         self._inboxes: Dict[str, _Inbox] = {}
         self._subs: Dict[str, Callable[[str, bytes], None]] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("transport.plane.TransportPlane._lock")
         self._stop = threading.Event()
 
     def _trace(self, name: str, duration_s: float = 0.0, **attrs) -> None:
@@ -419,7 +422,7 @@ class TransportPlane:
         if self._tracer:
             try:
                 self._tracer.record(name, duration_s=duration_s, **attrs)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — tracing must never block I/O
                 pass
 
     # -- listen side -----------------------------------------------------

@@ -11,6 +11,7 @@ import time
 import pytest
 
 from kubedl_tpu.api.common import JobConditionType, has_condition
+from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.operator import Operator, OperatorConfig
 
 from fake_workload import TEST_KIND, TestJobController
@@ -179,7 +180,7 @@ def test_ttl_cleanup_end_to_end():
         while time.monotonic() < deadline:
             try:
                 op.store.get(TEST_KIND, "default", "ttl-job")
-            except Exception:
+            except NotFound:
                 break
             time.sleep(0.1)
         else:

@@ -10,6 +10,7 @@ compose: operator -> gang (2 slices) -> pods -> trainer -> Succeeded.
 """
 import sys
 
+from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.operator import Operator, OperatorConfig
 from kubedl_tpu.workloads.jaxjob import JAXJobController
 import pytest
@@ -67,8 +68,8 @@ def test_multislice_job_trains_to_success(tmp_path):
                 pg = op.store.get("PodGroup", "default", "ms-e2e")
                 if pg.status.phase == "Reserved":
                     break
-            except Exception:
-                pass
+            except NotFound:
+                pass  # the PodGroup mirror has not been written yet
             time.sleep(0.2)
         assert pg is not None and pg.status.phase == "Reserved"
         assert pg.spec.num_slices == 2

@@ -4,6 +4,7 @@ reconnect, staleness bound, behavior-logprob parity oracle, learner
 parity vs the monolithic GRPO loop, mixed-role gang admission, metrics
 families, and the two-process actor+learner e2e on the local executor."""
 import json
+import os
 import sys
 
 import numpy as np
@@ -755,6 +756,12 @@ def test_two_process_actor_learner_e2e_one_trace_id(tmp_path):
 
     ckpt = str(tmp_path / "ckpt")
     trace_root = str(tmp_path / "trace")
+    # the chaos/e2e lanes run with the runtime lock witness ON
+    # (docs/static_analysis.md): each pod process records its real lock
+    # acquisition orders and the fleet must complete inversion-free
+    witness_dir = str(tmp_path / "witness")
+    pod_env = {**CPU_ENV, "KUBEDL_LOCK_WITNESS": "1",
+               "KUBEDL_LOCK_WITNESS_DIR": witness_dir}
     op = Operator(OperatorConfig(trace_dir=trace_root))
     op.register(JAXJobController())
     op.start()
@@ -775,7 +782,7 @@ def test_two_process_actor_learner_e2e_one_trace_id(tmp_path):
                     "restartPolicy": "ExitCode",
                     "template": {"spec": {"containers": [{
                         "name": "jax",
-                        "env": CPU_ENV,
+                        "env": pod_env,
                         "command": [
                             sys.executable, "-m", "kubedl_tpu.train.rl_pod",
                             "--model", "tiny", "--steps", str(steps),
@@ -810,6 +817,15 @@ def test_two_process_actor_learner_e2e_one_trace_id(tmp_path):
         gp = goodput(spans)
         assert gp["buckets"]["rollout"] > 0
         assert gp["buckets"]["steps"] > 0
+        # both pod processes exited cleanly -> both exported a witness
+        # report; the disaggregated fleet ran with zero lock inversions
+        reports = [f for f in os.listdir(witness_dir)
+                   if f.startswith("witness-")]
+        assert len(reports) >= 2, reports
+        for name in reports:
+            with open(os.path.join(witness_dir, name)) as f:
+                data = json.load(f)
+            assert data["inversions"] == [], data
     finally:
         op.stop()
 

@@ -106,14 +106,14 @@ def _run_main_and_post(argv, port, body, timeout=120):
     while time.time() < deadline and out is None:
         try:
             out = _post(f"{base}/generate", body, timeout=10)
-        except Exception:
+        except OSError:  # URLError/HTTPError both subclass it
             time.sleep(0.3)
     while t.is_alive() and time.time() < deadline:
         try:
             _post(f"{base}/generate",
                   {"tokens": [1], "max_new_tokens": 1, "eos_token": None},
                   timeout=5)
-        except Exception:
+        except OSError:  # server still draining the first request
             time.sleep(0.2)
     t.join(timeout=60)
     return out, rc.get("v")

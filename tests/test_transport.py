@@ -492,7 +492,7 @@ def test_mpmd_two_stage_parity_socket_vs_dir(tmp_path):
         def drive(i):
             try:
                 results[i] = rts[i].run_step(tokens)
-            except BaseException as e:  # noqa: BLE001
+            except BaseException as e:  # noqa: BLE001 — re-raised after join
                 errs.append(e)
 
         threads = [threading.Thread(target=drive, args=(i,)) for i in (0, 1)]
