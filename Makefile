@@ -11,13 +11,25 @@ test:
 
 # Fleet invariant analyzer (docs/static_analysis.md): AST lint passes
 # for the drifted-invariant classes (prom-escape, debug-vars-family,
-# shared-validation, payload-dtype, broad-except, bench-lane-merge)
-# plus lock-order/held-lock-I/O analysis over the concurrent planes.
+# shared-validation, payload-dtype, broad-except, bench-lane-merge,
+# env-contract, wire-schema, crash-consistency) plus lock-order/
+# held-lock-I/O analysis over the concurrent planes.
 # Exit 0 = zero unallowlisted findings; every allowlist pragma must
 # carry a justification. Also: `kubedl-tpu analyze`.
 .PHONY: lint
 lint:
 	$(PY) -m kubedl_tpu.analysis
+
+# Explicit-state model checker for the admitter/scheduler control plane
+# (docs/static_analysis.md "Protocol model"): exhaustively explores
+# every interleaving of grant/evict/drain/release/RESIZE/slice-failure
+# across 2-3 gangs and proves chip-conservation, exactly-once drain
+# release, all-or-nothing admission and the no-eviction-storm shield —
+# plus the PINNED restart counterexample (ROADMAP item 5 grant journal).
+# Also: `kubedl-tpu analyze --model`.
+.PHONY: model-check
+model-check:
+	$(PY) -m kubedl_tpu.analysis.model
 
 # The FULL suite, slow lane included — run before every snapshot commit
 # and quote the tail in the commit message (VERDICT r4 directive 1).
@@ -27,6 +39,7 @@ lint:
 .PHONY: presubmit
 presubmit:
 	$(PY) -m kubedl_tpu.analysis
+	$(PY) -m kubedl_tpu.analysis.model
 	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee .presubmit-fast.log
 	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60 \
 	  --total tests/test_gmm_moe.py=60 \
@@ -38,7 +51,8 @@ presubmit:
 	  --total tests/test_obs.py=60 \
 	  --total tests/test_transport.py=60 \
 	  --total tests/test_rl.py=150 \
-	  --total tests/test_analysis.py=60
+	  --total tests/test_analysis.py=60 \
+	  --total tests/test_protocol_model.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench

@@ -11,7 +11,7 @@ import argparse
 import os
 import sys
 
-from kubedl_tpu.analysis.framework import run_analysis
+from kubedl_tpu.analysis.framework import default_passes, run_analysis
 
 
 def main(argv=None) -> int:
@@ -25,7 +25,30 @@ def main(argv=None) -> int:
                     help="skip tests/ (the default scope includes it)")
     ap.add_argument("--show-allowlisted", action="store_true",
                     help="also print pragma-suppressed findings")
+    ap.add_argument("--only", default="",
+                    help="comma-separated pass ids to run (see "
+                         "--list-passes); unknown ids are a usage error")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the registered pass ids and exit")
+    ap.add_argument("--model", action="store_true",
+                    help="also run the protocol model checker "
+                         "(kubedl_tpu.analysis.model) — exhaustive "
+                         "admitter/scheduler state exploration")
     args = ap.parse_args(argv)
+    passes = default_passes()
+    if args.list_passes:
+        for p in passes:
+            print(f"{p.id}: {p.description}")
+        return 0
+    if args.only:
+        wanted = [t.strip() for t in args.only.split(",") if t.strip()]
+        known = {p.id for p in passes}
+        bad = [t for t in wanted if t not in known]
+        if bad:
+            print(f"error: unknown pass id(s): {', '.join(bad)} "
+                  f"(see --list-passes)", file=sys.stderr)
+            return 2
+        passes = [p for p in passes if p.id in wanted]
     root = args.root
     if root is None:
         # kubedl_tpu/analysis/__main__.py -> repo root two levels up
@@ -35,7 +58,12 @@ def main(argv=None) -> int:
         print(f"error: {root} does not look like the repo root "
               f"(no kubedl_tpu/)", file=sys.stderr)
         return 2
-    report = run_analysis(root, include_tests=not args.no_tests)
+    model_rc = 0
+    if args.model:
+        from kubedl_tpu.analysis.model import model_report
+        model_rc = model_report()
+    report = run_analysis(root, passes=passes,
+                          include_tests=not args.no_tests)
     if args.json:
         print(report.to_json())
     else:
@@ -44,7 +72,7 @@ def main(argv=None) -> int:
             print("-- allowlisted --")
             for f in report.allowlisted:
                 print(f.render())
-    return 0 if report.ok else 1
+    return model_rc or (0 if report.ok else 1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,732 @@
+"""Cross-process contract passes (docs/static_analysis.md):
+
+  env-contract      — the injection→consumption graph of every
+                      ``KUBEDL_*`` env var: the executor/workloads
+                      layer injects, trainers/runtimes consume, the
+                      docs env tables document.  Flags orphan
+                      injections (set but never read), orphan
+                      consumptions (read but never set AND not
+                      documented as a user knob), undocumented
+                      injections, and — the stale direction — doc
+                      table entries matching nothing in code.
+  wire-schema       — per transport channel family (RESIZE control,
+                      resize replies, pipeline boundary, RL
+                      trajectory/weights, staged-reshard blocks, KV
+                      handoff): header keys and tag formats the
+                      receiver reads must be keys the sender writes.
+                      The cross-process analog of shared-validation:
+                      the python in two pods never shares a type, so
+                      the wire dict IS the schema.
+  crash-consistency — every write to a durable path (control dir,
+                      staging dir, trace dir, heartbeat,
+                      ``.bench_extras.json``) must be atomic-rename
+                      (tmp + ``os.replace`` / a ``*atomic*`` helper /
+                      append-only JSONL), and a manifest must publish
+                      AFTER its payload files — the manifest is the
+                      commit point.
+
+All three over-approximate on the permissive side where the code is
+dynamic (f-string env names count as prefix injections; any string
+occurrence of a var counts as consumption; only constant header keys
+are checked) — a pass that cries wolf gets allowlisted into silence,
+so drift detection errs toward fewer, real findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from kubedl_tpu.analysis.framework import (
+    AnalysisPass,
+    Finding,
+    RepoContext,
+    SourceFile,
+)
+
+
+def _in_tests(path: str) -> bool:
+    return path.startswith("tests/")
+
+
+def _sub_key(node: ast.Subscript):
+    """The subscript key expression (3.8 ast.Index compatible)."""
+    sl = node.slice
+    if sl.__class__.__name__ == "Index":  # py3.8
+        sl = sl.value  # type: ignore[attr-defined]
+    return sl
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+# ---------------------------------------------------------------------------
+# env-contract
+# ---------------------------------------------------------------------------
+
+_ENV_TOKEN_RE = re.compile(r"KUBEDL_[A-Z0-9_]+")
+# docs tokens additionally allow one {A,B,...} brace group, a trailing
+# * wildcard, and A/B/C slash alternation, e.g.
+# KUBEDL_RL_{GROUP_SIZE,ENGINE}, KUBEDL_CHECKPOINT_*, or
+# KUBEDL_SERVING_SLOTS/MAX_LEN/KV_BLOCKS
+_DOC_TOKEN_RE = re.compile(
+    r"KUBEDL_[A-Z0-9_]*(?:\{[A-Z0-9_, ]+\})?[A-Z0-9_]*\*?"
+    r"(?:/[A-Z0-9_]+\*?)*")
+
+
+def _expand_doc_token(tok: str) -> Tuple[Set[str], Set[str]]:
+    """A docs table token -> (exact var names, documented prefixes)."""
+    # slash shorthand first: alternates share the FIRST name's prefix up
+    # to its last underscore (KUBEDL_SERVING_SLOTS/MAX_LEN documents
+    # KUBEDL_SERVING_SLOTS and KUBEDL_SERVING_MAX_LEN)
+    segs = tok.split("/")
+    stem = segs[0][: segs[0].rfind("_") + 1]
+    pre = [segs[0]] + [stem + s for s in segs[1:]]
+    names: List[str] = []
+    for nm in pre:
+        if "{" in nm and "}" in nm:
+            head, rest = nm.split("{", 1)
+            alts, tail = rest.split("}", 1)
+            names.extend(head + a.strip() + tail for a in alts.split(","))
+        else:
+            names.append(nm)
+    exact: Set[str] = set()
+    prefixes: Set[str] = set()
+    for n in names:
+        if n.endswith("*"):
+            prefixes.add(n[:-1])
+        elif n.endswith("_"):
+            prefixes.add(n)
+        else:
+            exact.add(n)
+    return exact, prefixes
+
+
+class EnvContractPass(AnalysisPass):
+    """Injection→consumption→documentation contract for KUBEDL_* env.
+
+    *Injection* = a constant ``d["KUBEDL_<name>"] = v`` / ``d.setdefault(
+    "KUBEDL_<name>", v)`` store into an env dict anywhere outside tests
+    (``os.environ`` stores are a process configuring ITSELF — that is
+    consumption-side), plus dict-literal keys inside the injector
+    layer (``kubedl_tpu/executor/``, ``kubedl_tpu/workloads/``), plus
+    f-string keys with a constant ``KUBEDL_`` head (prefix injection,
+    e.g. ``KUBEDL_LABEL_*``).  *Consumption* = any other string
+    occurrence of the var in non-test code — reads go through
+    ``environ.get``, named ``ENV_*`` constants and ``_env_int``-style
+    helpers, and chasing dataflow is not worth false findings.
+    *Documented* = the var (or a covering ``FOO_*`` prefix, with
+    ``{A,B}`` brace groups expanded) appears in README.md or any
+    docs/*.md.  The stale direction re-checks the three env-table docs
+    (jaxjob/transport/pipeline) token by token against code.
+    """
+
+    id = "env-contract"
+    description = ("KUBEDL_* env vars: orphan injections/consumptions, "
+                   "missing or stale docs env-table entries")
+
+    _INJECTOR_DIRS = ("kubedl_tpu/executor/", "kubedl_tpu/workloads/")
+    _TABLE_DOCS = ("docs/jaxjob.md", "docs/transport.md",
+                   "docs/pipeline.md")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        inject: Dict[str, Tuple[str, int]] = {}
+        inject_prefix: Dict[str, Tuple[str, int]] = {}
+        consumed: Dict[str, Tuple[str, int]] = {}
+        for src in files:
+            if _in_tests(src.path):
+                continue
+            key_ids = self._collect_injections(
+                src, inject, inject_prefix)
+            for node in ast.walk(src.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and id(node) not in key_ids):
+                    for tok in _ENV_TOKEN_RE.findall(node.value):
+                        if tok.endswith("_"):
+                            continue  # prose prefix mention, not a var
+                        consumed.setdefault(
+                            tok, (src.path, node.lineno))
+
+        doc_exact, doc_prefix = self._documented(ctx)
+        out: List[Finding] = []
+
+        def documented(var: str) -> bool:
+            return (var in doc_exact
+                    or any(var.startswith(p) for p in doc_prefix))
+
+        for var in sorted(inject):
+            path, line = inject[var]
+            if var not in consumed:
+                out.append(Finding(
+                    self.id, path, line,
+                    f"orphan injection: {var} is set on pods but no "
+                    f"non-test code reads it — wire a consumer or drop "
+                    f"the injection"))
+            if not documented(var):
+                out.append(Finding(
+                    self.id, path, line,
+                    f"undocumented injection: {var} is missing from the "
+                    f"docs env tables (docs/jaxjob.md etc.)"))
+        for prefix in sorted(inject_prefix):
+            path, line = inject_prefix[prefix]
+            if not (prefix in doc_prefix
+                    or any(e.startswith(prefix) for e in doc_exact)):
+                out.append(Finding(
+                    self.id, path, line,
+                    f"undocumented injection: dynamic {prefix}* vars are "
+                    f"missing from the docs env tables — document the "
+                    f"prefix (e.g. `{prefix}*`)"))
+
+        def injected(var: str) -> bool:
+            return (var in inject
+                    or any(var.startswith(p) for p in inject_prefix))
+
+        for var in sorted(consumed):
+            if injected(var) or documented(var):
+                continue
+            path, line = consumed[var]
+            out.append(Finding(
+                self.id, path, line,
+                f"orphan consumption: {var} is read here but nothing "
+                f"injects it and no docs env table documents it as a "
+                f"user-set knob"))
+
+        known_exact = set(inject) | set(consumed)
+        out.extend(self._stale_docs(ctx, known_exact, set(inject_prefix)))
+        return out
+
+    def _collect_injections(
+        self,
+        src: SourceFile,
+        inject: Dict[str, Tuple[str, int]],
+        inject_prefix: Dict[str, Tuple[str, int]],
+    ) -> Set[int]:
+        """Record injection sites; return ids of the key Constant nodes
+        so the consumption scan does not count a var's own injection."""
+        key_ids: Set[int] = set()
+        in_injector = src.path.startswith(self._INJECTOR_DIRS)
+
+        def record_key(key: ast.AST) -> None:
+            if (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    and _ENV_TOKEN_RE.fullmatch(key.value)):
+                key_ids.add(id(key))
+                inject.setdefault(key.value, (src.path, key.lineno))
+            elif (isinstance(key, ast.JoinedStr) and key.values
+                    and isinstance(key.values[0], ast.Constant)
+                    and isinstance(key.values[0].value, str)
+                    and key.values[0].value.startswith("KUBEDL_")):
+                head = key.values[0]
+                key_ids.add(id(head))
+                inject_prefix.setdefault(
+                    head.value, (src.path, key.lineno))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and not _is_os_environ(t.value)):
+                        record_key(_sub_key(t))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "setdefault"
+                    and not _is_os_environ(node.func.value)
+                    and node.args):
+                record_key(node.args[0])
+            elif isinstance(node, ast.Dict) and in_injector:
+                for key in node.keys:
+                    if key is not None:
+                        record_key(key)
+        return key_ids
+
+    @staticmethod
+    def _doc_paths(ctx: RepoContext) -> List[str]:
+        rels = []
+        if os.path.exists(os.path.join(ctx.root, "README.md")):
+            rels.append("README.md")
+        docs = os.path.join(ctx.root, "docs")
+        for dirpath, _dirnames, filenames in os.walk(docs):
+            for fn in sorted(filenames):
+                if fn.endswith(".md"):
+                    rels.append(os.path.relpath(
+                        os.path.join(dirpath, fn), ctx.root)
+                        .replace(os.sep, "/"))
+        return rels
+
+    def _documented(self, ctx: RepoContext) -> Tuple[Set[str], Set[str]]:
+        exact: Set[str] = set()
+        prefixes: Set[str] = set()
+        for rel in self._doc_paths(ctx):
+            for tok in _DOC_TOKEN_RE.findall(ctx.doc_text(rel)):
+                e, p = _expand_doc_token(tok)
+                exact |= e
+                prefixes |= p
+        return exact, prefixes
+
+    def _stale_docs(
+        self,
+        ctx: RepoContext,
+        known_exact: Set[str],
+        known_prefix: Set[str],
+    ) -> List[Finding]:
+        """Every KUBEDL_* token in the env-table docs must still exist
+        in code.  Doc findings are not pragma-able — fix the doc."""
+        out: List[Finding] = []
+
+        def known(var: str) -> bool:
+            return (var in known_exact
+                    or any(var.startswith(p) for p in known_prefix))
+
+        for rel in self._TABLE_DOCS:
+            text = ctx.doc_text(rel)
+            for i, line in enumerate(text.splitlines(), start=1):
+                for tok in _DOC_TOKEN_RE.findall(line):
+                    exact, prefixes = _expand_doc_token(tok)
+                    for var in sorted(exact):
+                        if not known(var):
+                            out.append(Finding(
+                                self.id, rel, i,
+                                f"stale docs entry: {var} matches no "
+                                f"injection or consumption in code"))
+                    for p in sorted(prefixes):
+                        if not (p in known_prefix
+                                or any(v.startswith(p)
+                                       for v in known_exact)):
+                            out.append(Finding(
+                                self.id, rel, i,
+                                f"stale docs entry: prefix {p}* matches "
+                                f"no injection or consumption in code"))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# wire-schema
+# ---------------------------------------------------------------------------
+
+_IDENT_KEY_RE = re.compile(r"[a-z_][a-z0-9_]*\Z")
+_TAG_RE = re.compile(r"[A-Za-z0-9_.:{}\-]+\Z")
+
+# (path, function names, mode) — mode "all": every identifier-like
+# string constant plus reply-kwargs counts as written; mode "reply":
+# ONLY keyword names of .reply(**kw) calls (the trainer's reply
+# payload rides kwargs, and its enclosing functions are huge).
+_W = Tuple[str, Tuple[str, ...], str]
+# (path, function names, receiver variable names)
+_R = Tuple[str, Tuple[str, ...], Tuple[str, ...]]
+
+_FAMILIES: List[Dict] = [
+    {
+        "id": "resize-control",
+        "writers": [
+            ("kubedl_tpu/sched/capacity.py", ("_post_resize",), "all"),
+            ("kubedl_tpu/transport/control.py", ("post",), "all"),
+            ("kubedl_tpu/executor/local.py", ("post_control",), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/train/trainer.py", ("handle_resize", "main"),
+             ("msg", "cmsg")),
+            ("kubedl_tpu/train/reshard_runtime.py", ("poll",), ("msg",)),
+            ("kubedl_tpu/transport/control.py", ("reply",), ("msg",)),
+        ],
+    },
+    {
+        "id": "resize-reply",
+        "writers": [
+            ("kubedl_tpu/train/trainer.py",
+             ("_resize_fallback", "_resize_staged", "handle_resize",
+              "main"), "reply"),
+        ],
+        "readers": [
+            ("kubedl_tpu/sched/capacity.py", ("_reshard_pass",),
+             ("r", "bad")),
+        ],
+    },
+    {
+        "id": "pipeline-boundary",
+        "writers": [
+            ("kubedl_tpu/parallel/pipeline_mpmd.py",
+             ("encode_boundary",), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/parallel/pipeline_mpmd.py",
+             ("decode_boundary",), ("header",)),
+        ],
+    },
+    {
+        "id": "rl-trajectory",
+        "writers": [
+            ("kubedl_tpu/rl/trajectory.py",
+             ("encode_trajectory", "send"), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/rl/trajectory.py",
+             ("decode_trajectory", "take"), ("meta", "arrays")),
+        ],
+    },
+    {
+        "id": "rl-weights",
+        "writers": [
+            ("kubedl_tpu/rl/weights.py",
+             ("encode_weights", "publish"), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/rl/weights.py",
+             ("decode_weights", "poll"), ("meta",)),
+        ],
+    },
+    {
+        "id": "reshard-blocks",
+        "writers": [
+            ("kubedl_tpu/transport/blocks.py",
+             ("serve_staging", "on_request", "_fetch_one"), "all"),
+            ("kubedl_tpu/train/reshard_runtime.py",
+             ("stage_shards", "write_manifest"), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/transport/blocks.py",
+             ("on_request", "_fetch_one", "fetch_staging"),
+             ("req", "header", "manifest")),
+            ("kubedl_tpu/train/reshard_runtime.py",
+             ("staging_exists", "state_from_staging"),
+             ("manifest", "info")),
+        ],
+    },
+    {
+        "id": "kv-handoff",
+        "writers": [
+            ("kubedl_tpu/serving/handoff.py", ("serialize_item",), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/serving/handoff.py",
+             ("deserialize_item", "rows"), ("z",)),
+        ],
+        # the per-layer KV arrays ride dynamic k{i}/v{i} keys; only the
+        # dtype probe reads the constant "k0"/"v0" spelling
+        "extra_written": ("k0", "v0"),
+    },
+]
+
+
+def _skeleton(js: ast.JoinedStr) -> Optional[str]:
+    """Normalize an f-string to its tag skeleton: constants verbatim,
+    interpolations as ``{}`` keeping the format spec (``{:08d}``)."""
+    parts: List[str] = []
+    for v in js.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        elif isinstance(v, ast.FormattedValue):
+            spec = ""
+            if v.format_spec is not None:
+                sub = []
+                for s in v.format_spec.values:
+                    if not isinstance(s, ast.Constant):
+                        return None
+                    sub.append(str(s.value))
+                spec = ":" + "".join(sub)
+            parts.append("{" + spec + "}")
+        else:
+            return None
+    return "".join(parts)
+
+
+class WireSchemaPass(AnalysisPass):
+    """Sender/receiver header-key and tag-format drift, per channel
+    family.  The family table is declarative; a scope that no longer
+    resolves (file or function renamed) is itself a finding so the
+    table cannot rot silently.  Gate direction: a key READ by the
+    receiver must be WRITTEN somewhere on the sender side (write-
+    never-read is legal — debug fields ride replies).  Tag skeletons
+    (compact f-strings, e.g. ``w.{:08d}``) read by consumers must
+    match a producer skeleton."""
+
+    id = "wire-schema"
+    description = ("transport channel families: receiver header "
+                   "keys/tag formats must match what senders write")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        by_path = {src.path: src for src in files}
+        out: List[Finding] = []
+        for fam in _FAMILIES:
+            written: Set[str] = set(fam.get("extra_written", ()))
+            wtags: Set[str] = set()
+            for path, funcs, mode in fam["writers"]:
+                scopes = self._resolve(by_path, path, funcs, fam, out)
+                for fn in scopes:
+                    w, t = self._collect_writes(fn, mode)
+                    written |= w
+                    wtags |= t
+            for path, funcs, receivers in fam["readers"]:
+                scopes = self._resolve(by_path, path, funcs, fam, out)
+                for fn in scopes:
+                    reads, rtags = self._collect_reads(fn, receivers)
+                    for key, line in sorted(reads):
+                        if key not in written:
+                            out.append(Finding(
+                                self.id, path, line,
+                                f"[{fam['id']}] receiver reads key "
+                                f"{key!r} that no sender in the family "
+                                f"writes — schema drift"))
+                    for sk, line in sorted(rtags):
+                        if sk not in wtags:
+                            out.append(Finding(
+                                self.id, path, line,
+                                f"[{fam['id']}] receiver expects tag "
+                                f"format {sk!r} but producers write "
+                                f"{sorted(wtags) or 'none'} — tag drift"))
+        return out
+
+    def _resolve(
+        self,
+        by_path: Dict[str, SourceFile],
+        path: str,
+        funcs: Sequence[str],
+        fam: Dict,
+        out: List[Finding],
+    ) -> List[ast.AST]:
+        src = by_path.get(path)
+        if src is None:
+            out.append(Finding(
+                self.id, path, 0,
+                f"[{fam['id']}] family table names missing module "
+                f"{path} — update _FAMILIES in analysis/contracts.py"))
+            return []
+        found: List[ast.AST] = []
+        seen: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in funcs):
+                found.append(node)
+                seen.add(node.name)
+        for name in funcs:
+            if name not in seen:
+                out.append(Finding(
+                    self.id, path, 1,
+                    f"[{fam['id']}] family table names function "
+                    f"{name}() which no longer exists in {path} — "
+                    f"update _FAMILIES in analysis/contracts.py"))
+        return found
+
+    @staticmethod
+    def _collect_writes(fn: ast.AST, mode: str) -> Tuple[Set[str], Set[str]]:
+        keys: Set[str] = set()
+        tags: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "reply"):
+                for kw in node.keywords:
+                    if kw.arg:
+                        keys.add(kw.arg)
+            if mode == "all":
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and _IDENT_KEY_RE.fullmatch(node.value)):
+                    keys.add(node.value)
+                if isinstance(node, ast.JoinedStr):
+                    sk = _skeleton(node)
+                    if sk and "{" in sk and _TAG_RE.fullmatch(sk):
+                        tags.add(sk)
+        return keys, tags
+
+    @staticmethod
+    def _collect_reads(
+        fn: ast.AST, receivers: Sequence[str],
+    ) -> Tuple[Set[Tuple[str, int]], Set[Tuple[str, int]]]:
+        def from_receiver(expr: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id in receivers
+                       for n in ast.walk(expr))
+
+        reads: Set[Tuple[str, int]] = set()
+        tags: Set[Tuple[str, int]] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("get", "setdefault")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and _IDENT_KEY_RE.fullmatch(node.args[0].value)
+                    and from_receiver(node.func.value)):
+                reads.add((node.args[0].value, node.lineno))
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and from_receiver(node.value)):
+                key = _sub_key(node)
+                if (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)
+                        and _IDENT_KEY_RE.fullmatch(key.value)):
+                    reads.add((key.value, node.lineno))
+            elif isinstance(node, ast.JoinedStr):
+                sk = _skeleton(node)
+                if sk and "{" in sk and _TAG_RE.fullmatch(sk):
+                    tags.add((sk, node.lineno))
+        return reads, tags
+
+
+# ---------------------------------------------------------------------------
+# crash-consistency
+# ---------------------------------------------------------------------------
+
+#: modules whose writes land on durable, cross-process paths: control
+#: dirs, reshard staging, trace/heartbeat files, the native lib cache,
+#: bench artifacts.  (Checkpointing itself is Orbax's atomicity.)
+_DURABLE_MODULES = (
+    "kubedl_tpu/transport/control.py",
+    "kubedl_tpu/transport/blocks.py",
+    "kubedl_tpu/executor/local.py",
+    "kubedl_tpu/obs/trace.py",
+    "kubedl_tpu/obs/steps.py",
+    "kubedl_tpu/train/reshard_runtime.py",
+    "kubedl_tpu/parallel/pipeline_mpmd.py",
+    "kubedl_tpu/analysis/witness.py",
+    "kubedl_tpu/native/build.py",
+    "kubedl_tpu/codesync/git_sync.py",
+    "bench.py",
+)
+
+
+class CrashConsistencyPass(AnalysisPass):
+    """Durable writes must be crash-atomic.  In the durable modules,
+    every write-mode ``open()`` must be one of: a ``.tmp``-suffixed
+    path later ``os.replace``d (the blessed rename discipline), inside
+    a ``*atomic*`` helper, append-mode (the JSONL logs — a torn tail
+    line is skipped by readers), an ``os.fdopen`` over ``mkstemp``, or
+    the bare ``open(p, "w").close()`` truncate idiom (one syscall,
+    empty file is a valid state).  And within a function, a publish
+    whose destination names a manifest/marker must be the LAST publish
+    — the manifest is the commit point; payloads land first
+    (reshard_runtime.stage_shards / write_manifest ordering)."""
+
+    id = "crash-consistency"
+    description = ("durable writes must be tmp+os.replace atomic and "
+                   "publish manifests after payloads")
+
+    def run(self, files: List[SourceFile], ctx: RepoContext) -> List[Finding]:
+        by_path = {src.path: src for src in files}
+        out: List[Finding] = []
+        for path in _DURABLE_MODULES:
+            src = by_path.get(path)
+            if src is None:
+                out.append(Finding(
+                    self.id, path, 0,
+                    f"durable module {path} not found — update "
+                    f"_DURABLE_MODULES in analysis/contracts.py"))
+                continue
+            out.extend(self._check_file(src))
+        return out
+
+    def _check_file(self, src: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        truncates: Set[int] = set()
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "close"
+                    and isinstance(node.func.value, ast.Call)):
+                truncates.add(id(node.func.value))
+        for fn in self._scopes(src.tree):
+            out.extend(self._check_scope(src, fn, truncates))
+        return out
+
+    @staticmethod
+    def _scopes(tree: ast.AST) -> List[ast.AST]:
+        return [tree] + [n for n in ast.walk(tree)
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))]
+
+    @staticmethod
+    def _own_nodes(scope: ast.AST) -> List[ast.AST]:
+        """Walk `scope` without descending into nested functions (each
+        function is its own atomicity scope)."""
+        own: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            own.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        return own
+
+    def _check_scope(
+        self, src: SourceFile, scope: ast.AST, truncates: Set[int],
+    ) -> List[Finding]:
+        own = self._own_nodes(scope)
+        name = getattr(scope, "name", "<module>")
+        seg = src.segment(scope) if name != "<module>" else ""
+        has_replace = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "replace"
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == "os"
+            for n in own)
+        out: List[Finding] = []
+        publishes: List[Tuple[int, str]] = []  # (line, dest segment)
+        for n in own:
+            if not isinstance(n, ast.Call):
+                continue
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "replace"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "os" and len(n.args) == 2):
+                publishes.append((n.lineno, src.segment(n.args[1])))
+                continue
+            callee = ""
+            if isinstance(n.func, ast.Name):
+                callee = n.func.id
+            elif isinstance(n.func, ast.Attribute):
+                callee = n.func.attr
+            if "atomic" in callee and n.args:
+                publishes.append((n.lineno, src.segment(n.args[0])))
+                continue
+            if callee not in ("open", "fdopen"):
+                continue
+            mode = self._mode(n)
+            if mode is None or mode.startswith("r") or "a" in mode:
+                continue
+            if id(n) in truncates:
+                continue  # open(p, "w").close() zero-byte truncate
+            if "atomic" in name:
+                continue
+            if callee == "fdopen" and "mkstemp" in seg:
+                continue  # tempfile.mkstemp + fdopen: private until linked
+            path_seg = src.segment(n.args[0]) if n.args else ""
+            if "tmp" in path_seg.lower() and has_replace:
+                continue
+            out.append(Finding(
+                self.id, src.path, n.lineno,
+                f"non-atomic durable write in {name}(): "
+                f"open({path_seg or '...'}, {mode!r}) — write a .tmp "
+                f"sibling and os.replace() it over the destination"))
+        publishes.sort()
+        for i, (line, dest) in enumerate(publishes):
+            low = dest.lower()
+            if ("manifest" in low or "marker" in low) \
+                    and i < len(publishes) - 1:
+                nxt = publishes[i + 1]
+                out.append(Finding(
+                    self.id, src.path, nxt[0],
+                    f"payload published after its manifest: {name}() "
+                    f"publishes {dest} (line {line}, the commit point) "
+                    f"before {nxt[1]} — reorder so the manifest lands "
+                    f"LAST"))
+        return out
+
+    @staticmethod
+    def _mode(call: ast.Call) -> Optional[str]:
+        mode: Optional[ast.AST] = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return "r"
+        if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+            return mode.value
+        return None

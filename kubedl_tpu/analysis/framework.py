@@ -243,6 +243,11 @@ class Report:
 def default_passes() -> List[AnalysisPass]:
     # imported lazily so framework stays importable without the passes
     # (and the passes can import the framework)
+    from kubedl_tpu.analysis.contracts import (
+        CrashConsistencyPass,
+        EnvContractPass,
+        WireSchemaPass,
+    )
     from kubedl_tpu.analysis.lockorder import LockOrderPass
     from kubedl_tpu.analysis.passes import (
         BenchLaneMergePass,
@@ -261,6 +266,9 @@ def default_passes() -> List[AnalysisPass]:
         BroadExceptPass(),
         BenchLaneMergePass(),
         LockOrderPass(),
+        EnvContractPass(),
+        WireSchemaPass(),
+        CrashConsistencyPass(),
     ]
 
 

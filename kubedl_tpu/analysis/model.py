@@ -1,0 +1,238 @@
+"""Explicit-state model checker (docs/static_analysis.md "Protocol
+model").
+
+Generic, stdlib-only, breadth-first: a *machine* is anything with
+``initial() -> state`` and ``successors(state) -> iterable[(label,
+state)]`` over hashable states; *invariants* are ``state -> None |
+message``.  BFS (not DFS) so the first counterexample found is a
+*shortest* one — counterexample traces double as specs
+(tests/test_protocol_model.py pins the operator-restart trace as the
+ROADMAP item 5 grant-journal spec), and a minimal trace is a readable
+spec.  Exhaustiveness comes from the visited set: the admitter model
+keeps no clocks or counters in its states, so the reachable space is
+finite and the checker closes it (state count in ``Result.states``).
+
+A :class:`~kubedl_tpu.analysis.protocol.ProtocolError` raised while
+*applying* a transition counts as a counterexample too — that is how
+structural one-shot rules ("drain releases exactly once") are checked
+without encoding history into the state.
+
+Entry points: ``kubedl-tpu analyze --model`` /
+``python -m kubedl_tpu.analysis --model`` (see :func:`run_model` /
+:func:`model_report`), ``make model-check``, and the tier-1 tests.
+"""
+from __future__ import annotations
+
+from collections import deque, namedtuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from kubedl_tpu.analysis.protocol import (
+    INVARIANTS,
+    ProtocolError,
+    State,
+    default_machine,
+    restart_machine,
+)
+
+__all__ = [
+    "Result",
+    "check",
+    "render_state",
+    "render_trace",
+    "run_model",
+    "model_report",
+]
+
+# trace: tuple of (label, state) from the initial state (label "" for
+# the initial entry) to the violating state, inclusive.
+Result = namedtuple(
+    "Result", "ok states depth invariant violation trace truncated")
+
+
+def check(
+    machine,
+    invariants: Optional[Dict[str, Callable]] = None,
+    max_states: int = 2_000_000,
+) -> Result:
+    """Exhaustively explore ``machine`` breadth-first, checking every
+    invariant at every reachable state.  Returns the shortest
+    counterexample (by transition count) or ``ok=True`` with the
+    closed state count.  ``truncated=True`` means ``max_states`` was
+    hit before the space closed — treat that as a failed proof."""
+    invs = INVARIANTS if invariants is None else invariants
+    init = machine.initial()
+    # state -> (parent_state, label); parent of init is None
+    parents: Dict[object, Optional[Tuple[object, str]]] = {init: None}
+    queue = deque([(init, 0)])
+    max_depth = 0
+
+    def trace_to(state, extra: Optional[Tuple[str, object]] = None):
+        steps: List[Tuple[str, object]] = []
+        cur = state
+        while True:
+            link = parents[cur]
+            if link is None:
+                break
+            parent, label = link
+            steps.append((label, cur))
+            cur = parent
+        steps.reverse()
+        steps.insert(0, ("", cur))
+        if extra is not None:
+            steps.append(extra)
+        return tuple(steps)
+
+    def violated(state):
+        for inv_id, fn in invs.items():
+            msg = fn(state)
+            if msg is not None:
+                return inv_id, msg
+        return None
+
+    bad = violated(init)
+    if bad is not None:
+        return Result(False, 1, 0, bad[0], bad[1], trace_to(init), False)
+
+    while queue:
+        state, depth = queue.popleft()
+        max_depth = max(max_depth, depth)
+        try:
+            succs = list(machine.successors(state))
+        except ProtocolError as e:
+            return Result(
+                False, len(parents), depth, "protocol-structure", str(e),
+                trace_to(state, ("<transition raised>", state)), False)
+        for label, nxt in succs:
+            if nxt in parents:
+                continue
+            parents[nxt] = (state, label)
+            bad = violated(nxt)
+            if bad is not None:
+                return Result(
+                    False, len(parents), depth + 1, bad[0], bad[1],
+                    trace_to(nxt), False)
+            if len(parents) >= max_states:
+                return Result(
+                    True, len(parents), max_depth, None, None, (), True)
+            queue.append((nxt, depth + 1))
+    return Result(True, len(parents), max_depth, None, None, (), False)
+
+
+# ---------------------------------------------------------------------------
+# rendering — counterexamples must read as transition traces
+# ---------------------------------------------------------------------------
+
+
+def render_state(state) -> str:
+    if not isinstance(state, State):
+        return repr(state)
+    parts = []
+    for s in state.slices:
+        tag = "DEAD " if s.dead else ""
+        parts.append(f"{s.name}={tag}{s.owner or 'free'}")
+    for g in state.gangs:
+        pods = ",".join(sorted(g.pods)) or "-"
+        rz = f" resizing={g.resizing}" if g.resizing else ""
+        parts.append(
+            f"{g.key}[need={g.need} granted={','.join(g.granted) or '-'}"
+            f" pods={pods}{rz}]")
+    for d in state.drains:
+        ben = f" for {d.for_gang}" if d.for_gang else ""
+        parts.append(f"drain({d.gang},{d.kind}{ben})")
+    return "  ".join(parts)
+
+
+def render_trace(result: Result) -> str:
+    """Human-readable counterexample: numbered transitions with the
+    state after each, then the violated invariant."""
+    if result.ok:
+        return (f"all invariants hold over {result.states} states "
+                f"(depth {result.depth})")
+    out = [f"counterexample ({len(result.trace) - 1} transitions), "
+           f"invariant [{result.invariant}]:"]
+    for i, (label, state) in enumerate(result.trace):
+        head = "initial" if i == 0 else f"{i}. {label}"
+        out.append(f"  {head}")
+        out.append(f"       {render_state(state)}")
+    out.append(f"  VIOLATION: {result.violation}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# the two standard runs behind `analyze --model` / make model-check
+# ---------------------------------------------------------------------------
+
+
+def run_model() -> Tuple[bool, str]:
+    """Run the standard configurations:
+
+    1. the HEAD machine (2 gangs, then 3 gangs, restart off) must
+       pass EVERY invariant over the exhaustively-closed state space;
+    2. the restart machine must fail ``no-regrant-over-live-pod`` —
+       the expected counterexample pinned as the ROADMAP item 5 spec.
+
+    Returns ``(ok, report_text)``; ok means every outcome matched.
+    """
+    lines: List[str] = []
+    ok = True
+
+    proved = [
+        ("admitter 2-gang", default_machine()),
+        ("admitter 3-gang", default_machine(
+            n_slices=4,
+            gangs=(("a", 1, 3, False), ("b", 2, 2, True),
+                   ("c", 2, 1, False)))),
+    ]
+    for tag, m in proved:
+        res = check(m)
+        lines.append(f"protocol model [{tag}]: {m.describe()}")
+        if res.truncated:
+            ok = False
+            lines.append(
+                f"  FAIL: state space did not close within {res.states} "
+                f"states — not a proof")
+        elif res.ok:
+            lines.append(
+                f"  invariants {', '.join(sorted(INVARIANTS))}: "
+                f"PROVED over {res.states} states (depth {res.depth})")
+        else:
+            ok = False
+            lines.append(
+                "  FAIL: " + render_trace(res).replace("\n", "\n  "))
+
+    m2 = restart_machine()
+    res2 = check(m2)
+    lines.append(f"protocol model [admitter+restart]: {m2.describe()}")
+    if res2.ok:
+        ok = False
+        lines.append(
+            "  FAIL: expected the no-regrant-over-live-pod "
+            "counterexample (operator restart without a grant journal "
+            "re-grants a held slice) but every invariant held — if the "
+            "grant journal landed, move this run to the proved set "
+            "(ROADMAP item 5)")
+    elif res2.invariant != "no-regrant-over-live-pod":
+        ok = False
+        lines.append(
+            f"  FAIL: expected invariant no-regrant-over-live-pod to "
+            f"fail, got [{res2.invariant}]:")
+        lines.append("  " + render_trace(res2).replace("\n", "\n  "))
+    else:
+        lines.append(
+            "  EXPECTED counterexample (pinned spec for the ROADMAP "
+            "item 5 grant journal — tests/test_protocol_model.py):")
+        lines.append("  " + render_trace(res2).replace("\n", "\n  "))
+    return ok, "\n".join(lines)
+
+
+def model_report() -> int:
+    """CLI entry: print the model run, return a process exit code."""
+    ok, text = run_model()
+    print(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # `make model-check`
+    import sys
+
+    sys.exit(model_report())

@@ -1,0 +1,502 @@
+"""Guarded-transition model of the admitter/scheduler control plane.
+
+This is the *protocol* half of the second verification tier
+(docs/static_analysis.md "Protocol model"): the
+TPUSliceAdmitter / CapacityScheduler / drain / elastic-resize /
+slice-failure machine from ``gang/slice_admitter.py`` and
+``sched/capacity.py``, re-stated as a small explicit-state transition
+system that ``analysis/model.py`` can exhaustively explore.  The model
+deliberately keeps the admitter's *dual bookkeeping* — a gang's
+``granted`` list AND the per-slice ``owner`` field — so chip
+conservation is a real cross-check, not a tautology: the invariant
+catches exactly the partial-grant / double-book / drain-drift bugs
+CHANGES.md shows were fixed by hand.
+
+Abstractions (each mirrors a choke point in the real code):
+
+* slices are uniform (1 chip each); hetero ROLE/stage gangs reduce to
+  "N *distinct* slices, all-or-nothing", which is what
+  ``_hetero_assign`` guarantees;
+* pod deletion for revoked survivors of a slice failure is atomic with
+  the revocation (the scheduler issues deletes synchronously before
+  the admitter returns);
+* grant selection is deterministic (lowest slice name) — the admitter's
+  ``_pick_slices`` is deterministic too, and determinism here bounds
+  the state space without losing interleavings;
+* timestamps/deadlines become nondeterministic ``*_timeout``
+  transitions: the checker explores "expired" at every reachable
+  point, which over-approximates every real clock.
+
+Transitions (ISSUE 17 list): grant, evict (drain-park or immediate
+free), confirm_drain, release (pod exit; last exit enables
+confirm_drain), slice_failed, resize_post (grow pre-grant),
+resize_reply (live-reshard migrate), resize_timeout (fallback),
+drain_timeout (grace expiry), pods_start, and restart — the operator
+forgetting all in-memory state while pods keep running.  ``restart``
+is OFF by default: with it on, the no-regrant-over-live-pod invariant
+FAILS, and that counterexample trace is the pinned spec for the
+ROADMAP item 5 grant journal (tests/test_protocol_model.py).
+
+Bug toggles (``bug_partial_grant``, ``bug_no_shield``) re-introduce
+two historical bug classes so the checker's counterexamples can be
+unit-tested against a known-bad machine.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "ProtocolError",
+    "Slice",
+    "Gang",
+    "Drain",
+    "State",
+    "AdmitterModel",
+    "INVARIANTS",
+    "default_machine",
+    "restart_machine",
+]
+
+
+class ProtocolError(Exception):
+    """A structural protocol violation raised *while applying* a
+    transition (e.g. freeing an already-free slice).  The checker
+    treats it as a counterexample, same as an invariant failure — this
+    is how "drain releases exactly once" is enforced: every release
+    funnels through :meth:`AdmitterModel._free`, which refuses a
+    second free."""
+
+
+# owner: "" (free) | "<gang>" (granted) | "drain:<gang>" (parked)
+Slice = namedtuple("Slice", "name owner dead")
+# need mutates on resize; hetero gangs need `need` *distinct* slices.
+Gang = namedtuple("Gang", "key need prio hetero granted pods resizing")
+# kind: evict | resize | failure; for_gang: beneficiary of an eviction
+# ("" otherwise) — the no-eviction-storm invariant needs to know WHO
+# the drain was supposed to help.
+Drain = namedtuple("Drain", "gang kind for_gang")
+State = namedtuple("State", "slices gangs drains")
+
+_DRAIN = "drain:"
+
+
+def _slice_names(st: State) -> List[str]:
+    return [s.name for s in st.slices]
+
+
+def _free_names(st: State) -> List[str]:
+    return [s.name for s in st.slices if s.owner == "" and not s.dead]
+
+
+def _alive_count(st: State) -> int:
+    return sum(1 for s in st.slices if not s.dead)
+
+
+def _gang(st: State, key: str) -> Gang:
+    for g in st.gangs:
+        if g.key == key:
+            return g
+    raise KeyError(key)
+
+
+def _set_gang(st: State, g: Gang) -> State:
+    return st._replace(
+        gangs=tuple(g if x.key == g.key else x for x in st.gangs))
+
+
+def _set_owner(st: State, name: str, owner: str) -> State:
+    return st._replace(slices=tuple(
+        s._replace(owner=owner) if s.name == name else s
+        for s in st.slices))
+
+
+def _mark_dead(st: State, name: str) -> State:
+    return st._replace(slices=tuple(
+        s._replace(dead=True) if s.name == name else s
+        for s in st.slices))
+
+
+def _drop_pod(st: State, name: str) -> State:
+    """Remove `name` from every gang's pod set (pod killed/dead)."""
+    return st._replace(gangs=tuple(
+        g._replace(pods=frozenset(p for p in g.pods if p != name))
+        if name in g.pods else g
+        for g in st.gangs))
+
+
+def _pods_on(st: State, name: str) -> bool:
+    return any(name in g.pods for g in st.gangs)
+
+
+class AdmitterModel:
+    """The admitter/scheduler machine as ``initial()`` +
+    ``successors(state)`` for :func:`kubedl_tpu.analysis.model.check`.
+
+    ``gangs`` is a tuple of ``(key, need, prio, hetero)``.  Higher
+    ``prio`` evicts lower, mirroring ``_reserve_waiting``'s
+    ``(-priority, seq)`` order.
+    """
+
+    def __init__(
+        self,
+        n_slices: int = 3,
+        gangs: Tuple[Tuple[str, int, int, bool], ...] = (
+            ("a", 1, 2, False), ("b", 2, 1, True)),
+        enable_restart: bool = False,
+        enable_resize: bool = True,
+        enable_failure: bool = True,
+        bug_partial_grant: bool = False,
+        bug_no_shield: bool = False,
+    ) -> None:
+        self.n_slices = n_slices
+        self.gang_specs = gangs
+        self.enable_restart = enable_restart
+        self.enable_resize = enable_resize
+        self.enable_failure = enable_failure
+        self.bug_partial_grant = bug_partial_grant
+        self.bug_no_shield = bug_no_shield
+
+    # -- construction ----------------------------------------------------
+
+    def initial(self) -> State:
+        return State(
+            slices=tuple(Slice(f"s{i}", "", False)
+                         for i in range(self.n_slices)),
+            gangs=tuple(Gang(k, need, prio, het, (), frozenset(), "")
+                        for k, need, prio, het in self.gang_specs),
+            drains=(),
+        )
+
+    def describe(self) -> str:
+        gangs = ", ".join(
+            f"{k}:need={need},prio={prio}{',hetero' if het else ''}"
+            for k, need, prio, het in self.gang_specs)
+        flags = []
+        if self.enable_restart:
+            flags.append("restart")
+        if self.bug_partial_grant:
+            flags.append("bug:partial-grant")
+        if self.bug_no_shield:
+            flags.append("bug:no-shield")
+        tail = f" [{'+'.join(flags)}]" if flags else ""
+        return f"{self.n_slices} slices x gangs({gangs}){tail}"
+
+    # -- the exactly-once release choke point ----------------------------
+
+    @staticmethod
+    def _free(st: State, name: str) -> State:
+        for s in st.slices:
+            if s.name == name:
+                if s.owner == "":
+                    raise ProtocolError(
+                        f"double release: slice {name} freed twice")
+                return _set_owner(st, name, "")
+        raise ProtocolError(f"release of unknown slice {name}")
+
+    def _finish_drain(self, st: State, gang_key: str) -> State:
+        """Free every ``drain:<gang>`` slice and drop the record —
+        the model's ``_free_drained_slice``/``_finish_drain``."""
+        for s in st.slices:
+            if s.owner == _DRAIN + gang_key:
+                st = self._free(st, s.name)
+        remaining = tuple(d for d in st.drains if d.gang != gang_key)
+        if len(remaining) == len(st.drains):
+            raise ProtocolError(
+                f"finish_drain for {gang_key} without a drain record")
+        return st._replace(drains=remaining)
+
+    # -- transitions -----------------------------------------------------
+
+    def successors(self, st: State) -> Iterator[Tuple[str, State]]:
+        free = _free_names(st)
+        alive = _alive_count(st)
+
+        # operator: grant — all-or-nothing over free slices, lowest
+        # names first (deterministic _pick_slices analog)
+        for g in st.gangs:
+            if g.granted or g.resizing:
+                continue
+            if self.bug_partial_grant:
+                take = tuple(free[:g.need])
+                if take:
+                    ns = st
+                    for name in take:
+                        ns = _set_owner(ns, name, g.key)
+                    ns = _set_gang(ns, g._replace(granted=take))
+                    yield f"grant({g.key})", ns
+            elif len(free) >= g.need:
+                take = tuple(free[:g.need])
+                ns = st
+                for name in take:
+                    ns = _set_owner(ns, name, g.key)
+                ns = _set_gang(ns, g._replace(granted=take))
+                yield f"grant({g.key})", ns
+
+        # executor: pods_start — pods come up on the granted slices
+        for g in st.gangs:
+            if g.granted and not g.pods and not g.resizing:
+                ns = _set_gang(st, g._replace(pods=frozenset(g.granted)))
+                yield f"pods_start({g.key})", ns
+
+        # operator: evict(victim for beneficiary) — drain-park when
+        # pods are live (fail closed), immediate free otherwise.  The
+        # feasibility shield mirrors _reserve_waiting: only evict when
+        # the beneficiary is feasible at all AND eviction actually
+        # unblocks it.
+        for victim in st.gangs:
+            if not victim.granted or victim.resizing:
+                continue
+            if any(d.gang == victim.key for d in st.drains):
+                continue
+            for ben in st.gangs:
+                if ben.key == victim.key or ben.granted or ben.resizing:
+                    continue
+                if ben.prio <= victim.prio:
+                    continue
+                if not self.bug_no_shield:
+                    if ben.need > alive:          # infeasible: shielded
+                        continue
+                    if ben.need <= len(free):     # no eviction needed
+                        continue
+                    if ben.need > len(free) + len(victim.granted):
+                        continue                  # eviction cannot help
+                ns = st
+                if victim.pods:
+                    for name in victim.granted:
+                        ns = _set_owner(ns, name, _DRAIN + victim.key)
+                    ns = ns._replace(drains=ns.drains + (
+                        Drain(victim.key, "evict", ben.key),))
+                else:
+                    for name in victim.granted:
+                        ns = self._free(ns, name)
+                ns = _set_gang(ns, _gang(ns, victim.key)._replace(
+                    granted=()))
+                yield f"evict({victim.key} for {ben.key})", ns
+
+        # executor: release — one pod exits; frees nothing by itself
+        # (the operator confirms via confirm_drain / drain_timeout)
+        for g in st.gangs:
+            for name in sorted(g.pods):
+                ns = _set_gang(st, g._replace(
+                    pods=frozenset(p for p in g.pods if p != name)))
+                yield f"release({g.key}@{name})", ns
+
+        # operator: confirm_drain — every pod on the parked slices has
+        # exited (or migrated), so the drain finishes exactly once
+        for d in st.drains:
+            parked = [s.name for s in st.slices
+                      if s.owner == _DRAIN + d.gang]
+            if any(_pods_on(st, name) for name in parked):
+                continue
+            ns = self._finish_drain(st, d.gang)
+            yield f"confirm_drain({d.gang})", ns
+
+        # operator: drain_timeout — grace expiry kills the remaining
+        # pods and frees the parked slices (the _expire_drains safety
+        # valve; deadline-only drains can ONLY finish this way)
+        for d in st.drains:
+            ns = st
+            for s in st.slices:
+                if s.owner == _DRAIN + d.gang:
+                    ns = _drop_pod(ns, s.name)
+            ns = self._finish_drain(ns, d.gang)
+            yield f"drain_timeout({d.gang})", ns
+
+        # operator+pods: elastic resize, grow by one slice with the
+        # grow pre-grant (new slices verified+granted BEFORE the old
+        # ones drain — resize_to in evict_gang)
+        if self.enable_resize:
+            for g in st.gangs:
+                if (not g.granted or g.resizing
+                        or g.pods != frozenset(g.granted)):
+                    continue
+                if any(d.gang == g.key for d in st.drains):
+                    continue
+                new_need = g.need + 1
+                if len(free) < new_need:
+                    continue
+                take = tuple(free[:new_need])
+                ns = st
+                for name in g.granted:
+                    ns = _set_owner(ns, name, _DRAIN + g.key)
+                for name in take:
+                    ns = _set_owner(ns, name, g.key)
+                ns = ns._replace(drains=ns.drains + (
+                    Drain(g.key, "resize", ""),))
+                ns = _set_gang(ns, _gang(ns, g.key)._replace(
+                    need=new_need, granted=take, resizing="posted"))
+                yield f"resize_post({g.key}->{new_need})", ns
+            for g in st.gangs:
+                if g.resizing != "posted":
+                    continue
+                # pods ack RESIZE with outcome=ok: live reshard moved
+                # them to the new slices; confirm_drain then frees the
+                # old ones (scheduler calls confirm_drain on ok)
+                ns = _set_gang(st, g._replace(
+                    pods=frozenset(g.granted), resizing=""))
+                yield f"resize_reply({g.key} ok)", ns
+                # no ack in time: checkpoint-restore fallback — old
+                # pods are torn down, fresh pods_start on the new grant
+                ns = _set_gang(st, g._replace(
+                    pods=frozenset(), resizing=""))
+                yield f"resize_timeout({g.key})", ns
+
+        # environment: slice_failed — whole-gang revocation; the dead
+        # slice parks as a deadline-only drain, survivors free with
+        # their pod deletes issued synchronously
+        if self.enable_failure:
+            for s in st.slices:
+                if s.dead:
+                    continue
+                ns = _mark_dead(st, s.name)
+                if s.owner.startswith(_DRAIN):
+                    ns = _drop_pod(ns, s.name)
+                elif s.owner:
+                    owner = _gang(ns, s.owner)
+                    ns = _drop_pod(ns, s.name)
+                    for name in owner.granted:
+                        if name == s.name:
+                            continue
+                        ns = self._free(ns, name)
+                        ns = _drop_pod(ns, name)
+                    ns = _set_owner(ns, s.name, _DRAIN + owner.key)
+                    if not any(d.gang == owner.key for d in ns.drains):
+                        ns = ns._replace(drains=ns.drains + (
+                            Drain(owner.key, "failure", ""),))
+                    ns = _set_gang(ns, _gang(ns, owner.key)._replace(
+                        granted=(), resizing=""))
+                else:
+                    ns = _drop_pod(ns, s.name)
+                yield f"slice_failed({s.name})", ns
+
+        # operator: restart — ALL in-memory state forgotten (grants,
+        # drains, resize progress); pods keep running because they are
+        # real processes, and dead slices stay dead because the
+        # inventory re-detects them.  ROADMAP item 5: a grant journal
+        # would make this transition safe.
+        if self.enable_restart:
+            ns = State(
+                slices=tuple(s._replace(owner="") for s in st.slices),
+                gangs=tuple(g._replace(granted=(), resizing="")
+                            for g in st.gangs),
+                drains=(),
+            )
+            yield "restart(operator)", ns
+
+
+# ---------------------------------------------------------------------------
+# invariants — each returns None (holds) or a violation message
+# ---------------------------------------------------------------------------
+
+
+def inv_chip_conservation(st: State) -> Optional[str]:
+    """Dual-bookkeeping cross-check: every slice has at most one
+    claimant, and gang.granted agrees with slice.owner both ways —
+    granted + draining + free + dead partitions the pool."""
+    claim = {}
+    for g in st.gangs:
+        if len(set(g.granted)) != len(g.granted):
+            return (f"gang {g.key} granted list has duplicates: "
+                    f"{g.granted}")
+        for name in g.granted:
+            if name in claim:
+                return (f"slice {name} double-booked by gangs "
+                        f"{claim[name]} and {g.key}")
+            claim[name] = g.key
+    names = set(_slice_names(st))
+    for name in claim:
+        if name not in names:
+            return f"gang {claim[name]} granted unknown slice {name}"
+    for s in st.slices:
+        want = claim.get(s.name, "")
+        if want and s.owner != want:
+            return (f"slice {s.name}: granted to {want} but owner "
+                    f"field says {s.owner!r}")
+        if not want and s.owner and not s.owner.startswith(_DRAIN):
+            return (f"slice {s.name}: owner field says {s.owner!r} "
+                    f"but no gang's granted list contains it")
+    draining = {s.owner[len(_DRAIN):]
+                for s in st.slices if s.owner.startswith(_DRAIN)}
+    recorded = {d.gang for d in st.drains}
+    if draining != recorded:
+        return (f"drain bookkeeping drift: slices parked for "
+                f"{sorted(draining)} but records exist for "
+                f"{sorted(recorded)}")
+    return None
+
+
+def inv_all_or_nothing(st: State) -> Optional[str]:
+    for g in st.gangs:
+        if len(g.granted) not in (0, g.need):
+            return (f"partial admission: gang {g.key} holds "
+                    f"{len(g.granted)}/{g.need} slices {g.granted}")
+        if g.hetero and len(set(g.granted)) != len(g.granted):
+            return (f"hetero gang {g.key} assigned the same slice to "
+                    f"two stages: {g.granted}")
+    return None
+
+
+def inv_no_eviction_storm(st: State) -> Optional[str]:
+    """An evict-drain must have a beneficiary whose demand can fit the
+    pool at all — evicting a running gang for demand that can NEVER be
+    admitted is a storm (work lost, nothing gained).  Judged against
+    the pool size, not the momentary alive count: a slice dying AFTER
+    a sound eviction decision does not make the decision a storm."""
+    pool = len(st.slices)
+    for d in st.drains:
+        if d.kind != "evict":
+            continue
+        try:
+            ben = _gang(st, d.for_gang)
+        except KeyError:
+            return (f"evict-drain of {d.gang} names unknown "
+                    f"beneficiary {d.for_gang!r}")
+        if ben.need > pool:
+            return (f"eviction storm: {d.gang} evicted for "
+                    f"{ben.key} which needs {ben.need} of a "
+                    f"{pool}-slice pool (unsatisfiable)")
+    return None
+
+
+def inv_no_regrant_over_live_pod(st: State) -> Optional[str]:
+    """The ROADMAP item 5 invariant: a slice must never be granted to
+    one gang while another gang's pod is still running on it, and
+    never granted at all while dead.  Fails under ``restart`` until
+    the grant journal lands."""
+    for g in st.gangs:
+        for name in g.granted:
+            for other in st.gangs:
+                if other.key != g.key and name in other.pods:
+                    return (
+                        f"slice {name} granted to gang {g.key} while "
+                        f"gang {other.key}'s pod still runs on it")
+    for s in st.slices:
+        if s.dead and s.owner and not s.owner.startswith(_DRAIN):
+            return f"dead slice {s.name} granted to {s.owner}"
+    return None
+
+
+#: id -> checker function; the ids appear in counterexample traces,
+#: docs/static_analysis.md, and the pinned-spec test.
+INVARIANTS = {
+    "chip-conservation": inv_chip_conservation,
+    "all-or-nothing": inv_all_or_nothing,
+    "no-eviction-storm": inv_no_eviction_storm,
+    "no-regrant-over-live-pod": inv_no_regrant_over_live_pod,
+}
+
+
+def default_machine(**overrides) -> AdmitterModel:
+    """HEAD machine: 3 slices, a hi-prio gang of 1 and a lo-prio
+    hetero gang of 2, resize + failure on, restart OFF.  Passes every
+    invariant (tests/test_protocol_model.py pins the state count)."""
+    return AdmitterModel(**overrides)
+
+
+def restart_machine(**overrides) -> AdmitterModel:
+    """Same machine with operator ``restart`` enabled — the
+    no-regrant-over-live-pod invariant fails by a short trace, which
+    is the committed spec for the ROADMAP item 5 grant journal."""
+    overrides.setdefault("enable_restart", True)
+    return AdmitterModel(**overrides)

@@ -568,6 +568,12 @@ def cmd_analyze(args) -> int:
         argv.append("--no-tests")
     if args.show_allowlisted:
         argv.append("--show-allowlisted")
+    if args.list_passes:
+        argv.append("--list-passes")
+    if args.only:
+        argv += ["--only", args.only]
+    if args.model:
+        argv.append("--model")
     if args.root:
         argv += ["--root", args.root]
     return analysis_main(argv)
@@ -851,6 +857,13 @@ def main(argv=None) -> int:
                       help="skip tests/ (default scope includes it)")
     p_an.add_argument("--show-allowlisted", action="store_true",
                       help="also print pragma-suppressed findings")
+    p_an.add_argument("--only", default="",
+                      help="comma-separated pass ids to run")
+    p_an.add_argument("--list-passes", action="store_true",
+                      help="print the registered pass ids and exit")
+    p_an.add_argument("--model", action="store_true",
+                      help="also run the protocol model checker "
+                           "(exhaustive grant/drain/resize exploration)")
     p_an.add_argument("--root", default="",
                       help="repo root (default: auto-detect)")
     p_an.set_defaults(fn=cmd_analyze)

@@ -74,8 +74,13 @@ def build(force: bool = False, quiet: bool = False, sanitize: str = "") -> str:
             pass
         return ""
     os.replace(tmp, lib)
-    with open(sidecar, "w") as f:
+    # sidecar rename-published too: a torn digest would force (harmless
+    # but slow) rebuilds — and a digest matching a half-written one
+    # could skip a NEEDED rebuild on the next process
+    stmp = sidecar + f".{os.getpid()}.tmp"
+    with open(stmp, "w") as f:
         f.write(digest + "\n")
+    os.replace(stmp, sidecar)
     return lib
 
 

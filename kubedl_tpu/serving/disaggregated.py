@@ -29,6 +29,7 @@ its own paged story and none is on the serving hot path this PR opens.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -45,6 +46,26 @@ from kubedl_tpu.serving.handoff import HandoffItem
 from kubedl_tpu.serving.kv_pool import PoolExhausted
 
 _log = logging.getLogger("kubedl_tpu.serving.disagg")
+
+
+def serving_env(environ: Optional[Dict[str, str]] = None) -> Dict:
+    """Pod-side view of the operator's ``spec.serving`` injection
+    (workloads/jaxjob.py): the six ``KUBEDL_SERVING_*`` vars, parsed.
+    Missing vars fall back to the engine defaults so a hand-run pod
+    behaves as if the job had no serving block.  ``role`` is the
+    replica's prefill/decode assignment — routing, not engine shape —
+    so :meth:`DisaggregatedEngine.from_env` drops it; fleet runners
+    read it to pick their lane."""
+    env = os.environ if environ is None else environ
+    return {
+        "role": env.get("KUBEDL_SERVING_ROLE", ""),
+        "slots": int(env.get("KUBEDL_SERVING_SLOTS", 8)),
+        "max_len": int(env.get("KUBEDL_SERVING_MAX_LEN", 1024)),
+        "block_size": int(env.get("KUBEDL_SERVING_BLOCK_SIZE", 16)),
+        "num_blocks": int(env.get("KUBEDL_SERVING_KV_BLOCKS", 0)) or None,
+        "share_prefixes":
+            env.get("KUBEDL_SERVING_SHARE_PREFIXES", "1") != "0",
+    }
 
 
 class DisaggregatedEngine:
@@ -81,6 +102,7 @@ class DisaggregatedEngine:
         self.slots = slots
         self.max_len = max_len
         self.temperature = temperature
+        self.role = ""  # set by from_env for operator-run replicas
         self.prefill = PrefillEngine(
             params, config, max_len=max_len, prompt_buckets=prompt_buckets,
             prefill_chunk=prefill_chunk, max_top_k=max_top_k)
@@ -99,6 +121,20 @@ class DisaggregatedEngine:
         self._t0 = time.monotonic()
         self._handoffs = 0
         self._requeues = 0
+
+    @classmethod
+    def from_env(cls, params: Dict, config: LlamaConfig,
+                 **overrides) -> "DisaggregatedEngine":
+        """Build the engine a serving replica was admitted for: the
+        paged-KV shape comes from the ``KUBEDL_SERVING_*`` injection
+        (same ``from_env`` discipline as ``control_from_env`` /
+        ``rl_fleet_env``); keyword overrides win over the env."""
+        knobs = serving_env()
+        role = knobs.pop("role")
+        knobs.update(overrides)
+        eng = cls(params, config, **knobs)
+        eng.role = role
+        return eng
 
     # -- submission (monolithic contract) ---------------------------------
 

@@ -91,7 +91,9 @@ def _rl_env_config(args):
         temperature=cfg["temperature"],
         broadcast_interval=cfg["broadcast_interval"],
         reward=cfg["reward"], eos_id=cfg["eos_id"],
-        rollout_engine=cfg["engine"], path="KUBEDL_RL")
+        rollout_engine=cfg["engine"],
+        # kubedl-analysis: allow[env-contract] error-message path label for validate_rl_shapes, not an env var read
+        path="KUBEDL_RL")
     if errs:
         raise ValueError("; ".join(errs))
     return cfg

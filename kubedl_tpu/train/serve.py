@@ -60,8 +60,12 @@ def parse_args(argv=None):
                         "the base model")
     p.add_argument("--bind", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 8000)))
-    p.add_argument("--slots", type=int, default=8)
-    p.add_argument("--max-len", type=int, default=1024)
+    # operator pods get these via the spec.serving KUBEDL_SERVING_*
+    # injection (workloads/jaxjob.py); flags still win when passed
+    p.add_argument("--slots", type=int,
+                   default=int(os.environ.get("KUBEDL_SERVING_SLOTS", 8)))
+    p.add_argument("--max-len", type=int,
+                   default=int(os.environ.get("KUBEDL_SERVING_MAX_LEN", 1024)))
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 (models/quant.py)")

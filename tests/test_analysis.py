@@ -20,6 +20,11 @@ import textwrap
 
 import pytest
 
+from kubedl_tpu.analysis.contracts import (
+    CrashConsistencyPass,
+    EnvContractPass,
+    WireSchemaPass,
+)
 from kubedl_tpu.analysis.framework import run_analysis
 from kubedl_tpu.analysis.lockorder import LockOrderPass
 from kubedl_tpu.analysis.passes import (
@@ -703,6 +708,389 @@ def test_lock_order_recognizes_witness_constructors(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# env-contract
+# ---------------------------------------------------------------------------
+
+
+def test_env_contract_orphan_injection(tmp_path):
+    """An injected var nothing reads is dead pod surface — flagged at
+    the injection site (documented, so ONLY the orphan fires)."""
+    rep = _run(tmp_path, {
+        "kubedl_tpu/executor/fake.py": '''
+            def env_for(pod):
+                return {"KUBEDL_UNREAD": "1"}
+        ''',
+        "docs/other.md": "`KUBEDL_UNREAD` is documented here.\n",
+    }, [EnvContractPass()])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert "orphan injection: KUBEDL_UNREAD" in f.message
+    assert f.path == "kubedl_tpu/executor/fake.py"
+
+
+def test_env_contract_undocumented_injection(tmp_path):
+    rep = _run(tmp_path, {
+        "kubedl_tpu/executor/fake.py": '''
+            def env_for(pod, env):
+                env["KUBEDL_SECRET_KNOB"] = "1"
+        ''',
+        "kubedl_tpu/train/fake.py": '''
+            import os
+
+            VALUE = os.environ.get("KUBEDL_SECRET_KNOB", "")
+        ''',
+    }, [EnvContractPass()])
+    assert len(rep.findings) == 1
+    assert ("undocumented injection: KUBEDL_SECRET_KNOB"
+            in rep.findings[0].message)
+
+
+def test_env_contract_orphan_consumption(tmp_path):
+    """A read of a var nothing injects and no doc declares as a
+    user-set knob is a typo or a doc gap — flagged at the read."""
+    rep = _run(tmp_path, {"kubedl_tpu/train/fake.py": '''
+        import os
+
+        VALUE = os.environ.get("KUBEDL_TYPOED_VAR", "")
+    '''}, [EnvContractPass()])
+    assert len(rep.findings) == 1
+    assert ("orphan consumption: KUBEDL_TYPOED_VAR"
+            in rep.findings[0].message)
+
+
+def test_env_contract_clean_contract(tmp_path):
+    """Injected + consumed + documented = silent; an os.environ store
+    is a process configuring itself (consumption side), never an
+    injection."""
+    rep = _run(tmp_path, {
+        "kubedl_tpu/executor/fake.py": '''
+            def env_for(pod, env):
+                env["KUBEDL_GOOD"] = "1"
+        ''',
+        "kubedl_tpu/train/fake.py": '''
+            import os
+
+            VALUE = os.environ.get("KUBEDL_GOOD", "")
+            os.environ["KUBEDL_SELFSET"] = "1"
+        ''',
+        "docs/other.md":
+            "`KUBEDL_GOOD` and `KUBEDL_SELFSET` are documented.\n",
+    }, [EnvContractPass()])
+    assert rep.findings == []
+
+
+def test_env_contract_doc_shorthands(tmp_path):
+    """Docs tables compress with {A,B} braces, A/B/C slash alternation
+    and FOO_* prefixes — each expansion documents the real vars."""
+    rep = _run(tmp_path, {
+        "kubedl_tpu/train/fake.py": '''
+            import os
+
+            A = os.environ.get("KUBEDL_EVAL_EVERY")
+            B = os.environ.get("KUBEDL_EVAL_BATCHES")
+            C = os.environ.get("KUBEDL_SERVING_SLOTS")
+            D = os.environ.get("KUBEDL_SERVING_MAX_LEN")
+            E = os.environ.get("KUBEDL_CKPT_INTERVAL")
+        ''',
+        "docs/other.md": (
+            "| `KUBEDL_EVAL_{EVERY,BATCHES}` | eval knobs |\n"
+            "| `KUBEDL_SERVING_SLOTS/MAX_LEN` | serving knobs |\n"
+            "| `KUBEDL_CKPT_*` | checkpoint family |\n"),
+    }, [EnvContractPass()])
+    assert rep.findings == []
+
+
+def test_env_contract_prefix_injection_needs_prefix_doc(tmp_path):
+    """f-string keys with a constant KUBEDL_ head are dynamic prefix
+    injections (KUBEDL_LABEL_<name>); the docs must carry the prefix."""
+    files = {
+        "kubedl_tpu/executor/fake.py": '''
+            def env_for(labels, env):
+                for k, v in labels.items():
+                    env[f"KUBEDL_LABEL_{k.upper()}"] = v
+        ''',
+    }
+    rep = _run(tmp_path, dict(files), [EnvContractPass()])
+    assert len(rep.findings) == 1
+    assert "dynamic KUBEDL_LABEL_* vars" in rep.findings[0].message
+    files["docs/other.md"] = "| `KUBEDL_LABEL_*` | pod labels |\n"
+    rep = _run(tmp_path, files, [EnvContractPass()])
+    assert rep.findings == []
+
+
+def test_env_contract_stale_docs_entry_is_not_pragmable(tmp_path):
+    """A var in the env-table docs that matches nothing in code is a
+    stale row — anchored at the DOC line, where no pragma can reach
+    (fix the doc, not the finding)."""
+    rep = _run(tmp_path, {
+        "kubedl_tpu/train/fake.py": '''
+            X = 1
+        ''',
+        "docs/jaxjob.md": "| `KUBEDL_REMOVED_LONG_AGO` | gone |\n",
+    }, [EnvContractPass()])
+    stale = [f for f in rep.findings if "stale docs entry" in f.message]
+    assert len(stale) == 1
+    assert stale[0].path == "docs/jaxjob.md" and stale[0].line == 1
+
+
+def test_env_contract_allowlist_pragma(tmp_path):
+    rep = _run(tmp_path, {"kubedl_tpu/train/fake.py": '''
+        def validate(cfg):
+            return check(
+                # kubedl-analysis: allow[env-contract] error-path label, not an env read
+                cfg, path="KUBEDL_RL")
+    '''}, [EnvContractPass()])
+    assert rep.findings == []
+    assert len(rep.allowlisted) == 1
+
+
+# ---------------------------------------------------------------------------
+# wire-schema
+# ---------------------------------------------------------------------------
+
+
+def _fam(monkeypatch, families):
+    from kubedl_tpu.analysis import contracts
+
+    monkeypatch.setattr(contracts, "_FAMILIES", families)
+
+
+_SENDER_RECEIVER = {
+    "kubedl_tpu/transport/fake_chan.py": '''
+        def post(msg_dir, typ, chips):
+            body = {"type": typ, "chips": chips}
+            tag = f"m.{chips:08d}"
+            return body, tag
+
+        def handle(msg):
+            kind = msg.get("type")
+            n = msg["chips"]
+            want = f"m.{n:08d}"
+            return kind, n, want
+    ''',
+}
+
+
+def test_wire_schema_clean_family(tmp_path, monkeypatch):
+    _fam(monkeypatch, [{
+        "id": "fake-chan",
+        "writers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("post",), "all")],
+        "readers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("handle",),
+             ("msg",))],
+    }])
+    rep = _run(tmp_path, dict(_SENDER_RECEIVER), [WireSchemaPass()])
+    assert rep.findings == []
+
+
+def test_wire_schema_flags_read_without_write(tmp_path, monkeypatch):
+    """The gate direction: a receiver reading a key no sender writes
+    is schema drift (write-never-read stays legal — debug fields)."""
+    _fam(monkeypatch, [{
+        "id": "fake-chan",
+        "writers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("post",), "all")],
+        "readers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("handle",),
+             ("msg",))],
+    }])
+    files = dict(_SENDER_RECEIVER)
+    files["kubedl_tpu/transport/fake_chan.py"] = '''
+        def post(msg_dir, typ, chips):
+            return {"type": typ, "chips": chips, "debug_extra": 1}
+
+        def handle(msg):
+            return msg.get("type"), msg["chip_count"]
+    '''
+    rep = _run(tmp_path, files, [WireSchemaPass()])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert "[fake-chan]" in f.message and "'chip_count'" in f.message
+
+
+def test_wire_schema_flags_tag_drift(tmp_path, monkeypatch):
+    _fam(monkeypatch, [{
+        "id": "fake-chan",
+        "writers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("post",), "all")],
+        "readers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("handle",),
+             ("msg",))],
+    }])
+    files = dict(_SENDER_RECEIVER)
+    files["kubedl_tpu/transport/fake_chan.py"] = '''
+        def post(seq):
+            return {"type": f"w.{seq:08d}"}
+
+        def handle(msg, seq):
+            t = msg.get("type")
+            return t == f"w.{seq:06d}"
+    '''
+    rep = _run(tmp_path, files, [WireSchemaPass()])
+    assert len(rep.findings) == 1
+    assert "tag drift" in rep.findings[0].message
+    assert "w.{:06d}" in rep.findings[0].message
+
+
+def test_wire_schema_reply_mode_counts_only_reply_kwargs(tmp_path,
+                                                         monkeypatch):
+    """mode='reply' writers sit in huge functions — only .reply(**kw)
+    keyword names count as written, not every string in the scope."""
+    _fam(monkeypatch, [{
+        "id": "fake-reply",
+        "writers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("worker",), "reply")],
+        "readers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("collect",),
+             ("r",))],
+    }])
+    rep = _run(tmp_path, {"kubedl_tpu/transport/fake_chan.py": '''
+        def worker(chan):
+            stray = "not_a_header"
+            chan.reply(outcome="ok", downtime_s=0.0)
+            return stray
+
+        def collect(r):
+            good = r.get("outcome"), r.get("downtime_s")
+            bad = r.get("not_a_header")
+            return good, bad
+    '''}, [WireSchemaPass()])
+    assert len(rep.findings) == 1
+    assert "'not_a_header'" in rep.findings[0].message
+
+
+def test_wire_schema_table_staleness_is_loud(tmp_path, monkeypatch):
+    """A family row naming a renamed module or function is itself a
+    finding — the declarative table must not rot silently."""
+    _fam(monkeypatch, [{
+        "id": "fake-chan",
+        "writers": [
+            ("kubedl_tpu/transport/gone.py", ("post",), "all")],
+        "readers": [
+            ("kubedl_tpu/transport/fake_chan.py", ("renamed_handler",),
+             ("msg",))],
+    }])
+    rep = _run(tmp_path, dict(_SENDER_RECEIVER), [WireSchemaPass()])
+    msgs = sorted(f.message for f in rep.findings)
+    assert len(msgs) == 2
+    assert "renamed_handler() which no longer exists" in msgs[0]
+    assert "missing module kubedl_tpu/transport/gone.py" in msgs[1]
+
+
+# ---------------------------------------------------------------------------
+# crash-consistency
+# ---------------------------------------------------------------------------
+
+
+def _durable(monkeypatch, paths):
+    from kubedl_tpu.analysis import contracts
+
+    monkeypatch.setattr(contracts, "_DURABLE_MODULES", tuple(paths))
+
+
+def test_crash_consistency_flags_bare_durable_write(tmp_path,
+                                                    monkeypatch):
+    _durable(monkeypatch, ["kubedl_tpu/transport/fake_store.py"])
+    rep = _run(tmp_path, {"kubedl_tpu/transport/fake_store.py": '''
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as f:
+                json.dump(obj, f)
+    '''}, [CrashConsistencyPass()])
+    assert len(rep.findings) == 1
+    f = rep.findings[0]
+    assert "non-atomic durable write in save()" in f.message
+    assert "os.replace" in f.message
+
+
+def test_crash_consistency_blessed_idioms_pass(tmp_path, monkeypatch):
+    """tmp+os.replace, append-mode JSONL, the open(p,'w').close()
+    truncate, *atomic* helpers and fdopen-over-mkstemp are all
+    crash-safe shapes."""
+    _durable(monkeypatch, ["kubedl_tpu/transport/fake_store.py"])
+    rep = _run(tmp_path, {"kubedl_tpu/transport/fake_store.py": '''
+        import json
+        import os
+        import tempfile
+
+        def save(path, obj):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+            os.replace(tmp, path)
+
+        def append_log(path, row):
+            with open(path, "a") as f:
+                f.write(row + "\\n")
+
+        def truncate_marker(path):
+            open(path, "w").close()
+
+        def write_atomic(path, data):
+            with open(path, "w") as f:
+                f.write(data)
+
+        def private_until_linked(data):
+            fd, tmp = tempfile.mkstemp()
+            with os.fdopen(fd, "w") as f:
+                f.write(data)
+            return tmp
+    '''}, [CrashConsistencyPass()])
+    assert rep.findings == []
+
+
+def test_crash_consistency_manifest_must_publish_last(tmp_path,
+                                                      monkeypatch):
+    """The manifest is the commit point: publishing a payload AFTER it
+    means a crash in between leaves a manifest describing missing
+    payloads."""
+    _durable(monkeypatch, ["kubedl_tpu/transport/fake_store.py"])
+    bad = {"kubedl_tpu/transport/fake_store.py": '''
+        import os
+
+        def publish(d):
+            os.replace(d + "/manifest.tmp", d + "/manifest.json")
+            os.replace(d + "/payload.tmp", d + "/payload.npz")
+    '''}
+    rep = _run(tmp_path, bad, [CrashConsistencyPass()])
+    assert len(rep.findings) == 1
+    assert "payload published after its manifest" in rep.findings[0].message
+    good = {"kubedl_tpu/transport/fake_store.py": '''
+        import os
+
+        def publish(d):
+            os.replace(d + "/payload.tmp", d + "/payload.npz")
+            os.replace(d + "/manifest.tmp", d + "/manifest.json")
+    '''}
+    rep = _run(tmp_path, good, [CrashConsistencyPass()])
+    assert rep.findings == []
+
+
+def test_crash_consistency_missing_module_is_loud(tmp_path,
+                                                  monkeypatch):
+    _durable(monkeypatch, ["kubedl_tpu/transport/renamed_away.py"])
+    rep = _run(tmp_path, {"kubedl_tpu/other.py": "X = 1\n"},
+               [CrashConsistencyPass()])
+    assert len(rep.findings) == 1
+    assert "durable module" in rep.findings[0].message
+    assert "_DURABLE_MODULES" in rep.findings[0].message
+
+
+def test_crash_consistency_allowlist_pragma(tmp_path, monkeypatch):
+    _durable(monkeypatch, ["kubedl_tpu/transport/fake_store.py"])
+    rep = _run(tmp_path, {"kubedl_tpu/transport/fake_store.py": '''
+        def save(path, obj):
+            # kubedl-analysis: allow[crash-consistency] scratch file on a tmpfs, never durable
+            with open(path, "w") as f:
+                f.write(obj)
+    '''}, [CrashConsistencyPass()])
+    assert rep.findings == []
+    assert len(rep.allowlisted) == 1
+
+
+# ---------------------------------------------------------------------------
 # the self-check: HEAD is clean, allowlists are justified
 # ---------------------------------------------------------------------------
 
@@ -744,6 +1132,54 @@ def test_cli_module_exit_codes(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert out.returncode == 1, out.stdout + out.stderr
     assert "prom-escape" in out.stdout
+
+
+def test_cli_list_passes_names_every_registered_pass():
+    from kubedl_tpu.analysis.framework import default_passes
+
+    out = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.analysis", "--list-passes"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    for p in default_passes():
+        assert f"{p.id}:" in out.stdout
+    assert "env-contract:" in out.stdout
+    assert "wire-schema:" in out.stdout
+    assert "crash-consistency:" in out.stdout
+
+
+def test_cli_only_filters_passes(tmp_path):
+    """--only runs just the named passes: a tree dirty for prom-escape
+    is clean when only env-contract runs, and the report says which
+    passes ran.  Unknown ids are a usage error (exit 2)."""
+    bad = tmp_path / "kubedl_tpu" / "metrics"
+    bad.mkdir(parents=True)
+    (tmp_path / "kubedl_tpu" / "__init__.py").write_text("")
+    (bad / "__init__.py").write_text("")
+    (bad / "bad.py").write_text(
+        "def r(n):\n"
+        "    return f'kubedl_x_total{{job=\"{n}\"}} 1'\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.analysis", "--root",
+         str(tmp_path), "--only", "prom-escape", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["passes"] == ["prom-escape"]
+    out = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.analysis", "--root",
+         str(tmp_path), "--only", "env-contract,wire-schema", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    data = json.loads(out.stdout)
+    assert data["passes"] == ["env-contract", "wire-schema"]
+    assert [f for f in data["findings"]
+            if f["pass"] == "prom-escape"] == []
+    out = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.analysis", "--only",
+         "no-such-pass"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+    assert "unknown pass id" in out.stderr
 
 
 # ---------------------------------------------------------------------------

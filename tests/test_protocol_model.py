@@ -1,0 +1,187 @@
+"""Explicit-state model checker tests (docs/static_analysis.md
+"Protocol model").
+
+Three layers:
+  * the checker itself finds counterexamples: seeded admitter bugs
+    (partial grant, missing eviction shield, double release) each
+    yield a short, readable transition trace naming the invariant;
+  * the HEAD machine is a PROOF: the 2-gang space closes exhaustively
+    (state count pinned) with every invariant holding;
+  * the restart machine's counterexample is PINNED transition by
+    transition — it is the committed spec for the ROADMAP item 5
+    grant journal.  When the journal lands and this trace disappears,
+    move the restart run into the proved set (model.run_model says
+    the same).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+from kubedl_tpu.analysis.model import (
+    check,
+    render_state,
+    render_trace,
+    run_model,
+)
+from kubedl_tpu.analysis.protocol import (
+    INVARIANTS,
+    AdmitterModel,
+    ProtocolError,
+    Slice,
+    default_machine,
+    restart_machine,
+)
+
+# ---------------------------------------------------------------------------
+# the HEAD machine is a proof
+# ---------------------------------------------------------------------------
+
+
+def test_head_machine_proves_all_invariants_exhaustively():
+    """The default 2-gang machine closes its reachable space and every
+    invariant holds at every state.  The state count is pinned: a
+    transition added or a guard changed moves it, and the diff should
+    say why."""
+    res = check(default_machine())
+    assert res.ok and not res.truncated
+    assert res.invariant is None and res.violation is None
+    assert res.states == 383
+    assert res.depth == 10
+
+
+def test_truncation_is_not_a_proof():
+    res = check(default_machine(), max_states=50)
+    assert res.truncated
+    assert res.states == 50
+
+
+# ---------------------------------------------------------------------------
+# seeded bugs yield counterexamples (the checker actually checks)
+# ---------------------------------------------------------------------------
+
+
+def test_partial_grant_bug_breaks_all_or_nothing():
+    """A grant that takes fewer slices than the gang needs (the bug
+    all-or-nothing admission exists to prevent) is caught, with a
+    trace ending in the partial grant."""
+    res = check(default_machine(bug_partial_grant=True))
+    assert not res.ok
+    assert res.invariant == "all-or-nothing"
+    labels = [label for label, _ in res.trace if label]
+    assert labels[-1].startswith("grant(")
+    assert "grant" in render_trace(res)
+
+
+def test_missing_shield_bug_breaks_no_eviction_storm():
+    """Evicting for a gang whose demand can NEVER be satisfied (need >
+    pool) is an eviction storm; the shield guard prevents it and the
+    bug toggle re-introduces the pre-shield behavior."""
+    m = default_machine(
+        bug_no_shield=True,
+        gangs=(("a", 5, 2, False), ("b", 2, 1, False)))
+    res = check(m)
+    assert not res.ok
+    assert res.invariant == "no-eviction-storm"
+    assert any(label.startswith("evict(") for label, _ in res.trace)
+    # the shielded machine proves the same configuration
+    ok_res = check(default_machine(
+        gangs=(("a", 5, 2, False), ("b", 2, 1, False))))
+    assert ok_res.ok
+
+
+def test_double_release_is_a_structural_protocol_error():
+    """Every release funnels through AdmitterModel._free, which
+    refuses to free a free slice — the exactly-once drain-release
+    rule is structural, not a state invariant."""
+    st = default_machine().initial()
+    with pytest.raises(ProtocolError, match="double release"):
+        AdmitterModel._free(st, "s0")
+    with pytest.raises(ProtocolError, match="unknown slice"):
+        AdmitterModel._free(st, "s99")
+
+
+def test_protocol_error_during_exploration_is_a_counterexample():
+    """A machine whose transition raises ProtocolError produces a
+    protocol-structure counterexample, not a crash."""
+
+    class DoubleFree(AdmitterModel):
+        def successors(self, st):
+            yield from super().successors(st)
+            for s in st.slices:
+                if s.owner and not s.owner.startswith("drain:"):
+                    freed = self._free(st, s.name)
+                    yield f"rogue_free({s.name})", self._free(
+                        freed, s.name)  # frees the SAME slice twice
+
+    res = check(DoubleFree())
+    assert not res.ok
+    assert res.invariant == "protocol-structure"
+    assert "double release" in res.violation
+
+
+# ---------------------------------------------------------------------------
+# the pinned restart counterexample (ROADMAP item 5 grant-journal spec)
+# ---------------------------------------------------------------------------
+
+
+def test_restart_counterexample_is_pinned():
+    """Operator restart forgets in-memory grants; with no durable
+    grant journal the admitter re-grants a slice whose previous pod
+    is still running.  BFS guarantees this shortest trace, pinned
+    transition by transition.  When the grant journal lands this test
+    MUST flip to a proof — that is the point."""
+    res = check(restart_machine())
+    assert not res.ok
+    assert res.invariant == "no-regrant-over-live-pod"
+    labels = [label for label, _ in res.trace if label]
+    assert labels == [
+        "grant(a)", "pods_start(a)", "restart(operator)", "grant(b)"]
+    assert "still runs" in res.violation
+
+
+def test_restart_trace_renders_readably():
+    res = check(restart_machine())
+    text = render_trace(res)
+    assert "counterexample (4 transitions)" in text
+    assert "invariant [no-regrant-over-live-pod]" in text
+    assert "3. restart(operator)" in text
+    assert "VIOLATION:" in text
+    # state lines show slice ownership and gang bookkeeping
+    assert "s0=free" in text and "pods=s0" in text
+
+
+def test_render_state_covers_drains_and_dead_slices():
+    st = default_machine().initial()
+    st = st._replace(slices=(
+        Slice("s0", "drain:b", False), Slice("s1", "b", True),
+        st.slices[2]))
+    text = render_state(st)
+    assert "s0=drain:b" in text
+    assert "s1=DEAD b" in text
+
+
+# ---------------------------------------------------------------------------
+# the standard run behind `analyze --model` / make model-check
+# ---------------------------------------------------------------------------
+
+
+def test_model_cli_entry_proves_head_and_pins_restart():
+    """`python -m kubedl_tpu.analysis.model` (= make model-check) runs
+    the standard configurations ONCE: the 2-gang and 3-gang spaces
+    close as proofs (state counts logged) and the restart
+    counterexample is expected — exit 0 means every outcome matched
+    (run_model returns ok=False, rc 1, on any drift)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "kubedl_tpu.analysis.model"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    text = out.stdout
+    assert "PROVED over 383 states" in text
+    assert "PROVED over 14350 states" in text
+    assert "EXPECTED counterexample" in text
+    assert "no-regrant-over-live-pod" in text
+    for inv_id in INVARIANTS:
+        assert inv_id in text
