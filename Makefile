@@ -52,7 +52,9 @@ presubmit:
 	  --total tests/test_transport.py=60 \
 	  --total tests/test_rl.py=150 \
 	  --total tests/test_analysis.py=60 \
-	  --total tests/test_protocol_model.py=60
+	  --total tests/test_protocol_model.py=60 \
+	  --total tests/test_journal.py=60 \
+	  --total tests/test_journal_chaos.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -103,6 +105,14 @@ bench-transport:
 .PHONY: bench-rl
 bench-rl:
 	$(PY) bench.py --rl-only
+
+# Journal-only fast loop: the journal_wal record — grant-path latency
+# with the write-ahead journal off vs on, raw fsync'd append
+# throughput, and a 1k-gang crash replay (merges ONLY the journal_wal
+# key into .bench_extras.json; span file at .bench_trace/journal.jsonl).
+.PHONY: bench-journal
+bench-journal:
+	$(PY) bench.py --journal-only
 
 .PHONY: manifests
 manifests:

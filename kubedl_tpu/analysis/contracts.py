@@ -576,6 +576,9 @@ class WireSchemaPass(AnalysisPass):
 #: dirs, reshard staging, trace/heartbeat files, the native lib cache,
 #: bench artifacts.  (Checkpointing itself is Orbax's atomicity.)
 _DURABLE_MODULES = (
+    "kubedl_tpu/journal/wal.py",
+    "kubedl_tpu/journal/history.py",
+    "kubedl_tpu/core/leader.py",
     "kubedl_tpu/transport/control.py",
     "kubedl_tpu/transport/blocks.py",
     "kubedl_tpu/executor/local.py",

@@ -31,6 +31,7 @@ from kubedl_tpu.analysis.protocol import (
     ProtocolError,
     State,
     default_machine,
+    journaled_restart_machine,
     restart_machine,
 )
 
@@ -168,20 +169,30 @@ def run_model() -> Tuple[bool, str]:
 
     1. the HEAD machine (2 gangs, then 3 gangs, restart off) must
        pass EVERY invariant over the exhaustively-closed state space;
-    2. the restart machine must fail ``no-regrant-over-live-pod`` —
-       the expected counterexample pinned as the ROADMAP item 5 spec.
+    2. the journaled-restart machine (the write-ahead journal of
+       ``kubedl_tpu/journal/`` replays every grant/drain on restart)
+       must ALSO prove every invariant — no-regrant-over-live-pod
+       included — over the same 2-gang and 3-gang spaces;
+    3. the journal-less restart machine must still fail
+       ``no-regrant-over-live-pod`` — kept as the seeded-bug control
+       showing the checker catches the pre-journal restart.
 
     Returns ``(ok, report_text)``; ok means every outcome matched.
     """
     lines: List[str] = []
     ok = True
 
+    _3gang = dict(
+        n_slices=4,
+        gangs=(("a", 1, 3, False), ("b", 2, 2, True),
+               ("c", 2, 1, False)))
     proved = [
         ("admitter 2-gang", default_machine()),
-        ("admitter 3-gang", default_machine(
-            n_slices=4,
-            gangs=(("a", 1, 3, False), ("b", 2, 2, True),
-                   ("c", 2, 1, False)))),
+        ("admitter 3-gang", default_machine(**_3gang)),
+        ("admitter 2-gang journaled restart",
+         journaled_restart_machine()),
+        ("admitter 3-gang journaled restart",
+         journaled_restart_machine(**_3gang)),
     ]
     for tag, m in proved:
         res = check(m)
@@ -208,9 +219,9 @@ def run_model() -> Tuple[bool, str]:
         lines.append(
             "  FAIL: expected the no-regrant-over-live-pod "
             "counterexample (operator restart without a grant journal "
-            "re-grants a held slice) but every invariant held — if the "
-            "grant journal landed, move this run to the proved set "
-            "(ROADMAP item 5)")
+            "re-grants a held slice) but every invariant held — the "
+            "journal-less machine is the seeded-bug control; if it "
+            "stopped failing, the checker lost the bug")
     elif res2.invariant != "no-regrant-over-live-pod":
         ok = False
         lines.append(
@@ -219,8 +230,9 @@ def run_model() -> Tuple[bool, str]:
         lines.append("  " + render_trace(res2).replace("\n", "\n  "))
     else:
         lines.append(
-            "  EXPECTED counterexample (pinned spec for the ROADMAP "
-            "item 5 grant journal — tests/test_protocol_model.py):")
+            "  EXPECTED counterexample (journal-less seeded-bug "
+            "control; the journaled machines above prove the fix — "
+            "tests/test_protocol_model.py):")
         lines.append("  " + render_trace(res2).replace("\n", "\n  "))
     return ok, "\n".join(lines)
 

@@ -56,6 +56,7 @@ __all__ = [
     "INVARIANTS",
     "default_machine",
     "restart_machine",
+    "journaled_restart_machine",
 ]
 
 
@@ -146,12 +147,14 @@ class AdmitterModel:
         enable_restart: bool = False,
         enable_resize: bool = True,
         enable_failure: bool = True,
+        journaled: bool = False,
         bug_partial_grant: bool = False,
         bug_no_shield: bool = False,
     ) -> None:
         self.n_slices = n_slices
         self.gang_specs = gangs
         self.enable_restart = enable_restart
+        self.journaled = journaled
         self.enable_resize = enable_resize
         self.enable_failure = enable_failure
         self.bug_partial_grant = bug_partial_grant
@@ -174,7 +177,7 @@ class AdmitterModel:
             for k, need, prio, het in self.gang_specs)
         flags = []
         if self.enable_restart:
-            flags.append("restart")
+            flags.append("restart+journal" if self.journaled else "restart")
         if self.bug_partial_grant:
             flags.append("bug:partial-grant")
         if self.bug_no_shield:
@@ -370,19 +373,59 @@ class AdmitterModel:
                     ns = _drop_pod(ns, s.name)
                 yield f"slice_failed({s.name})", ns
 
-        # operator: restart — ALL in-memory state forgotten (grants,
-        # drains, resize progress); pods keep running because they are
-        # real processes, and dead slices stay dead because the
-        # inventory re-detects them.  ROADMAP item 5: a grant journal
-        # would make this transition safe.
+        # operator: restart — pods keep running because they are real
+        # processes, and dead slices stay dead because the inventory
+        # re-detects them.  WITHOUT the journal, ALL in-memory state is
+        # forgotten (grants, drains, resize progress) and the
+        # no-regrant-over-live-pod counterexample follows; WITH the
+        # journal (kubedl_tpu/journal/wal.py), every transition above
+        # was durably appended before its commit, so replay rebuilds
+        # exactly the pre-crash bookkeeping.
         if self.enable_restart:
-            ns = State(
-                slices=tuple(s._replace(owner="") for s in st.slices),
-                gangs=tuple(g._replace(granted=(), resizing="")
-                            for g in st.gangs),
-                drains=(),
-            )
-            yield "restart(operator)", ns
+            if self.journaled:
+                yield "restart(journal-replay)", self._replay(st)
+            else:
+                ns = State(
+                    slices=tuple(s._replace(owner="") for s in st.slices),
+                    gangs=tuple(g._replace(granted=(), resizing="")
+                                for g in st.gangs),
+                    drains=(),
+                )
+                yield "restart(operator)", ns
+
+    def _replay(self, st: State) -> State:
+        """Journaled restart: the write-ahead ordering (append+fsync
+        BEFORE every in-memory commit) means the journal's effective
+        state equals the pre-crash state, so replay is the identity on
+        every reachable state — which is exactly what the checker
+        proves by closing the same space as the restart-free machine.
+
+        The conservative branch mirrors
+        ``TPUSliceAdmitter.restore_from_journal``: if replay ever met a
+        slice whose journaled grant conflicts with another gang's live
+        pod (possible only with a corrupted journal — such a state
+        already violates no-regrant-over-live-pod, so BFS can never
+        reach it here), the whole reservation is withheld: conflicted
+        slices park as a deadline-only drain, the rest free, the gang
+        returns to waiting.  Never re-grant over a live pod."""
+        ns = st
+        for g in st.gangs:
+            conflicted = [
+                name for name in g.granted
+                if any(name in o.pods for o in st.gangs if o.key != g.key)]
+            if not conflicted:
+                continue  # journal agrees with pod reality: keep as-is
+            for name in g.granted:
+                if name in conflicted:
+                    ns = _set_owner(ns, name, _DRAIN + g.key)
+                else:
+                    ns = self._free(ns, name)
+            if not any(d.gang == g.key for d in ns.drains):
+                ns = ns._replace(drains=ns.drains + (
+                    Drain(g.key, "failure", ""),))
+            ns = _set_gang(ns, _gang(ns, g.key)._replace(
+                granted=(), resizing=""))
+        return ns
 
 
 # ---------------------------------------------------------------------------
@@ -495,8 +538,21 @@ def default_machine(**overrides) -> AdmitterModel:
 
 
 def restart_machine(**overrides) -> AdmitterModel:
-    """Same machine with operator ``restart`` enabled — the
-    no-regrant-over-live-pod invariant fails by a short trace, which
-    is the committed spec for the ROADMAP item 5 grant journal."""
+    """Same machine with operator ``restart`` enabled but NO journal —
+    the no-regrant-over-live-pod invariant fails by a short trace.
+    Originally the committed spec for the grant journal (ROADMAP
+    item 5); now that ``kubedl_tpu/journal/`` exists it is kept as the
+    seeded-bug control proving the checker still catches the
+    journal-less restart."""
     overrides.setdefault("enable_restart", True)
+    return AdmitterModel(**overrides)
+
+
+def journaled_restart_machine(**overrides) -> AdmitterModel:
+    """Restart WITH the write-ahead journal: replay reconstructs the
+    pre-crash bookkeeping, so every invariant — including
+    no-regrant-over-live-pod — is PROVED over the same state space as
+    the restart-free machine (`make model-check` runs this)."""
+    overrides.setdefault("enable_restart", True)
+    overrides.setdefault("journaled", True)
     return AdmitterModel(**overrides)

@@ -21,7 +21,7 @@ import yaml
 
 from kubedl_tpu.api.common import is_failed, is_succeeded
 from kubedl_tpu.api.validation import ValidationError, validate as api_validate
-from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, data_root
 from kubedl_tpu.core.store import NotFound
 from kubedl_tpu.operator import Operator, OperatorConfig
 from kubedl_tpu.server import OperatorHTTPServer
@@ -80,6 +80,8 @@ def _mk_operator(args) -> Operator:
             leader_lease_duration=getattr(args, "leader_lease_duration", 15.0),
             leader_renew_period=getattr(args, "leader_renew_period", 5.0),
             leader_retry_period=getattr(args, "leader_retry_period", 2.0),
+            journal_dir=getattr(args, "journal_dir", ""),
+            history_dir=getattr(args, "history_dir", ""),
             kube_api_url=getattr(args, "kube_api_url", ""),
             kube_namespace=getattr(args, "kube_namespace", "default"),
         )
@@ -555,6 +557,46 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_history(args) -> int:
+    """Fleet history view of one job (docs/ha.md): the last trace
+    snapshot + goodput the history store captured, the lifecycle
+    markers, and the job/event rows the storage backends persisted —
+    still answerable after both the CRD (TTL) and the trace dir are
+    gone, which is when `kubedl-tpu trace` starts returning 404."""
+    out = _client_request(
+        args, "GET", f"/history/{args.namespace}/{args.job}")
+    if out is None:
+        return 1
+    spans = out.get("spans") or []
+    gp = out.get("goodput") or {}
+    print(f"history {args.namespace}/{args.job}: {len(spans)} spans "
+          f"snapshotted, goodput {gp.get('ratio', 0.0):.1%}")
+    job = out.get("job_record")
+    if job:
+        print(f"job record: kind={job.get('kind') or '?'} "
+              f"status={job.get('status') or '?'} "
+              f"deleted={bool(job.get('deleted'))} "
+              f"created={job.get('gmt_created') or '?'} "
+              f"finished={job.get('gmt_finished') or '?'}")
+    lifecycle = out.get("lifecycle") or []
+    if lifecycle:
+        rows = [("EVENT", "DETAIL")]
+        for rec in lifecycle:
+            detail = " ".join(
+                f"{k}={rec[k]}" for k in sorted(rec)
+                if k not in ("k", "kind", "t", "event"))
+            rows.append((rec.get("event", "?"), detail or "-"))
+        _print_table(rows)
+    events = out.get("events") or []
+    if events:
+        rows = [("TYPE", "REASON", "COUNT", "MESSAGE")]
+        for e in events:
+            rows.append((e.get("type", ""), e.get("reason", ""),
+                         e.get("count", 1), e.get("message", "")))
+        _print_table(rows)
+    return 0
+
+
 def cmd_analyze(args) -> int:
     """Fleet invariant analyzer (docs/static_analysis.md): run the AST
     lint passes + lock-order analysis and print the report — the same
@@ -771,6 +813,16 @@ def main(argv=None) -> int:
     p_op.add_argument("--api-token", default=None,
                       help="bearer token for the HTTP API (env KUBEDL_API_TOKEN); "
                            "REQUIRED for non-loopback --bind")
+    # durable control plane (docs/ha.md): the deployed operator journals
+    # and keeps history by default, under the data root (KUBEDL_DATA_DIR)
+    p_op.add_argument("--journal-dir",
+                      default=os.path.join(data_root(), "journal"),
+                      help="write-ahead grant/drain journal dir "
+                           "('' disables)")
+    p_op.add_argument("--history-dir",
+                      default=os.path.join(data_root(), "history"),
+                      help="fleet history store dir, outlives job TTL "
+                           "('' disables)")
     p_op.set_defaults(fn=cmd_operator)
 
     p_val = sub.add_parser("validate", help="parse and default manifests")
@@ -846,6 +898,12 @@ def main(argv=None) -> int:
                          help="read spans from a local trace dir instead "
                               "of the operator server")
     p_trace.set_defaults(fn=cmd_trace)
+
+    p_hist = client_parser(
+        "history", "fleet history for one job — outlives job TTL "
+                   "(docs/ha.md)")
+    p_hist.add_argument("job")
+    p_hist.set_defaults(fn=cmd_history)
 
     p_an = sub.add_parser(
         "analyze",

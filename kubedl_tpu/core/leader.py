@@ -1,4 +1,4 @@
-"""Leader election — single-active-operator HA.
+"""Leader election — single-active-operator HA with fencing epochs.
 
 The reference enables controller-runtime leader election by default
 (`--enable-leader-election`, main.go:56,70-75): replicas of the operator
@@ -7,10 +7,24 @@ This is the same contract for our process model: an exclusive flock on a
 lease file (on shared storage for multi-node HA, or local disk for
 single-node restarts). flock is released by the OS on process death, so a
 crashed leader hands over without a TTL protocol.
+
+Fencing (docs/ha.md): flock alone cannot stop a deposed-but-still-running
+old leader from writing — it may have been paused (GC, SIGSTOP, NFS
+hiccup) across a handover.  Each acquisition therefore bumps a monotonic
+epoch in a ``<lease_path>.epoch`` sidecar (atomic tmp+rename).  The grant
+journal stamps the epoch into every record and refuses appends once
+:func:`read_epoch` shows a newer leader; the transport control router
+stamps it into control messages so pods refuse a stale operator too.
+
+The lease path defaults UNDER the operator's data root (a predictable
+world-writable /tmp path would let any local user pre-create the lease
+and wedge election), and ``try_acquire`` refuses a lease file not owned
+by the current uid.
 """
 from __future__ import annotations
 
 import fcntl
+import logging
 import os
 import threading
 
@@ -18,7 +32,34 @@ from kubedl_tpu.analysis.witness import new_lock
 import time
 from typing import Callable, Optional
 
-DEFAULT_LEASE_PATH = "/tmp/kubedl-tpu-leader.lock"
+log = logging.getLogger(__name__)
+
+ENV_DATA_DIR = "KUBEDL_DATA_DIR"
+
+
+def data_root() -> str:
+    """The operator's durable data root (lease, journal, history).
+    ``KUBEDL_DATA_DIR`` overrides; default is per-user, not /tmp."""
+    return os.environ.get(ENV_DATA_DIR, "") or os.path.join(
+        os.path.expanduser("~"), ".kubedl-tpu")
+
+
+DEFAULT_LEASE_PATH = os.path.join(data_root(), "leader.lock")
+
+
+def epoch_path(lease_path: str) -> str:
+    return lease_path + ".epoch"
+
+
+def read_epoch(lease_path: str) -> int:
+    """Current fencing epoch for a lease (0 if never acquired).
+    Lock-free: the sidecar is replaced atomically, so a read sees
+    either the old or the new epoch, never a torn value."""
+    try:
+        with open(epoch_path(lease_path), "r", encoding="ascii") as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
 
 
 class FileLeaseElector:
@@ -31,6 +72,8 @@ class FileLeaseElector:
         self.lease_path = lease_path
         self.identity = identity or f"{os.uname().nodename}-{os.getpid()}"
         self.retry_period = retry_period
+        #: fencing epoch of OUR acquisition (0 until leader)
+        self.epoch = 0
         self._fd: Optional[int] = None
         self._lock = new_lock("core.leader.FileLeaseElector._lock")
 
@@ -40,10 +83,20 @@ class FileLeaseElector:
 
     def try_acquire(self) -> bool:
         """One non-blocking acquisition attempt."""
+        d = os.path.dirname(self.lease_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         with self._lock:
             if self._fd is not None:
                 return True
             fd = os.open(self.lease_path, os.O_CREAT | os.O_RDWR, 0o644)
+            st = os.fstat(fd)
+            if st.st_uid != os.getuid():
+                os.close(fd)
+                raise PermissionError(
+                    f"lease file {self.lease_path} is owned by uid "
+                    f"{st.st_uid}, not us (uid {os.getuid()}) — refusing "
+                    f"a planted lease (move it or set a private path)")
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             except OSError:
@@ -52,7 +105,27 @@ class FileLeaseElector:
             os.ftruncate(fd, 0)
             os.write(fd, self.identity.encode())
             self._fd = fd
-            return True
+        # Fencing: bump the epoch sidecar AFTER the flock is ours and
+        # OUTSIDE the thread lock (file I/O stays off the lock-order
+        # graph; the flock is the real cross-process guard here).
+        self.epoch = self._bump_epoch()
+        log.info("leader elected: %s epoch=%d lease=%s",
+                 self.identity, self.epoch, self.lease_path)
+        return True
+
+    def _bump_epoch(self) -> int:
+        """Monotonic epoch advance, atomic via tmp+rename.  Only the
+        flock holder calls this, so read-modify-write is safe."""
+        ep = read_epoch(self.lease_path) + 1
+        tmp = epoch_path(self.lease_path) + ".tmp"
+        fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        try:
+            os.write(fd, str(ep).encode("ascii"))
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, epoch_path(self.lease_path))
+        return ep
 
     def acquire(
         self,

@@ -15,6 +15,7 @@ reconciler engine) and the executor's scheduler protocol (assign/release).
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -50,6 +51,8 @@ from kubedl_tpu.gang.interface import (
 )
 from kubedl_tpu.utils.tenancy import get_tenancy
 from kubedl_tpu.analysis.witness import new_rlock
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -180,10 +183,243 @@ class TPUSliceAdmitter(GangScheduler):
         # entry points — a slow trace volume must never stall scheduling.
         self.tracer = None
         self._span_queue: List = []
+        # write-ahead grant/drain journal (kubedl_tpu/journal/wal.py),
+        # wired by the operator AFTER restore_from_journal: every
+        # transition below appends durably BEFORE its in-memory commit
+        self._journal = None
+        # pod keys whose pods_start is already journaled (dedup: the
+        # executor re-polls placements; replay rebuilds this set)
+        self._journal_started: set = set()
 
     @staticmethod
     def _drain_marker(gang_key: str) -> str:
         return f"drain:{gang_key}"
+
+    # ------------------------------------------------------------------
+    # write-ahead journal (docs/ha.md)
+    # ------------------------------------------------------------------
+
+    def attach_journal(self, journal) -> None:
+        """Start journaling transitions (without replay — tests and the
+        journal-off bench lane; the operator uses restore_from_journal,
+        which attaches after replaying)."""
+        with self._lock:
+            self._journal = journal
+
+    def _journal_op(self, op: str, gang: str = "", **data) -> None:
+        """Durable append BEFORE the in-memory commit — called under
+        the admitter lock at each transition choke point, so a crash
+        between the fsync and the commit leaves the journal at most one
+        record AHEAD of memory, which replay applies safely.  A
+        StaleEpochError (deposed leader) propagates: the mutation the
+        caller was about to make must NOT happen."""
+        if self._journal is not None:
+            self._journal.append(op, gang=gang, **data)
+
+    @staticmethod
+    def _gang_meta(state: _GangState) -> Dict:
+        """The _GangState snapshot a grant record carries so replay can
+        rebuild the gang without waiting for the job to re-reconcile."""
+        return {
+            "min_member": state.min_member,
+            "tpu_chips": state.tpu_chips,
+            "requested_slice": state.requested_slice,
+            "num_slices": state.num_slices,
+            "total_member": state.total_member,
+            "priority": state.priority,
+            "kind": state.kind,
+            "tenant": state.tenant,
+            "admissible_slices": list(state.admissible_slices),
+            "stage_slices": list(state.stage_slices),
+            "roles": list(state.roles),
+            "live_reshard": state.live_reshard,
+            "quiesce_s": state.quiesce_s,
+        }
+
+    def _state_from_meta(self, meta: Dict) -> _GangState:
+        self._seq += 1
+        return _GangState(
+            min_member=int(meta.get("min_member", 0)),
+            tpu_chips=int(meta.get("tpu_chips", 0)),
+            requested_slice=str(meta.get("requested_slice", "")),
+            num_slices=max(int(meta.get("num_slices", 1) or 1), 1),
+            total_member=int(meta.get("total_member", 0)),
+            priority=int(meta.get("priority", 0)),
+            seq=self._seq,
+            kind=str(meta.get("kind", "")),
+            tenant=str(meta.get("tenant", "") or "default"),
+            admissible_slices=[str(s) for s in meta.get(
+                "admissible_slices", [])],
+            stage_slices=[str(s) for s in meta.get("stage_slices", [])],
+            roles=[str(r) for r in meta.get("roles", [])],
+            waiting_since=time.monotonic(),
+            live_reshard=bool(meta.get("live_reshard", False)),
+            quiesce_s=float(meta.get("quiesce_s", 0.0) or 0.0),
+        )
+
+    def restore_from_journal(self, journal) -> Dict[str, int]:
+        """Replay the journal against the observed pod set and attach
+        it (the operator calls this once, on startup, BEFORE the
+        executor starts assigning).  Fold the records into an effective
+        state (grants, drains, dead slices, started pods), then
+        reconcile against the CURRENT pool: a grant whose slice is
+        missing, already claimed, or journaled dead resolves
+        CONSERVATIVELY — the whole reservation is withheld
+        (all-or-nothing), still-free slices park as a deadline-only
+        drain, and the gang returns to waiting.  Never re-grant over a
+        live pod."""
+        records = journal.open()
+        grants: Dict[str, Dict] = {}
+        drains: Dict[str, Dict] = {}
+        dead: set = set()
+        started: set = set()
+        for rec in records:
+            op = rec.get("op")
+            gang = rec.get("gang", "")
+            data = rec.get("data", {}) or {}
+            if op == "grant":
+                grants[gang] = {
+                    "slices": [str(s) for s in data.get("slices", [])],
+                    "meta": data.get("state", {}) or {},
+                }
+            elif op == "pods_start":
+                pod = data.get("pod")
+                if pod:
+                    started.add(str(pod))
+            elif op == "evict":
+                prev = grants.pop(gang, None)
+                if data.get("drain", True):
+                    d = drains.get(gang)
+                    fresh = d is None
+                    if fresh:
+                        d = drains[gang] = {"slices": [], "pods": None}
+                    for s in data.get("slices", []):
+                        if s not in d["slices"]:
+                            d["slices"].append(str(s))
+                    pods = data.get("pods")
+                    new_pods = (None if pods is None
+                                else {str(p) for p in pods})
+                    # merge mirrors evict_gang: unknown wins
+                    # (deadline-only) once either side is unknown
+                    if fresh:
+                        d["pods"] = new_pods
+                    elif d["pods"] is None or new_pods is None:
+                        d["pods"] = None
+                    else:
+                        d["pods"] |= new_pods
+                grow = data.get("grow") or []
+                if grow:
+                    meta = dict((prev or {}).get(
+                        "meta", data.get("state", {}) or {}))
+                    if data.get("resize_to"):
+                        meta["requested_slice"] = str(data["resize_to"])
+                    grants[gang] = {
+                        "slices": [str(s) for s in grow], "meta": meta}
+            elif op == "release":
+                d = drains.get(gang)
+                pod = data.get("pod")
+                if d is not None and d["pods"] is not None and pod:
+                    d["pods"].discard(str(pod))
+                started.discard(str(pod or ""))
+            elif op in ("confirm_drain", "drain_timeout"):
+                drains.pop(gang, None)
+            elif op == "slice_failed":
+                sname = str(data.get("slice", ""))
+                if sname:
+                    dead.add(sname)
+                if gang and gang in grants:
+                    grants.pop(gang)
+                    d = drains.setdefault(
+                        gang, {"slices": [], "pods": None})
+                    if sname and sname not in d["slices"]:
+                        d["slices"].append(sname)
+                    d["pods"] = None  # deadline-only, like the live op
+            elif op == "delete_gang":
+                grants.pop(gang, None)
+        conflicts = 0
+        restored = 0
+        with self._lock:
+            deadline = time.monotonic() + self.drain_timeout
+            for gang_key, g in sorted(grants.items()):
+                slices = g["slices"]
+                bad = [
+                    s for s in slices
+                    if s not in self._slices or s in dead
+                    or self._slices[s].reserved_by is not None
+                ]
+                if bad or not slices:
+                    # pool changed / double claim / dead slice under a
+                    # journaled grant: withhold the whole reservation
+                    conflicts += 1
+                    log.warning(
+                        "journal replay: grant for %s conflicts with "
+                        "reality on %s — parking as drain, gang back "
+                        "to waiting", gang_key, bad)
+                    marker = self._drain_marker(gang_key)
+                    parked = []
+                    for s in slices:
+                        info = self._slices.get(s)
+                        if info is not None and info.reserved_by is None:
+                            info.reserved_by = marker
+                            parked.append(s)
+                    if parked:
+                        self._drains[gang_key] = _Drain(
+                            slices=parked, pods=None, deadline=deadline)
+                        self._dead.update(
+                            s for s in parked if s in dead)
+                    continue
+                for s in slices:
+                    self._slices[s].reserved_by = gang_key
+                state = self._state_from_meta(g["meta"])
+                state.slice_names = list(slices)
+                state.granted_at = time.monotonic()
+                self._gangs[gang_key] = state
+                restored += 1
+            for gang_key, d in sorted(drains.items()):
+                marker = self._drain_marker(gang_key)
+                parked = []
+                for s in d["slices"]:
+                    info = self._slices.get(s)
+                    if info is not None and info.reserved_by is None:
+                        info.reserved_by = marker
+                        parked.append(s)
+                if parked:
+                    self._drains[gang_key] = _Drain(
+                        slices=parked,
+                        pods=(set(d["pods"])
+                              if d["pods"] is not None else None),
+                        deadline=deadline)
+                    self._dead.update(s for s in parked if s in dead)
+            # a journaled-dead slice that came back free in the pool
+            # listing: drop it — the inventory owns resurrection
+            for s in dead:
+                info = self._slices.get(s)
+                if info is not None and info.reserved_by is None:
+                    del self._slices[s]
+            self._journal_started = started
+        # observed-pod cross-check (store listing OUTSIDE the lock): a
+        # live pod whose gang the journal shows as gone means records
+        # and reality disagree — count it loudly; the reconcile loop
+        # deletes such pods, and their slices (if any were restored)
+        # are already parked or reserved, never free-for-grant.
+        covered = set(grants) | set(drains)
+        try:
+            pods = self.store.list("Pod")
+        except Exception:  # noqa: BLE001 — store racing startup
+            pods = []
+        for pod in pods:
+            gk = pod.metadata.annotations.get(ANNOTATION_GANG_NAME)
+            if gk and gk not in covered:
+                conflicts += 1
+                log.warning(
+                    "journal replay: live pod %s/%s belongs to gang %s "
+                    "with no journaled grant or drain",
+                    pod.metadata.namespace, pod.metadata.name, gk)
+        journal.note_replay(len(records), conflicts)
+        with self._lock:
+            self._journal = journal
+        return {"records": len(records), "conflicts": conflicts,
+                "gangs": restored}
 
     def set_director(self, director: Optional[CapacityDirector]) -> None:
         """Attach/detach the capacity scheduler's policy hooks."""
@@ -415,6 +651,12 @@ class TPUSliceAdmitter(GangScheduler):
                 "", expected_kind
             ):
                 return  # another kind's live gang took the key — not ours
+            if state is not None:
+                # write-AHEAD: the gang (and its reservation) is gone
+                # durably before the slices free
+                self._journal_op(
+                    "delete_gang", gang=key,
+                    slices=list(state.slice_names))
             self._gangs.pop(key, None)
             if state:
                 for sname in state.slice_names:
@@ -462,6 +704,16 @@ class TPUSliceAdmitter(GangScheduler):
             if not (0 <= slice_idx < len(state.slice_names)):
                 return None  # label out of range for the reservation
             info = self._slices[state.slice_names[slice_idx]]
+            pod_key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            if pod_key not in self._journal_started:
+                # write-AHEAD: pods_start, once per pod (the executor
+                # re-polls placements) — after a crash, replay knows a
+                # live process may be on this slice even before the pod
+                # listing says so
+                self._journal_op(
+                    "pods_start", gang=gang_key, pod=pod_key,
+                    slice=info.name)
+                self._journal_started.add(pod_key)
             return self._place_on_slice(pod, info, gang=state)
 
     def release(self, pod) -> None:
@@ -484,9 +736,15 @@ class TPUSliceAdmitter(GangScheduler):
             # touching its slices — now they may free and re-grant
             drain = self._drains.get(gang_key) if gang_key else None
             if drain is not None and drain.pods is not None:
+                if key in drain.pods:
+                    # write-AHEAD: the exit confirmation is durable
+                    # before the tracked set shrinks (the LAST one
+                    # enables confirm_drain, which journals itself)
+                    self._journal_op("release", gang=gang_key, pod=key)
                 drain.pods.discard(key)
                 if not drain.pods:
                     changed = self._finish_drain(gang_key)
+            self._journal_started.discard(key)
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -510,9 +768,14 @@ class TPUSliceAdmitter(GangScheduler):
         """Free a completed drain's slices (under the lock) and run a
         reservation pass — the successor takes over only now. Returns
         the keys of gangs granted in that pass."""
-        drain = self._drains.pop(gang_key, None)
+        drain = self._drains.get(gang_key)
         if drain is None:
             return []
+        # write-AHEAD: the drain completes durably before its slices
+        # free — replay must not re-park slices a successor now holds
+        self._journal_op(
+            "confirm_drain", gang=gang_key, slices=list(drain.slices))
+        self._drains.pop(gang_key)
         marker = self._drain_marker(gang_key)
         for sname in drain.slices:
             self._free_drained_slice(sname, marker)
@@ -524,7 +787,12 @@ class TPUSliceAdmitter(GangScheduler):
         for modes where nobody calls release() per pod (real-kubelet
         backends own the grace window themselves)."""
         for gk in [k for k, d in self._drains.items() if d.deadline <= now]:
-            drain = self._drains.pop(gk)
+            drain = self._drains[gk]
+            # write-AHEAD: grace expiry is a real transition too —
+            # without it replay would resurrect a finished drain
+            self._journal_op(
+                "drain_timeout", gang=gk, slices=list(drain.slices))
+            self._drains.pop(gk)
             marker = self._drain_marker(gk)
             for sname in drain.slices:
                 self._free_drained_slice(sname, marker)
@@ -558,6 +826,14 @@ class TPUSliceAdmitter(GangScheduler):
             if info is None:
                 return None
             owner = info.reserved_by
+            # write-AHEAD: the death is durable before any revocation —
+            # replay marks the slice dead and (for a gang owner) parks
+            # it while freeing the survivors, like the branches below
+            self._journal_op(
+                "slice_failed",
+                gang=(owner if isinstance(owner, str)
+                      and owner in self._gangs else ""),
+                slice=slice_name)
             if owner is None:
                 # free slice died: nothing drains, drop it now
                 del self._slices[slice_name]
@@ -851,6 +1127,18 @@ class TPUSliceAdmitter(GangScheduler):
                     return []  # multislice sum outgrows the cap
                 grow_chosen = picked
             released = list(state.slice_names)
+            # write-AHEAD: one record carries the whole eviction
+            # decision — drained slices, tracked pods, and (for a grow)
+            # the pre-verified new slices, so replay re-applies it
+            # atomically (grow pre-grant included)
+            self._journal_op(
+                "evict", gang=key, slices=released,
+                drain=bool(drain_pods is None or drain_pods),
+                pods=(sorted(drain_pods)
+                      if drain_pods is not None else None),
+                resize_to=resize_to,
+                grow=[s.name for s in grow_chosen],
+                state=(self._gang_meta(state) if grow_chosen else None))
             if drain_pods is None or drain_pods:
                 # hold the slices in draining until every pod confirms
                 # exit (or the deadline) — NOT free, NOT re-grantable.
@@ -1221,6 +1509,10 @@ class TPUSliceAdmitter(GangScheduler):
         chosen = self._pick_slices(state, candidates, n, headroom)
         if chosen is None:
             return
+        # write-AHEAD: the grant is durable before any bookkeeping moves
+        self._journal_op(
+            "grant", gang=key, slices=[s.name for s in chosen],
+            state=self._gang_meta(state))
         for s in chosen:
             s.reserved_by = key
         state.slice_names = [s.name for s in chosen]

@@ -1,0 +1,31 @@
+"""Durable control plane (docs/ha.md).
+
+Three pillars, one package:
+
+* :mod:`kubedl_tpu.journal.wal` — the write-ahead grant/drain journal
+  the admitter appends to BEFORE every in-memory commit, and replays
+  on restart (flips the pinned restart counterexample in
+  ``tests/test_protocol_model.py`` to a proof);
+* fencing epochs (:class:`~kubedl_tpu.journal.wal.StaleEpochError`) —
+  a deposed-but-still-running old leader's journal appends and
+  transport control posts are refused loudly;
+* :mod:`kubedl_tpu.journal.history` — the fleet history store that
+  outlives job TTL: trace spans, goodput summaries, and job lifecycle
+  records queryable via ``GET /history/<ns>/<job>`` and
+  ``kubedl-tpu history`` after the CRD and trace dir are gone.
+"""
+from kubedl_tpu.journal.wal import (
+    ENV_JOURNAL_TEST_DELAY,
+    GrantJournal,
+    JournalError,
+    StaleEpochError,
+)
+from kubedl_tpu.journal.history import HistoryStore
+
+__all__ = [
+    "ENV_JOURNAL_TEST_DELAY",
+    "GrantJournal",
+    "JournalError",
+    "StaleEpochError",
+    "HistoryStore",
+]

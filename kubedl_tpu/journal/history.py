@@ -1,0 +1,275 @@
+"""Fleet history store — evidence that outlives job TTL (docs/ha.md).
+
+The flight recorder's spans and goodput summaries die with the trace
+dir, and the CRD dies with the TTL — after that, nothing can answer
+"what happened to yesterday's job".  The reference treats durable
+history as core (persist controllers + pluggable storage backends);
+this module closes the same gap for the evidence planes:
+
+* :class:`HistoryStore` keeps an append-only ``history.jsonl`` under
+  the operator's data root (same torn-tail-tolerant JSONL idiom as the
+  grant journal and ``storage/jsonl_backend.py``) holding per-job
+  trace-span snapshots + goodput summaries + lifecycle markers, and
+  answers queries by joining that file with the job/event rows the
+  existing ``storage/`` backends already persist;
+* :class:`HistoryPersistController` watches every workload kind and
+  snapshots the job's trace dir into the store when the job reaches a
+  terminal condition AND when the object disappears (TTL / deletion —
+  the last chance before the trace dir is garbage-collected).
+
+Queryable through ``GET /history/<ns>/<job>`` (server.py) and
+``kubedl-tpu history`` (cli.py) after both the CRD and the trace dir
+are gone.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kubedl_tpu.core.manager import Result
+from kubedl_tpu.core.store import NotFound
+from kubedl_tpu.storage.interface import Query
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HistoryStore", "HistoryPersistController",
+           "setup_history_controllers"]
+
+
+class HistoryStore:
+    """Append-only per-job history records + backend row joins."""
+
+    def __init__(
+        self,
+        root_dir: str,
+        object_backend=None,
+        event_backend=None,
+        region: str = "",
+    ) -> None:
+        self.root_dir = root_dir
+        self.path = os.path.join(root_dir, "history.jsonl")
+        self.object_backend = object_backend
+        self.event_backend = event_backend
+        self.region = region
+        self._lock = threading.RLock()
+        self._fh = None
+        # key -> latest trace record (replayed at initialize; queries
+        # never rescan the file)
+        self._latest: Dict[str, Dict] = {}
+        # key -> lifecycle markers, in append order
+        self._lifecycle: Dict[str, List[Dict]] = {}
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    def initialize(self) -> None:
+        """Replay the existing file (skipping torn lines) into the
+        in-memory indexes, then open the append handle — the
+        ``storage/jsonl_backend.py`` idiom."""
+        with self._lock:
+            if self._fh is not None:
+                return
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail / corrupt line
+                        if isinstance(rec, dict) and rec.get("k"):
+                            self._index(rec)
+            except OSError:
+                pass  # cold start
+            os.makedirs(self.root_dir, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+
+    def _index(self, rec: Dict) -> None:
+        key = rec["k"]
+        if rec.get("kind") == "trace":
+            self._latest[key] = rec
+        else:
+            self._lifecycle.setdefault(key, []).append(rec)
+
+    def _append(self, rec: Dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                self.initialize()
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._index(rec)
+
+    # -- writers (HistoryPersistController) -------------------------------
+
+    def record_spans(self, namespace: str, name: str,
+                     spans: List[Dict], goodput: Dict) -> None:
+        """Snapshot a job's whole trace timeline + goodput summary."""
+        self._append({
+            "k": self._key(namespace, name),
+            "kind": "trace",
+            "t": time.time(),
+            "spans": spans,
+            "goodput": goodput,
+        })
+
+    def record_lifecycle(self, namespace: str, name: str,
+                         event: str, **attrs) -> None:
+        rec = {"k": self._key(namespace, name), "kind": "lifecycle",
+               "t": time.time(), "event": event}
+        rec.update(attrs)
+        self._append(rec)
+
+    # -- queries (server /history, kubedl-tpu history) ---------------------
+
+    def span_count(self, namespace: str, name: str) -> int:
+        with self._lock:
+            rec = self._latest.get(self._key(namespace, name))
+            return len(rec.get("spans", [])) if rec else 0
+
+    def get(self, namespace: str, name: str) -> Optional[Dict]:
+        """Everything history knows about one job, or None: the latest
+        trace snapshot + lifecycle markers from history.jsonl, joined
+        with the job row and events the storage backends persisted
+        (deleted rows included — outliving TTL is the point)."""
+        key = self._key(namespace, name)
+        with self._lock:
+            trace = self._latest.get(key)
+            lifecycle = list(self._lifecycle.get(key, []))
+        job_row = None
+        events: List[Dict] = []
+        if self.object_backend is not None:
+            try:
+                rows = self.object_backend.list_jobs(Query(
+                    name=name, namespace=namespace, region=self.region))
+                if rows:
+                    r = rows[0]  # newest first (backend sort order)
+                    job_row = {
+                        "kind": r.kind, "job_id": r.job_id,
+                        "status": r.status, "deleted": r.deleted,
+                        "resources": r.resources,
+                        "tenant": r.tenant,
+                        "gmt_created": r.gmt_created,
+                        "gmt_finished": r.gmt_finished,
+                    }
+            except Exception:  # noqa: BLE001 — backend racing shutdown
+                log.warning("history: job-row query failed for %s", key)
+        if self.event_backend is not None:
+            try:
+                events = [
+                    {"reason": e.reason, "message": e.message,
+                     "type": e.type, "count": e.count,
+                     "last_timestamp": e.last_timestamp}
+                    for e in self.event_backend.list_events(
+                        namespace, name)
+                ]
+            except Exception:  # noqa: BLE001 — backend racing shutdown
+                log.warning("history: event query failed for %s", key)
+        if trace is None and job_row is None and not lifecycle:
+            return None
+        return {
+            "namespace": namespace,
+            "job": name,
+            "spans": (trace or {}).get("spans", []),
+            "goodput": (trace or {}).get("goodput", {}),
+            "snapshot_time": (trace or {}).get("t"),
+            "lifecycle": lifecycle,
+            "job_record": job_row,
+            "events": events,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+
+class HistoryPersistController:
+    """Snapshot each job's flight-recorder evidence into the
+    HistoryStore at the moments that matter: terminal condition (the
+    timeline is complete) and object deletion (TTL fired — last chance
+    before the trace dir is garbage-collected).  Mirrors the
+    JobPersistController wiring: one instance per workload kind, an
+    ordinary ControllerRunner on the shared manager."""
+
+    def __init__(self, controller, history: HistoryStore, store,
+                 trace_root: str) -> None:
+        self.controller = controller
+        self.history = history
+        self.store = store
+        self.trace_root = trace_root
+        self.runner = None
+
+    def setup(self, runner) -> None:
+        self.runner = runner
+        runner.watch(self.controller.kind, self._on_event)
+
+    def _on_event(self, event) -> None:
+        obj = event.obj
+        self.runner.enqueue(
+            f"{obj.metadata.namespace}/{obj.metadata.name}/"
+            f"{obj.metadata.uid}")
+
+    def _snapshot(self, namespace: str, name: str) -> None:
+        """Idempotent-ish: re-snapshot only when the timeline grew (the
+        trace dir keeps filling between terminal condition and TTL)."""
+        from kubedl_tpu.obs import goodput as compute_goodput
+        from kubedl_tpu.obs import job_trace_dir, load_spans
+
+        d = job_trace_dir(self.trace_root, namespace, name) \
+            if self.trace_root else ""
+        if not d or not os.path.isdir(d):
+            return
+        spans = load_spans(d)
+        if not spans:
+            return
+        if len(spans) == self.history.span_count(namespace, name):
+            return  # nothing new since the last snapshot
+        self.history.record_spans(
+            namespace, name, spans, compute_goodput(spans))
+
+    def reconcile(self, key: str) -> Result:
+        ns, name, uid = key.split("/", 2)
+        from kubedl_tpu.api.common import is_failed, is_succeeded
+
+        try:
+            job = self.store.get(self.controller.kind, ns, name)
+            if job.metadata.uid != uid:
+                raise NotFound(key)  # name reused — old job is gone
+        except NotFound:
+            # TTL / deletion: snapshot whatever the trace dir still
+            # holds, then mark the lifecycle so the record says WHY
+            # the live object is gone
+            self._snapshot(ns, name)
+            self.history.record_lifecycle(ns, name, "deleted", uid=uid)
+            return Result()
+        status = self.controller.job_status(job)
+        if is_succeeded(status) or is_failed(status):
+            self._snapshot(ns, name)
+        return Result()
+
+
+def setup_history_controllers(
+    manager,
+    store,
+    workload_controllers: Dict[str, object],
+    history: HistoryStore,
+    trace_root: str,
+) -> list:
+    """Wire one history controller per workload kind onto the manager
+    (the setup_persist_controllers pattern)."""
+    created = []
+    for kind, wc in workload_controllers.items():
+        hpc = HistoryPersistController(wc, history, store, trace_root)
+        runner = manager.add_controller(
+            f"{kind.lower()}-history", hpc.reconcile)
+        hpc.setup(runner)
+        created.append(hpc)
+    return created

@@ -1,0 +1,228 @@
+"""Write-ahead grant/drain journal (docs/ha.md).
+
+Every admitter state transition the protocol model names (grant,
+pods_start, evict-with-shield, release, confirm_drain, drain_timeout,
+slice_failed, delete_gang — the RESIZE grow pre-grant rides the evict
+record's ``grow`` field) is appended here as an fsync'd, sha-checked
+JSONL record *before* the in-memory commit.  On restart the admitter
+replays the journal against the observed pod set
+(``TPUSliceAdmitter.restore_from_journal``) instead of starting empty;
+``analysis/protocol.py``'s journaled-restart machine proves the replay
+keeps no-regrant-over-live-pod over the exhaustive 2/3-gang spaces.
+
+Durability contract (mirrors ``storage/jsonl_backend.py``):
+
+* append-only, one JSON object per line, ``open(path, "a")`` +
+  ``flush`` + ``fsync`` per record — a record is either fully on disk
+  or absent;
+* each record carries a sha over its canonical (sorted-keys) JSON;
+  replay stops at the first torn or sha-mismatched line, so a crash
+  mid-append loses at most the record being written — which by the
+  write-AHEAD ordering had not been committed to memory either;
+* each record carries the writer's fencing epoch.  ``append`` checks
+  the epoch authority (the lease sidecar file,
+  ``core.leader.read_epoch``) and raises :class:`StaleEpochError` when
+  a newer leader exists — a deposed operator cannot extend the
+  journal.
+
+Crash seam for the chaos lane: ``KUBEDL_JOURNAL_TEST_DELAY_S`` sleeps
+INSIDE ``append`` after the fsync, widening the window between the
+durable record and the in-memory commit so tests/test_journal_chaos.py
+can SIGKILL the operator inside it deterministically.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "ENV_JOURNAL_TEST_DELAY",
+    "JOURNAL_VERSION",
+    "GrantJournal",
+    "JournalError",
+    "StaleEpochError",
+]
+
+ENV_JOURNAL_TEST_DELAY = "KUBEDL_JOURNAL_TEST_DELAY_S"
+JOURNAL_VERSION = 1
+
+#: every op the admitter journals — replay refuses records outside
+#: this set (schema drift must be explicit, not silently ignored).
+JOURNAL_OPS = frozenset((
+    "grant", "pods_start", "evict", "release", "confirm_drain",
+    "drain_timeout", "slice_failed", "delete_gang",
+))
+
+
+class JournalError(RuntimeError):
+    """Structural journal failure (unknown op, closed journal)."""
+
+
+class StaleEpochError(JournalError):
+    """The epoch authority shows a newer leader: this writer has been
+    deposed and must stop — its append was refused."""
+
+
+def _sha(record: Dict[str, Any]) -> str:
+    body = {k: v for k, v in record.items() if k != "sha"}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+class GrantJournal:
+    """One append-only journal file, one writer at a time (the fencing
+    epoch enforces the "one" part across processes; the internal lock
+    serializes threads of the same operator)."""
+
+    def __init__(
+        self,
+        path: str,
+        epoch: int = 0,
+        epoch_authority: Optional[Callable[[], int]] = None,
+    ) -> None:
+        self.path = path
+        self.epoch = int(epoch)
+        # callable returning the current fleet-wide epoch (the lease
+        # sidecar); None disables fencing (tests, journal-off bench).
+        self._authority = epoch_authority
+        self._lock = threading.RLock()
+        self._fh = None
+        self._seq = 0
+        # counters surfaced by metrics (kubedl_journal_* family)
+        self.appends_total = 0
+        self.replay_records = 0
+        self.replay_conflicts = 0
+        self.stale_epoch_refusals = 0
+
+    # -- open / replay ----------------------------------------------------
+
+    def open(self) -> List[Dict[str, Any]]:
+        """Scan the existing file (if any), returning every valid
+        record in order; stop at the first torn or sha-mismatched line
+        (crash tail).  Then open the append handle.  Idempotent."""
+        with self._lock:
+            if self._fh is not None:
+                return []
+            records: List[Dict[str, Any]] = []
+            torn = 0
+            max_epoch = 0
+            try:
+                with open(self.path, "r", encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            torn += 1
+                            break
+                        if (not isinstance(rec, dict)
+                                or rec.get("sha") != _sha(rec)
+                                or rec.get("op") not in JOURNAL_OPS):
+                            torn += 1
+                            break
+                        records.append(rec)
+                        max_epoch = max(max_epoch, int(rec.get("epoch", 0)))
+            except OSError:
+                pass  # no journal yet: cold start
+            if torn:
+                log.warning(
+                    "journal %s: stopped replay at torn/corrupt tail "
+                    "after %d valid records", self.path, len(records))
+            if self.epoch and max_epoch > self.epoch:
+                # a newer leader already wrote here; we were deposed
+                # before we even started
+                self.stale_epoch_refusals += 1
+                raise StaleEpochError(
+                    f"journal {self.path} holds epoch {max_epoch} > "
+                    f"ours {self.epoch}: refusing to open for append")
+            self._seq = int(records[-1]["seq"]) if records else 0
+            self.replay_records = len(records)
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            return records
+
+    # -- the write-ahead append -------------------------------------------
+
+    def append(self, op: str, gang: str = "", **data: Any) -> Dict[str, Any]:
+        """Durably append one record and return it.  Called by the
+        admitter UNDER its own lock, immediately BEFORE the in-memory
+        commit — the record must be on disk before memory changes."""
+        if op not in JOURNAL_OPS:
+            raise JournalError(f"unknown journal op {op!r}")
+        with self._lock:
+            if self._fh is None:
+                raise JournalError(
+                    f"journal {self.path} not open (call open() first)")
+            if self._authority is not None:
+                current = self._authority()
+                if current > self.epoch:
+                    self.stale_epoch_refusals += 1
+                    log.error(
+                        "journal %s: APPEND REFUSED — fencing epoch %d "
+                        "superseded by %d (a newer leader holds the "
+                        "lease); this operator must stop",
+                        self.path, self.epoch, current)
+                    raise StaleEpochError(
+                        f"append refused: epoch {self.epoch} superseded "
+                        f"by {current}")
+            self._seq += 1
+            rec: Dict[str, Any] = {
+                "v": JOURNAL_VERSION,
+                "seq": self._seq,
+                "epoch": self.epoch,
+                "t": time.time(),
+                "op": op,
+                "gang": gang,
+                "data": data,
+            }
+            rec["sha"] = _sha(rec)
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.appends_total += 1
+        # crash seam (chaos lane): widen the window between the durable
+        # append and the caller's in-memory commit.  Outside the lock so
+        # a SIGKILL here never leaves lock state behind in-process.
+        delay = float(os.environ.get(ENV_JOURNAL_TEST_DELAY, "0") or 0)
+        if delay > 0:
+            time.sleep(delay)
+        return rec
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def note_replay(self, records: int, conflicts: int) -> None:
+        """Recorded by the admitter after restore_from_journal."""
+        with self._lock:
+            self.replay_records = records
+            self.replay_conflicts = conflicts
+
+    def snapshot(self) -> Dict[str, int]:
+        """Metrics snapshot (kubedl_journal_* family)."""
+        with self._lock:
+            return {
+                "appends_total": self.appends_total,
+                "replay_records_total": self.replay_records,
+                "replay_conflicts_total": self.replay_conflicts,
+                "stale_epoch_refusals_total": self.stale_epoch_refusals,
+                "epoch": self.epoch,
+                "seq": self._seq,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None

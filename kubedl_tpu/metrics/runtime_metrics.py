@@ -119,6 +119,9 @@ class RuntimeMetrics:
         self._transport: Optional[Callable[[], Dict]] = None
         # RL-fleet snapshot callable (rl_metrics.snapshot)
         self._rl: Optional[Callable[[], Dict]] = None
+        # grant-journal snapshot callable (Operator._journal_snapshot:
+        # GrantJournal.snapshot() + the leader fencing epoch)
+        self._journal: Optional[Callable[[], Dict]] = None
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -178,6 +181,13 @@ class RuntimeMetrics:
         stale-dropped counters)."""
         with self._lock:
             self._rl = snapshot_fn
+
+    def register_journal(self, snapshot_fn: Callable[[], Dict]) -> None:
+        """snapshot_fn returns GrantJournal.snapshot()-shaped dicts
+        (append/replay/refusal counters) plus a ``leader_epoch`` key
+        (the operator folds its elector's fencing epoch in)."""
+        with self._lock:
+            self._journal = snapshot_fn
 
     # -- exposition ------------------------------------------------------
 
@@ -469,6 +479,37 @@ class RuntimeMetrics:
                     lines.append(f"# TYPE {metric} counter")
                     lines.append(sample(metric, tp.get(key, 0)))
         with self._lock:
+            journal_fn = self._journal
+        if journal_fn is not None:
+            # outside the metrics lock, same rationale as the pool snapshot
+            try:
+                jn = journal_fn()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                jn = None
+            if jn is not None:
+                for metric, key, mtype, help_ in (
+                    ("kubedl_journal_appends_total", "appends_total",
+                     "counter", "Write-ahead journal records appended "
+                     "(fsync'd before the in-memory commit)"),
+                    ("kubedl_journal_replay_records_total",
+                     "replay_records_total", "counter",
+                     "Journal records replayed at the last restart"),
+                    ("kubedl_journal_replay_conflicts_total",
+                     "replay_conflicts_total", "counter",
+                     "Replayed grants conservatively parked as drains "
+                     "(journal/pod-set mismatch)"),
+                    ("kubedl_journal_stale_epoch_refusals_total",
+                     "stale_epoch_refusals_total", "counter",
+                     "Journal appends refused because a newer leader "
+                     "holds the fencing epoch"),
+                    ("kubedl_leader_epoch", "leader_epoch", "gauge",
+                     "Fencing epoch of this operator's leadership "
+                     "(0 = not leader / unfenced)"),
+                ):
+                    lines.append(f"# HELP {metric} {help_}")
+                    lines.append(f"# TYPE {metric} {mtype}")
+                    lines.append(sample(metric, jn.get(key, 0)))
+        with self._lock:
             rl_fn = self._rl
         if rl_fn is not None:
             # outside the metrics lock, same rationale as the pool snapshot
@@ -525,6 +566,12 @@ class RuntimeMetrics:
             goodput_fn = self._goodput
             transport_fn = self._transport
             rl_fn = self._rl
+            journal_fn = self._journal
+        if journal_fn is not None:
+            try:
+                out["journal"] = journal_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["journal"] = None
         if rl_fn is not None:
             try:
                 out["rl"] = rl_fn()  # outside the lock, see render()

@@ -32,7 +32,7 @@ from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
 from kubedl_tpu.metrics.job_metrics import MetricsRegistry
 from kubedl_tpu.metrics.runtime_metrics import RuntimeMetrics
 from kubedl_tpu.api.validation import validate
-from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector
+from kubedl_tpu.core.leader import DEFAULT_LEASE_PATH, FileLeaseElector, read_epoch
 from kubedl_tpu.utils.serde import from_dict
 
 log = logging.getLogger("kubedl_tpu.operator")
@@ -87,6 +87,18 @@ class OperatorConfig:
     leader_lease_duration: float = 15.0
     leader_renew_period: float = 5.0
     leader_retry_period: float = 2.0
+    # Durable control plane (docs/ha.md): write-ahead grant/drain
+    # journal — every admitter transition is fsync'd to
+    # <journal_dir>/grant.journal BEFORE the in-memory commit and
+    # replayed on the next start, so a crashed operator never re-grants
+    # a slice whose previous pod still runs. "" disables (embedded/test
+    # operators); the CLI `operator` command defaults it under the data
+    # root (core/leader.py data_root()).
+    journal_dir: str = ""
+    # Fleet history store (docs/ha.md): trace spans + goodput +
+    # lifecycle markers persisted past job TTL, queryable via
+    # GET /history/<ns>/<job> and `kubedl-tpu history`. "" disables.
+    history_dir: str = ""
     # Kubernetes mode: reconcile real Pod/Service objects on a cluster
     # through the kube-apiserver instead of the in-process store + local
     # executor (ref main.go:70-75 manager-over-client-go). "in-cluster"
@@ -215,6 +227,14 @@ class Operator:
         self.object_backend = None
         self.event_backend = None
         self._persist_controllers: List = []
+        # durable control plane (docs/ha.md): wired at start() so the
+        # journal carries the fencing epoch of the WON election
+        self.journal = None  # GrantJournal when config.journal_dir set
+        self.history_store = None  # HistoryStore when config.history_dir set
+        self._history_controllers: List = []
+        # family registered even with the journal disabled so
+        # kubedl_journal_* render as zeros and /debug/vars stays complete
+        self.runtime_metrics.register_journal(self._journal_snapshot)
 
     # -- registration ----------------------------------------------------
 
@@ -303,6 +323,11 @@ class Operator:
                 self.elector = FileLeaseElector(self.config.leader_lease_path)
             if not self.elector.acquire(timeout=timeout, stop=self._stopping.is_set):
                 return False
+        if self.config.journal_dir and isinstance(self._gang, TPUSliceAdmitter):
+            # replay BEFORE the executor/manager start: pre-crash grants
+            # must be restored (or conservatively parked as drains)
+            # before anything can admit over them
+            self._setup_journal()
         self._started = True
         self._setup_persistence()
         if self.executor is not None:
@@ -342,41 +367,90 @@ class Operator:
             self.runtime_metrics.register_slice_pool(self._gang.utilization)
         return True
 
-    def _setup_persistence(self) -> None:
-        if not (self.config.object_storage or self.config.event_storage):
-            return
-        from kubedl_tpu.controllers.persist import setup_persist_controllers
-        from kubedl_tpu.storage import registry as storage_registry
+    def _setup_journal(self) -> None:
+        """Write-ahead grant/drain journal (docs/ha.md): open + replay
+        against the observed pod set, stamped with the fencing epoch of
+        the election we just won so a deposed predecessor's appends are
+        refused loudly."""
+        from kubedl_tpu.journal import GrantJournal
 
-        if self.config.object_storage:
-            self.object_backend = storage_registry.new_object_backend(
-                self.config.object_storage, db_path=self.config.storage_db_path
-            )
-            self.object_backend.initialize()
-        if self.config.event_storage:
-            # share the object backend when both flags name the same backend
-            # and it implements the event role too (sqlite does)
-            if (
-                self.config.event_storage == self.config.object_storage
-                and hasattr(self.object_backend, "save_event")
-            ):
-                self.event_backend = self.object_backend
-            else:
-                self.event_backend = storage_registry.new_event_backend(
-                    self.config.event_storage, db_path=self.config.storage_db_path
-                )
-                self.event_backend.initialize()
+        epoch, authority = 0, None
+        if isinstance(self.elector, FileLeaseElector):
+            epoch = self.elector.epoch
+            lease = self.elector.lease_path
+            authority = lambda: read_epoch(lease)  # noqa: E731
+        self.journal = GrantJournal(
+            os.path.join(self.config.journal_dir, "grant.journal"),
+            epoch=epoch,
+            epoch_authority=authority,
+        )
+        stats = self._gang.restore_from_journal(self.journal)
+        if stats["records"]:
+            log.info(
+                "grant journal replayed: records=%d conflicts=%d gangs=%d",
+                stats["records"], stats["conflicts"], stats["gangs"])
+
+    def _journal_snapshot(self) -> Dict:
+        """kubedl_journal_* + kubedl_leader_epoch source (metrics)."""
+        snap = dict(self.journal.snapshot()) if self.journal is not None else {}
+        snap["leader_epoch"] = (
+            getattr(self.elector, "epoch", 0) or snap.get("epoch", 0))
+        return snap
+
+    def _setup_persistence(self) -> None:
         workload_controllers = {
             kind: engine.controller for kind, engine in self.reconcilers.items()
         }
-        self._persist_controllers = setup_persist_controllers(
-            self.manager,
-            self.store,
-            workload_controllers,
-            object_backend=self.object_backend,
-            event_backend=self.event_backend,
-            region=self.config.region,
-        )
+        if self.config.object_storage or self.config.event_storage:
+            from kubedl_tpu.controllers.persist import setup_persist_controllers
+            from kubedl_tpu.storage import registry as storage_registry
+
+            if self.config.object_storage:
+                self.object_backend = storage_registry.new_object_backend(
+                    self.config.object_storage, db_path=self.config.storage_db_path
+                )
+                self.object_backend.initialize()
+            if self.config.event_storage:
+                # share the object backend when both flags name the same backend
+                # and it implements the event role too (sqlite does)
+                if (
+                    self.config.event_storage == self.config.object_storage
+                    and hasattr(self.object_backend, "save_event")
+                ):
+                    self.event_backend = self.object_backend
+                else:
+                    self.event_backend = storage_registry.new_event_backend(
+                        self.config.event_storage, db_path=self.config.storage_db_path
+                    )
+                    self.event_backend.initialize()
+            self._persist_controllers = setup_persist_controllers(
+                self.manager,
+                self.store,
+                workload_controllers,
+                object_backend=self.object_backend,
+                event_backend=self.event_backend,
+                region=self.config.region,
+            )
+        if self.config.history_dir:
+            # fleet history: joins its own JSONL evidence with whatever
+            # job/event rows the backends above persist (both optional)
+            from kubedl_tpu.journal import HistoryStore
+            from kubedl_tpu.journal.history import setup_history_controllers
+
+            self.history_store = HistoryStore(
+                self.config.history_dir,
+                object_backend=self.object_backend,
+                event_backend=self.event_backend,
+                region=self.config.region,
+            )
+            self.history_store.initialize()
+            self._history_controllers = setup_history_controllers(
+                self.manager,
+                self.store,
+                workload_controllers,
+                self.history_store,
+                self.trace_root,
+            )
 
     def _on_leadership_lost(self) -> None:
         log.error("leadership lost — stopping reconcilers (standby takes over)")
@@ -393,6 +467,10 @@ class Operator:
             self.elector.release()
         if self.executor is not None:
             self.executor.stop()
+        if self.journal is not None:
+            self.journal.close()
+        if self.history_store is not None:
+            self.history_store.close()
         self.tracer.close()
         if self.object_backend is not None:
             self.object_backend.close()

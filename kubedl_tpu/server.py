@@ -12,6 +12,7 @@ object CRUD. Standalone, this server provides both:
   DELETE /apis/<kind>/<ns>/<name>   delete a job
   GET  /events/<ns>                 recent events in a namespace
   GET  /trace/<ns>/<job>            flight-recorder span timeline + goodput
+  GET  /history/<ns>/<job>          fleet history (outlives job TTL)
   GET  /serving/fleet               serving-fleet pods by role (JSON)
   POST /serving/drain/<ns>/<pod>    annotate a serving pod for drain
 
@@ -182,6 +183,25 @@ class OperatorHTTPServer:
                         "spans": spans,
                         "goodput": compute_goodput(spans),
                     })
+                elif len(parts) == 3 and parts[0] == "history":
+                    # fleet history (docs/ha.md): everything the history
+                    # store kept about one job — trace snapshot, goodput,
+                    # lifecycle markers, persisted job row + events —
+                    # still answerable after the CRD hit its TTL and the
+                    # trace dir was garbage-collected
+                    hs = getattr(op, "history_store", None)
+                    if hs is None:
+                        self._json(404, {
+                            "error": "history store not enabled "
+                                     "(set history_dir / --history-dir)"})
+                        return
+                    rec = hs.get(parts[1], parts[2])
+                    if rec is None:
+                        self._json(404, {
+                            "error": f"no history recorded for "
+                                     f"{parts[1]}/{parts[2]}"})
+                        return
+                    self._json(200, rec)
                 elif split.path == "/serving/fleet":
                     # the serving-fleet view the router and operators
                     # watch: every pod carrying a serving role label,

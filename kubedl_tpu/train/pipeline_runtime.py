@@ -460,7 +460,23 @@ class MPMDPipeline:
         for t in threads:
             t.join()
         if errors:
-            raise errors[0]
+            # a stage's recv timeout is usually the SECONDARY symptom of a
+            # neighbor dying first (stale incarnation, auth refusal, a
+            # poisoned boundary message): its peer stops sending, so the
+            # survivor times out. Surface the root cause, not the timeout
+            # that followed it — errors[0] is merely whichever thread
+            # appended first, a scheduling race under load.
+            def _is_timeout(e: BaseException) -> bool:
+                seen = 0
+                while e is not None and seen < 8:
+                    if isinstance(e, TimeoutError):
+                        return True
+                    e = e.__cause__
+                    seen += 1
+                return False
+
+            raise next(
+                (e for e in errors if not _is_timeout(e)), errors[0])
         out = {
             "loss": results[S - 1]["loss"],
             "stage_step_s": [r["step_s"] for r in results],

@@ -51,10 +51,15 @@ class SocketControlRouter:
         spool_dir: str,
         addr_for: Callable[[str, str], Optional[str]],
         reply_ttl_s: float = 600.0,
+        epoch_fn: Optional[Callable[[], int]] = None,
     ) -> None:
         self.plane = plane
         self.spool_dir = spool_dir
         self.addr_for = addr_for  # (namespace, pod) -> host:port | None
+        # leader fencing (docs/ha.md): stamp the current epoch into
+        # every control message so pods refuse a deposed operator's
+        # posts; None (tests, non-HA mode) stamps epoch 0 = unfenced
+        self.epoch_fn = epoch_fn
         # a pod killed mid-resize never replies: without a TTL its
         # pending entry (and a very late stale reply's spool write)
         # would outlive the scheduler's own deadline forever
@@ -88,6 +93,7 @@ class SocketControlRouter:
         msg = dict(message)
         msg["reply"] = tag
         msg["reply_addr"] = self.plane.bound_addr
+        msg["epoch"] = int(self.epoch_fn()) if self.epoch_fn else 0
         with self._lock:
             self._pending[tag] = (path, now + self.reply_ttl_s)
         try:
@@ -129,6 +135,11 @@ class SocketReshardControl:
     def __init__(self, plane: TransportPlane) -> None:
         self.plane = plane
         self._channel = plane.channel(CONTROL_CHANNEL)
+        # leader fencing (docs/ha.md): highest epoch seen so far — a
+        # message stamped with a LOWER (non-zero) epoch comes from a
+        # deposed operator and is refused loudly, never acted on
+        self._max_epoch = 0
+        self.stale_epoch_refusals = 0
 
     def poll(self) -> Optional[dict]:
         """Earliest pending control message, or None. Cheap enough for a
@@ -142,8 +153,20 @@ class SocketReshardControl:
                 msg = json.loads(data.decode("utf-8"))
             except ValueError:
                 continue  # corrupt frame payload: skip, never crash a step
-            if isinstance(msg, dict):
-                return msg
+            if not isinstance(msg, dict):
+                continue
+            epoch = int(msg.get("epoch", 0) or 0)
+            if epoch and epoch < self._max_epoch:
+                self.stale_epoch_refusals += 1
+                log.error(
+                    "control message REFUSED: fencing epoch %d is stale "
+                    "(a newer leader at epoch %d has spoken) — a deposed "
+                    "operator is still posting; dropping %r",
+                    epoch, self._max_epoch, msg.get("reply"))
+                continue
+            if epoch > self._max_epoch:
+                self._max_epoch = epoch
+            return msg
 
     def reply(self, msg: dict, **payload) -> None:
         tag = msg.get("reply")

@@ -1,0 +1,239 @@
+"""Durable control-plane chaos (docs/ha.md): SIGKILL a REAL operator
+process inside the journal's append/commit window (the
+KUBEDL_JOURNAL_TEST_DELAY_S seam widens it deterministically), restart,
+and prove the replayed admitter never re-grants over a live pod, never
+re-journals a transition it already owns, and conserves chips — plus
+the fencing pins: a deposed leader's control message is refused loudly
+by the pod-side endpoint.
+
+Runs with the runtime lock witness ON (docs/static_analysis.md): both
+incarnations record their real acquisition orders and any inversion
+fails loudly — the chaos lane doubles as the -race lane."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from kubedl_tpu.analysis import witness
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+CHILD_SRC = """\
+import sys, time
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+import os
+os.environ['KUBEDL_LOCK_WITNESS'] = '1'
+os.environ['KUBEDL_LOCK_WITNESS_DIR'] = {witness_dir!r}
+from kubedl_tpu.operator import Operator, OperatorConfig
+from fake_workload import TEST_KIND, TestJobController
+op = Operator(OperatorConfig(
+    enable_gang_scheduling=True, tpu_slices=['v5e-8'],
+    journal_dir={journal_dir!r},
+    enable_leader_election=True, leader_lease_path={lease!r},
+    trace_dir={trace_dir!r}))
+op.register(TestJobController())
+op.start()
+print('STARTED', flush=True)
+op.apply({{
+    'kind': TEST_KIND,
+    'metadata': {{'name': 'chaos-job'}},
+    'spec': {{
+        'replicaSpecs': {{'Worker': {{
+            'replicas': 2, 'restartPolicy': 'Never',
+            'template': {{'spec': {{'containers': [{{
+                'name': 'c', 'image': 'none',
+                'command': [sys.executable, '-c',
+                            'import time; time.sleep(5)'],
+                'resources': {{'limits': {{'google.com/tpu': 4}}}},
+            }}]}}}},
+        }}}},
+        'runPolicy': {{}},
+    }},
+}})
+time.sleep(120)  # SIGKILLed long before this
+"""
+
+
+def _spawn_victim(tmp_path, delay="2.0"):
+    """A real operator process with the append/commit window widened to
+    `delay` seconds — every journal append sleeps that long AFTER the
+    fsync, BEFORE the caller's in-memory commit."""
+    env = dict(os.environ,
+               KUBEDL_JOURNAL_TEST_DELAY_S=delay,
+               JAX_PLATFORMS="cpu")
+    src = CHILD_SRC.format(
+        repo=REPO_ROOT, tests=TESTS_DIR,
+        witness_dir=str(tmp_path / "witness"),
+        journal_dir=str(tmp_path / "journal"),
+        lease=str(tmp_path / "leader.lock"),
+        trace_dir=str(tmp_path / "trace"))
+    proc = subprocess.Popen([sys.executable, "-c", src],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    assert "STARTED" in proc.stdout.readline()
+    return proc
+
+
+def _kill_at_journal_marker(proc, tmp_path, marker, timeout=30.0):
+    """SIGKILL the victim the moment `marker` hits the journal file —
+    inside the delay seam, so the record is durable but the in-memory
+    commit never happened."""
+    path = str(tmp_path / "journal" / "grant.journal")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                if marker in f.read():
+                    break
+        except OSError:
+            pass
+        time.sleep(0.02)
+    else:
+        proc.kill()
+        pytest.fail(f"journal never showed {marker!r}")
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def _restart_and_check(tmp_path, monkeypatch, min_records):
+    """The successor incarnation: fresh store, same journal dir, same
+    lease — replay must restore the gang without journaling a single
+    new transition (no re-admission, no eviction) and conserve chips."""
+    from kubedl_tpu.operator import Operator, OperatorConfig
+    from fake_workload import TestJobController
+
+    monkeypatch.delenv("KUBEDL_JOURNAL_TEST_DELAY_S", raising=False)
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.registry.reset()
+    op = Operator(OperatorConfig(
+        enable_gang_scheduling=True, tpu_slices=["v5e-8"],
+        journal_dir=str(tmp_path / "journal"),
+        enable_leader_election=True,
+        leader_lease_path=str(tmp_path / "leader.lock"),
+        trace_dir=str(tmp_path / "trace2")))
+    op.register(TestJobController())
+    op.start()
+    try:
+        snap = op.journal.snapshot()
+        assert snap["replay_records_total"] >= min_records
+        assert snap["replay_conflicts_total"] == 0
+        # the flock died with the victim; the successor fenced PAST it
+        assert op.elector.epoch == 2 and snap["epoch"] == 2
+        # the victim's records carry its epoch — the fencing audit trail
+        with open(tmp_path / "journal" / "grant.journal") as f:
+            epochs = {json.loads(ln)["epoch"] for ln in f if ln.strip()}
+        assert epochs == {1}
+        # the gang came back exactly once, on its journaled slice
+        gang = op._gang.get_gang("default", "chaos-job")
+        assert gang is not None and gang.slice_name
+        util = op._gang.utilization()
+        assert util["chips_reserved"] == 8 and util["chips_total"] == 8
+        owners = {s["name"]: s["reserved_by"] for s in util["slices"]}
+        assert owners[gang.slice_name] == "default/chaos-job"
+        # settle: reconcile + scheduler ticks run — NOTHING new may hit
+        # the journal (no re-admissions, no evictions of the survivor)
+        time.sleep(1.2)
+        assert op.journal.snapshot()["appends_total"] == 0
+    finally:
+        op.stop()
+    # the admitter's lock ran witness-wrapped with zero order inversions
+    assert type(op._gang._lock).__name__ == "WitnessLock"
+    assert witness.registry.report()["inversions"] == []
+
+
+def test_sigkill_mid_grant_then_replay_restores_without_regrant(
+        tmp_path, monkeypatch):
+    """Crash INSIDE the grant's append/commit window: the record is
+    durable, the reservation never reached memory.  Replay re-applies
+    the grant; the successor journals nothing new."""
+    proc = _spawn_victim(tmp_path)
+    try:
+        _kill_at_journal_marker(proc, tmp_path, '"op": "grant"')
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _restart_and_check(tmp_path, monkeypatch, min_records=1)
+
+
+def test_sigkill_between_grant_and_pods_start(tmp_path, monkeypatch):
+    """Crash after the grant committed but inside the FIRST pods_start
+    window: a live process may already be on the slice.  Replay keeps
+    the grant AND the started-pod latch — the successor neither
+    re-grants the slice nor re-journals the pod's start."""
+    proc = _spawn_victim(tmp_path)
+    try:
+        _kill_at_journal_marker(proc, tmp_path, '"op": "pods_start"')
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    _restart_and_check(tmp_path, monkeypatch, min_records=2)
+
+
+# ---------------------------------------------------------------------------
+# fencing over the transport control plane
+# ---------------------------------------------------------------------------
+
+
+def test_stale_epoch_control_message_refused(tmp_path, caplog):
+    """A deposed operator (older fencing epoch) posting a control
+    message after the new leader has spoken is refused LOUDLY by the
+    pod-side endpoint — never acted on, never replied to."""
+    from kubedl_tpu.transport import TransportPlane
+    from kubedl_tpu.transport.control import (
+        SocketControlRouter,
+        SocketReshardControl,
+    )
+
+    op_plane = TransportPlane(token="fence-tok", service="operator",
+                              latch=False)
+    op_plane.listen("127.0.0.1:0")
+    pod_plane = TransportPlane(token="fence-tok", service="pod",
+                               latch=False)
+    pod_addr = pod_plane.listen("127.0.0.1:0")
+    try:
+        epoch = {"e": 2}
+        router = SocketControlRouter(
+            op_plane, str(tmp_path / "spool"),
+            addr_for=lambda ns, n: pod_addr,
+            epoch_fn=lambda: epoch["e"])
+        ctl = SocketReshardControl(pod_plane)
+
+        assert router.post("default", "w0", {"type": "RESIZE"}) is not None
+        msg = None
+        deadline = time.monotonic() + 5
+        while msg is None and time.monotonic() < deadline:
+            msg = ctl.poll()
+            time.sleep(0.01)
+        assert msg is not None and msg["epoch"] == 2  # leader accepted
+
+        epoch["e"] = 1  # the deposed incarnation is still posting
+        with caplog.at_level("ERROR"):
+            assert router.post(
+                "default", "w0", {"type": "RESIZE"}) is not None
+            deadline = time.monotonic() + 5
+            while (ctl.stale_epoch_refusals == 0
+                   and time.monotonic() < deadline):
+                assert ctl.poll() is None  # refused, never surfaced
+                time.sleep(0.01)
+        assert ctl.stale_epoch_refusals == 1
+        assert any("REFUSED" in r.message and "stale" in r.message
+                   for r in caplog.records)
+        # epoch 0 (unfenced test traffic) still passes — fencing only
+        # bites once a NEWER leader has spoken and an OLDER one posts
+        epoch["e"] = 0
+        assert router.post("default", "w0", {"type": "RESIZE"}) is not None
+        msg = None
+        deadline = time.monotonic() + 5
+        while msg is None and time.monotonic() < deadline:
+            msg = ctl.poll()
+            time.sleep(0.01)
+        assert msg is not None and msg["epoch"] == 0
+    finally:
+        op_plane.close()
+        pod_plane.close()

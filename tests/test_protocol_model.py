@@ -7,11 +7,13 @@ Three layers:
     yield a short, readable transition trace naming the invariant;
   * the HEAD machine is a PROOF: the 2-gang space closes exhaustively
     (state count pinned) with every invariant holding;
-  * the restart machine's counterexample is PINNED transition by
-    transition — it is the committed spec for the ROADMAP item 5
-    grant journal.  When the journal lands and this trace disappears,
-    move the restart run into the proved set (model.run_model says
-    the same).
+  * the journaled-restart machine (the kubedl_tpu/journal/ write-ahead
+    journal replays every grant/drain on restart) PROVES
+    no-regrant-over-live-pod over the same spaces — the pinned
+    counterexample below flipped to a proof when the journal landed;
+  * the journal-LESS restart machine's counterexample stays PINNED
+    transition by transition as the seeded-bug control: the checker
+    must keep catching the pre-journal restart.
 """
 from __future__ import annotations
 
@@ -29,9 +31,12 @@ from kubedl_tpu.analysis.model import (
 from kubedl_tpu.analysis.protocol import (
     INVARIANTS,
     AdmitterModel,
+    Gang,
     ProtocolError,
     Slice,
+    State,
     default_machine,
+    journaled_restart_machine,
     restart_machine,
 )
 
@@ -123,16 +128,76 @@ def test_protocol_error_during_exploration_is_a_counterexample():
 
 
 # ---------------------------------------------------------------------------
-# the pinned restart counterexample (ROADMAP item 5 grant-journal spec)
+# journaled restart is a proof; journal-less restart stays the control
 # ---------------------------------------------------------------------------
 
 
+def test_journaled_restart_proves_no_regrant_over_live_pod():
+    """THE flip this repo's grant journal exists for: with the
+    write-ahead journal replayed on restart, the restart transition is
+    exactly the pre-crash state (write-ahead ordering: every commit
+    was journaled first), so the machine closes the SAME state space
+    as the restart-free proof — 383 states, depth 10 — and every
+    invariant, no-regrant-over-live-pod included, holds."""
+    res = check(journaled_restart_machine())
+    assert res.ok and not res.truncated
+    assert res.invariant is None and res.violation is None
+    assert res.states == 383
+    assert res.depth == 10
+    assert "restart+journal" in journaled_restart_machine().describe()
+
+
+def test_journaled_restart_proves_3gang_space():
+    res = check(journaled_restart_machine(
+        n_slices=4,
+        gangs=(("a", 1, 3, False), ("b", 2, 2, True),
+               ("c", 2, 1, False))))
+    assert res.ok and not res.truncated
+    assert res.states == 14350
+
+
+def test_replay_conservative_branch_parks_conflicts_as_drain():
+    """The conservative arm of AdmitterModel._replay (mirroring
+    TPUSliceAdmitter.restore_from_journal): a journaled grant that
+    conflicts with another gang's live pod is never restored — the
+    conflicted slice parks as a drain, the gang's other slices free,
+    and the gang returns to waiting.  Unreachable via BFS (such a
+    state already violates the invariant), so exercised directly."""
+    m = journaled_restart_machine()
+    # corrupt-journal fiction: b holds s0+s1 but a's pod lives on s0
+    st = State(
+        slices=(Slice("s0", "b", False), Slice("s1", "b", False),
+                Slice("s2", "", False)),
+        gangs=(Gang("a", 1, 2, False, (), frozenset({"s0"}), ""),
+               Gang("b", 2, 1, True, ("s0", "s1"), frozenset(), "")),
+        drains=(),
+    )
+    ns = m._replay(st)
+    by_name = {s.name: s for s in ns.slices}
+    assert by_name["s0"].owner == "drain:b"   # parked, NOT re-granted
+    assert by_name["s1"].owner == ""          # all-or-nothing: freed
+    assert ns.gangs[1].granted == ()          # b back to waiting
+    assert any(d.gang == "b" for d in ns.drains)
+    # and the resulting state satisfies the invariant it protects
+    assert INVARIANTS["no-regrant-over-live-pod"](ns) is None
+    # a consistent state replays as the identity
+    st_ok = State(
+        slices=(Slice("s0", "a", False), Slice("s1", "", False),
+                Slice("s2", "", False)),
+        gangs=(Gang("a", 1, 2, False, ("s0",), frozenset({"s0"}), ""),
+               Gang("b", 2, 1, True, (), frozenset(), "")),
+        drains=(),
+    )
+    assert m._replay(st_ok) == st_ok
+
+
 def test_restart_counterexample_is_pinned():
-    """Operator restart forgets in-memory grants; with no durable
-    grant journal the admitter re-grants a slice whose previous pod
-    is still running.  BFS guarantees this shortest trace, pinned
-    transition by transition.  When the grant journal lands this test
-    MUST flip to a proof — that is the point."""
+    """Journal-LESS operator restart forgets in-memory grants and
+    re-grants a slice whose previous pod is still running.  BFS
+    guarantees this shortest trace, pinned transition by transition.
+    Kept as the seeded-bug control now that the journal landed (the
+    journaled machine above proves the fix) — the checker must keep
+    catching the pre-journal restart."""
     res = check(restart_machine())
     assert not res.ok
     assert res.invariant == "no-regrant-over-live-pod"
@@ -181,6 +246,7 @@ def test_model_cli_entry_proves_head_and_pins_restart():
     text = out.stdout
     assert "PROVED over 383 states" in text
     assert "PROVED over 14350 states" in text
+    assert "restart+journal" in text      # journaled machines proved
     assert "EXPECTED counterexample" in text
     assert "no-regrant-over-live-pod" in text
     for inv_id in INVARIANTS:
