@@ -54,7 +54,11 @@ presubmit:
 	  --total tests/test_analysis.py=60 \
 	  --total tests/test_protocol_model.py=60 \
 	  --total tests/test_journal.py=60 \
-	  --total tests/test_journal_chaos.py=60
+	  --total tests/test_journal_chaos.py=60 \
+	  --total tests/test_workqueue.py=30 \
+	  --total tests/test_manager.py=30 \
+	  --total tests/test_capacity_scheduler.py=60 \
+	  --total tests/test_runtime_metrics.py=60
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -113,6 +117,16 @@ bench-rl:
 .PHONY: bench-journal
 bench-journal:
 	$(PY) bench.py --journal-only
+
+# Fleet-scale control-plane loop: the fleet_scale record — 10k-job /
+# 100k-pod closed-loop launch latency through the real operator,
+# sharded-reconcile throughput (1 vs 8 workers), incremental
+# demand-view tick cost, and concurrent group-commit grant cost, all
+# under the lock witness (merges ONLY the fleet_scale key into
+# .bench_extras.json; span file at .bench_trace/fleet.jsonl).
+.PHONY: bench-fleet
+bench-fleet:
+	$(PY) bench.py --fleet-only
 
 .PHONY: manifests
 manifests:

@@ -1454,17 +1454,22 @@ def _tpu_child(results_path: str) -> int:
         def grant_cycle(adm, n, tag):
             # round-trips through the REAL reserve path (the journal
             # hook fires inside _reserve_waiting); the inline free is
-            # bench-side surgery so the one-slice pool never wedges
+            # bench-side surgery so the one-slice pool never wedges.
+            # _note_change keeps the waiting index honest — reserve
+            # passes only look at indexed gangs, so a bare _gangs[]
+            # insert would never grant
             for i in range(n):
                 key = f"bench/{tag}-{i}"
                 st = adm._state_from_meta(meta)
                 with adm._lock:
                     adm._gangs[key] = st
+                    adm._note_change(key)
                     adm._reserve_waiting()
                     for s in st.slice_names:
                         adm._slices[s].reserved_by = None
                     st.slice_names = []
                     del adm._gangs[key]
+                    adm._note_change(key)
 
         rec = {}
         try:
@@ -1542,6 +1547,476 @@ def _tpu_child(results_path: str) -> int:
             shutil.rmtree(root, ignore_errors=True)
         _emit(out, "journal_wal", rec)
 
+    def fleet_scale_milestone():
+        """Control-plane speed at fleet scale
+        (docs/control_plane_scale.md) — pure host, no devices. Five
+        sub-records under one key: (1) closed-loop job launch through
+        the REAL watch-driven operator (8 sharded reconcile workers, a
+        simulated kubelet marking pods Ready) at cumulative fleet sizes
+        10 / 1k / 10k jobs, gated on launch_p50 @10k <= 2x @10; (2)
+        reconcile fan-out throughput, 1 vs 8 workers over a sharded
+        per-key-ordered queue, gated >= 5x; (3) capacity-scheduler tick
+        cost on the incremental demand view — full rebuild vs
+        steady-state skip vs one-gang delta vs the full-rescan oracle;
+        (4) concurrent grant cost with the group-commit journal, gated
+        <= 2x journal-off; (5) a queue-op flatness micro-assert (depth
+        10 vs 100k). The whole lane runs under the lock witness and
+        fails on any recorded inversion."""
+        import shutil
+        import statistics
+        import tempfile
+        from dataclasses import dataclass
+
+        from kubedl_tpu.analysis.witness import registry as lock_registry
+        from kubedl_tpu.api.common import JobConditionType, ReplicaType, has_condition
+        from kubedl_tpu.api.job import BaseJob
+        from kubedl_tpu.api.pod import (
+            ContainerStateTerminated,
+            ContainerStatus,
+            PodCondition,
+            PodPhase,
+        )
+        from kubedl_tpu.controllers.base import BaseWorkloadController
+        from kubedl_tpu.core.manager import Manager, Result
+        from kubedl_tpu.core.store import ADDED, NotFound, ObjectStore
+        from kubedl_tpu.core.workqueue import RateLimitingQueue
+        from kubedl_tpu.gang.slice_admitter import TPUSliceAdmitter
+        from kubedl_tpu.journal import GrantJournal
+        from kubedl_tpu.operator import Operator, OperatorConfig
+        from kubedl_tpu.sched import CapacityConfig, CapacityScheduler
+
+        root = tempfile.mkdtemp(prefix="kubedl-bench-fleet-")
+        rec = {}
+        gmeta = {"min_member": 2, "tpu_chips": 8, "requested_slice": "v5e-8",
+                 "num_slices": 1, "total_member": 2, "priority": 0,
+                 "kind": "TFJob", "tenant": "default",
+                 "admissible_slices": ["v5e-8"], "stage_slices": [],
+                 "roles": [], "live_reshard": False, "quiesce_s": 0.0}
+
+        # -- (5 first: cheapest) queue-op flatness with depth ------------
+        def queue_cycle_us(prefill, ops):
+            q = RateLimitingQueue()
+            for i in range(prefill):
+                q.add(f"pre/{i}")
+            # steady cycle at constant depth: pop the head, finish it,
+            # push it back — deque ops, so depth must not matter
+            t0 = time.perf_counter()
+            for _ in range(ops):
+                k = q.get(timeout=1.0)
+                q.done(k)
+                q.add(k)
+            return (time.perf_counter() - t0) / ops * 1e6
+
+        q_ops = 2000 if small else 5000
+        deep = 20_000 if small else 100_000
+        shallow_us = queue_cycle_us(10, q_ops)
+        deep_us = queue_cycle_us(deep, q_ops)
+        flat_ratio = deep_us / max(shallow_us, 1e-9)
+        if flat_ratio > 3.0:
+            # a list.pop(0) regression scales with depth and lands
+            # orders of magnitude past this bound
+            raise RuntimeError(
+                f"workqueue ops not flat with depth: {shallow_us:.2f}us "
+                f"@10 vs {deep_us:.2f}us @{deep} ({flat_ratio:.1f}x)")
+        rec["workqueue"] = {
+            "cycle_us_depth_10": round(shallow_us, 3),
+            f"cycle_us_depth_{deep}": round(deep_us, 3),
+            "depth_ratio": round(flat_ratio, 2),
+        }
+
+        # -- (2) reconcile fan-out: 1 worker vs 8 sharded workers --------
+        def reconcile_rate(workers, n_keys):
+            mgr = Manager(store=ObjectStore())
+            done_n = [0]
+            done_lock = threading.Lock()
+            all_done = threading.Event()
+
+            def rec_fn(key):
+                time.sleep(0.0005)  # synthetic 0.5ms reconcile body
+                with done_lock:
+                    done_n[0] += 1
+                    if done_n[0] >= n_keys:
+                        all_done.set()
+                return Result()
+
+            c = mgr.add_controller("fleet-bench", rec_fn, workers=workers)
+            mgr.start()
+            t0 = time.perf_counter()
+            for i in range(n_keys):
+                c.enqueue(f"ns-{i % 64}/job-{i}")
+            all_done.wait(timeout=300)
+            elapsed = time.perf_counter() - t0
+            mgr.stop()
+            mgr.store.close()
+            return n_keys / elapsed
+
+        n_keys = 400 if small else 3000
+        rate_1 = reconcile_rate(1, n_keys)
+        rate_8 = reconcile_rate(8, n_keys)
+        rec["reconcile"] = {
+            "keys": n_keys,
+            "keys_per_s_1_worker": round(rate_1, 1),
+            "keys_per_s_8_workers": round(rate_8, 1),
+            "speedup_8_workers": round(rate_8 / rate_1, 2),
+        }
+
+        # -- (3) scheduler tick cost on the incremental demand view ------
+        n_gangs = 200 if small else 2000
+
+        def granted_fleet():
+            store = ObjectStore()
+            adm = TPUSliceAdmitter.with_pool(store, ["v5e-8"] * n_gangs)
+            for i in range(n_gangs):
+                st = adm._state_from_meta(
+                    {**gmeta, "tenant": f"team-{i % 16}"})
+                with adm._lock:
+                    adm._gangs[f"fleet/g-{i}"] = st
+                    adm._note_change(f"fleet/g-{i}")  # join waiting index
+            granted = adm.kick()
+            if len(granted) != n_gangs:
+                raise RuntimeError(
+                    f"fleet setup: {len(granted)}/{n_gangs} gangs granted")
+            return store, adm
+
+        def tick_us(sched, n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                sched.tick()
+            return (time.perf_counter() - t0) / n * 1e6
+
+        sched_store, sched_adm = granted_fleet()
+        sched_cfg = dict(policy="fair_share", enable_preemption=False,
+                         enable_elastic=False)
+        sched = CapacityScheduler(
+            sched_adm, sched_store, CapacityConfig(**sched_cfg))
+        first_us = tick_us(sched, 1)  # primes the view: full O(n) rebuild
+        steady_us = tick_us(sched, 50 if small else 200)  # skip path
+        n_touch = 20 if small else 100
+        t0 = time.perf_counter()
+        for i in range(n_touch):
+            with sched_adm._lock:  # one-gang delta: O(changed) fold
+                sched_adm._note_change(f"fleet/g-{i % n_gangs}")
+            sched.tick()
+        touch_us = (time.perf_counter() - t0) / n_touch * 1e6
+        parity = sched._view.parity_diff()
+        if parity:
+            raise RuntimeError(
+                f"incremental demand view diverged from full rescan "
+                f"after {n_touch} delta ticks: {list(parity)[:5]}")
+        rescan = CapacityScheduler(
+            sched_adm, sched_store,
+            CapacityConfig(incremental_demand_view=False, **sched_cfg))
+        rescan_us = tick_us(rescan, 20 if small else 50)
+        snap = sched.snapshot()
+        sched_store.close()
+        rec["sched_tick"] = {
+            "gangs": n_gangs,
+            "first_tick_us": round(first_us, 1),
+            "steady_tick_us": round(steady_us, 1),
+            "one_gang_delta_tick_us": round(touch_us, 1),
+            "full_rescan_tick_us": round(rescan_us, 1),
+            "ticks_skipped": snap["ticks_skipped"],
+            "ticks_total": snap["ticks_total"],
+            "view_parity": "ok",
+        }
+
+        # -- (4) concurrent grant cost: group-commit journal off vs on.
+        # The fleet's arrival shape is bursty — a reserve pass grants a
+        # BATCH of waiting gangs, and the group commit folds the whole
+        # batch (plus any other thread's in-flight appends) into one
+        # fsync. 8 threads each cycle bursts of 8 gangs over a shared
+        # 64-slice pool through the admitter's public kick().
+        n_threads = 8
+        burst = 8
+
+        def concurrent_grants(journal_on):
+            store = ObjectStore()
+            adm = TPUSliceAdmitter.with_pool(
+                store, ["v5e-8"] * (n_threads * burst))
+            j = None
+            if journal_on:
+                j = GrantJournal(
+                    os.path.join(root, "concurrent.journal"))
+                j.open()
+                adm.attach_journal(j)
+            grants = [0]
+            glock = threading.Lock()
+            per_thread = 10 if small else 40
+            barrier = threading.Barrier(n_threads + 1)
+
+            def worker(t):
+                barrier.wait()
+                for i in range(per_thread):
+                    keys = [f"fleet/c{t}-{i}-{b}" for b in range(burst)]
+                    sts = []
+                    with adm._lock:
+                        for key in keys:
+                            st = adm._state_from_meta(gmeta)
+                            adm._gangs[key] = st
+                            adm._note_change(key)  # join waiting index
+                            sts.append(st)
+                    for _ in range(400):
+                        # the REAL public entry point: reserve under the
+                        # lock, append_nosync per grant, then the
+                        # group-commit barrier outside it
+                        g = adm.kick()
+                        if g:
+                            with glock:
+                                grants[0] += len(g)
+                        with adm._lock:
+                            granted_all = all(s.slice_names for s in sts)
+                        if granted_all:
+                            break
+                    # inline free is bench-side surgery so the pool
+                    # cycles; unconditional so a starved burst can never
+                    # wedge the other threads' slices
+                    with adm._lock:
+                        for st, key in zip(sts, keys):
+                            for s in st.slice_names:
+                                adm._slices[s].reserved_by = None
+                            st.slice_names = []
+                            adm._gangs.pop(key, None)
+                            adm._note_change(key)
+
+            threads = [threading.Thread(target=worker, args=(t,))
+                       for t in range(n_threads)]
+            for x in threads:
+                x.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for x in threads:
+                x.join()
+            elapsed = time.perf_counter() - t0
+            fsyncs = j.snapshot().get("fsyncs_total", 0) if j else 0
+            if j is not None:
+                j.close()
+            store.close()
+            return (elapsed / max(grants[0], 1) * 1e6, grants[0], fsyncs)
+
+        off_us, off_n, _ = concurrent_grants(False)
+        on_us, on_n, on_fsyncs = concurrent_grants(True)
+        rec["journal_concurrent"] = {
+            "threads": n_threads,
+            "burst": burst,
+            "grant_us_off": round(off_us, 1),
+            "grants_off": off_n,
+            "grant_us_on": round(on_us, 1),
+            "grants_on": on_n,
+            "fsyncs_on": on_fsyncs,
+            "grants_per_fsync": round(on_n / max(on_fsyncs, 1), 2),
+            "cost_ratio_on_vs_off": round(on_us / max(off_us, 1e-9), 2),
+        }
+
+        # -- (1) the 10k-job / 100k-pod closed-loop launch lane ----------
+        @dataclass
+        class FleetJob(BaseJob):
+            kind: str = "FleetJob"
+
+        class FleetJobController(BaseWorkloadController):
+            kind = "FleetJob"
+            api_version = "bench.kubedl-tpu.io/v1"
+            default_container_name = "bench"
+            default_port_name = "bench-port"
+            default_port = 2222
+
+            def job_type(self):
+                return FleetJob
+
+            def replica_specs(self, job):
+                return job.spec.replica_specs
+
+            def set_cluster_spec(self, job, pod_template, rtype, index):
+                pass
+
+            def reconcile_orders(self):
+                return [ReplicaType.WORKER]
+
+            @property
+            def master_types(self):
+                return []
+
+        pods_per_job = 2 if small else 10
+        tiers = [10, 50, 150] if small else [10, 1000, 10000]
+        # constant offered load: the @10 tier IS one batch, so every
+        # later tier must run the same outstanding window or the p50
+        # comparison measures batch size, not fleet size
+        batch = 10
+
+        def fleet_manifest(ns, name):
+            return {
+                "kind": "FleetJob",
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {
+                    "replicaSpecs": {
+                        "Worker": {
+                            "replicas": pods_per_job,
+                            "restartPolicy": "Never",
+                            "template": {"spec": {"containers": [
+                                {"name": "bench", "image": "none",
+                                 "command": ["true"]}]}},
+                        }
+                    },
+                    # self-cleaning closed loop: pods deleted at
+                    # completion, the job TTL'd right after — the store
+                    # stays bounded at the outstanding window
+                    "runPolicy": {"cleanPodPolicy": "All",
+                                  "ttlSecondsAfterFinished": 0},
+                },
+            }
+
+        op = Operator(OperatorConfig(
+            run_executor=False, max_reconciles=8,
+            trace_dir=os.path.join(root, "trace")))
+        op.register(FleetJobController())
+        op.start()
+        kubelet_watch = op.store.watch(["Pod"])
+        kubelet_stop = threading.Event()
+
+        def kubelet():
+            # the cluster's kubelets, simulated: every created pod goes
+            # Running + Ready the moment its ADDED event lands
+            while not kubelet_stop.is_set():
+                ev = kubelet_watch.next(timeout=0.05)
+                if ev is None or ev.type != ADDED:
+                    continue
+                try:
+                    pod = op.store.get(
+                        "Pod", ev.obj.metadata.namespace,
+                        ev.obj.metadata.name)
+                    pod.status.phase = PodPhase.RUNNING
+                    pod.status.start_time = time.time()
+                    pod.status.conditions = [PodCondition(
+                        type="Ready", status="True",
+                        last_transition_time=time.time())]
+                    op.store.update_status(pod)
+                except NotFound:
+                    continue
+
+        kubelet_thread = threading.Thread(
+            target=kubelet, name="bench-kubelet", daemon=True)
+        kubelet_thread.start()
+        jm = op.metrics_registry.get("FleetJob")
+
+        def wait_for(pred, names, what, timeout=120.0):
+            pending = set(names)
+            deadline = time.monotonic() + timeout
+            while pending:
+                pending = {nn for nn in pending if not pred(*nn)}
+                if not pending:
+                    return
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"fleet lane stuck waiting for {what}: "
+                        f"{sorted(pending)[:5]} (+{len(pending) - 5 if len(pending) > 5 else 0})")
+                time.sleep(0.002)
+
+        def is_running(ns, name):
+            try:
+                job = op.store.get("FleetJob", ns, name)
+            except NotFound:
+                return False
+            return has_condition(job.status, JobConditionType.RUNNING)
+
+        def is_gone(ns, name):
+            try:
+                op.store.get("FleetJob", ns, name)
+            except NotFound:
+                return True
+            return False
+
+        def succeed_pods(ns, name):
+            for i in range(pods_per_job):
+                pod_name = f"{name}-worker-{i}"
+                try:
+                    pod = op.store.get("Pod", ns, pod_name)
+                except NotFound:
+                    continue
+                pod.status.phase = PodPhase.SUCCEEDED
+                pod.status.container_statuses = [ContainerStatus(
+                    name="bench",
+                    terminated=ContainerStateTerminated(exit_code=0))]
+                op.store.update_status(pod)
+
+        def drive_to(target, next_idx):
+            t0 = time.perf_counter()
+            while next_idx < target:
+                b = min(batch, target - next_idx)
+                names = []
+                for j in range(next_idx, next_idx + b):
+                    # distinct namespaces, the fleet shape — keys spread
+                    # across the sharded queue's workers
+                    nn = (f"fleet-{j % 97}", f"fj-{j}")
+                    op.apply(fleet_manifest(*nn))
+                    names.append(nn)
+                next_idx += b
+                wait_for(is_running, names, "Running")
+                for nn in names:
+                    succeed_pods(*nn)
+                wait_for(is_gone, names, "TTL cleanup")
+            return next_idx, time.perf_counter() - t0
+
+        tier_recs = []
+        idx = 0
+        try:
+            for target in tiers:
+                base = len(jm.first_launch_delays)
+                idx, wall = drive_to(target, idx)
+                delays = [d for (_n, d) in jm.first_launch_delays[base:]]
+                delays.sort()
+                tier_recs.append({
+                    "fleet_jobs": target,
+                    "tier_jobs": len(delays),
+                    "tier_pods": len(delays) * pods_per_job,
+                    "wall_s": round(wall, 2),
+                    "jobs_per_s": round(len(delays) / max(wall, 1e-9), 1),
+                    "launch_p50_ms": round(
+                        statistics.median(delays) * 1e3, 2),
+                    "launch_p90_ms": round(
+                        delays[int(len(delays) * 0.9)] * 1e3, 2),
+                })
+        finally:
+            kubelet_stop.set()
+            kubelet_watch.stop()
+            op.stop()
+            kubelet_thread.join(timeout=2.0)
+        p50_small = tier_recs[0]["launch_p50_ms"]
+        p50_big = tier_recs[-1]["launch_p50_ms"]
+        rec["launch"] = {
+            "pods_per_job": pods_per_job,
+            "total_jobs": idx,
+            "total_pods": idx * pods_per_job,
+            "tiers": tier_recs,
+            "p50_ratio_full_fleet_vs_10": round(
+                p50_big / max(p50_small, 1e-9), 2),
+        }
+
+        # -- witness + gates ---------------------------------------------
+        shutil.rmtree(root, ignore_errors=True)
+        report = lock_registry.report()
+        if report["inversions"]:
+            raise RuntimeError(
+                f"lock witness recorded ordering inversions: "
+                f"{report['inversions'][:3]}")
+        rec["lock_witness"] = {
+            "enabled": bool(os.environ.get("KUBEDL_LOCK_WITNESS")),
+            "edges": len(report["edges"]),
+            "inversions": len(report["inversions"]),
+        }
+        rec["gates"] = {
+            "launch_p50_full_le_2x_10": p50_big <= 2.0 * p50_small,
+            "reconcile_speedup_ge_5x": rate_8 / rate_1 >= 5.0,
+            "journal_concurrent_le_2x": on_us <= 2.0 * off_us,
+            "workqueue_flat_le_3x": flat_ratio <= 3.0,
+        }
+        rec["environment"] = (
+            "host-only, lock witness on: launch lane through the real "
+            "operator (watch-driven reconcile, 8 sharded workers, "
+            "simulated kubelet, TTL-cleaned closed loop); scheduler "
+            "ticks on the incremental demand view with the full-rescan "
+            "parity oracle; grants through the admitter's public kick "
+            "with the group-commit journal")
+        _emit(out, "fleet_scale", rec)
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -1559,6 +2034,7 @@ def _tpu_child(results_path: str) -> int:
         ("pipeline_schedule", pipeline_schedule_milestone, 150),
         ("transport_roundtrip", transport_roundtrip_milestone, 60),
         ("journal_wal", journal_wal_milestone, 60),
+        ("fleet_scale", fleet_scale_milestone, 120),
         ("grpo", grpo_milestone, 150),
         ("rl_throughput", rl_throughput_milestone, 200),
     ]
@@ -1947,6 +2423,21 @@ def _journal_only() -> int:
         "journal", ("journal_wal",), merge_keys=("journal_wal",))
 
 
+def _fleet_only() -> int:
+    """`bench.py --fleet-only` (make bench-fleet): ONLY the fleet_scale
+    record — 10k-job / 100k-pod closed-loop launch latency through the
+    real operator, sharded-reconcile throughput, incremental demand-view
+    tick cost, and concurrent group-commit grant cost, merged into
+    .bench_extras.json with the paired .bench_trace/fleet.jsonl span
+    file. The whole lane runs with the lock witness armed (set BEFORE
+    any kubedl import constructs a lock) and fails on any recorded
+    ordering inversion — the perf numbers are only evidence if the
+    locking they measure stayed sound."""
+    os.environ.setdefault("KUBEDL_LOCK_WITNESS", "1")
+    return _single_lane(
+        "fleet", ("fleet_scale",), merge_keys=("fleet_scale",))
+
+
 def _rl_only() -> int:
     """`bench.py --rl-only` (make bench-rl): ONLY the rl_throughput
     record — rollout tok/s, learner step/s, weight-sync latency, and the
@@ -1972,6 +2463,8 @@ def main() -> int:
         return _transport_only()
     if "--journal-only" in sys.argv:
         return _journal_only()
+    if "--fleet-only" in sys.argv:
+        return _fleet_only()
     if "--rl-only" in sys.argv:
         return _rl_only()
 

@@ -81,6 +81,8 @@ def _mk_operator(args) -> Operator:
             leader_renew_period=getattr(args, "leader_renew_period", 5.0),
             leader_retry_period=getattr(args, "leader_retry_period", 2.0),
             journal_dir=getattr(args, "journal_dir", ""),
+            journal_compact_bytes=getattr(
+                args, "journal_compact_bytes", 1024 * 1024),
             history_dir=getattr(args, "history_dir", ""),
             kube_api_url=getattr(args, "kube_api_url", ""),
             kube_namespace=getattr(args, "kube_namespace", "default"),
@@ -819,6 +821,11 @@ def main(argv=None) -> int:
                       default=os.path.join(data_root(), "journal"),
                       help="write-ahead grant/drain journal dir "
                            "('' disables)")
+    p_op.add_argument("--journal-compact-bytes", type=int,
+                      default=1024 * 1024,
+                      help="compact the journal (snapshot + truncate) "
+                           "once it grows past this many bytes "
+                           "(0 disables compaction)")
     p_op.add_argument("--history-dir",
                       default=os.path.join(data_root(), "history"),
                       help="fleet history store dir, outlives job TTL "

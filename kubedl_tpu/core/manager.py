@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 from kubedl_tpu.core.store import ObjectStore, WatchEvent
-from kubedl_tpu.core.workqueue import RateLimitingQueue
+from kubedl_tpu.core.workqueue import RateLimitingQueue, ShardedRateLimitingQueue
 
 log = logging.getLogger("kubedl_tpu.manager")
 
@@ -35,8 +35,16 @@ class ControllerRunner:
     def __init__(self, name: str, reconcile: ReconcileFn, workers: int = 1) -> None:
         self.name = name
         self.reconcile = reconcile
-        self.workers = workers
-        self.queue = RateLimitingQueue()
+        self.workers = max(1, workers)
+        # One worker keeps the historical single-queue behavior (every
+        # embedded/test operator); N>1 workers drain a sharded queue where
+        # each key hashes to exactly one worker's shard, preserving per-key
+        # ordering and in-flight dedup under concurrency
+        # (docs/control_plane_scale.md).
+        if self.workers == 1:
+            self.queue = RateLimitingQueue()
+        else:
+            self.queue = ShardedRateLimitingQueue(self.workers)
         # kind -> handlers interested in that kind's events
         self.handlers: Dict[str, List[EventHandler]] = {}
 
@@ -132,19 +140,26 @@ class Manager:
         for c in self._controllers:
             for i in range(c.workers):
                 t = threading.Thread(
-                    target=self._worker, args=(c,), name=f"{c.name}-worker-{i}", daemon=True
+                    target=self._worker,
+                    args=(c, i),
+                    name=f"{c.name}-worker-{i}",
+                    daemon=True,
                 )
                 t.start()
                 self._threads.append(t)
         for name, fn, interval in self._loops:
             self._start_loop(name, fn, interval)
 
-    def _worker(self, c: ControllerRunner) -> None:
+    def _worker(self, c: ControllerRunner, worker_index: int = 0) -> None:
         import time
 
         rm = self.runtime_metrics
+        sharded = isinstance(c.queue, ShardedRateLimitingQueue)
         while not self._stop.is_set():
-            key = c.queue.get(timeout=0.1)
+            if sharded:
+                key = c.queue.get(timeout=0.1, shard=worker_index)
+            else:
+                key = c.queue.get(timeout=0.1)
             if key is None:
                 continue
             t0 = time.perf_counter()
@@ -186,7 +201,7 @@ class Manager:
         deadline = time.monotonic() + timeout
         quiet_since = None
         while time.monotonic() < deadline:
-            busy = any(len(c.queue) or c.queue._processing for c in self._controllers)
+            busy = any(c.queue.busy() for c in self._controllers)
             if busy:
                 quiet_since = None
             else:

@@ -190,6 +190,21 @@ class TPUSliceAdmitter(GangScheduler):
         # pod keys whose pods_start is already journaled (dedup: the
         # executor re-polls placements; replay rebuilds this set)
         self._journal_started: set = set()
+        # group commit (docs/control_plane_scale.md): seq of the last
+        # journal record this admitter wrote; _journal_sync() blocks
+        # until an fsync covers it, at every public entry point, after
+        # the lock drops and before any effect escapes
+        self._journal_last_seq = 0
+        # -- incremental demand view (docs/control_plane_scale.md) ------
+        # every scheduling-relevant mutation bumps _rev; per-gang deltas
+        # accumulate in _changed (drained by demand_changes, single
+        # consumer: the capacity scheduler's IncrementalDemandView)
+        self._rev = 0
+        self._changed: set = set()
+        self._pool_changed = False
+        # waiting-gang index: keys of gangs with TPU demand and no
+        # reservation — the reservation pass scans THIS, not every gang
+        self._waiting: set = set()
 
     @staticmethod
     def _drain_marker(gang_key: str) -> str:
@@ -207,14 +222,85 @@ class TPUSliceAdmitter(GangScheduler):
             self._journal = journal
 
     def _journal_op(self, op: str, gang: str = "", **data) -> None:
-        """Durable append BEFORE the in-memory commit — called under
-        the admitter lock at each transition choke point, so a crash
-        between the fsync and the commit leaves the journal at most one
-        record AHEAD of memory, which replay applies safely.  A
-        StaleEpochError (deposed leader) propagates: the mutation the
-        caller was about to make must NOT happen."""
+        """Journal write BEFORE the in-memory commit — called under the
+        admitter lock at each transition choke point, so journal order
+        always equals commit order and a crash between the write and the
+        commit leaves the journal at most one record AHEAD of memory,
+        which replay applies safely.  A StaleEpochError (deposed leader)
+        propagates: the mutation the caller was about to make must NOT
+        happen.  The write is flushed but not yet fsync'd: the public
+        entry point that triggered it calls _journal_sync() after the
+        lock drops, BEFORE any effect of the transition escapes — which
+        lets concurrent entry points share one group-commit fsync
+        instead of serializing the disk inside the lock."""
         if self._journal is not None:
-            self._journal.append(op, gang=gang, **data)
+            rec = self._journal.append_nosync(op, gang=gang, **data)
+            self._journal_last_seq = int(rec["seq"])
+
+    def _journal_sync(self) -> None:
+        """Group-commit barrier (docs/control_plane_scale.md): block
+        until an fsync covers the last record this admitter wrote.
+        Called by every public mutating entry point AFTER the admitter
+        lock drops and BEFORE any effect externalizes (a placement
+        returned to the executor, a PodGroup mirror written, a caller
+        proceeding to pod deletion) — so no transition is observable
+        before its record is durable, which is the write-ahead contract
+        the model checker's journaled machines assume."""
+        j = self._journal
+        if j is not None:
+            j.sync_to(self._journal_last_seq)
+
+    # -- incremental demand view marks (under the lock) -----------------
+
+    def _note_change(self, key: str) -> None:
+        """Mark a gang's scheduling state changed (grant, evict, resize,
+        create, delete) and maintain the waiting index."""
+        self._rev += 1
+        self._changed.add(key)
+        state = self._gangs.get(key)
+        if state is not None and state.tpu_chips > 0 and not state.slice_names:
+            self._waiting.add(key)
+        else:
+            self._waiting.discard(key)
+
+    def _note_pool(self) -> None:
+        """Pool membership or shape changed (set_pool, slice death) —
+        the view consumer must rebuild from scratch."""
+        self._rev += 1
+        self._pool_changed = True
+
+    def _note_avail(self) -> None:
+        """Slice availability changed without any gang's own state
+        changing (a drain freed, a solo pod came or went): no per-gang
+        delta, but a scheduler tick must not skip on an unchanged rev."""
+        self._rev += 1
+
+    def demand_rev(self) -> int:
+        """Monotonic change counter: unchanged rev == no scheduling-
+        relevant admitter transition since (tick-skip check)."""
+        with self._lock:
+            return self._rev
+
+    def demand_changes(self, since_rev: int):
+        """Single-consumer delta feed for the incremental demand view:
+        (rev, {gang key: GangSnapshot or None}, pool_changed) covering
+        every gang whose scheduling state changed since the last drain
+        (None = gang deleted); clears the marks.  pool_changed means
+        slice membership/shape changed — rebuild from gang_snapshots().
+        """
+        with self._lock:
+            if (since_rev == self._rev and not self._changed
+                    and not self._pool_changed):
+                return self._rev, {}, False
+            delta = {}
+            for key in self._changed:
+                state = self._gangs.get(key)
+                delta[key] = (None if state is None
+                              else self._snapshot(key, state))
+            self._changed.clear()
+            pool_changed = self._pool_changed
+            self._pool_changed = False
+            return self._rev, delta, pool_changed
 
     @staticmethod
     def _gang_meta(state: _GangState) -> Dict:
@@ -397,6 +483,9 @@ class TPUSliceAdmitter(GangScheduler):
                 if info is not None and info.reserved_by is None:
                     del self._slices[s]
             self._journal_started = started
+            for key in self._gangs:
+                self._changed.add(key)
+            self._note_pool()  # replay reshaped everything: full rebuild
         # observed-pod cross-check (store listing OUTSIDE the lock): a
         # live pod whose gang the journal shows as gone means records
         # and reality disagree — count it loudly; the reconcile loop
@@ -453,6 +542,7 @@ class TPUSliceAdmitter(GangScheduler):
                     invalidated.discard(info.name)
                 new[info.name] = info
             self._slices = new
+            self._note_pool()
             changed_keys = []
             for key, state in self._gangs.items():
                 if state.slice_names and any(
@@ -468,6 +558,7 @@ class TPUSliceAdmitter(GangScheduler):
                     state.slice_names = []
                     state.waiting_since = time.monotonic()
                     changed_keys.append(key)
+                    self._note_change(key)
             self._solo = {
                 pod_key: sname for pod_key, sname in self._solo.items()
                 if sname in new and sname not in invalidated
@@ -485,6 +576,7 @@ class TPUSliceAdmitter(GangScheduler):
                 if not drain.slices:
                     del self._drains[gk]
             changed_keys.extend(self._reserve_waiting())
+        self._journal_sync()
         for key in changed_keys:
             self._remirror_podgroup_status(key)
         self._drain_spans()
@@ -623,7 +715,9 @@ class TPUSliceAdmitter(GangScheduler):
                         getattr(elastic, "quiesce_timeout_s", 0.0) or 0.0),
                 )
                 self._gangs[key] = state
+                self._note_change(key)
             self._reserve_waiting()
+        self._journal_sync()
         self._drain_spans()
         self._mirror_podgroup(job, state)
         return state
@@ -663,6 +757,8 @@ class TPUSliceAdmitter(GangScheduler):
                     info = self._slices.get(sname)
                     if info and info.reserved_by == key:
                         info.reserved_by = None
+                self._note_change(key)
+        self._journal_sync()
         try:
             self.store.delete("PodGroup", job.metadata.namespace, job.metadata.name)
         except NotFound:
@@ -674,6 +770,8 @@ class TPUSliceAdmitter(GangScheduler):
 
     def assign(self, pod) -> Optional[Placement]:
         placement = self._assign(pod)
+        # grant/pods_start records durable BEFORE the placement escapes
+        self._journal_sync()
         self._drain_spans()  # a poll that granted exports its span now
         return placement
 
@@ -745,6 +843,9 @@ class TPUSliceAdmitter(GangScheduler):
                 if not drain.pods:
                     changed = self._finish_drain(gang_key)
             self._journal_started.discard(key)
+            if slice_name:
+                self._note_avail()  # a solo reservation freed
+        self._journal_sync()
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -761,8 +862,10 @@ class TPUSliceAdmitter(GangScheduler):
         if sname in self._dead:
             self._dead.discard(sname)
             del self._slices[sname]
+            self._note_pool()  # a dead slice left the pool
         else:
             info.reserved_by = None
+            self._note_avail()
 
     def _finish_drain(self, gang_key: str) -> List[str]:
         """Free a completed drain's slices (under the lock) and run a
@@ -804,6 +907,7 @@ class TPUSliceAdmitter(GangScheduler):
         will never come (the pods did not restart)."""
         with self._lock:
             changed = self._finish_drain(gang_key)
+        self._journal_sync()
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -838,6 +942,7 @@ class TPUSliceAdmitter(GangScheduler):
                 # free slice died: nothing drains, drop it now
                 del self._slices[slice_name]
                 self._dead.discard(slice_name)
+                self._note_pool()
             elif isinstance(owner, str) and owner.startswith("drain:"):
                 # already draining (eviction in flight): just mark dead so
                 # the drain completion drops it instead of re-granting
@@ -868,11 +973,14 @@ class TPUSliceAdmitter(GangScheduler):
                 state.slice_names = []
                 state.waiting_since = time.monotonic()
                 changed.append(owner)
+                self._note_change(owner)
             else:
                 # solo-pod reservation: mark dead; release() drops it when
                 # the pod goes away (deadline-free — the pod owns no gang)
                 self._dead.add(slice_name)
+                self._note_avail()
             changed.extend(self._reserve_waiting())
+        self._journal_sync()
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -946,13 +1054,59 @@ class TPUSliceAdmitter(GangScheduler):
 
     def kick(self) -> List[str]:
         """Run a reservation pass now (scheduler tick / hold expiry).
-        Returns the keys of gangs that obtained a reservation."""
+        Returns the keys of gangs that obtained a reservation.  Also the
+        journal-compaction choke point: the snapshot is built and the
+        file truncated UNDER the lock, atomically with the state it
+        mirrors — no append can interleave between the two."""
         with self._lock:
             granted = self._reserve_waiting()
+            if self._journal is not None and self._journal.should_compact():
+                try:
+                    self._journal.compact(self._compaction_records())
+                except Exception:  # noqa: BLE001 — a failed compaction
+                    # (deposed epoch, disk trouble) must never break a
+                    # scheduling pass; appends keep the journal correct
+                    log.exception("journal compaction failed")
+        self._journal_sync()
         for key in granted:
             self._remirror_podgroup_status(key)
         self._drain_spans()
         return granted
+
+    def _compaction_records(self):
+        """Effective-state snapshot for GrantJournal.compact, built UNDER
+        the admitter lock.  Replay-equivalent to the live state: drains
+        first (an ``evict`` record whose ``grow`` field re-grants the
+        gang's CURRENT slices when it also holds some — the grow-while-
+        draining shape), then plain grants, the started-pod latches, and
+        the dead-slice reports.  Waiting gangs are not journaled (same
+        as live operation: they re-enter via job reconcile)."""
+        recs = []
+        for gk, drain in sorted(self._drains.items()):
+            state = self._gangs.get(gk)
+            recs.append(("evict", gk, {
+                "slices": list(drain.slices),
+                "drain": True,
+                "pods": (sorted(drain.pods)
+                         if drain.pods is not None else None),
+                "resize_to": "",
+                "grow": (list(state.slice_names)
+                         if state is not None else []),
+                "state": (self._gang_meta(state)
+                          if state is not None and state.slice_names
+                          else None),
+            }))
+        for gk, state in sorted(self._gangs.items()):
+            if not state.slice_names or gk in self._drains:
+                continue
+            recs.append(("grant", gk, {
+                "slices": list(state.slice_names),
+                "state": self._gang_meta(state)}))
+        for pod_key in sorted(self._journal_started):
+            recs.append(("pods_start", "", {"pod": pod_key}))
+        for sname in sorted(self._dead):
+            recs.append(("slice_failed", "", {"slice": sname}))
+        return recs
 
     def gang_snapshots(self) -> List[GangSnapshot]:
         """Read-only copies of every gang's scheduling state."""
@@ -1190,7 +1344,9 @@ class TPUSliceAdmitter(GangScheduler):
                 state.slice_names = [s.name for s in grow_chosen]
                 state.granted_at = time.monotonic()
                 self._record_admission(key, state)
+            self._note_change(key)
             changed = [key] + self._reserve_waiting()
+        self._journal_sync()
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -1211,7 +1367,9 @@ class TPUSliceAdmitter(GangScheduler):
             ):
                 return False
             state.requested_slice = slice_type
+            self._note_change(key)
             changed = [key] + self._reserve_waiting()
+        self._journal_sync()
         for k in changed:
             self._remirror_podgroup_status(k)
         self._drain_spans()
@@ -1277,10 +1435,17 @@ class TPUSliceAdmitter(GangScheduler):
         reservation in this pass."""
         now = time.monotonic()
         self._expire_drains(now)
+        # the waiting index keeps this O(waiting), not O(all gangs) —
+        # at fleet scale almost every gang is running, not waiting
         eligible = [
-            (k, s) for k, s in self._gangs.items()
-            if not s.slice_names and s.tpu_chips > 0 and not s.held(now)
+            (k, s)
+            for k, s in ((k, self._gangs.get(k)) for k in self._waiting)
+            if s is not None and not s.slice_names
+            and s.tpu_chips > 0 and not s.held(now)
         ]
+        if not eligible:
+            return []
+        eligible.sort(key=lambda kv: kv[1].seq)  # admission order
         director = self._director
         usage: Dict[str, int] = {}
         total_chips = 0
@@ -1380,12 +1545,18 @@ class TPUSliceAdmitter(GangScheduler):
         full-pool walk under the lock)."""
         now = time.monotonic()
         director = self._director
+        waiting = [
+            s for s in (self._gangs.get(k) for k in self._waiting)
+            if s is not None and not s.slice_names and s.tpu_chips > 0
+            and not s.held(now)
+        ]
+        if not waiting:
+            return []
         if director is not None and usage is None:
             usage, total_chips = self._usage_by_tenant()
         return [
-            s for s in self._gangs.values()
-            if not s.slice_names and s.tpu_chips > 0
-            and not s.held(now) and self._feasible(s)
+            s for s in waiting
+            if self._feasible(s)
             and (director is None
                  or director.may_reserve(s, usage, total_chips))
         ]
@@ -1517,6 +1688,7 @@ class TPUSliceAdmitter(GangScheduler):
             s.reserved_by = key
         state.slice_names = [s.name for s in chosen]
         state.granted_at = time.monotonic()
+        self._note_change(key)
         self._record_admission(key, state)
 
     def _record_admission(self, key: str, state: _GangState) -> None:
@@ -1629,6 +1801,7 @@ class TPUSliceAdmitter(GangScheduler):
             best = min(candidates, key=lambda s: s.type.chips)
             best.reserved_by = key
             self._solo[key] = best.name
+            self._note_avail()
             return self._place_on_slice(pod, best)
 
     def _place_on_slice(

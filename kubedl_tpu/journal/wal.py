@@ -12,23 +12,43 @@ keeps no-regrant-over-live-pod over the exhaustive 2/3-gang spaces.
 
 Durability contract (mirrors ``storage/jsonl_backend.py``):
 
-* append-only, one JSON object per line, ``open(path, "a")`` +
-  ``flush`` + ``fsync`` per record — a record is either fully on disk
-  or absent;
+* append-only, one JSON object per line; a record is written + flushed
+  to the OS under the journal lock (so log order == commit order) and
+  fsync-covered before any effect of its transition ESCAPES the
+  admitter — a placement returned to a caller, a pod started, an
+  eviction delivered.  A record is either fully on disk or absent;
 * each record carries a sha over its canonical (sorted-keys) JSON;
   replay stops at the first torn or sha-mismatched line, so a crash
   mid-append loses at most the record being written — which by the
-  write-AHEAD ordering had not been committed to memory either;
-* each record carries the writer's fencing epoch.  ``append`` checks
-  the epoch authority (the lease sidecar file,
-  ``core.leader.read_epoch``) and raises :class:`StaleEpochError` when
-  a newer leader exists — a deposed operator cannot extend the
-  journal.
+  write-AHEAD ordering had not externalized any effect either;
+* each record carries the writer's fencing epoch.  Appends check the
+  epoch authority (the lease sidecar file, ``core.leader.read_epoch``)
+  and raise :class:`StaleEpochError` when a newer leader exists — a
+  deposed operator cannot extend (or compact) the journal.
 
-Crash seam for the chaos lane: ``KUBEDL_JOURNAL_TEST_DELAY_S`` sleeps
-INSIDE ``append`` after the fsync, widening the window between the
-durable record and the in-memory commit so tests/test_journal_chaos.py
-can SIGKILL the operator inside it deterministically.
+Group commit (docs/control_plane_scale.md): ``append_nosync`` does the
+epoch check + write + flush and returns a sequence ticket; ``sync_to``
+is a leader/follower group fsync — the first waiter becomes the leader
+and issues ONE fsync covering every record written so far; followers
+whose tickets that fsync covers return without touching the disk.  A
+caller's append is never considered committed before a sync covers it:
+the admitter syncs before any entry point returns.  ``append`` (=
+``append_nosync`` + ``sync_to``) keeps the original blocking,
+single-writer behavior — same syscall sequence, same latency.  Group
+commit changes batching only, never ordering (the journal lock
+serializes writes) or the commit point (the fsync covering the record).
+
+Compaction (``compact``): snapshots effective state into a fresh
+epoch-stamped file via tmp + ``os.replace`` and truncates the history.
+Sequence numbers stay MONOTONIC across a compaction (the snapshot is
+re-stamped above the current watermark) so outstanding sync tickets are
+always covered, never orphaned.
+
+Crash seam for the chaos lane: ``KUBEDL_JOURNAL_TEST_DELAY_S`` makes
+every append eagerly fsync and then sleep AFTER the fsync, widening the
+window between the durable record and the in-memory commit so
+tests/test_journal_chaos.py can SIGKILL the operator inside it
+deterministically.
 """
 from __future__ import annotations
 
@@ -38,7 +58,9 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from kubedl_tpu.analysis.witness import new_lock, new_rlock
 
 log = logging.getLogger(__name__)
 
@@ -79,24 +101,44 @@ def _sha(record: Dict[str, Any]) -> str:
 class GrantJournal:
     """One append-only journal file, one writer at a time (the fencing
     epoch enforces the "one" part across processes; the internal lock
-    serializes threads of the same operator)."""
+    serializes threads of the same operator).
+
+    Lock order (one-directional, witness-named): ``_sync_mutex`` ->
+    ``_lock`` -> ``_sync_cond``'s lock.  ``append_nosync`` takes only
+    ``_lock``; the group-commit leader takes ``_sync_mutex`` alone
+    around the fsync (so writers keep writing while the disk syncs) and
+    captures the watermark under ``_lock`` briefly; ``compact``/``close``
+    take ``_sync_mutex`` -> ``_lock`` to quiesce the disk."""
 
     def __init__(
         self,
         path: str,
         epoch: int = 0,
         epoch_authority: Optional[Callable[[], int]] = None,
+        compact_bytes: int = 0,
     ) -> None:
         self.path = path
         self.epoch = int(epoch)
         # callable returning the current fleet-wide epoch (the lease
         # sidecar); None disables fencing (tests, journal-off bench).
         self._authority = epoch_authority
-        self._lock = threading.RLock()
+        # journal size (bytes) past which should_compact() fires;
+        # 0 disables compaction.
+        self.compact_bytes = int(compact_bytes)
+        self._lock = new_rlock("journal.wal.GrantJournal._lock")
         self._fh = None
         self._seq = 0
+        # group commit state: _durable_seq is the highest seq an fsync
+        # has covered; _sync_leader marks an fsync in flight.
+        self._sync_mutex = new_lock("journal.wal.GrantJournal._sync_mutex")
+        self._sync_cond = threading.Condition(
+            new_lock("journal.wal.GrantJournal._sync_cond"))
+        self._durable_seq = 0
+        self._sync_leader = False
         # counters surfaced by metrics (kubedl_journal_* family)
         self.appends_total = 0
+        self.fsyncs_total = 0
+        self.compactions_total = 0
         self.replay_records = 0
         self.replay_conflicts = 0
         self.stale_epoch_refusals = 0
@@ -150,14 +192,30 @@ class GrantJournal:
             if d:
                 os.makedirs(d, exist_ok=True)
             self._fh = open(self.path, "a", encoding="utf-8")
-            return records
+            seq = self._seq
+        with self._sync_cond:
+            # everything replayed is on disk already
+            self._durable_seq = max(self._durable_seq, seq)
+        return records
 
     # -- the write-ahead append -------------------------------------------
 
     def append(self, op: str, gang: str = "", **data: Any) -> Dict[str, Any]:
-        """Durably append one record and return it.  Called by the
-        admitter UNDER its own lock, immediately BEFORE the in-memory
-        commit — the record must be on disk before memory changes."""
+        """Durably append one record and return it: write + flush under
+        the lock, then block until a group fsync covers it.  A single
+        writer becomes the sync leader immediately — same syscall
+        sequence and latency as the original per-record fsync."""
+        rec = self.append_nosync(op, gang, **data)
+        self.sync_to(int(rec["seq"]))
+        return rec
+
+    def append_nosync(self, op: str, gang: str = "", **data: Any) -> Dict[str, Any]:
+        """Write + flush one record and return it WITHOUT waiting for an
+        fsync.  Called by the admitter UNDER its own lock, immediately
+        BEFORE the in-memory commit, so journal order always equals
+        commit order.  The caller must ``sync_to`` the returned seq (the
+        admitter's per-entry-point sync barrier) before any effect of
+        the transition escapes the process."""
         if op not in JOURNAL_OPS:
             raise JournalError(f"unknown journal op {op!r}")
         with self._lock:
@@ -186,18 +244,152 @@ class GrantJournal:
                 "gang": gang,
                 "data": data,
             }
-            rec["sha"] = _sha(rec)
-            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            # one serialization per record, not two: the sha covers the
+            # compact sorted body, and the written line is that same
+            # body with the sha spliced in before the closing brace.
+            # Key order in the file is irrelevant — replay re-parses the
+            # line and re-derives the sha from the dict. This runs under
+            # the admitter's lock on every grant, so the duplicate
+            # json.dumps was a measurable slice of concurrent grant cost
+            # (the fleet_scale bench's journal_concurrent lane).
+            body = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+            sha = hashlib.sha256(body.encode("utf-8")).hexdigest()[:16]
+            rec["sha"] = sha
+            self._fh.write(body[:-1] + ',"sha":"' + sha + '"}\n')
             self._fh.flush()
-            os.fsync(self._fh.fileno())
             self.appends_total += 1
-        # crash seam (chaos lane): widen the window between the durable
-        # append and the caller's in-memory commit.  Outside the lock so
-        # a SIGKILL here never leaves lock state behind in-process.
+        # crash seam (chaos lane): make the record durable NOW, then
+        # widen the window between the durable append and the caller's
+        # in-memory commit.  Outside the lock so a SIGKILL here never
+        # leaves lock state behind in-process.
         delay = float(os.environ.get(ENV_JOURNAL_TEST_DELAY, "0") or 0)
         if delay > 0:
+            self.sync_to(int(rec["seq"]))
             time.sleep(delay)
         return rec
+
+    def sync_to(self, seq: int) -> None:
+        """Block until an fsync covers record `seq` (leader/follower
+        group commit).  The first waiter becomes the leader, issues one
+        fsync for everything written so far, and wakes every follower
+        that fsync covered; a follower whose record is already covered
+        returns immediately without touching the disk."""
+        if seq <= 0:
+            return
+        while True:
+            with self._sync_cond:
+                if self._durable_seq >= seq:
+                    return
+                if self._sync_leader:
+                    # a sync is in flight; it may or may not cover us —
+                    # re-check when it lands
+                    self._sync_cond.wait(0.5)
+                    continue
+                self._sync_leader = True
+            target = 0
+            try:
+                with self._sync_mutex:
+                    with self._lock:
+                        fh = self._fh
+                        target = self._seq
+                    if fh is not None:
+                        # fsync holding only the sync mutex: writers keep
+                        # appending while the disk syncs
+                        os.fsync(fh.fileno())
+                        self.fsyncs_total += 1
+                    # fh None: close()/compact() already fsync'd
+                    # everything written — target is durable
+            finally:
+                with self._sync_cond:
+                    self._sync_leader = False
+                    if target > self._durable_seq:
+                        self._durable_seq = target
+                    self._sync_cond.notify_all()
+
+    # -- compaction --------------------------------------------------------
+
+    def should_compact(self) -> bool:
+        """Size-threshold trigger; the admitter polls this at its
+        scheduling choke point and feeds ``compact`` a state snapshot."""
+        if self.compact_bytes <= 0:
+            return False
+        with self._lock:
+            if self._fh is None:
+                return False
+            try:
+                return os.fstat(self._fh.fileno()).st_size >= self.compact_bytes
+            except OSError:
+                return False
+
+    def compact(self, records: Iterable[Tuple[str, str, Dict[str, Any]]]) -> int:
+        """Replace the journal's history with an effective-state snapshot:
+        `records` is (op, gang, data) tuples replay-equivalent to the
+        current in-memory state (the admitter builds them under ITS lock,
+        atomically with calling this).  Written to `path + ".tmp"`,
+        fsync'd, then ``os.replace``d — a crash at any point leaves
+        either the full old journal or the full new one.  Snapshot
+        records are stamped with the CURRENT epoch and with sequence
+        numbers ABOVE the old watermark, so seq stays monotonic and
+        every outstanding sync ticket ends up covered.  Returns the
+        number of snapshot records written."""
+        recs = list(records)
+        with self._sync_mutex:
+            with self._lock:
+                if self._fh is None:
+                    raise JournalError(
+                        f"journal {self.path} not open (call open() first)")
+                if self._authority is not None:
+                    current = self._authority()
+                    if current > self.epoch:
+                        self.stale_epoch_refusals += 1
+                        log.error(
+                            "journal %s: COMPACT REFUSED — fencing epoch "
+                            "%d superseded by %d", self.path, self.epoch,
+                            current)
+                        raise StaleEpochError(
+                            f"compact refused: epoch {self.epoch} "
+                            f"superseded by {current}")
+                seq = self._seq
+                tmp = self.path + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for op, gang, data in recs:
+                        if op not in JOURNAL_OPS:
+                            raise JournalError(
+                                f"unknown journal op {op!r} in compaction "
+                                f"snapshot")
+                        seq += 1
+                        rec: Dict[str, Any] = {
+                            "v": JOURNAL_VERSION,
+                            "seq": seq,
+                            "epoch": self.epoch,
+                            "t": time.time(),
+                            "op": op,
+                            "gang": gang,
+                            "data": data,
+                        }
+                        rec["sha"] = _sha(rec)
+                        f.write(json.dumps(rec, sort_keys=True) + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
+                old = self._fh
+                self._fh = open(self.path, "a", encoding="utf-8")
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                self._seq = seq
+                self.compactions_total += 1
+                self.fsyncs_total += 1
+            with self._sync_cond:
+                # the snapshot (which subsumes every earlier record) is
+                # durable: cover all outstanding tickets
+                if seq > self._durable_seq:
+                    self._durable_seq = seq
+                self._sync_cond.notify_all()
+        log.info("journal %s: compacted to %d snapshot records (seq %d)",
+                 self.path, len(recs), seq)
+        return len(recs)
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -212,6 +404,8 @@ class GrantJournal:
         with self._lock:
             return {
                 "appends_total": self.appends_total,
+                "fsyncs_total": self.fsyncs_total,
+                "compactions_total": self.compactions_total,
                 "replay_records_total": self.replay_records,
                 "replay_conflicts_total": self.replay_conflicts,
                 "stale_epoch_refusals_total": self.stale_epoch_refusals,
@@ -220,9 +414,21 @@ class GrantJournal:
             }
 
     def close(self) -> None:
-        with self._lock:
-            if self._fh is not None:
-                try:
-                    self._fh.close()
-                finally:
-                    self._fh = None
+        with self._sync_mutex:
+            with self._lock:
+                seq = self._seq
+                if self._fh is not None:
+                    try:
+                        self._fh.flush()
+                        os.fsync(self._fh.fileno())
+                        self.fsyncs_total += 1
+                    except (OSError, ValueError):
+                        pass
+                    try:
+                        self._fh.close()
+                    finally:
+                        self._fh = None
+            with self._sync_cond:
+                if seq > self._durable_seq:
+                    self._durable_seq = seq
+                self._sync_cond.notify_all()

@@ -122,6 +122,16 @@ class RuntimeMetrics:
         # grant-journal snapshot callable (Operator._journal_snapshot:
         # GrantJournal.snapshot() + the leader fencing epoch)
         self._journal: Optional[Callable[[], Dict]] = None
+        # O(changed) rendering (docs/control_plane_scale.md): optional
+        # per-family version callables registered alongside the snapshot
+        # hooks — while a family's token stands still its formatted text
+        # is reused verbatim and the snapshot hook is never called
+        self._version_fns: Dict[str, Optional[Callable[[], object]]] = {}
+        self._family_cache: Dict[str, tuple] = {}  # family -> (token, text)
+        self._core_rev = 0  # bumps on every observe_* fold
+        # family -> number of times its text was (re)built; the
+        # no-change-scrape test pins that a quiet scrape adds nothing
+        self.family_builds: Dict[str, int] = {}
 
     def observe_reconcile(self, controller: str, seconds: float, error: bool = False) -> None:
         with self._lock:
@@ -131,124 +141,204 @@ class RuntimeMetrics:
             h.observe(seconds)
             if error:
                 self._errors[controller] = self._errors.get(controller, 0) + 1
+            self._core_rev += 1
 
     def observe_requeue(self, controller: str) -> None:
         with self._lock:
             self._requeues[controller] = self._requeues.get(controller, 0) + 1
+            self._core_rev += 1
 
     def register_queue(self, controller: str, depth_fn: Callable[[], int]) -> None:
         with self._lock:
             self._queue_depth[controller] = depth_fn
 
-    def register_slice_pool(self, snapshot_fn: Callable[[], Dict]) -> None:
-        """snapshot_fn returns TPUSliceAdmitter.utilization()-shaped dicts."""
+    def register_slice_pool(self, snapshot_fn: Callable[[], Dict],
+                            version_fn: Optional[Callable] = None) -> None:
+        """snapshot_fn returns TPUSliceAdmitter.utilization()-shaped
+        dicts. version_fn (optional, any registration here and below): a
+        cheap change token — while it returns the same value the family's
+        cached text is served without calling snapshot_fn; None renders
+        live every scrape."""
         with self._lock:
             self._slice_pool = snapshot_fn
+            self._version_fns["slice_pool"] = version_fn
 
-    def register_capacity(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_capacity(self, snapshot_fn: Callable[[], Dict],
+                          version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns CapacityScheduler.snapshot()-shaped dicts
         (per-tenant quota/usage + the waiting queue)."""
         with self._lock:
             self._capacity = snapshot_fn
+            self._version_fns["capacity"] = version_fn
 
-    def register_pipeline(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_pipeline(self, snapshot_fn: Callable[[], Dict],
+                          version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns PipelineMetrics.snapshot()-shaped dicts
         (per-job schedule, bubble fraction, per-stage step seconds)."""
         with self._lock:
             self._pipeline = snapshot_fn
+            self._version_fns["pipeline"] = version_fn
 
-    def register_steps(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_steps(self, snapshot_fn: Callable[[], Dict],
+                       version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns StepAggregator.snapshot()-shaped dicts
         (per-job per-pod step time, stragglers, compile events)."""
         with self._lock:
             self._steps = snapshot_fn
+            self._version_fns["steps"] = version_fn
 
-    def register_goodput(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_goodput(self, snapshot_fn: Callable[[], Dict],
+                         version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns GoodputReporter.snapshot()-shaped dicts
         (per-job goodput ratio + bucket breakdown)."""
         with self._lock:
             self._goodput = snapshot_fn
+            self._version_fns["goodput"] = version_fn
 
-    def register_transport(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_transport(self, snapshot_fn: Callable[[], Dict],
+                           version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns transport_metrics.snapshot()-shaped dicts
         (per-channel message/byte counters, reconnects, auth failures)."""
         with self._lock:
             self._transport = snapshot_fn
+            self._version_fns["transport"] = version_fn
 
-    def register_rl(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_rl(self, snapshot_fn: Callable[[], Dict],
+                    version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns rl_metrics.snapshot()-shaped dicts
         (per-job trajectory queue depth, weight lag, produced/consumed/
         stale-dropped counters)."""
         with self._lock:
             self._rl = snapshot_fn
+            self._version_fns["rl"] = version_fn
 
-    def register_journal(self, snapshot_fn: Callable[[], Dict]) -> None:
+    def register_journal(self, snapshot_fn: Callable[[], Dict],
+                         version_fn: Optional[Callable] = None) -> None:
         """snapshot_fn returns GrantJournal.snapshot()-shaped dicts
         (append/replay/refusal counters) plus a ``leader_epoch`` key
         (the operator folds its elector's fencing epoch in)."""
         with self._lock:
             self._journal = snapshot_fn
+            self._version_fns["journal"] = version_fn
 
     # -- exposition ------------------------------------------------------
 
-    def render(self) -> str:
-        """Prometheus text format."""
+    def _family(self, family: str, token, build: Callable[[], List[str]]) -> str:
+        """Per-family render cache: while `token` equals the cached one
+        the family's formatted text is served verbatim (the builder —
+        and so the snapshot hook inside it — never runs). token None =
+        live family, rebuilt every scrape. family_builds counts rebuilds;
+        the no-change-scrape test pins it flat."""
+        if token is not None:
+            with self._lock:
+                hit = self._family_cache.get(family)
+                if hit is not None and hit[0] == token:
+                    return hit[1]
+        text = "\n".join(build())
         with self._lock:
-            lines: List[str] = [
-                "# HELP kubedl_reconcile_duration_seconds Reconcile latency per controller",
-                "# TYPE kubedl_reconcile_duration_seconds histogram",
-            ]
-            for name in sorted(self._durations):
-                h = self._durations[name]
-                cum = 0
-                for b, c in zip(BUCKETS, h.counts):
-                    cum += c
+            self.family_builds[family] = self.family_builds.get(family, 0) + 1
+            if token is not None:
+                self._family_cache[family] = (token, text)
+        return text
+
+    def _token(self, family: str):
+        """The family's current version token (None = render live):
+        calls the registered version_fn outside any lock it may take."""
+        with self._lock:
+            version_fn = self._version_fns.get(family)
+        if version_fn is None:
+            return None
+        try:
+            return version_fn()
+        except Exception:  # noqa: BLE001 — callback raced shutdown
+            return None
+
+    def render(self) -> str:
+        """Prometheus text format, O(changed families): each family's
+        text caches against a version token — the internal counters use
+        a bump-on-observe revision, registered snapshots the version_fn
+        given at registration — so a scrape where nothing moved reuses
+        every cached family without re-formatting a line. Families
+        without a version_fn (and the live queue-depth gauges) render
+        every scrape, as before."""
+        parts: List[str] = []
+        with self._lock:
+            core_token = self._core_rev
+
+        def core_lines() -> List[str]:
+            with self._lock:
+                lines: List[str] = [
+                    "# HELP kubedl_reconcile_duration_seconds Reconcile latency per controller",
+                    "# TYPE kubedl_reconcile_duration_seconds histogram",
+                ]
+                for name in sorted(self._durations):
+                    h = self._durations[name]
+                    cum = 0
+                    for b, c in zip(BUCKETS, h.counts):
+                        cum += c
+                        lines.append(
+                            f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="{_label(b)}"}} {cum}'
+                        )
                     lines.append(
-                        f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="{_label(b)}"}} {cum}'
+                        f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="+Inf"}} {h.total}'
                     )
-                lines.append(
-                    f'kubedl_reconcile_duration_seconds_bucket{{controller="{_label(name)}",le="+Inf"}} {h.total}'
-                )
-                lines.append(
-                    f'kubedl_reconcile_duration_seconds_sum{{controller="{_label(name)}"}} {h.sum:.6f}'
-                )
-                lines.append(
-                    f'kubedl_reconcile_duration_seconds_count{{controller="{_label(name)}"}} {h.total}'
-                )
-            lines.append("# HELP kubedl_reconcile_errors_total Reconcile errors per controller")
-            lines.append("# TYPE kubedl_reconcile_errors_total counter")
-            for name, n in sorted(self._errors.items()):
-                lines.append(f'kubedl_reconcile_errors_total{{controller="{_label(name)}"}} {n}')
-            lines.append("# HELP kubedl_reconcile_requeues_total Rate-limited requeues per controller")
-            lines.append("# TYPE kubedl_reconcile_requeues_total counter")
-            for name, n in sorted(self._requeues.items()):
-                lines.append(f'kubedl_reconcile_requeues_total{{controller="{_label(name)}"}} {n}')
-            lines.append("# HELP kubedl_workqueue_depth Current workqueue depth per controller")
-            lines.append("# TYPE kubedl_workqueue_depth gauge")
-            for name, fn in sorted(self._queue_depth.items()):
+                    lines.append(
+                        f'kubedl_reconcile_duration_seconds_sum{{controller="{_label(name)}"}} {h.sum:.6f}'
+                    )
+                    lines.append(
+                        f'kubedl_reconcile_duration_seconds_count{{controller="{_label(name)}"}} {h.total}'
+                    )
+                lines.append("# HELP kubedl_reconcile_errors_total Reconcile errors per controller")
+                lines.append("# TYPE kubedl_reconcile_errors_total counter")
+                for name, n in sorted(self._errors.items()):
+                    lines.append(f'kubedl_reconcile_errors_total{{controller="{_label(name)}"}} {n}')
+                lines.append("# HELP kubedl_reconcile_requeues_total Rate-limited requeues per controller")
+                lines.append("# TYPE kubedl_reconcile_requeues_total counter")
+                for name, n in sorted(self._requeues.items()):
+                    lines.append(f'kubedl_reconcile_requeues_total{{controller="{_label(name)}"}} {n}')
+            return lines
+
+        parts.append(self._family("core", core_token, core_lines))
+
+        def queue_lines() -> List[str]:
+            with self._lock:
+                depth_fns = sorted(self._queue_depth.items())
+            lines = [
+                "# HELP kubedl_workqueue_depth Current workqueue depth per controller",
+                "# TYPE kubedl_workqueue_depth gauge",
+            ]
+            for name, fn in depth_fns:
                 try:
                     depth = fn()
                 except Exception:  # noqa: BLE001 — callback raced shutdown
                     depth = -1
                 lines.append(f'kubedl_workqueue_depth{{controller="{_label(name)}"}} {depth}')
+            return lines
+
+        # depth gauges poll live state — never cached
+        parts.append(self._family("workqueue", None, queue_lines))
+
+        with self._lock:
             slice_fn = self._slice_pool
         # Call the pool snapshot OUTSIDE the metrics lock: it takes the
         # admitter's lock, and holding both would pin a lock order that a
-        # callback into RuntimeMetrics could deadlock against.
+        # callback into RuntimeMetrics could deadlock against. (Every
+        # snapshot hook below runs outside it for the same reason.)
         if slice_fn is not None:
-            lines.append(
-                "# HELP kubedl_slice_utilization Fraction of pool TPU chips reserved"
-            )
-            lines.append("# TYPE kubedl_slice_utilization gauge")
-            try:
-                snap = slice_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                # explicit sentinel (like kubedl_workqueue_depth) so the
-                # series degrades visibly instead of flapping absent
-                snap = None
-            if snap is None:
-                lines.append("kubedl_slice_utilization -1")
-            else:
+            def slice_lines() -> List[str]:
+                lines = [
+                    "# HELP kubedl_slice_utilization Fraction of pool TPU chips reserved",
+                    "# TYPE kubedl_slice_utilization gauge",
+                ]
+                try:
+                    snap = slice_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    # explicit sentinel (like kubedl_workqueue_depth) so the
+                    # series degrades visibly instead of flapping absent
+                    snap = None
+                if snap is None:
+                    lines.append("kubedl_slice_utilization -1")
+                    return lines
                 lines.append(f"kubedl_slice_utilization {snap['utilization']:.4f}")
                 for metric, key in (
                     ("kubedl_slices_total", "slices_total"),
@@ -270,15 +360,22 @@ class RuntimeMetrics:
                         f',type="{_label(s["type"])}"}} '
                         f'{1 if s["reserved_by"] else 0}'
                     )
+                return lines
+
+            parts.append(self._family(
+                "slice_pool", self._token("slice_pool"), slice_lines))
         with self._lock:
             cap_fn = self._capacity
         if cap_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                cap = cap_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                cap = None
-            if cap is not None:
+
+            def capacity_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    cap = cap_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    cap = None
+                if cap is None:
+                    return lines
                 for metric, key, mtype, help_ in (
                     ("kubedl_tenant_chips_in_use", "chips_in_use", "gauge",
                      "TPU chips currently reserved per tenant"),
@@ -339,15 +436,22 @@ class RuntimeMetrics:
                     lines.append(
                         f"kubedl_resize_downtime_seconds_count "
                         f"{downtime['count']}")
+                return lines
+
+            parts.append(self._family(
+                "capacity", self._token("capacity"), capacity_lines))
         with self._lock:
             pipe_fn = self._pipeline
         if pipe_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                pipe = pipe_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                pipe = None
-            if pipe is not None and pipe.get("jobs"):
+
+            def pipeline_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    pipe = pipe_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    pipe = None
+                if pipe is None or not pipe.get("jobs"):
+                    return lines
                 lines.append("# HELP kubedl_pipeline_bubble_frac Pipeline "
                              "schedule fill/drain bubble fraction per job")
                 lines.append("# TYPE kubedl_pipeline_bubble_frac gauge")
@@ -376,15 +480,22 @@ class RuntimeMetrics:
                     lines.append(
                         f'kubedl_pipeline_steps_total{{job="{_label(job)}"}} '
                         f'{rec.get("steps", 0)}')
+                return lines
+
+            parts.append(self._family(
+                "pipeline", self._token("pipeline"), pipeline_lines))
         with self._lock:
             steps_fn = self._steps
         if steps_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                steps = steps_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                steps = None
-            if steps is not None and steps.get("jobs"):
+
+            def steps_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    steps = steps_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    steps = None
+                if steps is None or not steps.get("jobs"):
+                    return lines
                 jobs = sorted(steps["jobs"].items())
                 lines.append("# HELP kubedl_step_time_seconds Last train-"
                              "step wall time per pod (heartbeat stream)")
@@ -409,15 +520,22 @@ class RuntimeMetrics:
                     lines.append(sample(
                         "kubedl_compile_events_total",
                         rec.get("compile_events", 0), {"job": job}))
+                return lines
+
+            parts.append(self._family(
+                "steps", self._token("steps"), steps_lines))
         with self._lock:
             goodput_fn = self._goodput
         if goodput_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                gp = goodput_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                gp = None
-            if gp is not None and gp.get("jobs"):
+
+            def goodput_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    gp = goodput_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    gp = None
+                if gp is None or not gp.get("jobs"):
+                    return lines
                 jobs = sorted(gp["jobs"].items())
                 lines.append("# HELP kubedl_goodput_ratio Productive step "
                              "time / wall time over the job's span timeline")
@@ -435,15 +553,22 @@ class RuntimeMetrics:
                         lines.append(sample(
                             "kubedl_goodput_seconds", f"{secs:.6f}",
                             {"job": job, "bucket": bucket}))
+                return lines
+
+            parts.append(self._family(
+                "goodput", self._token("goodput"), goodput_lines))
         with self._lock:
             transport_fn = self._transport
         if transport_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                tp = transport_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                tp = None
-            if tp is not None:
+
+            def transport_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    tp = transport_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    tp = None
+                if tp is None:
+                    return lines
                 lines.append("# HELP kubedl_transport_messages_total "
                              "Messages carried per channel and direction")
                 lines.append("# TYPE kubedl_transport_messages_total counter")
@@ -478,15 +603,22 @@ class RuntimeMetrics:
                     lines.append(f"# HELP {metric} {help_}")
                     lines.append(f"# TYPE {metric} counter")
                     lines.append(sample(metric, tp.get(key, 0)))
+                return lines
+
+            parts.append(self._family(
+                "transport", self._token("transport"), transport_lines))
         with self._lock:
             journal_fn = self._journal
         if journal_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                jn = journal_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                jn = None
-            if jn is not None:
+
+            def journal_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    jn = journal_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    jn = None
+                if jn is None:
+                    return lines
                 for metric, key, mtype, help_ in (
                     ("kubedl_journal_appends_total", "appends_total",
                      "counter", "Write-ahead journal records appended "
@@ -509,15 +641,22 @@ class RuntimeMetrics:
                     lines.append(f"# HELP {metric} {help_}")
                     lines.append(f"# TYPE {metric} {mtype}")
                     lines.append(sample(metric, jn.get(key, 0)))
+                return lines
+
+            parts.append(self._family(
+                "journal", self._token("journal"), journal_lines))
         with self._lock:
             rl_fn = self._rl
         if rl_fn is not None:
-            # outside the metrics lock, same rationale as the pool snapshot
-            try:
-                rl = rl_fn()
-            except Exception:  # noqa: BLE001 — callback raced shutdown
-                rl = None
-            if rl is not None and rl.get("jobs"):
+
+            def rl_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    rl = rl_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    rl = None
+                if rl is None or not rl.get("jobs"):
+                    return lines
                 jobs = sorted(rl["jobs"].items())
                 for metric, key, mtype, help_ in (
                     ("kubedl_rl_trajectory_queue_depth", "queue_depth",
@@ -539,7 +678,10 @@ class RuntimeMetrics:
                     for job, rec in jobs:
                         lines.append(sample(metric, rec.get(key, 0),
                                             {"job": job}))
-        return "\n".join(lines) + "\n"
+                return lines
+
+            parts.append(self._family("rl", self._token("rl"), rl_lines))
+        return "\n".join(p for p in parts if p) + "\n"
 
     def debug_vars(self) -> Dict:
         """JSON snapshot for /debug/vars (the pprof-style surface)."""

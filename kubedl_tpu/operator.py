@@ -95,6 +95,12 @@ class OperatorConfig:
     # operators); the CLI `operator` command defaults it under the data
     # root (core/leader.py data_root()).
     journal_dir: str = ""
+    # Journal compaction threshold in bytes: when the on-disk journal
+    # grows past this, the next admitter reservation pass snapshots the
+    # effective state and truncates (tmp+rename, epoch-stamped). 0
+    # disables compaction (the PR 18 behavior: the journal grows until
+    # job TTL cleanup).
+    journal_compact_bytes: int = 1024 * 1024
     # Fleet history store (docs/ha.md): trace spans + goodput +
     # lifecycle markers persisted past job TTL, queryable via
     # GET /history/<ns>/<job> and `kubedl-tpu history`. "" disables.
@@ -169,8 +175,12 @@ class Operator:
             # admission grants retro-record the gang's queue wait as spans
             self._gang.tracer = self.tracer
         if self.config.tpu_slices and isinstance(self._gang, TPUSliceAdmitter):
-            # BASELINE.md "slice utilization" gauge: /metrics + /debug/vars
-            self.runtime_metrics.register_slice_pool(self._gang.utilization)
+            # BASELINE.md "slice utilization" gauge: /metrics + /debug/vars.
+            # demand_rev is the version token: a scrape with no admitter
+            # transition since the last one reuses the cached family text
+            # (docs/control_plane_scale.md)
+            self.runtime_metrics.register_slice_pool(
+                self._gang.utilization, version_fn=self._gang.demand_rev)
         self.capacity_scheduler = None
         if self.config.scheduler_policy and isinstance(self._gang, TPUSliceAdmitter):
             from kubedl_tpu.sched import CapacityConfig, CapacityScheduler
@@ -191,7 +201,9 @@ class Operator:
                 ),
             )
             self.capacity_scheduler.tracer = self.tracer
-            self.runtime_metrics.register_capacity(self.capacity_scheduler.snapshot)
+            self.runtime_metrics.register_capacity(
+                self.capacity_scheduler.snapshot,
+                version_fn=self.capacity_scheduler.version)
             self.manager.add_loop(
                 "capacity-scheduler",
                 self.capacity_scheduler.tick,
@@ -233,8 +245,12 @@ class Operator:
         self.history_store = None  # HistoryStore when config.history_dir set
         self._history_controllers: List = []
         # family registered even with the journal disabled so
-        # kubedl_journal_* render as zeros and /debug/vars stays complete
-        self.runtime_metrics.register_journal(self._journal_snapshot)
+        # kubedl_journal_* render as zeros and /debug/vars stays complete;
+        # the snapshot doubles as its own version token (pure counters,
+        # O(1)) so an unchanged scrape skips the re-format
+        self.runtime_metrics.register_journal(
+            self._journal_snapshot,
+            version_fn=lambda: tuple(sorted(self._journal_snapshot().items())))
 
     # -- registration ----------------------------------------------------
 
@@ -364,7 +380,8 @@ class Operator:
                 self.store.client, on_change=self._gang.set_pool
             )
             self.node_inventory.start()
-            self.runtime_metrics.register_slice_pool(self._gang.utilization)
+            self.runtime_metrics.register_slice_pool(
+                self._gang.utilization, version_fn=self._gang.demand_rev)
         return True
 
     def _setup_journal(self) -> None:
@@ -383,6 +400,7 @@ class Operator:
             os.path.join(self.config.journal_dir, "grant.journal"),
             epoch=epoch,
             epoch_authority=authority,
+            compact_bytes=self.config.journal_compact_bytes,
         )
         stats = self._gang.restore_from_journal(self.journal)
         if stats["records"]:

@@ -49,6 +49,10 @@ log = logging.getLogger("kubedl_tpu.sched")
 @dataclass
 class CapacityConfig:
     policy: str = "priority"  # fifo | priority | fair_share | gavel
+    # delta-maintained demand mirror (docs/control_plane_scale.md): a
+    # tick folds admitter deltas instead of re-snapshotting the whole
+    # fleet; False restores the full-rescan path (the parity oracle)
+    incremental_demand_view: bool = True
     tenant_weights: Dict[str, float] = field(default_factory=dict)
     tenant_caps: Dict[str, int] = field(default_factory=dict)
     enable_preemption: bool = True
@@ -89,6 +93,122 @@ class _PendingReshard:
     direction: str = ""  # shrink | grow | dead-slice
 
 
+class IncrementalDemandView:
+    """Delta-maintained mirror of the admitter's scheduling state.
+
+    Primed once from a full ``gang_snapshots()`` pass, then kept current
+    by draining ``admitter.demand_changes()`` — a refresh costs O(changed
+    gangs), not O(fleet), which is what keeps a scheduler tick flat at
+    10k jobs (docs/control_plane_scale.md). Pool-membership changes
+    (set_pool, slice death, drain completion of a dead slice) arrive as
+    ``pool_changed`` and force a full rebuild, because slice shapes feed
+    the total-chip denominator.
+
+    The full-rescan path (``_rebuild``) doubles as the parity oracle:
+    ``parity_diff()`` recomputes from scratch and reports any divergence;
+    tests drive it over randomized event streams.
+
+    Not thread-safe on its own — the scheduler calls ``refresh()`` only
+    from its tick loop, matching the admitter's single-consumer contract
+    for ``demand_changes()``.
+    """
+
+    def __init__(self, admitter) -> None:
+        self.admitter = admitter
+        self._snaps: Dict[str, GangSnapshot] = {}
+        self._usage: Dict[str, int] = {}
+        self._total = 0
+        self._rev = -1
+        self._primed = False
+        self.rebuilds_total = 0
+        self.delta_refreshes_total = 0
+
+    def refresh(self) -> int:
+        """Fold pending admitter deltas into the mirror; returns the
+        admitter rev now covered. Call before reading snapshots/usage."""
+        if not self._primed:
+            return self._rebuild()
+        rev, delta, pool_changed = self.admitter.demand_changes(self._rev)
+        if pool_changed:
+            return self._rebuild()
+        for key, snap in delta.items():
+            old = self._snaps.get(key)
+            if old is not None and old.reserved_chips:
+                left = self._usage.get(old.tenant, 0) - old.reserved_chips
+                if left > 0:
+                    self._usage[old.tenant] = left
+                else:
+                    self._usage.pop(old.tenant, None)
+            if snap is None:
+                self._snaps.pop(key, None)
+            else:
+                self._snaps[key] = snap
+                if snap.reserved_chips:
+                    self._usage[snap.tenant] = (
+                        self._usage.get(snap.tenant, 0) + snap.reserved_chips)
+        if delta:
+            self.delta_refreshes_total += 1
+        self._rev = rev
+        return rev
+
+    def _rebuild(self) -> int:
+        # Drain stale marks FIRST: anything marked after this drain stays
+        # marked for the next refresh; a change landing between the drain
+        # and the snapshot below is both in the snapshot and re-applied
+        # as a (idempotent) delta next refresh.
+        rev, _, _ = self.admitter.demand_changes(-1)
+        snaps = self.admitter.gang_snapshots()
+        self._snaps = {g.key: g for g in snaps}
+        usage: Dict[str, int] = {}
+        for g in snaps:
+            if g.reserved_chips:
+                usage[g.tenant] = usage.get(g.tenant, 0) + g.reserved_chips
+        self._usage = usage
+        self._total = self.admitter.total_chips()
+        self._rev = rev
+        self._primed = True
+        self.rebuilds_total += 1
+        return rev
+
+    # -- readers (valid until the next refresh) --------------------------
+
+    def snapshots(self) -> List[GangSnapshot]:
+        return list(self._snaps.values())
+
+    def mirror(self) -> Dict[str, GangSnapshot]:
+        return dict(self._snaps)
+
+    def usage(self) -> Dict[str, int]:
+        return dict(self._usage)
+
+    def total_chips(self) -> int:
+        return self._total
+
+    # -- parity oracle ---------------------------------------------------
+
+    def parity_diff(self) -> Dict:
+        """Recompute demand from scratch and diff the mirror against it.
+        Empty dict = parity. Only meaningful when the admitter is quiet
+        (tests); a concurrent mutation between the two reads is not a
+        divergence."""
+        oracle = {g.key: g for g in self.admitter.gang_snapshots()}
+        usage: Dict[str, int] = {}
+        for g in oracle.values():
+            if g.reserved_chips:
+                usage[g.tenant] = usage.get(g.tenant, 0) + g.reserved_chips
+        diff: Dict = {}
+        for key in set(oracle) | set(self._snaps):
+            if oracle.get(key) != self._snaps.get(key):
+                diff[key] = {"oracle": oracle.get(key),
+                             "view": self._snaps.get(key)}
+        if usage != self._usage:
+            diff["__usage__"] = {"oracle": usage, "view": dict(self._usage)}
+        total = self.admitter.total_chips()
+        if total != self._total:
+            diff["__total__"] = {"oracle": total, "view": self._total}
+        return diff
+
+
 class CapacityScheduler(CapacityDirector):
     """Implements the admitter's CapacityDirector hooks (policy order,
     caps, slice pricing) and drives preemption/elastic passes on tick()."""
@@ -110,6 +230,20 @@ class CapacityScheduler(CapacityDirector):
         self._last_tick: Optional[float] = None
         self._preemptions_total = 0
         self._resizes_total = 0
+        # O(changed) tick plumbing: the delta-maintained demand mirror
+        # (None = full-rescan fallback), the admitter rev the last full
+        # pass round covered, and the earliest future moment a pure time
+        # gate (grow_delay) could newly open with nothing else changing
+        self._view = (
+            IncrementalDemandView(admitter)
+            if (self.config.incremental_demand_view
+                and hasattr(admitter, "demand_changes"))
+            else None
+        )
+        self._sched_rev = -1
+        self._next_due = 0.0
+        self._ticks_total = 0
+        self._ticks_skipped = 0
         # live-reshard plane: control channel into running pods (the
         # operator wires the executor's post_control on the local
         # executor, or a transport/control.SocketControlRouter.post over
@@ -158,20 +292,102 @@ class CapacityScheduler(CapacityDirector):
 
     def tick(self) -> None:
         """One scheduling round: accrue usage, grant what's grantable,
-        then unblock the queue with preemption / elastic resizes."""
+        then unblock the queue with preemption / elastic resizes.
+
+        With the incremental view, the preempt/elastic round is SKIPPED
+        when it provably reproduces a no-op: the admitter rev is
+        unchanged since the last full round (so every demand_view probe
+        and may_reserve gate would answer the same), no gang is waiting
+        for slices (the preempt pass and the shrink arm both early-out),
+        no RESIZE is pending, and no grow_delay gate has newly opened.
+        Policy ordering IS time-sensitive (fair-share deficits accrue),
+        but ordering only matters when something is waiting — which
+        forces a full round. Accrual itself runs every tick."""
         now = time.monotonic()
-        usage, total = self._usage()
+        if self._view is not None:
+            rev = self._view.refresh()
+            usage, total = self._view.usage(), self._view.total_chips()
+            snaps = self._view.snapshots()
+        else:
+            rev = -1
+            snaps = self.admitter.gang_snapshots()
+            usage, total = self._usage(snaps)
         with self._lock:
+            self._ticks_total += 1
             if self._last_tick is not None:
                 self.quotas.accrue(usage, now - self._last_tick)
             self._last_tick = now
+            pending = bool(self._pending_reshards)
+        if (
+            self._view is not None
+            and rev == self._sched_rev
+            and not pending
+            and now < self._next_due
+            and not any(
+                not g.slice_names and g.tpu_chips > 0 for g in snaps)
+            # drains expire on wall-clock deadlines with no rev bump —
+            # kick()'s sweep must keep running while any are in flight
+            and not (hasattr(self.admitter, "draining")
+                     and self.admitter.draining())
+        ):
+            with self._lock:
+                self._ticks_skipped += 1
+            return
         self.admitter.kick()
+        if self._view is None:
+            # full-rescan fallback: each pass snapshots for itself, the
+            # pre-incremental behavior
+            self._reshard_pass()
+            if self.config.enable_preemption:
+                self._preempt_pass()
+            if self.config.enable_elastic:
+                self._elastic_pass()
+            self.admitter.kick()
+            return
+        # each pass works on a view refreshed past the previous pass's
+        # mutations (kick's grants, preemption's evictions) — every
+        # refresh is O(changed), so this costs deltas, not rescans
+        self._view.refresh()
         self._reshard_pass()
         if self.config.enable_preemption:
-            self._preempt_pass()
+            self._preempt_pass(self._view.snapshots(), self._view.usage(),
+                               self._view.total_chips())
         if self.config.enable_elastic:
-            self._elastic_pass()
+            self._view.refresh()
+            self._elastic_pass(self._view.snapshots(), self._view.usage(),
+                               self._view.total_chips())
         self.admitter.kick()
+        # rev AFTER the round: the round's own mutations don't force a
+        # re-round (their follow-on effects — drain confirms, pod exits,
+        # re-grants — all bump the rev when they land). Taken from a
+        # refresh() so the recorded rev covers exactly the deltas folded
+        # into the mirror — a mutation racing this line lands at a higher
+        # rev and defeats the next tick's skip.
+        self._sched_rev = self._view.refresh()
+        self._next_due = self._next_time_gate(time.monotonic())
+
+    def _next_time_gate(self, now: float) -> float:
+        """Earliest future moment the elastic grow gate could newly open
+        with NO admitter event in between. Waiting/held gangs never reach
+        this: their presence disables the skip entirely (holds, shrink
+        delays, and policy-order accrual all resolve through full
+        rounds). float('inf') = nothing time-gated; skip until a rev
+        bump."""
+        due = float("inf")
+        if self._view is None or not self.config.enable_elastic:
+            return due
+        for g in self._view.snapshots():
+            if (
+                g.slice_names
+                and g.tpu_chips > 0
+                and len(g.admissible_slices) >= 2
+                and g.requested_slice in g.admissible_slices
+                and g.admissible_slices.index(g.requested_slice) > 0
+            ):
+                gate = g.granted_at + self.config.grow_delay
+                if gate > now:
+                    due = min(due, gate)
+        return due
 
     # -- live reshard ----------------------------------------------------
 
@@ -439,16 +655,23 @@ class CapacityScheduler(CapacityDirector):
 
     # -- preemption ------------------------------------------------------
 
-    def _preempt_pass(self) -> None:
+    def _preempt_pass(
+        self,
+        snaps: Optional[List[GangSnapshot]] = None,
+        usage: Optional[Dict[str, int]] = None,
+        total: Optional[int] = None,
+    ) -> None:
         """Evict policy-selected victims for the first unsatisfiable
         waiting gang the policy favors. One demander per tick: each
         eviction changes the pool, so re-evaluate from fresh state."""
         now = time.monotonic()
-        snaps = self.admitter.gang_snapshots()
+        if snaps is None:
+            snaps = self.admitter.gang_snapshots()
         waiting = self._waiting(snaps, now)
         if not waiting:
             return
-        usage, total = self._usage(snaps)
+        if usage is None or total is None:
+            usage, total = self._usage(snaps)
         for demander in self.policy.order_waiting(waiting, usage, total):
             if not self.policy.may_reserve(demander, usage, total):
                 continue
@@ -532,10 +755,17 @@ class CapacityScheduler(CapacityDirector):
 
     # -- elastic resize --------------------------------------------------
 
-    def _elastic_pass(self) -> None:
+    def _elastic_pass(
+        self,
+        snaps: Optional[List[GangSnapshot]] = None,
+        usage: Optional[Dict[str, int]] = None,
+        total: Optional[int] = None,
+    ) -> None:
         now = time.monotonic()
-        snaps = self.admitter.gang_snapshots()
-        usage, total = self._usage(snaps)
+        if snaps is None:
+            snaps = self.admitter.gang_snapshots()
+        if usage is None or total is None:
+            usage, total = self._usage(snaps)
         for g in snaps:
             if len(g.admissible_slices) < 2 or g.tpu_chips <= 0:
                 continue
@@ -640,6 +870,27 @@ class CapacityScheduler(CapacityDirector):
     # exposition (metrics/runtime_metrics.py register_capacity, CLI)
     # ------------------------------------------------------------------
 
+    def version(self):
+        """Cheap change token for the metrics render cache: moves when
+        anything the prom exposition derives from may have moved —
+        admitter scheduling state (usage, tenants), the scheduler's own
+        counters, or quota accrual. snapshot() is O(fleet) (it lists the
+        whole queue); this is O(1) and lets an unchanged scrape skip it
+        entirely (docs/control_plane_scale.md)."""
+        if not hasattr(self.admitter, "demand_rev"):
+            return None  # no change feed — the scrape renders live
+        rev = self.admitter.demand_rev()
+        with self._lock:
+            return (
+                rev,
+                self._preemptions_total,
+                self._resizes_total,
+                tuple(sorted(self._reshards_total.items())),
+                self._downtime_n,
+                len(self._pending_reshards),
+                self.quotas.version(),
+            )
+
     def snapshot(self) -> Dict:
         now = time.monotonic()
         snaps = self.admitter.gang_snapshots()
@@ -684,6 +935,8 @@ class CapacityScheduler(CapacityDirector):
         with self._lock:
             preemptions = self._preemptions_total
             resizes = self._resizes_total
+            ticks = self._ticks_total
+            skipped = self._ticks_skipped
             reshards = dict(self._reshards_total)
             downtime = {
                 "last": self._downtime_last,
@@ -700,6 +953,15 @@ class CapacityScheduler(CapacityDirector):
             "queue": queue,
             "preemptions_total": preemptions,
             "resizes_total": resizes,
+            "ticks_total": ticks,
+            "ticks_skipped": skipped,
+            "demand_view": (
+                {
+                    "rebuilds_total": self._view.rebuilds_total,
+                    "delta_refreshes_total": self._view.delta_refreshes_total,
+                }
+                if self._view is not None else None
+            ),
             "reshards_total": reshards,
             "reshards_pending": pending,
             "resize_downtime": downtime,

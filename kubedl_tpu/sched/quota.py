@@ -51,6 +51,7 @@ class TenantQuotas:
         self._lock = new_lock("sched.quota.TenantQuotas._lock")
         self._chip_seconds: Dict[str, float] = {}
         self._preemptions: Dict[str, int] = {}
+        self._rev = 0  # bumps when the counters above actually move
 
     # -- config reads ----------------------------------------------------
 
@@ -89,16 +90,28 @@ class TenantQuotas:
         if dt <= 0:
             return
         with self._lock:
+            accrued = False
             for tenant, chips in usage.items():
                 if chips <= 0:
                     continue
                 t = normalize_tenant(tenant)
                 self._chip_seconds[t] = self._chip_seconds.get(t, 0.0) + chips * dt
+                accrued = True
+            if accrued:
+                self._rev += 1
 
     def note_preemption(self, tenant: str) -> None:
         with self._lock:
             t = normalize_tenant(tenant)
             self._preemptions[t] = self._preemptions.get(t, 0) + 1
+            self._rev += 1
+
+    def version(self) -> int:
+        """Change token for the metrics render cache: moves whenever the
+        accumulated counters moved (an idle fleet accrues nothing, so its
+        token — and the rendered text — stays put)."""
+        with self._lock:
+            return self._rev
 
     def preemptions(self, tenant: str) -> int:
         with self._lock:

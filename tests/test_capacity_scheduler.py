@@ -691,3 +691,93 @@ def test_hetero_gang_respects_tenant_cap():
     het = _mpmd_job("het", ["v5e-16", "v5e-8"], tenant="t1")
     st = adm.create_gang(het, het.spec.replica_specs)
     assert st.slice_names == []
+
+
+# ---------------------------------------------------------------------------
+# incremental demand view (docs/control_plane_scale.md)
+# ---------------------------------------------------------------------------
+
+
+def test_demand_view_parity_on_randomized_event_streams():
+    """Drive the REAL admitter through seeded random create/grant/evict/
+    delete/slice-failure streams, folding deltas into the incremental
+    view at random points — after every refresh the delta-maintained
+    mirror must equal the full-rescan oracle exactly (parity_diff()
+    empty), including usage sums and the total-chip denominator."""
+    import random
+
+    from kubedl_tpu.sched.capacity import IncrementalDemandView
+
+    for seed in (7, 23, 1999):
+        rng = random.Random(seed)
+        adm = TPUSliceAdmitter.with_pool(
+            ObjectStore(), ["v5e-8"] * 6 + ["v5e-4"] * 2)
+        view = IncrementalDemandView(adm)  # the single delta consumer
+        assert view.refresh() >= 0 and view.parity_diff() == {}
+        jobs = {}
+        refreshes = 0
+        for step in range(120):
+            roll = rng.random()
+            if roll < 0.35 or not jobs:  # submit
+                name = f"g{seed}-{step}"
+                job = _job(name, chips=rng.choice([4, 8]),
+                           priority=rng.randrange(3),
+                           tenant=rng.choice(["a", "b", "c"]))
+                jobs[name] = job
+                adm.create_gang(job, job.spec.replica_specs)
+            elif roll < 0.55:  # grant pass
+                adm.kick()
+            elif roll < 0.70:  # evict a random granted gang
+                granted = [g for g in adm.gang_snapshots() if g.slice_names]
+                if granted:
+                    g = rng.choice(granted)
+                    adm.evict_gang(g.namespace, g.name)
+            elif roll < 0.85:  # finish a random job
+                name = rng.choice(list(jobs))
+                adm.delete_gang(jobs.pop(name))
+            else:  # a pool slice dies
+                alive = [s["name"] for s in adm.utilization()["slices"]]
+                if len(alive) > 2:
+                    adm.slice_failed(rng.choice(alive))
+            if step == 60:  # guarantee one pool-membership change per
+                # stream (inventory growth): set_pool forces the
+                # pool_changed path, so refresh must fully rebuild
+                from kubedl_tpu.gang.slice_admitter import (
+                    SliceInfo,
+                    parse_slice_type,
+                )
+                infos = [SliceInfo(name=s.name, type=s.type)
+                         for s in adm._slices.values()]
+                infos.append(SliceInfo(name=f"slice-grow-{seed}",
+                                       type=parse_slice_type("v5e-8")))
+                adm.set_pool(infos)
+            if rng.random() < 0.4:  # fold deltas at arbitrary cut points
+                view.refresh()
+                refreshes += 1
+                assert view.parity_diff() == {}, (
+                    f"seed {seed} step {step}: view diverged from oracle")
+        view.refresh()
+        assert view.parity_diff() == {}
+        # the stream exercised BOTH maintenance paths
+        assert view.delta_refreshes_total > 0
+        assert view.rebuilds_total >= 2  # prime + >=1 pool change
+        assert refreshes > 10
+
+
+def test_demand_view_usage_drops_tenant_at_zero():
+    """Eviction returns a tenant's reserved chips to zero: the delta
+    path must remove the tenant from the usage map (not leave a 0
+    entry), or parity against the recomputed oracle breaks.  The hold
+    keeps the requeue paced so the freed slice is not instantly
+    re-granted to the same gang."""
+    from kubedl_tpu.sched.capacity import IncrementalDemandView
+
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8"])
+    view = IncrementalDemandView(adm)
+    job = _job("solo", tenant="t1")
+    adm.create_gang(job, job.spec.replica_specs)
+    view.refresh()
+    assert view.usage() == {"t1": 8} and view.parity_diff() == {}
+    adm.evict_gang("default", "solo", hold_seconds=60.0)
+    view.refresh()
+    assert view.usage() == {} and view.parity_diff() == {}

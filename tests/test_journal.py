@@ -342,6 +342,84 @@ def test_restore_counts_live_pod_with_no_journaled_gang(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# compaction (docs/control_plane_scale.md)
+# ---------------------------------------------------------------------------
+
+
+def test_replay_after_compaction_is_state_equivalent(tmp_path):
+    """The size-threshold compaction at the admitter's kick() choke
+    point must be invisible to replay: a fresh admitter restored from
+    the compacted journal rebuilds the exact same grants, drains, and
+    dead-slice set as one restored from the full history — with the file
+    shrunk to the effective-state snapshot and seq still monotonic."""
+    adm1 = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8"] * 3)
+    # threshold of 1 byte: every kick() with a non-empty file compacts
+    j = GrantJournal(_jpath(tmp_path), compact_bytes=1)
+    j.open()
+    adm1.attach_journal(j)
+    jobs = [_job(f"g{i}") for i in range(5)]
+    for job in jobs:
+        adm1.create_gang(job, job.spec.replica_specs)
+    granted = sorted(g.key for g in adm1.gang_snapshots() if g.slice_names)
+    assert len(granted) == 3  # pool-bound; g3/g4 wait
+    # churn grows the history: each eviction frees a slice that a
+    # waiting gang immediately re-reserves (evict + grant records), so
+    # the compacted snapshot is strictly smaller than the full log
+    for _ in range(3):
+        g = next(g for g in adm1.gang_snapshots() if g.slice_names)
+        adm1.evict_gang(g.namespace, g.name)
+    # one granted slice dies: its gang parks as a deadline-only drain
+    owner = next(g for g in adm1.gang_snapshots() if g.slice_names)
+    victim = owner.slice_names[0]
+    assert adm1.slice_failed(victim) == owner.key
+    seq_before = j.snapshot()["seq"]
+    lines_before = len(open(_jpath(tmp_path)).read().splitlines())
+
+    adm1.kick()  # the compaction choke point
+    assert j.compactions_total >= 1
+    seq_after = j.snapshot()["seq"]
+    assert seq_after > seq_before  # snapshot re-stamped ABOVE the watermark
+    lines_after = len(open(_jpath(tmp_path)).read().splitlines())
+    assert lines_after < lines_before
+    # the journal is still appendable after the os.replace swap: finish
+    # one of the still-granted jobs
+    done = next(g for g in adm1.gang_snapshots() if g.slice_names)
+    adm1.delete_gang(jobs[int(done.name[1:])])
+    j.close()
+
+    adm2, stats = _restored(tmp_path, pool=("v5e-8",) * 3)
+    assert stats["conflicts"] == 0
+    live1 = {g.key: sorted(g.slice_names)
+             for g in adm1.gang_snapshots() if g.slice_names}
+    live2 = {g.key: sorted(g.slice_names)
+             for g in adm2.gang_snapshots() if g.slice_names}
+    assert live2 == live1 and live2  # something survived, identically
+    assert adm2.get_gang(done.namespace, done.name) is None
+    # the drain and the dead-slice report survived the compaction
+    assert adm2.draining() == adm1.draining()
+    assert adm2.draining() == {owner.key: [victim]}
+    assert victim in adm2._dead
+    u1, u2 = adm1.utilization(), adm2.utilization()
+    assert (u2["chips_reserved"], u2["slices_draining"]) == (
+        u1["chips_reserved"], u1["slices_draining"])
+
+
+def test_compaction_disabled_at_zero_threshold(tmp_path):
+    """compact_bytes=0 (the default) must never compact — the knob's
+    documented off switch."""
+    adm = TPUSliceAdmitter.with_pool(ObjectStore(), ["v5e-8"])
+    j = GrantJournal(_jpath(tmp_path))
+    j.open()
+    adm.attach_journal(j)
+    job = _job("a")
+    adm.create_gang(job, job.spec.replica_specs)
+    assert not j.should_compact()
+    adm.kick()
+    assert j.compactions_total == 0
+    j.close()
+
+
+# ---------------------------------------------------------------------------
 # HistoryStore
 # ---------------------------------------------------------------------------
 

@@ -20,6 +20,7 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from kubedl_tpu.analysis import witness
+from kubedl_tpu.journal.wal import GrantJournal
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -154,7 +155,7 @@ def test_sigkill_mid_grant_then_replay_restores_without_regrant(
     the grant; the successor journals nothing new."""
     proc = _spawn_victim(tmp_path)
     try:
-        _kill_at_journal_marker(proc, tmp_path, '"op": "grant"')
+        _kill_at_journal_marker(proc, tmp_path, '"op":"grant"')
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -168,11 +169,77 @@ def test_sigkill_between_grant_and_pods_start(tmp_path, monkeypatch):
     re-grants the slice nor re-journals the pod's start."""
     proc = _spawn_victim(tmp_path)
     try:
-        _kill_at_journal_marker(proc, tmp_path, '"op": "pods_start"')
+        _kill_at_journal_marker(proc, tmp_path, '"op":"pods_start"')
     finally:
         if proc.poll() is None:
             proc.kill()
     _restart_and_check(tmp_path, monkeypatch, min_records=2)
+
+
+# ---------------------------------------------------------------------------
+# group-commit durability (docs/control_plane_scale.md)
+# ---------------------------------------------------------------------------
+
+GROUP_COMMIT_SRC = """\
+import sys, threading
+sys.path.insert(0, {repo!r})
+from kubedl_tpu.journal.wal import GrantJournal
+j = GrantJournal({path!r})
+j.open()
+out = sys.stdout
+lock = threading.Lock()
+def worker(t):
+    for i in range(2000):
+        rec = j.append_nosync('grant', gang=f'default/g{{t}}-{{i}}',
+                              slices=[f's{{t}}'], state={{}})
+        j.sync_to(int(rec['seq']))
+        # ONLY after sync_to returns is the record claimed committed
+        with lock:
+            out.write(f"COMMITTED {{rec['seq']}}\\n")
+            out.flush()
+ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+for t in ts: t.start()
+for t in ts: t.join()
+print('DRAINED', flush=True)
+"""
+
+
+def test_sigkill_after_group_commit_ack_never_loses_acked_records(
+        tmp_path):
+    """Four writers race append_nosync + sync_to (the leader/follower
+    group fsync) and acknowledge each record only after its sync ticket
+    is covered; the process is SIGKILLed mid-stream.  Every record acked
+    BEFORE the kill — leader- and follower-committed alike — must come
+    back on replay: a follower returning without touching the disk is
+    still a durability promise."""
+    path = str(tmp_path / "grant.journal")
+    src = GROUP_COMMIT_SRC.format(repo=REPO_ROOT, path=path)
+    proc = subprocess.Popen([sys.executable, "-c", src],
+                            stdout=subprocess.PIPE, text=True,
+                            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    acked = []
+    try:
+        while len(acked) < 200:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("COMMITTED "):
+                acked.append(int(line.split()[1]))
+            elif line.startswith("DRAINED"):
+                break
+        proc.kill()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+    assert len(acked) >= 200, "victim drained before the kill landed"
+
+    j = GrantJournal(path)
+    replayed = {r["seq"] for r in j.open()}
+    j.close()
+    lost = sorted(set(acked) - replayed)
+    assert not lost, (
+        f"{len(lost)} acked records lost after SIGKILL: {lost[:10]}")
 
 
 # ---------------------------------------------------------------------------

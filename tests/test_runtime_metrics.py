@@ -162,3 +162,45 @@ def test_slice_pool_sentinel_on_callback_failure():
     rm.register_slice_pool(boom)
     assert "kubedl_slice_utilization -1" in rm.render()
     assert rm.debug_vars()["slice_pool"] is None
+
+
+def test_quiet_scrape_reformats_nothing():
+    """O(changed) rendering (docs/control_plane_scale.md): a scrape
+    where nothing moved must serve every versioned family from its
+    cached text — zero rebuilds, zero snapshot-hook calls — while a
+    version bump or an observe_* fold rebuilds exactly that family."""
+    rm = RuntimeMetrics()
+    rm.observe_reconcile("tfjob", 0.01)
+    ver = {"v": 1}
+    calls = {"n": 0}
+
+    def pool_snapshot():
+        calls["n"] += 1
+        return {"slices_total": 1, "slices_reserved": 0, "chips_total": 8,
+                "chips_reserved": 0, "utilization": 0.0,
+                "slices": [{"name": "slice-0-v5e-8", "type": "v5e-8",
+                            "reserved_by": ""}]}
+
+    rm.register_slice_pool(pool_snapshot, version_fn=lambda: ver["v"])
+    first = rm.render()
+    builds = dict(rm.family_builds)
+    hook_calls = calls["n"]
+
+    second = rm.render()  # nothing moved
+    assert second == first
+    assert rm.family_builds["core"] == builds["core"]
+    assert rm.family_builds["slice_pool"] == builds["slice_pool"]
+    assert calls["n"] == hook_calls  # snapshot hook never ran
+    # the live depth gauges are documented to render every scrape
+    assert rm.family_builds["workqueue"] == builds["workqueue"] + 1
+
+    ver["v"] = 2  # the pool changed: ONLY that family rebuilds
+    rm.render()
+    assert rm.family_builds["slice_pool"] == builds["slice_pool"] + 1
+    assert rm.family_builds["core"] == builds["core"]
+    assert calls["n"] == hook_calls + 1
+
+    rm.observe_reconcile("tfjob", 0.02)  # a fold bumps the core rev
+    rm.render()
+    assert rm.family_builds["core"] == builds["core"] + 1
+    assert rm.family_builds["slice_pool"] == builds["slice_pool"] + 1

@@ -1,6 +1,10 @@
+import threading
 import time
 
-from kubedl_tpu.core.workqueue import RateLimitingQueue
+from kubedl_tpu.core.workqueue import (
+    RateLimitingQueue,
+    ShardedRateLimitingQueue,
+)
 
 
 def test_dedup_while_queued():
@@ -46,3 +50,92 @@ def test_shutdown_unblocks_get():
     q.shutdown()
     assert q.get(timeout=5) is None
     assert time.monotonic() - t0 < 1
+
+
+# ---------------------------------------------------------------------------
+# ShardedRateLimitingQueue (docs/control_plane_scale.md)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_routing_is_stable_and_exclusive():
+    """Every key hashes to exactly one shard, and only that shard's
+    worker ever sees it — the ordering-domain invariant."""
+    q = ShardedRateLimitingQueue(4)
+    keys = [f"ns-{i}/job-{i}" for i in range(64)]
+    for k in keys:
+        assert q.shard_for(k) == q.shard_for(k)  # deterministic
+        q.add(k)
+    seen = {}
+    for shard in range(4):
+        while True:
+            k = q.get(timeout=0.05, shard=shard)
+            if k is None:
+                break
+            seen[k] = shard
+            q.done(k)
+    assert set(seen) == set(keys)
+    for k, shard in seen.items():
+        assert shard == q.shard_for(k)
+
+
+def test_sharded_keeps_per_key_contract():
+    """Dedup-while-queued, requeue-if-added-while-processing, and
+    backoff/forget all stay per key because a key never leaves its
+    shard."""
+    q = ShardedRateLimitingQueue(3)
+    key = "default/a"
+    shard = q.shard_for(key)
+    q.add(key)
+    q.add(key)  # coalesces
+    assert q.get(timeout=0.1, shard=shard) == key
+    q.add(key)  # while processing: re-queued only after done()
+    assert q.get(timeout=0.05, shard=shard) is None
+    q.done(key)
+    assert q.get(timeout=0.5, shard=shard) == key
+    q.done(key)
+    q.add_rate_limited(key)
+    assert q.num_requeues(key) == 1
+    q.forget(key)
+    assert q.num_requeues(key) == 0
+    # other shards never saw anything
+    for other in range(3):
+        if other != shard:
+            assert q.get(timeout=0.02, shard=other) is None
+
+
+def test_sharded_distinct_keys_proceed_in_parallel():
+    """A worker stuck processing one shard's key must not block keys on
+    other shards — the whole point of sharding the queue."""
+    q = ShardedRateLimitingQueue(2)
+    # find two keys on different shards
+    a = "default/a"
+    b = next(f"default/x{i}" for i in range(64)
+             if q.shard_for(f"default/x{i}") != q.shard_for(a))
+    q.add(a)
+    q.add(b)
+    got_a = q.get(timeout=0.5, shard=q.shard_for(a))
+    assert got_a == a
+    # a is in flight (never done()'d) — b is still handed out instantly
+    t0 = time.monotonic()
+    assert q.get(timeout=0.5, shard=q.shard_for(b)) == b
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_sharded_shutdown_and_busy_cover_all_shards():
+    q = ShardedRateLimitingQueue(3)
+    assert not q.busy()
+    q.add("default/a")
+    assert q.busy() and len(q) == 1
+    q.shutdown()
+    waiters = []
+
+    def drain(shard):
+        waiters.append(q.get(timeout=5, shard=shard))
+
+    ts = [threading.Thread(target=drain, args=(i,)) for i in range(3)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=2)
+    assert time.monotonic() - t0 < 1.5  # shutdown unblocked every shard
