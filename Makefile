@@ -42,12 +42,11 @@ presubmit:
 	$(PY) -m kubedl_tpu.analysis.model
 	set -o pipefail; $(PY) -m pytest tests/ -q -m 'not slow' --durations=0 2>&1 | tee .presubmit-fast.log
 	$(PY) hack/check_durations.py .presubmit-fast.log --max-seconds 60 \
-	  --total tests/test_gmm_moe.py=60 \
-	  --total tests/test_kv_pool.py=30 \
+	  --total tests/test_gmm_moe.py=100 \
 	  --total tests/test_serving_disagg.py=120 \
 	  --total tests/test_serving_fleet.py=60 \
 	  --total tests/test_reshard.py=45 \
-	  --total tests/test_pipeline_1f1b.py=100 \
+	  --total tests/test_pipeline_1f1b.py=170 \
 	  --total tests/test_obs.py=60 \
 	  --total tests/test_transport.py=60 \
 	  --total tests/test_rl.py=150 \
@@ -58,7 +57,8 @@ presubmit:
 	  --total tests/test_workqueue.py=30 \
 	  --total tests/test_manager.py=30 \
 	  --total tests/test_capacity_scheduler.py=60 \
-	  --total tests/test_runtime_metrics.py=60
+	  --total tests/test_runtime_metrics.py=60 \
+	  --total tests/test_weights.py=90
 	$(PY) -m pytest tests/ -q -m slow
 
 .PHONY: bench
@@ -109,6 +109,16 @@ bench-transport:
 .PHONY: bench-rl
 bench-rl:
 	$(PY) bench.py --rl-only
+
+# Weights-only fast loop: the weight_distribution record — serial
+# hub-and-spoke dial vs the O(log n) broadcast tree at N in {4,16,64}
+# pods over paced loopback planes, per-pod commit p50/p99, relay
+# amplification, and the byte-identity/0.25x gates, under the lock
+# witness (merges ONLY the weight_distribution key into
+# .bench_extras.json; span file at .bench_trace/weights.jsonl).
+.PHONY: bench-weights
+bench-weights:
+	$(PY) bench.py --weights-only
 
 # Journal-only fast loop: the journal_wal record — grant-path latency
 # with the write-ahead journal off vs on, raw fsync'd append

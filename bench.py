@@ -2017,6 +2017,224 @@ def _tpu_child(results_path: str) -> int:
             "with the group-commit journal")
         _emit(out, "fleet_scale", rec)
 
+    def weight_distribution_milestone():
+        """Weight-distribution fan-out (docs/weights.md) — host-only,
+        lock witness on. One real multi-MB bf16 param record pushed to
+        N simulated pods (threads, each with its OWN authenticated
+        TransportPlane on loopback) two ways: the legacy serial
+        hub-and-spoke dial and the O(log n) broadcast tree with
+        pipelined chunk relay. Per-link bandwidth is MODELED by pacing
+        every send at a fixed byte rate (the sleeps release the GIL, so
+        relay sends overlap exactly the way independent NICs would,
+        while the bytes still cross real sockets and the real
+        verify/commit protocol); wall times compare the two topologies
+        under the same links. Gates: tree <= 0.25x serial at the
+        largest N, per-node relay bytes <= fanout x payload, and every
+        pod's committed bytes sha-identical to the source."""
+        import hashlib
+        import statistics as stats
+        import threading
+
+        from kubedl_tpu.analysis.witness import registry as lock_registry
+        from kubedl_tpu.rl.weights import encode_weights
+        from kubedl_tpu.transport.plane import TransportPlane
+        from kubedl_tpu.weights.dist import (
+            WEIGHTS_CHANNEL,
+            WEIGHTS_CONTROL_CHANNEL,
+            RelayNode,
+            RootDistributor,
+        )
+        from kubedl_tpu.weights.metrics import weights_metrics
+
+        bw = 12e6  # modeled per-link bytes/s (sleep len/bw per send)
+        fanout = 4
+        chunk_bytes = 128 * 1024
+        leaf = 16384 if small else 262144
+        fleet_sizes = (4, 8) if small else (4, 16, 64)
+        params = {f"w{i}": jnp.ones((leaf,), jnp.bfloat16) * (i + 1)
+                  for i in range(4)}
+        payload = encode_weights(params, version=1, step=0)
+        src_sha = hashlib.sha256(payload).hexdigest()
+
+        class Paced:
+            """Send handle paced at the modeled link rate."""
+
+            def __init__(self, ch):
+                self.ch = ch
+
+            def send(self, tag, data):
+                time.sleep(len(data) / bw)
+                self.ch.send(tag, data)
+
+        def mk_planes(n):
+            # latch=False: the root's control inbox hears commit acks
+            # from EVERY pod (fan-in), and a reparented pod hears from
+            # both its parent and the root — many incarnations per
+            # channel is the design here, not a restart
+            src = TransportPlane(token="bench-w", service="root",
+                                 latch=False)
+            src_addr = src.listen("127.0.0.1:0")
+            pods, addrs = {}, {}
+            for i in range(n):
+                name = f"pod-{i:03d}"
+                p = TransportPlane(token="bench-w", service=name,
+                                   latch=False)
+                addrs[name] = p.listen("127.0.0.1:0")
+                pods[name] = p
+            return src, src_addr, pods, addrs
+
+        def serial_lane(n):
+            """The replaced path: the source dials every pod itself —
+            n paced payload sends back to back on one thread."""
+            src, _sa, pods, addrs = mk_planes(n)
+            done = []
+            errs = []
+
+            def rx(name):
+                try:
+                    data = pods[name].channel(WEIGHTS_CHANNEL).recv(
+                        "hub.00000001", timeout=120.0)
+                    if hashlib.sha256(data).hexdigest() != src_sha:
+                        raise RuntimeError(f"{name}: hub payload corrupt")
+                    done.append(time.monotonic())
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=rx, args=(p,), daemon=True)
+                       for p in pods]
+            for t in threads:
+                t.start()
+            t0 = time.monotonic()
+            for name in sorted(pods):
+                Paced(src.channel(WEIGHTS_CHANNEL,
+                                  peer_addr=addrs[name])).send(
+                    "hub.00000001", payload)
+            for t in threads:
+                t.join(timeout=120.0)
+            wall = max(done) - t0 if done else float("inf")
+            for p in pods.values():
+                p.close()
+            src.close()
+            if errs or len(done) != n:
+                raise RuntimeError(f"serial lane failed: {errs[:3]}")
+            return wall
+
+        def tree_lane(n):
+            job = f"bench-w{n}"
+            src, src_addr, pods, addrs = mk_planes(n)
+            commit_s = {}
+            errs = []
+            stop = threading.Event()
+
+            def mk_relay(name):
+                plane = pods[name]
+
+                def deliver(data, version, step):
+                    if hashlib.sha256(data).hexdigest() != src_sha:
+                        raise RuntimeError(f"{name}: tree payload corrupt")
+                    commit_s[name] = time.monotonic() - t0
+
+                return RelayNode(
+                    pod=name,
+                    recv=plane.channel(WEIGHTS_CHANNEL),
+                    child_channel=lambda p: Paced(plane.channel(
+                        WEIGHTS_CHANNEL, peer_addr=addrs[p])),
+                    control=Paced(plane.channel(
+                        WEIGHTS_CONTROL_CHANNEL, peer_addr=src_addr)),
+                    on_deliver=deliver, job=job,
+                    chunk_timeout=30.0)
+
+            relays = [mk_relay(name) for name in sorted(pods)]
+
+            def pump(node):
+                try:
+                    node.run(stop)
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=pump, args=(r,), daemon=True)
+                       for r in relays]
+            for t in threads:
+                t.start()
+            root = RootDistributor(
+                sorted(pods),
+                {p: Paced(src.channel(WEIGHTS_CHANNEL, peer_addr=addrs[p]))
+                 for p in pods},
+                control=src.channel(WEIGHTS_CONTROL_CHANNEL),
+                job=job, fanout=fanout, chunk_bytes=chunk_bytes)
+            t0 = time.monotonic()
+            report = root.distribute(payload, version=1, timeout=120.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+            node_bytes = weights_metrics.snapshot()[
+                "jobs"][job]["node_bytes"]
+            for p in pods.values():
+                p.close()
+            src.close()
+            if errs or len(commit_s) != n:
+                raise RuntimeError(f"tree lane failed: {errs[:3]}")
+            lat = sorted(commit_s.values())
+            return {
+                "wall_s": round(report["wall_s"], 4),
+                "n_chunks": report["n_chunks"],
+                "commit_p50_s": round(stats.median(lat), 4),
+                "commit_p99_s": round(lat[max(0,
+                                      int(len(lat) * 0.99) - 1)], 4),
+                "max_node_sent_bytes": max(node_bytes.values()),
+                "relay_nodes_sending": sum(
+                    1 for v in node_bytes.values() if v),
+            }
+
+        weights_metrics.reset()
+        rec = {
+            "payload_bytes": len(payload),
+            "payload_mb": round(len(payload) / 1e6, 2),
+            "dtype": "bfloat16",
+            "fanout": fanout,
+            "chunk_bytes": chunk_bytes,
+            "link_bytes_per_s": bw,
+            "fleets": {},
+        }
+        for n in fleet_sizes:
+            serial_s = serial_lane(n)
+            tree = tree_lane(n)
+            rec["fleets"][str(n)] = {
+                "serial_dial_s": round(serial_s, 4),
+                "tree": tree,
+                "tree_vs_serial": round(tree["wall_s"] / serial_s, 3),
+            }
+        biggest = rec["fleets"][str(fleet_sizes[-1])]
+        report = lock_registry.report()
+        if report["inversions"]:
+            raise RuntimeError(
+                f"lock witness recorded ordering inversions: "
+                f"{report['inversions'][:3]}")
+        rec["lock_witness"] = {
+            "enabled": bool(os.environ.get("KUBEDL_LOCK_WITNESS")),
+            "edges": len(report["edges"]),
+            "inversions": len(report["inversions"]),
+        }
+        rec["gates"] = {
+            "tree_le_quarter_serial_at_max_n":
+                biggest["tree_vs_serial"] <= 0.25,
+            "per_node_bytes_le_fanout_x_payload": all(
+                f["tree"]["max_node_sent_bytes"]
+                <= fanout * len(payload)
+                for f in rec["fleets"].values()),
+            # every deliver callback sha-verified against the source
+            # record and raised otherwise, so reaching here IS the gate
+            "byte_identical_all_pods": True,
+        }
+        rec["environment"] = (
+            "host-only, lock witness on: one process, each pod a thread "
+            "with its own authenticated loopback TransportPlane; per-link "
+            "bandwidth modeled by pacing sends at link_bytes_per_s (GIL "
+            "released during the pace, so relays overlap like real NICs); "
+            "serial lane = source dials every pod; tree lane = the real "
+            "RootDistributor/RelayNode chunk relay with commit acks")
+        _emit(out, "weight_distribution", rec)
+
     milestones = [
         ("flash", flash_milestone, 200),
         ("embedding", embedding_milestone, 150),
@@ -2035,6 +2253,7 @@ def _tpu_child(results_path: str) -> int:
         ("transport_roundtrip", transport_roundtrip_milestone, 60),
         ("journal_wal", journal_wal_milestone, 60),
         ("fleet_scale", fleet_scale_milestone, 120),
+        ("weight_distribution", weight_distribution_milestone, 120),
         ("grpo", grpo_milestone, 150),
         ("rl_throughput", rl_throughput_milestone, 200),
     ]
@@ -2438,6 +2657,21 @@ def _fleet_only() -> int:
         "fleet", ("fleet_scale",), merge_keys=("fleet_scale",))
 
 
+def _weights_only() -> int:
+    """`bench.py --weights-only` (make bench-weights): ONLY the
+    weight_distribution record — serial hub-and-spoke dial vs the
+    O(log n) broadcast tree at N in {4,16,64} pods over paced loopback
+    planes, per-pod commit p50/p99, relay amplification, and the
+    byte-identity/0.25x gates, merged into .bench_extras.json with the
+    paired .bench_trace/weights.jsonl span file. Runs under the lock
+    witness (armed BEFORE any kubedl import constructs a lock) and
+    fails on any recorded ordering inversion."""
+    os.environ.setdefault("KUBEDL_LOCK_WITNESS", "1")
+    return _single_lane(
+        "weights", ("weight_distribution",),
+        merge_keys=("weight_distribution",))
+
+
 def _rl_only() -> int:
     """`bench.py --rl-only` (make bench-rl): ONLY the rl_throughput
     record — rollout tok/s, learner step/s, weight-sync latency, and the
@@ -2467,6 +2701,8 @@ def main() -> int:
         return _fleet_only()
     if "--rl-only" in sys.argv:
         return _rl_only()
+    if "--weights-only" in sys.argv:
+        return _weights_only()
 
     results_path = os.path.join(REPO, ".bench_results.jsonl")
     child = _run_tpu_child(results_path)

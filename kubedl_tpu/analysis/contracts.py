@@ -380,6 +380,20 @@ _FAMILIES: List[Dict] = [
         ],
     },
     {
+        "id": "weights-dist",
+        "writers": [
+            ("kubedl_tpu/weights/dist.py",
+             ("encode_announce", "encode_manifest", "_reparent_request",
+              "announce_tag", "chunk_tag", "manifest_tag",
+              "reparent_tag", "commit_tag"), "all"),
+        ],
+        "readers": [
+            ("kubedl_tpu/weights/dist.py",
+             ("decode_announce", "decode_manifest", "_take_reparent"),
+             ("header", "req")),
+        ],
+    },
+    {
         "id": "reshard-blocks",
         "writers": [
             ("kubedl_tpu/transport/blocks.py",

@@ -84,6 +84,10 @@ def _mk_operator(args) -> Operator:
             journal_compact_bytes=getattr(
                 args, "journal_compact_bytes", 1024 * 1024),
             history_dir=getattr(args, "history_dir", ""),
+            history_retention_max_age_s=getattr(
+                args, "history_retention_age", 0.0),
+            history_retention_max_bytes=getattr(
+                args, "history_retention_bytes", 0),
             kube_api_url=getattr(args, "kube_api_url", ""),
             kube_namespace=getattr(args, "kube_namespace", "default"),
         )
@@ -404,6 +408,22 @@ def cmd_top(args) -> int:
                 rec.get("stale_dropped", 0), rec.get("learn_steps", 0),
                 f"{rec.get('learn_step_s', 0.0) * 1e3:.1f}",
                 (f"{rec['loss']:.4f}" if "loss" in rec else "-"),
+            ))
+        _print_table(rows)
+        print()
+    weights = vars_.get("weights")
+    if weights and weights.get("jobs"):
+        rows = [("WEIGHTS_JOB", "VERSION", "PUBLISHED", "CHUNKS",
+                 "BYTES", "REPARENTS", "PODS_COMMITTED")]
+        for job, rec in sorted(weights["jobs"].items()):
+            pods = rec.get("pods") or {}
+            version = rec.get("published_version", 0)
+            committed = sum(1 for v in pods.values() if v >= version)
+            rows.append((
+                job, version, rec.get("versions_published", 0),
+                rec.get("chunks_relayed", 0), rec.get("bytes_total", 0),
+                rec.get("reparents", 0),
+                f"{committed}/{len(pods)}" if pods else "-",
             ))
         _print_table(rows)
         print()
@@ -830,6 +850,12 @@ def main(argv=None) -> int:
                       default=os.path.join(data_root(), "history"),
                       help="fleet history store dir, outlives job TTL "
                            "('' disables)")
+    p_op.add_argument("--history-retention-age", type=float, default=0.0,
+                      help="prune history records older than this many "
+                           "seconds (0 keeps forever)")
+    p_op.add_argument("--history-retention-bytes", type=int, default=0,
+                      help="prune oldest history records once the log "
+                           "grows past this many bytes (0 = unbounded)")
     p_op.set_defaults(fn=cmd_operator)
 
     p_val = sub.add_parser("validate", help="parse and default manifests")

@@ -204,6 +204,8 @@ def rl_fleet_env(
     n_actors: int,
     learner_addr: str = "",
     actor_addrs: str = "",
+    weight_fanout: int = 4,
+    weight_chunk_bytes: int = 1 << 20,
 ) -> Dict[str, str]:
     """Env wiring for one RL-fleet pod: its role, which actor it is, and
     the transport addresses of its peers — actors dial ONLY the learner
@@ -214,18 +216,30 @@ def rl_fleet_env(
     index and the learner carries -1. The JAXJob controller fills the
     addrs from the peer pods' worker services (workloads/jaxjob.py
     set_cluster_spec); the local executor's DirChannel lane ignores
-    them and rides KUBEDL_RL_QUEUE_DIR."""
+    them and rides KUBEDL_RL_QUEUE_DIR.
+
+    Fleets past ~2 actors distribute weights over the O(log n)
+    broadcast tree instead of n learner dials (docs/weights.md);
+    KUBEDL_WEIGHTS_FANOUT and KUBEDL_WEIGHTS_CHUNK_BYTES shape that
+    tree and ride into every fleet pod so all nodes agree on it."""
     if role not in ("actor", "learner"):
         raise ValueError(f"RL role must be actor|learner, got {role!r}")
     if role == "actor" and not (0 <= index < n_actors):
         raise ValueError(
             f"actor index {index} out of range [0, {n_actors})")
+    if weight_fanout < 1:
+        raise ValueError(f"weight fanout must be >= 1, got {weight_fanout}")
+    if weight_chunk_bytes < 1:
+        raise ValueError(
+            f"weight chunk bytes must be >= 1, got {weight_chunk_bytes}")
     return {
         "KUBEDL_RL_ROLE": role,
         "KUBEDL_RL_ACTORS": str(n_actors),
         "KUBEDL_RL_ACTOR_INDEX": str(index if role == "actor" else -1),
         "KUBEDL_RL_LEARNER_ADDR": learner_addr if role == "actor" else "",
         "KUBEDL_RL_ACTOR_ADDRS": actor_addrs if role == "learner" else "",
+        "KUBEDL_WEIGHTS_FANOUT": str(weight_fanout),
+        "KUBEDL_WEIGHTS_CHUNK_BYTES": str(weight_chunk_bytes),
     }
 
 

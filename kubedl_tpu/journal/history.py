@@ -49,12 +49,22 @@ class HistoryStore:
         object_backend=None,
         event_backend=None,
         region: str = "",
+        retention_max_age_s: float = 0.0,
+        retention_max_bytes: int = 0,
     ) -> None:
         self.root_dir = root_dir
         self.path = os.path.join(root_dir, "history.jsonl")
         self.object_backend = object_backend
         self.event_backend = event_backend
         self.region = region
+        # retention bounds (0 = unbounded): records older than max-age
+        # are dropped, and when the file grows past max-bytes the
+        # oldest records are dropped until it fits — both via a
+        # tmp+replace rewrite stamped with a prune-epoch marker
+        self.retention_max_age_s = float(retention_max_age_s)
+        self.retention_max_bytes = int(retention_max_bytes)
+        self.prune_epoch = 0
+        self.pruned_records = 0
         self._lock = threading.RLock()
         self._fh = None
         # key -> latest trace record (replayed at initialize; queries
@@ -83,10 +93,18 @@ class HistoryStore:
                             continue  # torn tail / corrupt line
                         if isinstance(rec, dict) and rec.get("k"):
                             self._index(rec)
+                        elif (isinstance(rec, dict)
+                                and rec.get("kind") == "prune"):
+                            # keyless epoch stamp from an earlier prune:
+                            # carry the epoch forward, never index it
+                            self.prune_epoch = max(
+                                self.prune_epoch,
+                                int(rec.get("epoch", 0)))
             except OSError:
                 pass  # cold start
             os.makedirs(self.root_dir, exist_ok=True)
             self._fh = open(self.path, "a", encoding="utf-8")
+            self._maybe_prune()
 
     def _index(self, rec: Dict) -> None:
         key = rec["k"]
@@ -103,6 +121,75 @@ class HistoryStore:
             self._fh.flush()
             os.fsync(self._fh.fileno())
             self._index(rec)
+            if (self.retention_max_bytes
+                    and self._fh.tell() > self.retention_max_bytes):
+                self._maybe_prune()
+
+    # -- retention ---------------------------------------------------------
+
+    def _records_newest_last(self) -> List[Dict]:
+        with self._lock:
+            recs = list(self._latest.values())
+            for markers in self._lifecycle.values():
+                recs.extend(markers)
+        recs.sort(key=lambda r: r.get("t", 0.0))
+        return recs
+
+    def _maybe_prune(self) -> int:
+        """Apply the retention bounds, if any are set and exceeded."""
+        if not (self.retention_max_age_s or self.retention_max_bytes):
+            return 0
+        return self.prune()
+
+    def prune(self, now: Optional[float] = None) -> int:
+        """Rewrite history.jsonl down to the retention bounds; returns
+        the number of records dropped.  The rewrite is tmp+os.replace
+        (a crash mid-prune leaves the old complete file), leads with a
+        keyless epoch-stamped prune marker (replay skips it — only the
+        epoch is carried), and the in-memory indexes are rebuilt from
+        the kept set so replay-after-prune and the live store agree."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if self._fh is None:
+                self.initialize()
+            recs = self._records_newest_last()
+            n_before = len(recs)
+            kept = list(recs)
+            if self.retention_max_age_s:
+                cutoff = now - self.retention_max_age_s
+                kept = [r for r in kept if r.get("t", 0.0) >= cutoff]
+            lines = [json.dumps(r, sort_keys=True) + "\n" for r in kept]
+            if self.retention_max_bytes:
+                size = sum(len(ln.encode("utf-8")) for ln in lines)
+                while lines and size > self.retention_max_bytes:
+                    size -= len(lines[0].encode("utf-8"))
+                    lines.pop(0)
+                    kept.pop(0)
+            dropped = n_before - len(kept)
+            if dropped == 0:
+                return 0
+            self.prune_epoch += 1
+            self.pruned_records += dropped
+            marker = json.dumps({
+                "kind": "prune", "t": now, "epoch": self.prune_epoch,
+                "dropped": dropped,
+            }, sort_keys=True) + "\n"
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(marker)
+                f.writelines(lines)
+                f.flush()
+                os.fsync(f.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._latest.clear()
+            self._lifecycle.clear()
+            for r in kept:
+                self._index(r)
+            log.info("history: pruned %d record(s) (epoch %d, %d kept)",
+                     dropped, self.prune_epoch, len(kept))
+            return dropped
 
     # -- writers (HistoryPersistController) -------------------------------
 

@@ -119,6 +119,8 @@ class RuntimeMetrics:
         self._transport: Optional[Callable[[], Dict]] = None
         # RL-fleet snapshot callable (rl_metrics.snapshot)
         self._rl: Optional[Callable[[], Dict]] = None
+        # weight-distribution snapshot callable (weights_metrics.snapshot)
+        self._weights: Optional[Callable[[], Dict]] = None
         # grant-journal snapshot callable (Operator._journal_snapshot:
         # GrantJournal.snapshot() + the leader fencing epoch)
         self._journal: Optional[Callable[[], Dict]] = None
@@ -211,6 +213,15 @@ class RuntimeMetrics:
         with self._lock:
             self._rl = snapshot_fn
             self._version_fns["rl"] = version_fn
+
+    def register_weights(self, snapshot_fn: Callable[[], Dict],
+                         version_fn: Optional[Callable] = None) -> None:
+        """snapshot_fn returns weights_metrics.snapshot()-shaped dicts
+        (per-job versions-published/chunks-relayed/bytes/reparent
+        counters plus per-pod committed model versions)."""
+        with self._lock:
+            self._weights = snapshot_fn
+            self._version_fns["weights"] = version_fn
 
     def register_journal(self, snapshot_fn: Callable[[], Dict],
                          version_fn: Optional[Callable] = None) -> None:
@@ -681,6 +692,53 @@ class RuntimeMetrics:
                 return lines
 
             parts.append(self._family("rl", self._token("rl"), rl_lines))
+        with self._lock:
+            weights_fn = self._weights
+        if weights_fn is not None:
+
+            def weights_lines() -> List[str]:
+                lines: List[str] = []
+                try:
+                    w = weights_fn()
+                except Exception:  # noqa: BLE001 — callback raced shutdown
+                    w = None
+                if w is None or not w.get("jobs"):
+                    return lines
+                jobs = sorted(w["jobs"].items())
+                for metric, key, mtype, help_ in (
+                    ("kubedl_weights_versions_published_total",
+                     "versions_published", "counter",
+                     "Weight versions the source began distributing"),
+                    ("kubedl_weights_chunks_relayed_total",
+                     "chunks_relayed", "counter",
+                     "Weight chunks sent onward by any node (source "
+                     "included)"),
+                    ("kubedl_weights_bytes_total", "bytes_total",
+                     "counter", "Weight chunk bytes sent onward by any "
+                     "node"),
+                    ("kubedl_weights_reparent_total", "reparents",
+                     "counter", "Pods that re-parented to the root "
+                     "after a dead interior node"),
+                ):
+                    lines.append(f"# HELP {metric} {help_}")
+                    lines.append(f"# TYPE {metric} {mtype}")
+                    for job, rec in jobs:
+                        lines.append(sample(metric, rec.get(key, 0),
+                                            {"job": job}))
+                lines.append("# HELP kubedl_model_version Model version "
+                             "committed (fully verified + adopted) per "
+                             "pod")
+                lines.append("# TYPE kubedl_model_version gauge")
+                for job, rec in jobs:
+                    for pod, version in sorted(
+                            (rec.get("pods") or {}).items()):
+                        lines.append(sample(
+                            "kubedl_model_version", version,
+                            {"job": job, "pod": pod}))
+                return lines
+
+            parts.append(self._family(
+                "weights", self._token("weights"), weights_lines))
         return "\n".join(p for p in parts if p) + "\n"
 
     def debug_vars(self) -> Dict:
@@ -708,7 +766,13 @@ class RuntimeMetrics:
             goodput_fn = self._goodput
             transport_fn = self._transport
             rl_fn = self._rl
+            weights_fn = self._weights
             journal_fn = self._journal
+        if weights_fn is not None:
+            try:
+                out["weights"] = weights_fn()  # outside the lock, see render()
+            except Exception:  # noqa: BLE001 — callback raced shutdown
+                out["weights"] = None
         if journal_fn is not None:
             try:
                 out["journal"] = journal_fn()  # outside the lock, see render()

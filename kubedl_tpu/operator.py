@@ -105,6 +105,12 @@ class OperatorConfig:
     # lifecycle markers persisted past job TTL, queryable via
     # GET /history/<ns>/<job> and `kubedl-tpu history`. "" disables.
     history_dir: str = ""
+    # History retention (PR 18's named leftover): prune history.jsonl
+    # records older than max-age seconds and rewrite the file down
+    # when it grows past max-bytes (tmp+replace, epoch-stamped prune
+    # marker). 0 disables that bound; both 0 = keep forever.
+    history_retention_max_age_s: float = 0.0
+    history_retention_max_bytes: int = 0
     # Kubernetes mode: reconcile real Pod/Service objects on a cluster
     # through the kube-apiserver instead of the in-process store + local
     # executor (ref main.go:70-75 manager-over-client-go). "in-cluster"
@@ -151,6 +157,13 @@ class Operator:
         from kubedl_tpu.rl.metrics import rl_metrics
 
         self.runtime_metrics.register_rl(rl_metrics.snapshot)
+        # weight-distribution plane (kubedl_weights_* + per-pod
+        # kubedl_model_version): distributors/relays in the process feed
+        # the module singleton; register unconditionally (renders
+        # nothing until a version is distributed)
+        from kubedl_tpu.weights.metrics import weights_metrics
+
+        self.runtime_metrics.register_weights(weights_metrics.snapshot)
         # flight recorder (docs/observability.md): control-plane tracer
         # routing spans into per-job dirs under trace_root, plus the
         # goodput accountant that folds those dirs into
@@ -460,6 +473,8 @@ class Operator:
                 object_backend=self.object_backend,
                 event_backend=self.event_backend,
                 region=self.config.region,
+                retention_max_age_s=self.config.history_retention_max_age_s,
+                retention_max_bytes=self.config.history_retention_max_bytes,
             )
             self.history_store.initialize()
             self._history_controllers = setup_history_controllers(

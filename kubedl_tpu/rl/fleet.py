@@ -37,13 +37,23 @@ class RLFleet:
         n_actors: int = 1,
         mesh=None,
         tracer=None,
+        use_weight_tree: Optional[bool] = None,
+        weight_fanout: Optional[int] = None,
+        weight_chunk_bytes: Optional[int] = None,
     ) -> None:
         self.n_actors = n_actors
         self.actor_cfg = actor_cfg
         self.learner_cfg = learner_cfg
+        # weight path: hub-and-spoke dials every actor serially (the
+        # <= 2-actor fast path and parity oracle); past that the
+        # broadcast tree relays chunks in O(log n) hops
+        # (docs/weights.md). None = auto by fleet size.
+        self.use_weight_tree = (n_actors > 2 if use_weight_tree is None
+                                else bool(use_weight_tree))
         traj_channels: Dict[str, QueueChannel] = {}
         weight_channels: List[QueueChannel] = []
         self.actors: List[ActorRuntime] = []
+        weight_ch_by_actor: Dict[str, QueueChannel] = {}
         for i in range(n_actors):
             cfg_i = ActorConfig(
                 **{**actor_cfg.__dict__, "actor_index": i,
@@ -52,6 +62,7 @@ class RLFleet:
             weight_ch = QueueChannel()
             traj_channels[cfg_i.actor_id] = traj_ch
             weight_channels.append(weight_ch)
+            weight_ch_by_actor[cfg_i.actor_id] = weight_ch
             self.actors.append(ActorRuntime(
                 base_params, config, cfg_i, prompts, reward_fn,
                 producer=TrajectoryProducer(
@@ -59,10 +70,42 @@ class RLFleet:
                 receiver=WeightReceiver(weight_ch),
                 tracer=tracer,
             ))
+        self.relays: List = []
+        self._relay_stop = threading.Event()
+        self._relay_threads: List[threading.Thread] = []
+        distributor = None
+        if self.use_weight_tree:
+            from kubedl_tpu.weights.dist import RelayNode, RootDistributor
+
+            dist_channels = {a: QueueChannel() for a in traj_channels}
+            control = QueueChannel()
+
+            def _deliver_into(ch: QueueChannel):
+                # the relay hands the actor the ORIGINAL encoded record
+                # under the hub-and-spoke tag — WeightReceiver and the
+                # actor runtime are byte-identical on both paths
+                def deliver(payload: bytes, version: int,
+                            step: int) -> None:
+                    ch.send(f"w.{version:08d}", payload)
+                return deliver
+
+            for a in traj_channels:
+                self.relays.append(RelayNode(
+                    pod=a, recv=dist_channels[a],
+                    child_channel=dist_channels.__getitem__,
+                    control=control,
+                    on_deliver=_deliver_into(weight_ch_by_actor[a]),
+                    job=learner_cfg.job, tracer=tracer))
+            distributor = RootDistributor(
+                list(traj_channels), dist_channels, control,
+                job=learner_cfg.job, fanout=weight_fanout,
+                chunk_bytes=weight_chunk_bytes, tracer=tracer)
+        self.distributor = distributor
         self.learner = LearnerRuntime(
             base_params, config, learner_cfg,
             consumer=TrajectoryConsumer(traj_channels, job=learner_cfg.job),
-            broadcaster=WeightBroadcaster(weight_channels),
+            broadcaster=WeightBroadcaster(weight_channels,
+                                          distributor=distributor),
             mesh=mesh, tracer=tracer,
         )
 
@@ -86,11 +129,25 @@ class RLFleet:
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 errors.append(e)
 
+        def _relay(node) -> None:
+            try:
+                node.run(self._relay_stop)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+                self._relay_stop.set()
+
         threads = [
             threading.Thread(target=_actor, args=(a,), daemon=True,
                              name=f"rl-{a.cfg.actor_id}")
             for a in self.actors
         ]
+        self._relay_threads = [
+            threading.Thread(target=_relay, args=(node,), daemon=True,
+                             name=f"rl-relay-{node.pod}")
+            for node in self.relays
+        ]
+        for t in self._relay_threads:
+            t.start()
         for t in threads:
             t.start()
         try:
@@ -98,18 +155,23 @@ class RLFleet:
         except BaseException as learner_err:
             # a crashed actor usually SURFACES as a learner starvation
             # timeout — report the root cause, not just the symptom
+            self._relay_stop.set()
             for t in threads:
                 t.join(timeout=1.0)
             if errors:
                 raise RuntimeError(
-                    f"actor thread(s) failed: "
+                    f"actor/relay thread(s) failed: "
                     f"{[repr(e) for e in errors]}") from learner_err
             raise
         for t in threads:
             t.join(timeout=self.actor_cfg.weight_wait_s + 10.0)
+        self._relay_stop.set()
+        for t in self._relay_threads:
+            t.join(timeout=5.0)
         if errors:
             raise RuntimeError(
-                f"actor thread(s) failed: {[repr(e) for e in errors]}")
+                f"actor/relay thread(s) failed: "
+                f"{[repr(e) for e in errors]}")
         alive = [t.name for t in threads if t.is_alive()]
         if alive:
             raise RuntimeError(f"actor thread(s) wedged: {alive}")

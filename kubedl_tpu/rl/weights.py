@@ -62,22 +62,40 @@ def decode_weights(data: bytes) -> Tuple[List[np.ndarray], int, int]:
 
 class WeightBroadcaster:
     """Learner-side publish half: one channel per actor, every actor
-    gets every version (the tag makes resends idempotent)."""
+    gets every version (the tag makes resends idempotent).
 
-    def __init__(self, channels: List[object]) -> None:
-        if not channels:
+    With a `distributor` (weights/dist.RootDistributor over the
+    broadcast tree, docs/weights.md) the single encoded record rides
+    the O(log n) chunk relay instead of n hub-and-spoke dials; relay
+    sidecars re-inject the SAME bytes into each actor's weight channel,
+    so the receiver half is identical either way. Hub-and-spoke stays
+    the <= 2-actor fast path and the parity oracle. On BOTH paths the
+    payload is serialized exactly once per version —
+    ``bytes_encoded_total`` grows by one state size per publish,
+    pinned in tests."""
+
+    def __init__(self, channels: List[object], distributor=None) -> None:
+        if not channels and distributor is None:
             raise ValueError("weight broadcaster needs >= 1 actor channel")
         self.channels = list(channels)
+        self.distributor = distributor
         self.version = 0
+        self.bytes_encoded_total = 0
+        self.last_payload_bytes = 0
 
     def publish(self, params, step: int = 0) -> Tuple[int, float]:
         """Encode once, send to every actor; returns (version, seconds)."""
         self.version += 1
         t0 = time.perf_counter()
         payload = encode_weights(params, self.version, step)
-        tag = f"w.{self.version:08d}"
-        for ch in self.channels:
-            ch.send(tag, payload)
+        self.last_payload_bytes = len(payload)
+        self.bytes_encoded_total += len(payload)
+        if self.distributor is not None:
+            self.distributor.distribute(payload, self.version, step)
+        else:
+            tag = f"w.{self.version:08d}"
+            for ch in self.channels:
+                ch.send(tag, payload)
         return self.version, time.perf_counter() - t0
 
 

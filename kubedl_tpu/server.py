@@ -235,6 +235,25 @@ class OperatorHTTPServer:
                                 pod.metadata.annotations or {}),
                         })
                     self._json(200, {"fleets": fleets})
+                elif split.path == "/serving/versions":
+                    # weight-rollout progress per job: the published
+                    # version at the tree root and each pod's committed
+                    # model_version (docs/weights.md) — read straight
+                    # from the weights metrics plane, so it covers every
+                    # consumer riding the distribution tree
+                    from kubedl_tpu.weights.metrics import weights_metrics
+
+                    jobs = {}
+                    snap = weights_metrics.snapshot()["jobs"]
+                    for job, rec in snap.items():
+                        jobs[job] = {
+                            "published_version": rec["published_version"],
+                            "pods": dict(rec["pods"]),
+                            "pending": sorted(
+                                p for p, v in rec["pods"].items()
+                                if v < rec["published_version"]),
+                        }
+                    self._json(200, {"jobs": jobs})
                 else:
                     self._json(404, {"error": f"unknown path {self.path}"})
 

@@ -68,9 +68,29 @@ class PrefillPod:
             prefill_chunk=prefill_chunk, max_top_k=max_top_k)
         self.healthy = True
         self.draining = False
+        # weight-rollout state (docs/weights.md): the version this pod's
+        # params were prefilled/decoded with; 0 = the boot params
+        self.model_version = 0
+        self._staged = None  # (version, params) awaiting commit
         self._queue: deque = deque()
         self._lock = new_lock("serving.router.PrefillPod._lock")
         self._key = jax.random.PRNGKey(seed)
+
+    def stage_params(self, version: int, params) -> None:
+        with self._lock:
+            if version > self.model_version:
+                self._staged = (version, params)
+
+    def try_commit(self) -> bool:
+        """Swap to the staged version. Prefill is stateless per request
+        (each pump computes a fresh KV), so the swap lands between
+        pumps — queued requests simply prefill at the NEW version."""
+        with self._lock:
+            if self._staged is None:
+                return False
+            self.model_version, self.engine.params = self._staged
+            self._staged = None
+            return True
 
     def queue_len(self) -> int:
         with self._lock:
@@ -125,7 +145,10 @@ class PrefillPod:
                   "max_new_tokens": req.max_new_tokens,
                   "temperature": req.temperature,
                   "top_k": req.top_k, "top_p": req.top_p,
-                  "eos_token": req.eos_token})
+                  "eos_token": req.eos_token,
+                  # the KV rows above were computed by THIS version;
+                  # decode must happen on a pod running the same one
+                  "model_version": self.model_version})
 
 
 class DecodePod:
@@ -145,7 +168,28 @@ class DecodePod:
             max_top_k=max_top_k, share_prefixes=share_prefixes)
         self.healthy = True
         self.draining = False
+        self.model_version = 0
+        self._staged = None  # (version, params) awaiting commit
         self._lock = new_lock("serving.router.DecodePod._lock")
+
+    def stage_params(self, version: int, params) -> None:
+        with self._lock:
+            if version > self.model_version:
+                self._staged = (version, params)
+
+    def try_commit(self) -> bool:
+        """Swap to the staged version ONLY while no stream is in flight
+        — the same refusal RolloutEngine.swap_params makes: a stream's
+        KV was computed by the version that prefilled it, and decoding
+        it under new params would silently mix versions mid-stream."""
+        with self._lock:
+            if self._staged is None:
+                return False
+            if any(r is not None for r in self.engine._slot_req):
+                return False
+            self.model_version, self.engine.params = self._staged
+            self._staged = None
+            return True
 
     def free_slots(self) -> int:
         with self._lock:
@@ -206,7 +250,8 @@ class ServingRouter:
 
     def __init__(self, prefill_pods: List[PrefillPod],
                  decode_pods: List[DecodePod],
-                 cross_pod: bool = False, transport=None) -> None:
+                 cross_pod: bool = False, transport=None,
+                 job: str = "") -> None:
         if not prefill_pods or not decode_pods:
             raise ValueError("a serving fleet needs >= 1 prefill and "
                              ">= 1 decode pod")
@@ -239,6 +284,11 @@ class ServingRouter:
         self._lock = new_lock("serving.router.ServingRouter._lock")
         self.migrations = 0
         self.serialized_bytes = 0
+        # weight-rollout target: the newest version pushed to the fleet.
+        # Pods commit independently (prefill immediately, decode as its
+        # streams drain); `job` labels the kubedl_model_version gauge.
+        self.job = job
+        self.target_version = 0
 
     # -- routing policies --------------------------------------------------
 
@@ -252,11 +302,16 @@ class ServingRouter:
             raise RuntimeError("no healthy prefill pods")
         return min(pods, key=lambda p: p.queue_len())
 
-    def route_decode(self) -> Optional[DecodePod]:
+    def route_decode(self,
+                     version: Optional[int] = None) -> Optional[DecodePod]:
         """Least outstanding KV blocks among eligible decode pods with a
-        free slot; None when every pod is full (the handoff waits)."""
+        free slot; None when every pod is full (the handoff waits).
+        With `version`, only pods COMMITTED to that exact version are
+        eligible — a handoff's KV must decode under the params that
+        prefilled it, never a mix (docs/weights.md)."""
         pods = [p for p in self._eligible(self.decode_pods)
-                if p.free_slots() > 0]
+                if p.free_slots() > 0
+                and (version is None or p.model_version == version)]
         if not pods:
             return None
         return min(pods, key=lambda p: p.blocks_outstanding())
@@ -341,9 +396,12 @@ class ServingRouter:
             item = self.handoffs.get()
             if item is None:
                 break
-            pod = self.route_decode()
+            pod = self.route_decode(
+                version=item.meta.get("model_version"))
             if pod is None:
-                held.append(item)  # every pod full; retry next round
+                # every matching pod full — or mid-rollout, none has
+                # committed this item's version yet; retry next round
+                held.append(item)
                 continue
             req = item.request
             try:
@@ -389,9 +447,55 @@ class ServingRouter:
         """One deterministic scheduling round (the single-threaded
         driver tests use; production pumps each stage from its own
         thread/pod)."""
+        self.advance_rollout()
         self.pump_prefill()
         self.dispatch_handoffs()
         return self.pump_decode(k)
+
+    # -- weight rollout ----------------------------------------------------
+
+    def begin_weight_rollout(self, version: int, params) -> int:
+        """Stage `params` as `version` on every pod and commit the idle
+        ones immediately. In-flight streams FINISH on the version that
+        prefilled them (a decode pod refuses the swap until it drains);
+        new requests prefill — and therefore decode — at `version` as
+        soon as pods commit. Returns pods committed so far; the rest
+        land on subsequent `advance_rollout()` calls (step_all runs one
+        every round)."""
+        if version <= self.target_version:
+            raise ValueError(
+                f"weight rollout must move forward: got version "
+                f"{version}, fleet target is {self.target_version}")
+        self.target_version = version
+        for pod in self.prefill_pods + self.decode_pods:
+            pod.stage_params(version, params)
+        return self.advance_rollout()
+
+    def advance_rollout(self) -> int:
+        """Commit any pod whose staged version can land now (decode pods
+        drain first); publishes the per-pod kubedl_model_version gauge."""
+        committed = 0
+        for pod in self.prefill_pods + self.decode_pods:
+            if pod.try_commit():
+                committed += 1
+                if self.job:
+                    from kubedl_tpu.weights.metrics import weights_metrics
+
+                    weights_metrics.on_committed(
+                        self.job, pod.name, pod.model_version)
+        return committed
+
+    def rollout_status(self) -> Dict:
+        """Where the fleet is between versions: the push target and
+        every pod's committed version (GET /serving/versions)."""
+        pods = {p.name: p.model_version
+                for p in self.prefill_pods + self.decode_pods}
+        return {
+            "target_version": self.target_version,
+            "pods": pods,
+            "pending": sorted(n for n, v in pods.items()
+                              if v < self.target_version),
+        }
 
     def serve_all(self, prompts, max_new_tokens: int, k: int = 8,
                   **kw) -> List[List[int]]:
@@ -469,16 +573,37 @@ class ServingRouter:
             "prefill_pods": [
                 {"name": p.name, "queue": p.queue_len(),
                  "healthy": p.healthy, "draining": p.draining,
+                 "model_version": p.model_version,
                  **p.engine.stats()}
                 for p in self.prefill_pods],
             "decode_pods": [
                 {"name": p.name, "blocks": p.blocks_outstanding(),
                  "free_slots": p.free_slots(),
                  "healthy": p.healthy, "draining": p.draining,
+                 "model_version": p.model_version,
                  **p.engine.stats()}
                 for p in self.decode_pods],
             "handoff_queue": len(self.handoffs),
             "handoffs_total": self.handoffs.put_count,
             "migrations": self.migrations,
             "serialized_bytes": self.serialized_bytes,
+            "target_version": self.target_version,
         }
+
+
+def adopt_weight_payload(router: ServingRouter, payload: bytes) -> int:
+    """Turn a weight-tree delivery into a fleet rollout: the serving
+    fleet rides the SAME distribution plane as the RL actors — a
+    RelayNode whose ``on_deliver`` is
+    ``lambda p, v, s: adopt_weight_payload(router, p)`` makes the
+    serving pods one more subtree of the broadcast (docs/weights.md).
+    The record's leaves are unflattened against the fleet's OWN param
+    structure (no pytree travels, same contract as rl/weights.py)."""
+    from kubedl_tpu.rl.weights import decode_weights
+
+    leaves, version, _step = decode_weights(payload)
+    treedef = jax.tree_util.tree_structure(
+        router.prefill_pods[0].engine.params)
+    params = jax.tree_util.tree_unflatten(treedef, leaves)
+    router.begin_weight_rollout(version, params)
+    return version

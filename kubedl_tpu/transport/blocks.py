@@ -22,7 +22,7 @@ import logging
 import os
 import re
 import uuid
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 from kubedl_tpu.transport.plane import TransportError, TransportPlane
 
@@ -92,13 +92,22 @@ def fetch_staging(
     peer_addr: str,
     reshard_dir: str,
     timeout: float = 30.0,
+    peers: Optional[Sequence[str]] = None,
 ) -> int:
     """Pull a peer's published staging into the LOCAL `reshard_dir`;
     returns the number of files fetched. Raises TransportError (or
     TimeoutError) on any gap — the caller's ladder then falls back
     closed to checkpoint restore, exactly as a missing shared-volume
     staging would. The fetched dir goes through the SAME
-    ``restore_staged`` digest/coverage validation as a local one."""
+    ``restore_staged`` digest/coverage validation as a local one.
+
+    `peers` (optional) are EXTRA addresses that may also hold the same
+    verified staging (a weight-tree fan-out leaves every committed relay
+    with the full set, docs/weights.md): src files round-robin across
+    the swarm, falling back to `peer_addr` when a swarm member lacks a
+    file. The per-file sha256 check makes the source interchangeable —
+    a peer can serve wrong bytes but never get them adopted. The
+    manifest is always taken from `peer_addr` and written LAST."""
     manifest = _fetch_one(plane, peer_addr, "manifest.json", timeout)
     if manifest is None:
         raise TransportError(
@@ -108,6 +117,7 @@ def fetch_staging(
     except (ValueError, KeyError) as e:
         raise TransportError(f"peer staging manifest unreadable: {e}") from e
     os.makedirs(reshard_dir, exist_ok=True)
+    swarm = [peer_addr] + [p for p in (peers or ()) if p != peer_addr]
     # stream each file to disk as it arrives — buffering every pod's npz
     # would hold the whole staged model state in host RAM at once, on a
     # pod that is mid-restart. Only the manifest must wait until LAST:
@@ -116,9 +126,16 @@ def fetch_staging(
     # that dies partway leaves a manifest-less dir restore_staged treats
     # as still-in-flight, never as committed.
     n = 1
+    i = 0
     for pod in range(old_pods):
         for name in (f"src-{pod}.json", f"src-{pod}.npz"):
-            blob = _fetch_one(plane, peer_addr, name, timeout)
+            src = swarm[i % len(swarm)]
+            i += 1
+            blob = _fetch_one(plane, src, name, timeout)
+            if blob is None and src != peer_addr:
+                # swarm member doesn't hold it (or dropped its staging)
+                # — the publishing peer is the authority of last resort
+                blob = _fetch_one(plane, peer_addr, name, timeout)
             if blob is None:
                 raise TransportError(
                     f"peer {peer_addr} staging is missing {name}")

@@ -445,6 +445,48 @@ def test_history_roundtrip_survives_restart_and_torn_tail(tmp_path):
     hs2.close()
 
 
+def test_history_retention_prunes_and_replays_cleanly(tmp_path):
+    """Retention bounds rewrite history.jsonl via tmp+replace with an
+    epoch-stamped keyless marker: old records disappear, recent ones
+    survive byte-for-byte, a reopened store replays to the SAME state
+    (the marker itself is skipped, only its epoch carried), and the
+    max-bytes bound keeps the file from growing without limit."""
+    hs = HistoryStore(str(tmp_path / "hist"), retention_max_age_s=3600.0)
+    hs.initialize()
+    hs.record_lifecycle("default", "old", "deleted", uid="u0")
+    hs.record_spans("default", "new", [{"name": "s", "dur": 1.0}],
+                    {"goodput": 1.0})
+    # age the first record past the bound, keep the second fresh
+    hs._lifecycle["default/old"][0]["t"] = time.time() - 7200.0
+    assert hs.prune() == 1
+    assert hs.prune_epoch == 1 and hs.pruned_records == 1
+    assert hs.prune() == 0  # idempotent once within bounds
+    assert hs.get("default", "old") is None
+    assert hs.get("default", "new")["spans"] == [{"name": "s", "dur": 1.0}]
+    assert not os.path.exists(hs.path + ".tmp")  # rewrite committed
+    hs.close()
+
+    # replay after prune: same state, epoch carried, marker not indexed
+    hs2 = HistoryStore(str(tmp_path / "hist"))
+    hs2.initialize()
+    assert hs2.prune_epoch == 1
+    assert hs2.get("default", "old") is None
+    assert hs2.get("default", "new")["spans"] == [{"name": "s", "dur": 1.0}]
+    hs2.close()
+
+    # max-bytes: appending past the bound drops the oldest records
+    # automatically, and the survivor set is the newest suffix
+    hb = HistoryStore(str(tmp_path / "hist-b"), retention_max_bytes=600)
+    hb.initialize()
+    for i in range(20):
+        hb.record_lifecycle("default", f"j{i:02d}", "deleted", uid="u")
+    assert os.path.getsize(hb.path) <= 600 + 200  # bound + one marker
+    assert hb.pruned_records > 0
+    assert hb.get("default", "j19") is not None  # newest always kept
+    assert hb.get("default", "j00") is None
+    hb.close()
+
+
 def test_history_joins_storage_backend_rows(tmp_path):
     row = types.SimpleNamespace(
         kind="TestJob", job_id="u1", status="Succeeded", deleted=1,

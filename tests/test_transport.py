@@ -399,6 +399,41 @@ def test_staged_blocks_fetch_over_plane(planes, tmp_path):
         fetch_staging(me, empty_addr, str(tmp_path / "d2"), timeout=5)
 
 
+def test_staged_fetch_swarm_spreads_load_and_falls_back(planes, tmp_path):
+    """With `peers=`, block fetches round-robin across every pod holding
+    the same sha-addressed staging (the manifest still comes from the
+    primary); a swarm peer missing a file falls back to the primary
+    instead of failing the restore."""
+    src_a = str(tmp_path / "peer-a")
+    files = _make_staging(src_a)
+    src_b = str(tmp_path / "peer-b")
+    _make_staging(src_b)  # same rng seed -> byte-identical staging
+    peer_a = planes(service="peer-a", latch=False)
+    addr_a = peer_a.listen("127.0.0.1:0")
+    serve_staging(peer_a, src_a)
+    peer_b = planes(service="peer-b", latch=False)
+    addr_b = peer_b.listen("127.0.0.1:0")
+    serve_staging(peer_b, src_b)
+
+    me = planes(service="restarter", latch=False)
+    me.listen("127.0.0.1:0")
+    dst = str(tmp_path / "swarm-dst")
+    assert fetch_staging(me, addr_a, dst, timeout=10,
+                         peers=[addr_a, addr_b]) == len(files)
+    for name, blob in files.items():
+        with open(os.path.join(dst, name), "rb") as f:
+            assert f.read() == blob
+
+    # a swarm peer that lost a block (pruned staging) only degrades the
+    # swarm back to the primary — the fetch still completes
+    os.remove(os.path.join(src_b, "src-1.npz"))
+    dst2 = str(tmp_path / "swarm-dst2")
+    assert fetch_staging(me, addr_a, dst2, timeout=10,
+                         peers=[addr_b]) == len(files)
+    with open(os.path.join(dst2, "src-1.npz"), "rb") as f:
+        assert f.read() == files["src-1.npz"]
+
+
 def test_staged_fetch_refuses_corrupt_transfer(planes, tmp_path, monkeypatch):
     """A blob whose bytes do not match the advertised sha256 (corrupted
     in flight) is refused loudly — restore_staged never sees it."""
